@@ -1,0 +1,26 @@
+"""bigdl_tpu — a TPU-native deep-learning framework with the capabilities of
+classic BigDL (reference: ugiwgh/BigDL, the Scala/Spark BigDL 0.x line).
+
+Rebuilt idiomatically on JAX/XLA rather than ported:
+
+* ``Tensor[Float]`` on MKL-backed JVM arrays  ->  ``jnp.ndarray`` on TPU HBM
+* hand-written per-layer backwards            ->  ``jax.vjp`` / ``jax.grad``
+* thread-pool model replicas per executor     ->  one XLA program per chip
+* ``AllReduceParameter`` over Spark BlockManager
+                                              ->  ``psum_scatter`` +
+                                                  owner-shard update +
+                                                  ``all_gather`` (ZeRO-1)
+                                                  inside one jitted step
+* Spark job-per-iteration barrier             ->  implicit synchrony of the
+                                                  jitted train step
+
+Reference layout cited throughout as ``«bigdl»/`` =
+``spark/dl/src/main/scala/com/intel/analytics/bigdl/`` (see SURVEY.md for the
+evidence-status preamble: the reference mount was empty, paths are the
+upstream 0.x layout).
+"""
+
+from bigdl_tpu.engine import Engine
+from bigdl_tpu.common import RandomGenerator
+
+__version__ = "0.1.0"

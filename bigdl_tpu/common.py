@@ -1,0 +1,99 @@
+"""Common utilities: RNG, dtype handling, pytree helpers.
+
+Mirrors the role of «bigdl»/utils/RandomGenerator.scala (the global,
+seedable RNG every layer's ``reset()`` draws from) and small pieces of
+«bigdl»/utils/Table.scala / File.scala.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class _RNG:
+    """Global seedable RNG used for parameter initialisation.
+
+    BigDL layers draw their initial weights from a process-global
+    ``RandomGenerator.RNG`` so that ``RNG.setSeed(k)`` makes model
+    construction deterministic (see the per-layer unit-spec pattern in
+    SURVEY.md §4.1).  Parameter init happens on host, eagerly, at module
+    construction time — exactly like the reference — so we use a numpy
+    Generator here, not a JAX key (JAX keys drive only the *traced*
+    randomness: dropout masks etc.).
+    """
+
+    def __init__(self, seed: int | None = None):
+        self._seed = seed if seed is not None else 0
+        self._rng = np.random.RandomState(self._seed)
+
+    def set_seed(self, seed: int) -> "_RNG":
+        self._seed = int(seed)
+        self._rng = np.random.RandomState(self._seed)
+        return self
+
+    # camelCase alias for API parity with the reference's Scala spelling.
+    setSeed = set_seed
+
+    @property
+    def seed(self) -> int:
+        return self._seed
+
+    def uniform(self, low: float, high: float, size=None):
+        return self._rng.uniform(low, high, size=size)
+
+    def normal(self, mean: float, stdv: float, size=None):
+        return self._rng.normal(mean, stdv, size=size)
+
+    def randperm(self, n: int):
+        return self._rng.permutation(n)
+
+    def randint(self, low, high=None, size=None):
+        return self._rng.randint(low, high, size=size)
+
+
+class RandomGenerator:
+    """Namespace matching the reference's ``RandomGenerator.RNG`` spelling."""
+
+    RNG = _RNG()
+
+
+def get_dtype(dtype=None):
+    import jax.numpy as jnp
+
+    if dtype is None:
+        return jnp.float32
+    if isinstance(dtype, str):
+        return {
+            "float32": jnp.float32,
+            "float": jnp.float32,
+            "bfloat16": jnp.bfloat16,
+            "bf16": jnp.bfloat16,
+            "float16": jnp.float16,
+            "float64": jnp.float64,
+            "double": jnp.float64,
+            "int32": jnp.int32,
+            "int8": jnp.int8,
+        }[dtype]
+    return dtype
+
+
+def to_numpy(x):
+    return np.asarray(x)
+
+
+class Table(dict):
+    """1-based-keyed activity table, the reference's generic container
+    («bigdl»/utils/Table.scala).  In the rebuild, plain Python lists/tuples
+    serve as tables on the compute path; this class exists for API-parity
+    spots where user code indexes ``output[1]``, ``output[2]``.
+    """
+
+    @staticmethod
+    def from_seq(seq):
+        t = Table()
+        for i, v in enumerate(seq):
+            t[i + 1] = v
+        return t
+
+    def to_seq(self):
+        return [self[i + 1] for i in range(len(self))]

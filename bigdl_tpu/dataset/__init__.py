@@ -1,0 +1,30 @@
+"""bigdl_tpu.dataset — data pipeline.
+
+Rebuild of «bigdl»/dataset/ (SURVEY.md §2.1 "Dataset core"): DataSet
+abstractions, Sample/MiniBatch packing, Transformer combinators.  The
+reference's ``DistributedDataSet`` wraps a Spark RDD; here the
+"distributed" dataset is a host-side iterator whose global batches get
+``device_put`` with a ``NamedSharding`` over the mesh's data axis — the
+host→device feed that replaces executor-local RDD caching.
+"""
+
+from bigdl_tpu.dataset.dataset import (
+    DataSet,
+    LocalDataSet,
+    ArrayDataSet,
+    DistributedDataSet,
+    to_dataset,
+)
+from bigdl_tpu.dataset.sample import Sample, MiniBatch
+from bigdl_tpu.dataset.transformer import (
+    Transformer,
+    SampleToMiniBatch,
+    Shuffle,
+    Normalizer,
+)
+
+__all__ = [
+    "DataSet", "LocalDataSet", "ArrayDataSet", "DistributedDataSet",
+    "to_dataset", "Sample", "MiniBatch", "Transformer", "SampleToMiniBatch",
+    "Shuffle", "Normalizer",
+]

@@ -1,0 +1,152 @@
+"""DataSet abstractions.
+
+Rebuild of «bigdl»/dataset/DataSet.scala: ``LocalDataSet`` (host
+iterators) and ``DistributedDataSet`` (reference: an RDD per executor;
+here: a marker that batches should be sharded over the mesh data axis by
+the optimizer's ``_put_batch``).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Iterator, Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu.common import RandomGenerator
+from bigdl_tpu.dataset.sample import MiniBatch, Sample, samples_to_minibatch
+
+
+class DataSet:
+    """Iterable of (input, target) numpy batches."""
+
+    def data(self, train: bool = True) -> Iterator:
+        raise NotImplementedError
+
+    def size(self) -> int:
+        raise NotImplementedError
+
+    # reference: DataSet.transform / ``->`` chaining
+    def transform(self, transformer):
+        return _TransformedDataSet(self, transformer)
+
+    def __rshift__(self, transformer):
+        return self.transform(transformer)
+
+
+class _TransformedDataSet(DataSet):
+    def __init__(self, base: DataSet, transformer):
+        self.base = base
+        self.transformer = transformer
+
+    def data(self, train: bool = True):
+        return self.transformer(self.base.data(train))
+
+    def size(self):
+        return self.base.size()
+
+
+class LocalDataSet(DataSet):
+    pass
+
+
+class ArrayDataSet(LocalDataSet):
+    """In-memory (features, labels) arrays batched to (input, target).
+
+    Shuffles per epoch with the global RNG in train mode; drops the
+    ragged tail batch in train mode (keeps it for eval) so the jitted
+    step never retraces on a new batch shape — the TPU analogue of the
+    reference's fixed-size MiniBatch packing.
+    """
+
+    def __init__(self, features, labels, batch_size: int = 32,
+                 shuffle: bool = True):
+        if isinstance(features, (list, tuple)):
+            self.features = [np.asarray(f) for f in features]
+            self._multi = True
+            n = self.features[0].shape[0]
+        else:
+            self.features = np.asarray(features)
+            self._multi = False
+            n = self.features.shape[0]
+        self.labels = np.asarray(labels)
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self._n = n
+
+    def size(self):
+        return self._n
+
+    def data(self, train: bool = True):
+        idx = np.arange(self._n)
+        if train and self.shuffle:
+            idx = RandomGenerator.RNG.randperm(self._n)
+        bs = self.batch_size
+        n_full = self._n // bs
+        for b in range(n_full):
+            sel = idx[b * bs : (b + 1) * bs]
+            if self._multi:
+                inp = tuple(f[sel] for f in self.features)
+            else:
+                inp = self.features[sel]
+            yield inp, self.labels[sel]
+        rem = self._n - n_full * bs
+        if rem and not train:
+            sel = idx[n_full * bs :]
+            if self._multi:
+                inp = tuple(f[sel] for f in self.features)
+            else:
+                inp = self.features[sel]
+            yield inp, self.labels[sel]
+
+
+class SampleDataSet(LocalDataSet):
+    """Dataset over Sample records with pad-at-batch semantics
+    (reference: DataSet.array(samples) -> SampleToMiniBatch)."""
+
+    def __init__(self, samples: Sequence[Sample], batch_size: int = 32,
+                 padding_value: float = 0.0, fixed_length: Optional[int] = None,
+                 shuffle: bool = True):
+        self.samples = list(samples)
+        self.batch_size = batch_size
+        self.padding_value = padding_value
+        self.fixed_length = fixed_length
+        self.shuffle = shuffle
+
+    def size(self):
+        return len(self.samples)
+
+    def data(self, train: bool = True):
+        order = np.arange(len(self.samples))
+        if train and self.shuffle:
+            order = RandomGenerator.RNG.randperm(len(self.samples))
+        bs = self.batch_size
+        n_full = len(self.samples) // bs
+        for b in range(n_full):
+            batch = [self.samples[i] for i in order[b * bs : (b + 1) * bs]]
+            mb = samples_to_minibatch(batch, self.padding_value, self.fixed_length)
+            yield mb.input, mb.target
+        rem = len(self.samples) - n_full * bs
+        if rem and not train:
+            batch = [self.samples[i] for i in order[n_full * bs :]]
+            mb = samples_to_minibatch(batch, self.padding_value, self.fixed_length)
+            yield mb.input, mb.target
+
+
+class DistributedDataSet(ArrayDataSet):
+    """Marker subclass: batches are global and get sharded over the mesh
+    data axis by DistriOptimizer (reference: DistributedDataSet wraps an
+    RDD coalesced to nodeNumber — SURVEY.md §3.2 job 0)."""
+
+
+def to_dataset(data, batch_size: int = 32) -> Optional[DataSet]:
+    """Coerce user input to a DataSet (reference: Optimizer accepts
+    RDD[Sample] or DataSet)."""
+    if data is None:
+        return None
+    if isinstance(data, DataSet):
+        return data
+    if isinstance(data, tuple) and len(data) == 2:
+        return ArrayDataSet(data[0], data[1], batch_size)
+    if isinstance(data, (list,)) and data and isinstance(data[0], Sample):
+        return SampleDataSet(data, batch_size)
+    raise TypeError(f"cannot build a DataSet from {type(data)}")

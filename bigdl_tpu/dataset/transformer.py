@@ -1,0 +1,83 @@
+"""Transformer combinators.
+
+Rebuild of «bigdl»/dataset/Transformer.scala: composable iterator →
+iterator stages chained with ``->`` in the reference; ``>>`` here (and a
+``.chain`` method).  SampleToMiniBatch is the canonical one (SURVEY.md
+§3.2: distDataset = DataSet.rdd(samples) -> SampleToMiniBatch).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+import numpy as np
+
+from bigdl_tpu.common import RandomGenerator
+from bigdl_tpu.dataset.sample import samples_to_minibatch
+
+
+class Transformer:
+    def __call__(self, iterator: Iterator) -> Iterator:
+        raise NotImplementedError
+
+    def chain(self, other: "Transformer") -> "Transformer":
+        return _Chained(self, other)
+
+    def __rshift__(self, other):
+        return self.chain(other)
+
+
+class _Chained(Transformer):
+    def __init__(self, first, second):
+        self.first, self.second = first, second
+
+    def __call__(self, iterator):
+        return self.second(self.first(iterator))
+
+
+class SampleToMiniBatch(Transformer):
+    """«bigdl»/dataset/SampleToMiniBatch.scala — group Samples into
+    padded MiniBatches, yielding (input, target) pairs."""
+
+    def __init__(self, batch_size: int, padding_value: float = 0.0,
+                 fixed_length: Optional[int] = None, drop_last: bool = True):
+        self.batch_size = batch_size
+        self.padding_value = padding_value
+        self.fixed_length = fixed_length
+        self.drop_last = drop_last
+
+    def __call__(self, iterator):
+        buf = []
+        for s in iterator:
+            buf.append(s)
+            if len(buf) == self.batch_size:
+                mb = samples_to_minibatch(buf, self.padding_value, self.fixed_length)
+                yield mb.input, mb.target
+                buf = []
+        if buf and not self.drop_last:
+            mb = samples_to_minibatch(buf, self.padding_value, self.fixed_length)
+            yield mb.input, mb.target
+
+
+class Shuffle(Transformer):
+    """Buffer-and-shuffle (the reference shuffles at the RDD level)."""
+
+    def __call__(self, iterator):
+        items = list(iterator)
+        for i in RandomGenerator.RNG.randperm(len(items)):
+            yield items[i]
+
+
+class Normalizer(Transformer):
+    """Grey-image normalizer (reference:
+    «bigdl»/dataset/image/GreyImgNormalizer.scala) — (x - mean) / std over
+    Sample features."""
+
+    def __init__(self, mean: float, std: float):
+        self.mean, self.std = mean, std
+
+    def __call__(self, iterator):
+        from bigdl_tpu.dataset.sample import Sample
+
+        for s in iterator:
+            yield Sample((np.asarray(s.features) - self.mean) / self.std, s.labels)

@@ -1,0 +1,160 @@
+"""Engine — process-level resource singleton.
+
+Rebuild of «bigdl»/utils/Engine.scala + ThreadPool.scala.  The reference's
+Engine detects node/core counts from the Spark conf, builds the task/model
+thread pools with MKL pinning, and validates required Spark properties
+(SURVEY.md §3.1).  On TPU none of that machinery survives: XLA owns the
+chip's parallelism, so ``Engine.init`` reduces to
+
+* optional multi-host bring-up (``jax.distributed.initialize``) driven by
+  launcher env vars (the ``spark-submit``-compatibility path: one JAX
+  process per executor slot),
+* building the global ``jax.sharding.Mesh`` that DistriOptimizer shards
+  over (the analogue of ``Engine.nodeNumber * Engine.coreNumber``),
+* the singleton guard (``bigdl.check.singleton``) against double init.
+
+The mesh axes are created up front with seams for more than data
+parallelism (``data``, optionally ``model``/``seq``) even though the
+reference implements synchronous data parallelism only (SURVEY.md §2.4).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+
+class _EngineState:
+    initialized = False
+    node_number = 1
+    core_number = 1
+    mesh = None           # jax.sharding.Mesh, data axis at minimum
+    engine_type = "xla"   # reference: mklblas | mkldnn; here always XLA
+
+
+class Engine:
+    _state = _EngineState()
+
+    # ------------------------------------------------------------------ init
+    @classmethod
+    def init(
+        cls,
+        node_number: Optional[int] = None,
+        core_number: Optional[int] = None,
+        backend: Optional[str] = None,
+        mesh_shape: Optional[dict] = None,
+    ):
+        """Initialise the engine.
+
+        Reference behavior («bigdl»/utils/Engine.scala): parse executor
+        count/cores from SparkConf, build thread pools, check the singleton
+        guard.  Here: initialise JAX distributed if launcher env says so,
+        then build the device mesh.
+
+        Args:
+          node_number / core_number: accepted for API parity; on TPU the
+            "core" pool is XLA's business, so these only gate the default
+            mesh size when running on CPU with forced host devices.
+          backend: "tpu" | "cpu" | None (auto).
+          mesh_shape: optional dict of axis name -> size, e.g.
+            ``{"data": 8}`` or ``{"data": 4, "model": 2}``.  Defaults to
+            all devices on one ``data`` axis (the reference's only
+            parallelism, SURVEY.md §2.4).
+        """
+        import jax
+
+        if cls._state.initialized and os.environ.get(
+            "BIGDL_CHECK_SINGLETON", "false"
+        ).lower() in ("true", "1"):
+            # bigdl.check.singleton analogue
+            raise RuntimeError(
+                "Engine.init called twice with BIGDL_CHECK_SINGLETON set; "
+                "the reference forbids two BigDL contexts in one process."
+            )
+
+        # spark-submit compatibility: if the launcher exported coordinator
+        # env vars, join the multi-host world (SURVEY.md §2.5 "TPU-native
+        # equivalent").
+        coord = os.environ.get("BIGDL_COORDINATOR_ADDRESS")
+        if coord and not cls._state.initialized:
+            jax.distributed.initialize(
+                coordinator_address=coord,
+                num_processes=int(os.environ.get("BIGDL_NUM_PROCESSES", "1")),
+                process_id=int(os.environ.get("BIGDL_PROCESS_ID", "0")),
+            )
+
+        devices = jax.devices(backend) if backend else jax.devices()
+        n = len(devices)
+        cls._state.node_number = node_number or n
+        cls._state.core_number = core_number or 1
+        cls._state.mesh = cls.build_mesh(mesh_shape, devices=devices)
+        cls._state.engine_type = "xla"
+        cls._state.initialized = True
+        return cls
+
+    # singleton-ish accessors -------------------------------------------------
+    @classmethod
+    def is_initialized(cls) -> bool:
+        return cls._state.initialized
+
+    @classmethod
+    def node_number(cls) -> int:
+        return cls._state.node_number
+
+    @classmethod
+    def core_number(cls) -> int:
+        return cls._state.core_number
+
+    @classmethod
+    def mesh(cls):
+        if cls._state.mesh is None:
+            cls.init()
+        return cls._state.mesh
+
+    @classmethod
+    def reset(cls):
+        """Test hook: drop the singleton (no reference analogue)."""
+        cls._state = _EngineState()
+
+    # ------------------------------------------------------------------ mesh
+    @staticmethod
+    def build_mesh(mesh_shape: Optional[dict] = None, devices: Optional[Sequence] = None):
+        """Build a ``jax.sharding.Mesh``.
+
+        Default: 1-D ``('data',)`` mesh over all devices — the TPU-native
+        replacement for the reference's "one Spark partition per executor"
+        world (SURVEY.md §2.4 row 1).  Extra axes (model/seq/expert) are
+        accepted to leave the seams open for parallelism the reference does
+        not have.
+        """
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        devices = list(devices if devices is not None else jax.devices())
+        if not mesh_shape:
+            mesh_shape = {"data": len(devices)}
+        axis_names = tuple(mesh_shape.keys())
+        sizes = tuple(mesh_shape.values())
+        total = int(np.prod(sizes))
+        if total != len(devices):
+            raise ValueError(
+                f"mesh shape {mesh_shape} needs {total} devices, have {len(devices)}"
+            )
+        dev_array = np.asarray(devices).reshape(sizes)
+        return Mesh(dev_array, axis_names)
+
+    # ------------------------------------------------- spark-conf parity shim
+    @staticmethod
+    def create_spark_conf() -> dict:
+        """Reference: Engine.createSparkConf loads dist/conf/spark-bigdl.conf
+        (locality off, min-resources-ratio 1.0, speculation off — SURVEY.md
+        §3.1).  The rebuild keeps the spelling so launch scripts keep
+        working; on TPU these become env hints for the per-executor JAX
+        process launcher.
+        """
+        return {
+            "spark.shuffle.reduceLocality.enabled": "false",
+            "spark.scheduler.minRegisteredResourcesRatio": "1.0",
+            "spark.speculation": "false",
+        }

@@ -1,0 +1,8 @@
+"""bigdl_tpu.models — reference model zoo.
+
+Rebuild of «bigdl»/models/ (SURVEY.md §2.1 "Reference models"): lenet,
+resnet (CIFAR + ImageNet), inception, vgg, alexnet, rnn (PTB LM),
+autoencoder — each with a builder and a runnable train entry point.
+"""
+
+from bigdl_tpu.models.lenet import build_lenet5

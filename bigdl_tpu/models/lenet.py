@@ -1,0 +1,93 @@
+"""LeNet-5 on MNIST.
+
+Rebuild of «bigdl»/models/lenet/LeNet5.scala (+ Train.scala/Test.scala):
+the reference's first-model milestone — Sequential(Reshape, conv5x5x6,
+tanh, maxpool, conv5x5x12, tanh, maxpool, Linear(100), tanh, Linear(10),
+LogSoftMax), trained with SGD + ClassNLLCriterion.
+"""
+
+from __future__ import annotations
+
+from bigdl_tpu.nn import (
+    Linear,
+    LogSoftMax,
+    Reshape,
+    Sequential,
+    SpatialConvolution,
+    SpatialMaxPooling,
+    Tanh,
+)
+
+
+def build_lenet5(class_num: int = 10) -> Sequential:
+    model = Sequential()
+    model.add(Reshape([1, 28, 28])) \
+        .add(SpatialConvolution(1, 6, 5, 5).set_name("conv1_5x5")) \
+        .add(Tanh()) \
+        .add(SpatialMaxPooling(2, 2, 2, 2)) \
+        .add(SpatialConvolution(6, 12, 5, 5).set_name("conv2_5x5")) \
+        .add(Tanh()) \
+        .add(SpatialMaxPooling(2, 2, 2, 2)) \
+        .add(Reshape([12 * 4 * 4])) \
+        .add(Linear(12 * 4 * 4, 100).set_name("fc1")) \
+        .add(Tanh()) \
+        .add(Linear(100, class_num).set_name("score")) \
+        .add(LogSoftMax())
+    return model
+
+
+def train_lenet(
+    data_dir: str = None,
+    batch_size: int = 128,
+    max_epoch: int = 2,
+    learning_rate: float = 0.05,
+    checkpoint_path: str = None,
+    distributed: bool = False,
+):
+    """Runnable training entry (reference: models/lenet/Train.scala)."""
+    from bigdl_tpu.dataset import ArrayDataSet
+    from bigdl_tpu.dataset.mnist import load_mnist, normalize
+    from bigdl_tpu.nn import ClassNLLCriterion
+    from bigdl_tpu.optim import Optimizer, SGD, Top1Accuracy, Trigger
+
+    x_train, y_train = load_mnist(data_dir, "train")
+    x_test, y_test = load_mnist(data_dir, "test")
+    train_ds = ArrayDataSet(normalize(x_train), y_train, batch_size)
+    test_ds = ArrayDataSet(normalize(x_test), y_test, batch_size)
+
+    model = build_lenet5()
+    optimizer = Optimizer(
+        model=model,
+        training_set=train_ds,
+        criterion=ClassNLLCriterion(),
+        batch_size=batch_size,
+        distributed=distributed,
+    )
+    optimizer.set_optim_method(SGD(learningrate=learning_rate)) \
+        .set_end_when(Trigger.max_epoch(max_epoch)) \
+        .set_validation(
+            trigger=Trigger.every_epoch(),
+            dataset=test_ds,
+            methods=[Top1Accuracy()],
+        )
+    if checkpoint_path:
+        optimizer.set_checkpoint(checkpoint_path)
+    trained = optimizer.optimize()
+    return trained, optimizer
+
+
+if __name__ == "__main__":
+    import argparse
+    import logging
+
+    logging.basicConfig(level=logging.INFO)
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-f", "--data-dir", default=None)
+    ap.add_argument("-b", "--batch-size", type=int, default=128)
+    ap.add_argument("-e", "--max-epoch", type=int, default=2)
+    ap.add_argument("--learning-rate", type=float, default=0.05)
+    ap.add_argument("--checkpoint", default=None)
+    ap.add_argument("--distributed", action="store_true")
+    args = ap.parse_args()
+    train_lenet(args.data_dir, args.batch_size, args.max_epoch,
+                args.learning_rate, args.checkpoint, args.distributed)

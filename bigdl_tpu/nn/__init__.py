@@ -1,0 +1,91 @@
+"""bigdl_tpu.nn — the module library.
+
+Rebuild of «bigdl»/nn/ (layer library, containers, criterions) and
+«bigdl»/nn/abstractnn/ (the module contract).  One import surface exposing
+every layer by its reference name, so user code reads like classic BigDL:
+
+    from bigdl_tpu.nn import Sequential, SpatialConvolution, ReLU, Linear
+"""
+
+from bigdl_tpu.nn.module import (
+    AbstractModule,
+    Container,
+    Sequential,
+    Identity,
+    Echo,
+)
+from bigdl_tpu.nn.layers import *  # noqa: F401,F403
+from bigdl_tpu.nn.layers import __all__ as _layers_all
+from bigdl_tpu.nn.graph import Graph, Input, Node, Model
+from bigdl_tpu.nn.table_ops import (
+    ConcatTable,
+    ParallelTable,
+    CAddTable,
+    CSubTable,
+    CMulTable,
+    CDivTable,
+    CMaxTable,
+    CMinTable,
+    JoinTable,
+    SelectTable,
+    FlattenTable,
+    MM,
+    MV,
+    CosineDistance,
+    DotProduct,
+    Concat,
+)
+from bigdl_tpu.nn.criterion import (
+    AbstractCriterion,
+    ClassNLLCriterion,
+    CrossEntropyCriterion,
+    MSECriterion,
+    AbsCriterion,
+    SmoothL1Criterion,
+    BCECriterion,
+    BCECriterionWithLogits,
+    MultiLabelSoftMarginCriterion,
+    MarginCriterion,
+    HingeEmbeddingCriterion,
+    DistKLDivCriterion,
+    CosineEmbeddingCriterion,
+    SoftmaxWithCriterion,
+    MultiCriterion,
+    ParallelCriterion,
+    TimeDistributedCriterion,
+    ClassSimplexCriterion,
+    L1Cost,
+    MarginRankingCriterion,
+    MultiMarginCriterion,
+)
+from bigdl_tpu.nn.recurrent import (
+    Recurrent,
+    RnnCell,
+    LSTM,
+    LSTMPeephole,
+    GRU,
+    BiRecurrent,
+    TimeDistributed,
+    Select,
+)
+
+__all__ = (
+    [
+        "AbstractModule", "Container", "Sequential", "Identity", "Echo",
+        "Graph", "Input", "Node", "Model",
+        "ConcatTable", "ParallelTable", "CAddTable", "CSubTable", "CMulTable",
+        "CDivTable", "CMaxTable", "CMinTable", "JoinTable", "SelectTable",
+        "FlattenTable", "MM", "MV", "CosineDistance", "DotProduct", "Concat",
+        "AbstractCriterion", "ClassNLLCriterion", "CrossEntropyCriterion",
+        "MSECriterion", "AbsCriterion", "SmoothL1Criterion", "BCECriterion",
+        "BCECriterionWithLogits", "MultiLabelSoftMarginCriterion",
+        "MarginCriterion", "HingeEmbeddingCriterion", "DistKLDivCriterion",
+        "CosineEmbeddingCriterion", "SoftmaxWithCriterion", "MultiCriterion",
+        "ParallelCriterion", "TimeDistributedCriterion",
+        "ClassSimplexCriterion", "L1Cost", "MarginRankingCriterion",
+        "MultiMarginCriterion",
+        "Recurrent", "RnnCell", "LSTM", "LSTMPeephole", "GRU", "BiRecurrent",
+        "TimeDistributed", "Select",
+    ]
+    + list(_layers_all)
+)

@@ -1,0 +1,151 @@
+"""Graph container — DAG execution.
+
+Rebuild of «bigdl»/nn/Graph.scala + «bigdl»/utils/DirectedGraph.scala
+(SURVEY.md §2.1 "Graph container": topological sort at build, fwd/bwd
+scheduling, Input/Output nodes; backward replays reverse topo order and
+sums fan-in gradients).
+
+The rebuild only needs the *forward* scheduler: reverse-topo backward and
+fan-in gradient summation fall out of ``jax.vjp`` over the whole-graph
+pure apply.  The reference's ``DynamicGraph`` (data-dependent control
+flow) maps to ``lax.cond``/``lax.while_loop`` inside individual modules
+rather than a separate graph engine — under XLA the *static* graph is the
+only graph.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from bigdl_tpu.nn.module import AbstractModule, Container
+
+
+class Node:
+    """A module wired into a DAG (reference: «bigdl»/utils/Node.scala)."""
+
+    _counter = 0
+
+    def __init__(self, module: AbstractModule, prev_nodes: Sequence["Node"] = ()):
+        Node._counter += 1
+        self.id = Node._counter
+        self.module = module
+        self.prev_nodes: List[Node] = list(prev_nodes)
+
+    def __repr__(self):
+        return f"Node[{self.id}]({self.module!r})"
+
+
+def _as_nodes(nodes):
+    flat = []
+    for n in nodes:
+        if isinstance(n, (list, tuple)):
+            flat.extend(n)
+        elif n is not None:
+            flat.append(n)
+    return flat
+
+
+class _InputModule(AbstractModule):
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        return input
+
+    def __repr__(self):
+        return "Input"
+
+
+def Input(name: Optional[str] = None):
+    """Reference: «bigdl»/nn/Input.scala — a placeholder source node."""
+    m = _InputModule()
+    if name:
+        m.set_name(name)
+    return Node(m, [])
+
+
+class Graph(Container):
+    """«bigdl»/nn/Graph.scala (StaticGraph).
+
+    Built from output nodes + input nodes; executes children in
+    topological order.  A node with multiple predecessors receives a
+    *table* (tuple) of their outputs, matching the reference's Table
+    convention.
+    """
+
+    def __init__(self, input, output):
+        super().__init__()
+        self.input_nodes: List[Node] = (
+            list(input) if isinstance(input, (list, tuple)) else [input]
+        )
+        self.output_nodes: List[Node] = (
+            list(output) if isinstance(output, (list, tuple)) else [output]
+        )
+        self._topo = self._topological_sort()
+        # children registered in topo order so params()/state() line up
+        for node in self._topo:
+            self.modules.append(node.module)
+        self._node_index = {node.id: i for i, node in enumerate(self._topo)}
+
+    # -------------------------------------------------------------- topology
+    def _topological_sort(self) -> List[Node]:
+        visited, order, on_stack = set(), [], set()
+
+        def visit(node: Node):
+            if node.id in visited:
+                return
+            if node.id in on_stack:
+                raise ValueError("Graph contains a cycle")
+            on_stack.add(node.id)
+            for p in node.prev_nodes:
+                visit(p)
+            on_stack.discard(node.id)
+            visited.add(node.id)
+            order.append(node)
+
+        for out in self.output_nodes:
+            visit(out)
+        # inputs may be disconnected placeholders; make sure they're present
+        for inp in self.input_nodes:
+            if inp.id not in visited:
+                order.insert(0, inp)
+                visited.add(inp.id)
+        return order
+
+    # --------------------------------------------------------------- forward
+    def apply(self, params, state, input, *, training=False, rng=None):
+        import jax
+
+        if len(self.input_nodes) == 1 and not isinstance(input, (tuple, list)):
+            inputs = [input]
+        else:
+            inputs = list(input)
+        if len(inputs) != len(self.input_nodes):
+            raise ValueError(
+                f"Graph expects {len(self.input_nodes)} inputs, got {len(inputs)}"
+            )
+        values = {}
+        new_state = {}
+        input_ids = {n.id: i for i, n in enumerate(self.input_nodes)}
+        for node in self._topo:
+            i = self._node_index[node.id]
+            key = str(i)
+            if node.id in input_ids:
+                x = inputs[input_ids[node.id]]
+            elif len(node.prev_nodes) == 1:
+                x = values[node.prev_nodes[0].id]
+            else:
+                x = tuple(values[p.id] for p in node.prev_nodes)
+            r = None if rng is None else jax.random.fold_in(rng, i)
+            y, s = node.module.apply(
+                params[key], state[key], x, training=training, rng=r
+            )
+            values[node.id] = y
+            new_state[key] = s
+        outs = tuple(values[n.id] for n in self.output_nodes)
+        return (outs[0] if len(outs) == 1 else outs), new_state
+
+    def __repr__(self):
+        return f"Graph({len(self._topo)} nodes)"
+
+
+def Model(input, output):
+    """Python-BigDL spelling («py»/nn/layer.py Model) for Graph."""
+    return Graph(input, output)

@@ -1,0 +1,390 @@
+"""The module contract + basic containers.
+
+Rebuild of «bigdl»/nn/abstractnn/AbstractModule.scala and
+«bigdl»/nn/Sequential.scala.  The reference contract is
+
+    updateOutput / updateGradInput / accGradParameters
+
+with a **hand-written backward per layer — no autograd** (SURVEY.md §1 L2).
+The rebuild keeps that API surface (``forward``/``backward``/
+``update_grad_input``/``acc_grad_parameters``, mutable ``output``/
+``gradInput``, ``zeroGradParameters``...) but derives every backward from
+``jax.vjp`` over a **pure functional core**:
+
+    apply(params, state, input, *, training, rng) -> (output, new_state)
+
+``params`` is a pytree of ``jnp`` arrays (weights), ``state`` a pytree of
+non-trained buffers (e.g. BatchNormalization running stats).  Optimizers
+never touch the stateful API: they jit one train step over
+``module.apply`` + ``criterion.loss`` — that single XLA program replaces
+the reference's per-core threaded replica loop (SURVEY.md §3.2 hot loop).
+
+Parameter *initialisation* stays eager and host-side at construction time,
+drawn from the global seedable ``RandomGenerator.RNG`` exactly like the
+reference, so seeded unit tests translate directly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from bigdl_tpu.common import RandomGenerator
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+class AbstractModule:
+    """Base of every layer and container."""
+
+    # names of attributes that are trainable parameters / non-trained state
+    param_names: tuple = ()
+    state_names: tuple = ()
+
+    def __init__(self):
+        self.output = None
+        self.grad_input = None
+        self.is_training = True
+        self._name: Optional[str] = None
+        self._grad_params = None  # pytree matching params(), lazily allocated
+        self._forward_count = 0
+        self._last_rng = None
+
+    # ------------------------------------------------------------ functional
+    def params(self) -> Dict[str, Any]:
+        """Pytree of trainable parameters (empty dict if none)."""
+        out = {}
+        for n in self.param_names:
+            v = getattr(self, n, None)
+            if v is not None:
+                out[n] = v
+        return out
+
+    def set_params(self, params: Dict[str, Any]):
+        for n in self.param_names:
+            if n in params:
+                setattr(self, n, params[n])
+
+    def state(self) -> Dict[str, Any]:
+        out = {}
+        for n in self.state_names:
+            v = getattr(self, n, None)
+            if v is not None:
+                out[n] = v
+        return out
+
+    def set_state(self, state: Dict[str, Any]):
+        for n in self.state_names:
+            if n in state:
+                setattr(self, n, state[n])
+
+    def apply(self, params, state, input, *, training: bool = False, rng=None):
+        """Pure forward.  Default: stateless layer delegating to
+        :meth:`update_output_pure`."""
+        return (
+            self.update_output_pure(params, input, training=training, rng=rng),
+            state,
+        )
+
+    def update_output_pure(self, params, input, *, training: bool = False, rng=None):
+        raise NotImplementedError(
+            f"{type(self).__name__} must implement update_output_pure or apply"
+        )
+
+    # ------------------------------------------------------- stateful parity
+    def _next_rng(self):
+        import jax
+
+        base = jax.random.key(RandomGenerator.RNG.seed + 1013904223)
+        self._forward_count += 1
+        self._last_rng = jax.random.fold_in(base, self._forward_count)
+        return self._last_rng
+
+    def forward(self, input):
+        """Stateful forward (reference: AbstractModule.forward ->
+        updateOutput).  Updates ``self.output`` and any internal state
+        (e.g. BN running stats when training)."""
+        out, new_state = self.apply(
+            self.params(),
+            self.state(),
+            input,
+            training=self.is_training,
+            rng=self._next_rng(),
+        )
+        self.set_state(new_state)
+        self.output = out
+        return out
+
+    update_output = forward  # parity alias (updateOutput)
+
+    def _vjp(self, input):
+        import jax
+
+        params = self.params()
+        state = self.state()
+        rng = self._last_rng
+
+        def f(p, x):
+            return self.apply(p, state, x, training=self.is_training, rng=rng)[0]
+
+        return jax.vjp(f, params, input)
+
+    def update_grad_input(self, input, grad_output):
+        """Reference: updateGradInput — input gradient only, no parameter
+        gradient accumulation."""
+        _, vjp_fn = self._vjp(input)
+        _, grad_in = vjp_fn(grad_output)
+        self.grad_input = grad_in
+        return grad_in
+
+    def acc_grad_parameters(self, input, grad_output):
+        """Reference: accGradParameters — accumulate parameter gradients."""
+        _, vjp_fn = self._vjp(input)
+        grad_p, _ = vjp_fn(grad_output)
+        self._accumulate(grad_p)
+
+    def backward(self, input, grad_output):
+        """updateGradInput + accGradParameters in one vjp call."""
+        _, vjp_fn = self._vjp(input)
+        grad_p, grad_in = vjp_fn(grad_output)
+        self._accumulate(grad_p)
+        self.grad_input = grad_in
+        return grad_in
+
+    def _accumulate(self, grad_p):
+        import jax
+
+        if self._grad_params is None:
+            self._grad_params = grad_p
+        else:
+            self._grad_params = jax.tree.map(
+                lambda a, b: a + b, self._grad_params, grad_p
+            )
+
+    def zero_grad_parameters(self):
+        import jax
+
+        p = self.params()
+        jnp = _jnp()
+        self._grad_params = jax.tree.map(jnp.zeros_like, p)
+
+    zeroGradParameters = zero_grad_parameters
+
+    def grad_params(self):
+        if self._grad_params is None:
+            self.zero_grad_parameters()
+        return self._grad_params
+
+    def update_parameters(self, learning_rate: float):
+        """Reference: updateParameters — vanilla SGD step in place."""
+        import jax
+
+        g = self.grad_params()
+        p = self.params()
+        new_p = jax.tree.map(lambda w, gw: w - learning_rate * gw, p, g)
+        self.set_params(new_p)
+
+    def parameters(self):
+        """Reference: parameters() -> (Array[Tensor] weights,
+        Array[Tensor] gradWeights) — flat leaf lists here."""
+        import jax
+
+        w = jax.tree.leaves(self.params())
+        g = jax.tree.leaves(self.grad_params())
+        return w, g
+
+    # ---------------------------------------------------------- weights I/O
+    def _ordered_params(self):
+        """(module, attr) pairs in declaration order — weight before bias,
+        children in add order — matching the reference's parameters()
+        ordering (a dict pytree would sort alphabetically)."""
+        return [
+            (self, n) for n in self.param_names if getattr(self, n, None) is not None
+        ]
+
+    def get_weights(self):
+        return [np.asarray(getattr(m, n)) for m, n in self._ordered_params()]
+
+    def set_weights(self, weights):
+        jnp = _jnp()
+        slots = self._ordered_params()
+        if len(weights) != len(slots):
+            raise ValueError(
+                f"expected {len(slots)} weight arrays, got {len(weights)}"
+            )
+        for (m, n), new in zip(slots, weights):
+            old = getattr(m, n)
+            new = jnp.asarray(new, dtype=old.dtype)
+            if new.shape != old.shape:
+                raise ValueError(f"shape mismatch: {new.shape} vs {old.shape}")
+            setattr(m, n, new)
+        return self
+
+    # ------------------------------------------------------------ mode/name
+    def training(self):
+        self.is_training = True
+        return self
+
+    def evaluate(self):
+        self.is_training = False
+        return self
+
+    def set_name(self, name: str):
+        self._name = name
+        return self
+
+    setName = set_name
+
+    def get_name(self) -> str:
+        return self._name or f"{type(self).__name__}@{id(self):x}"
+
+    getName = get_name
+
+    def reset(self):
+        """Re-draw initial parameters from RandomGenerator.RNG."""
+        return self
+
+    # ------------------------------------------------------- regularization
+    def regularization_loss(self, params) -> Any:
+        """Sum of regularizer penalties (reference applies wRegularizer /
+        bRegularizer gradients inside accGradParameters; the rebuild adds
+        the penalty to the jitted loss instead — same gradients)."""
+        loss = 0.0
+        regs = getattr(self, "_regularizers", None)
+        if regs:
+            for pname, reg in regs:
+                if pname in params:
+                    loss = loss + reg(params[pname])
+        return loss
+
+    # ------------------------------------------------------------- graph fn
+    def __call__(self, *nodes):
+        """Functional-graph sugar: wrap this module in a Node wired to
+        predecessor nodes (reference: ``module.inputs(n1, n2)``)."""
+        from bigdl_tpu.nn.graph import Node, _as_nodes
+
+        return Node(self, _as_nodes(nodes))
+
+    inputs = __call__
+
+    # ------------------------------------------------------------- helpers
+    def __repr__(self):
+        return f"{type(self).__name__}"
+
+    # serialization hook: constructor arguments, captured by subclasses
+    def get_config(self) -> Dict[str, Any]:
+        return dict(getattr(self, "_config", {}))
+
+
+class Container(AbstractModule):
+    """Base container (reference: «bigdl»/nn/Container.scala)."""
+
+    def __init__(self):
+        super().__init__()
+        self.modules: list[AbstractModule] = []
+
+    def add(self, module: AbstractModule):
+        self.modules.append(module)
+        return self
+
+    # params/state pytrees keyed by child index (stable structure: every
+    # child contributes a key even when empty, so jit retraces never see a
+    # structure change)
+    def params(self):
+        return {str(i): m.params() for i, m in enumerate(self.modules)}
+
+    def set_params(self, params):
+        for i, m in enumerate(self.modules):
+            m.set_params(params.get(str(i), {}))
+
+    def state(self):
+        return {str(i): m.state() for i, m in enumerate(self.modules)}
+
+    def set_state(self, state):
+        for i, m in enumerate(self.modules):
+            m.set_state(state.get(str(i), {}))
+
+    def training(self):
+        super().training()
+        for m in self.modules:
+            m.training()
+        return self
+
+    def evaluate(self):
+        super().evaluate()
+        for m in self.modules:
+            m.evaluate()
+        return self
+
+    def reset(self):
+        for m in self.modules:
+            m.reset()
+        return self
+
+    def regularization_loss(self, params):
+        loss = 0.0
+        for i, m in enumerate(self.modules):
+            loss = loss + m.regularization_loss(params.get(str(i), {}))
+        return loss
+
+    def _ordered_params(self):
+        out = []
+        for m in self.modules:
+            out.extend(m._ordered_params())
+        return out
+
+    def find_module(self, name: str):
+        """Reference: Container.apply(name) — find a child by name."""
+        for m in self.modules:
+            if m._name == name:
+                return m
+            if isinstance(m, Container):
+                found = m.find_module(name)
+                if found is not None:
+                    return found
+        return None
+
+
+class Sequential(Container):
+    """Feed-forward chain (reference: «bigdl»/nn/Sequential.scala;
+    forward loops ``output = module.forward(prevOutput)`` — SURVEY.md
+    §3.3)."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        import jax
+
+        x = input
+        new_state = {}
+        for i, m in enumerate(self.modules):
+            r = None if rng is None else jax.random.fold_in(rng, i)
+            x, s = m.apply(
+                params[str(i)], state[str(i)], x, training=training, rng=r
+            )
+            new_state[str(i)] = s
+        return x, new_state
+
+    def __repr__(self):
+        body = "\n".join(f"  ({i}): {m!r}" for i, m in enumerate(self.modules))
+        return f"Sequential {{\n{body}\n}}"
+
+
+class Identity(AbstractModule):
+    """«bigdl»/nn/Identity.scala"""
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        return input
+
+
+class Echo(AbstractModule):
+    """«bigdl»/nn/Echo.scala — prints shape on forward (debug aid).  The
+    print happens at trace time (host), matching its debugging purpose."""
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        shape = getattr(input, "shape", None)
+        print(f"Echo[{self.get_name()}]: shape={shape}")
+        return input

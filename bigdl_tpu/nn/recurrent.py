@@ -1,0 +1,431 @@
+"""Recurrent stack.
+
+Rebuild of the reference sequence-modeling layers (SURVEY.md §2.1
+"Recurrent stack"): «bigdl»/nn/Recurrent.scala (unrolls Cells over time,
+reusing state tensors), LSTM.scala, LSTMPeephole.scala, GRU.scala,
+RnnCell.scala, BiRecurrent.scala, TimeDistributed.scala, Select.scala.
+
+TPU-native mechanics instead of the reference's per-timestep Scala loop:
+
+* the time loop is ``lax.scan`` — one compiled program, no per-step
+  dispatch;
+* input-to-hidden projections for *all* timesteps are hoisted out of the
+  scan into a single large (B*T, in) x (in, gates*H) matmul that the MXU
+  eats whole; the scan body only carries the small recurrent matmul;
+* gate weights are packed into one matrix per direction so each step is
+  one fused matmul, not 3-4 small ones;
+* the reference's per-gate input Dropout(p) applies independent masks to
+  the input of each gate — done here as one (gates, B, T, in) masked
+  einsum, still outside the scan.
+
+Input layout is batch-first (B, T, F), matching the reference's
+``batchNormParams``-free default.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as np
+
+from bigdl_tpu.common import RandomGenerator
+from bigdl_tpu.nn.module import AbstractModule, Container
+from bigdl_tpu.nn.layers import Sigmoid, Tanh, _to_device
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _gate_dropout(x, n_gates: int, p: float, training: bool, rng):
+    """Reference: each gate's input connection has its own Dropout(p)
+    («bigdl»/nn/LSTM.scala wires Dropout before every i2h Linear).
+    Returns (n_gates, B, T, in) with independent inverted-dropout masks,
+    or None when dropout is inactive (caller uses the plain x @ W path)."""
+    if p <= 0.0 or not training or rng is None:
+        return None
+    import jax
+
+    jnp = _jnp()
+    keep = 1.0 - p
+    masks = jax.random.bernoulli(rng, keep, shape=(n_gates,) + x.shape)
+    return jnp.where(masks, x[None], 0.0) / keep
+
+
+class Cell(AbstractModule):
+    """Base recurrent cell (reference: «bigdl»/nn/Cell.scala).
+
+    Subclasses define:
+      * ``hidden_size`` and gate packing
+      * ``precompute(params, x, training=..., rng=...)`` — (B, T, in) ->
+        (B, T, gates*H), the hoisted input projection (incl. per-gate
+        input dropout)
+      * ``step(params, carry, proj_t)`` -> (new_carry, output_t)
+      * ``init_carry(batch, dtype)``
+    """
+
+    hidden_size: int = 0
+
+    def precompute(self, params, x, *, training=False, rng=None):
+        raise NotImplementedError
+
+    def step(self, params, carry, proj_t):
+        raise NotImplementedError
+
+    def init_carry(self, batch: int, dtype):
+        raise NotImplementedError
+
+    # a bare cell can also be applied to a single timestep; the common
+    # path is through Recurrent, so apply() runs one step.
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        proj = self.precompute(params, input[:, None, :], training=training,
+                               rng=rng)[:, 0]
+        carry = self.init_carry(input.shape[0], input.dtype)
+        _, out = self.step(params, carry, proj)
+        return out
+
+
+def _uniform(shape, stdv):
+    return _to_device(
+        RandomGenerator.RNG.uniform(-stdv, stdv, size=shape).astype(np.float32)
+    )
+
+
+def _gated_projection(x, w, b, n_gates, hidden, dropped):
+    """x @ w + b, or the per-gate-masked equivalent when dropout is on.
+    w: (in, n_gates*H)."""
+    jnp = _jnp()
+    if dropped is None:
+        return x @ w + b
+    wg = w.reshape(w.shape[0], n_gates, hidden)
+    proj = jnp.einsum("gbti,igh->btgh", dropped, wg)
+    return proj.reshape(x.shape[0], x.shape[1], n_gates * hidden) + b
+
+
+class RnnCell(Cell):
+    """«bigdl»/nn/RnnCell.scala — h' = act(W x + U h + b)."""
+
+    param_names = ("w", "u", "b")
+
+    def __init__(self, input_size: int, hidden_size: int, activation=None):
+        super().__init__()
+        self._config = dict(input_size=input_size, hidden_size=hidden_size)
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.activation = activation or Tanh()
+        self.reset()
+
+    def reset(self):
+        stdv = 1.0 / math.sqrt(self.hidden_size)
+        self.w = _uniform((self.input_size, self.hidden_size), stdv)
+        self.u = _uniform((self.hidden_size, self.hidden_size), stdv)
+        self.b = _to_device(np.zeros(self.hidden_size, dtype=np.float32))
+        return self
+
+    def precompute(self, params, x, *, training=False, rng=None):
+        return x @ params["w"] + params["b"]
+
+    def init_carry(self, batch, dtype):
+        jnp = _jnp()
+        return jnp.zeros((batch, self.hidden_size), dtype=dtype)
+
+    def step(self, params, carry, proj_t):
+        h = self.activation.update_output_pure({}, proj_t + carry @ params["u"])
+        return h, h
+
+
+class LSTM(Cell):
+    """«bigdl»/nn/LSTM.scala — gates packed (i, f, g, o) into one
+    (in, 4H) input matrix and one (H, 4H) recurrent matrix.
+
+    Reference options honored: ``p`` (per-gate input dropout),
+    ``activation`` (candidate/output nonlinearity, default Tanh),
+    ``inner_activation`` (gate nonlinearity, default Sigmoid).
+    """
+
+    param_names = ("w", "u", "b")
+    n_gates = 4
+
+    def __init__(
+        self,
+        input_size: int,
+        hidden_size: int,
+        p: float = 0.0,
+        activation=None,
+        inner_activation=None,
+        w_regularizer=None,
+        u_regularizer=None,
+        b_regularizer=None,
+    ):
+        super().__init__()
+        self._config = dict(input_size=input_size, hidden_size=hidden_size, p=p)
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.p = p
+        self.activation = activation or Tanh()
+        self.inner_activation = inner_activation or Sigmoid()
+        self._regularizers = []
+        for name, reg in (("w", w_regularizer), ("u", u_regularizer),
+                          ("b", b_regularizer)):
+            if reg is not None:
+                self._regularizers.append((name, reg))
+        self.reset()
+
+    def reset(self):
+        stdv = 1.0 / math.sqrt(self.hidden_size)
+        self.w = _uniform((self.input_size, 4 * self.hidden_size), stdv)
+        self.u = _uniform((self.hidden_size, 4 * self.hidden_size), stdv)
+        self.b = _to_device(np.zeros(4 * self.hidden_size, dtype=np.float32))
+        return self
+
+    def precompute(self, params, x, *, training=False, rng=None):
+        dropped = _gate_dropout(x, self.n_gates, self.p, training, rng)
+        return _gated_projection(x, params["w"], params["b"], self.n_gates,
+                                 self.hidden_size, dropped)
+
+    def init_carry(self, batch, dtype):
+        jnp = _jnp()
+        z = jnp.zeros((batch, self.hidden_size), dtype=dtype)
+        return (z, z)
+
+    def step(self, params, carry, proj_t):
+        jnp = _jnp()
+        h, c = carry
+        gates = proj_t + h @ params["u"]
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        act, inner = self.activation, self.inner_activation
+        i = inner.update_output_pure({}, i)
+        f = inner.update_output_pure({}, f)
+        o = inner.update_output_pure({}, o)
+        g = act.update_output_pure({}, g)
+        c_new = f * c + i * g
+        h_new = o * act.update_output_pure({}, c_new)
+        return (h_new, c_new), h_new
+
+    def __repr__(self):
+        return f"LSTM({self.input_size}, {self.hidden_size})"
+
+
+class LSTMPeephole(Cell):
+    """«bigdl»/nn/LSTMPeephole.scala — LSTM with diagonal peephole
+    connections from the cell state into i/f/o gates."""
+
+    param_names = ("w", "u", "b", "p_i", "p_f", "p_o")
+    n_gates = 4
+
+    def __init__(self, input_size: int, hidden_size: int, p: float = 0.0):
+        super().__init__()
+        self._config = dict(input_size=input_size, hidden_size=hidden_size, p=p)
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.p = p
+        self.reset()
+
+    def reset(self):
+        stdv = 1.0 / math.sqrt(self.hidden_size)
+        self.w = _uniform((self.input_size, 4 * self.hidden_size), stdv)
+        self.u = _uniform((self.hidden_size, 4 * self.hidden_size), stdv)
+        self.b = _to_device(np.zeros(4 * self.hidden_size, dtype=np.float32))
+        self.p_i = _uniform((self.hidden_size,), stdv)
+        self.p_f = _uniform((self.hidden_size,), stdv)
+        self.p_o = _uniform((self.hidden_size,), stdv)
+        return self
+
+    def precompute(self, params, x, *, training=False, rng=None):
+        dropped = _gate_dropout(x, self.n_gates, self.p, training, rng)
+        return _gated_projection(x, params["w"], params["b"], self.n_gates,
+                                 self.hidden_size, dropped)
+
+    def init_carry(self, batch, dtype):
+        jnp = _jnp()
+        z = jnp.zeros((batch, self.hidden_size), dtype=dtype)
+        return (z, z)
+
+    def step(self, params, carry, proj_t):
+        import jax
+
+        jnp = _jnp()
+        h, c = carry
+        gates = proj_t + h @ params["u"]
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i = jax.nn.sigmoid(i + params["p_i"] * c)
+        f = jax.nn.sigmoid(f + params["p_f"] * c)
+        g = jnp.tanh(g)
+        c_new = f * c + i * g
+        o = jax.nn.sigmoid(o + params["p_o"] * c_new)
+        h_new = o * jnp.tanh(c_new)
+        return (h_new, c_new), h_new
+
+
+class GRU(Cell):
+    """«bigdl»/nn/GRU.scala — gates packed (r, z) + candidate; honors
+    ``p`` per-gate input dropout like the reference."""
+
+    param_names = ("w_rz", "u_rz", "b_rz", "w_h", "u_h", "b_h")
+
+    def __init__(self, input_size: int, hidden_size: int, p: float = 0.0):
+        super().__init__()
+        self._config = dict(input_size=input_size, hidden_size=hidden_size, p=p)
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.p = p
+        self.reset()
+
+    def reset(self):
+        stdv = 1.0 / math.sqrt(self.hidden_size)
+        self.w_rz = _uniform((self.input_size, 2 * self.hidden_size), stdv)
+        self.u_rz = _uniform((self.hidden_size, 2 * self.hidden_size), stdv)
+        self.b_rz = _to_device(np.zeros(2 * self.hidden_size, dtype=np.float32))
+        self.w_h = _uniform((self.input_size, self.hidden_size), stdv)
+        self.u_h = _uniform((self.hidden_size, self.hidden_size), stdv)
+        self.b_h = _to_device(np.zeros(self.hidden_size, dtype=np.float32))
+        return self
+
+    def precompute(self, params, x, *, training=False, rng=None):
+        jnp = _jnp()
+        dropped = _gate_dropout(x, 3, self.p, training, rng)
+        if dropped is None:
+            rz = x @ params["w_rz"] + params["b_rz"]
+            hcand = x @ params["w_h"] + params["b_h"]
+        else:
+            H = self.hidden_size
+            rz = _gated_projection(x, params["w_rz"], params["b_rz"], 2, H,
+                                   dropped[:2])
+            hcand = dropped[2] @ params["w_h"] + params["b_h"]
+        return jnp.concatenate([rz, hcand], axis=-1)
+
+    def init_carry(self, batch, dtype):
+        jnp = _jnp()
+        return jnp.zeros((batch, self.hidden_size), dtype=dtype)
+
+    def step(self, params, carry, proj_t):
+        import jax
+
+        jnp = _jnp()
+        h = carry
+        H = self.hidden_size
+        rz = proj_t[..., : 2 * H] + h @ params["u_rz"]
+        r, z = jnp.split(jax.nn.sigmoid(rz), 2, axis=-1)
+        cand = jnp.tanh(proj_t[..., 2 * H :] + (r * h) @ params["u_h"])
+        h_new = (1 - z) * cand + z * h
+        return h_new, h_new
+
+    def __repr__(self):
+        return f"GRU({self.input_size}, {self.hidden_size})"
+
+
+class Recurrent(Container):
+    """«bigdl»/nn/Recurrent.scala — wraps one Cell, maps (B, T, in) ->
+    (B, T, H).  The reference's per-timestep loop with reused state
+    tensors becomes ``lax.scan``; see module docstring for what gets
+    hoisted."""
+
+    def __init__(self):
+        super().__init__()
+
+    def add(self, cell: Cell):
+        if len(self.modules) > 0:
+            raise ValueError("Recurrent takes exactly one Cell")
+        if not isinstance(cell, Cell):
+            raise TypeError("Recurrent.add expects a recurrent Cell")
+        return super().add(cell)
+
+    @property
+    def cell(self) -> Cell:
+        return self.modules[0]
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        import jax.lax as lax
+
+        jnp = _jnp()
+        cell = self.cell
+        cparams = params["0"]
+        proj = cell.precompute(cparams, input, training=training, rng=rng)
+        proj_t = jnp.swapaxes(proj, 0, 1)               # time-major for scan
+        carry0 = cell.init_carry(input.shape[0], input.dtype)
+
+        def body(carry, p_t):
+            return cell.step(cparams, carry, p_t)
+
+        _, ys = lax.scan(body, carry0, proj_t)
+        return jnp.swapaxes(ys, 0, 1), state
+
+    def __repr__(self):
+        return f"Recurrent({self.modules[0]!r})" if self.modules else "Recurrent()"
+
+
+class BiRecurrent(Container):
+    """«bigdl»/nn/BiRecurrent.scala — forward + time-reversed cells;
+    outputs merged (default: concat on the feature dim, like the
+    reference's JoinTable default).  The reverse cell is independently
+    re-initialized, as the reference constructs a fresh cell."""
+
+    def __init__(self, merge=None):
+        super().__init__()
+        self.merge = merge  # None -> concat last dim; else a table module
+
+    def add(self, cell: Cell):
+        import copy
+
+        if len(self.modules) > 0:
+            raise ValueError("BiRecurrent takes exactly one Cell")
+        fwd = Recurrent().add(cell)
+        bwd_cell = copy.deepcopy(cell)
+        bwd_cell.reset()  # fresh draw — cells implement reset()
+        bwd = Recurrent().add(bwd_cell)
+        super().add(fwd)
+        super().add(bwd)
+        return self
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        import jax
+
+        jnp = _jnp()
+        r_f = None if rng is None else jax.random.fold_in(rng, 0)
+        r_b = None if rng is None else jax.random.fold_in(rng, 1)
+        fwd_out, _ = self.modules[0].apply(
+            params["0"], state["0"], input, training=training, rng=r_f
+        )
+        rev = jnp.flip(input, axis=1)
+        bwd_out, _ = self.modules[1].apply(
+            params["1"], state["1"], rev, training=training, rng=r_b
+        )
+        bwd_out = jnp.flip(bwd_out, axis=1)
+        if self.merge is None:
+            return jnp.concatenate([fwd_out, bwd_out], axis=-1), state
+        merged = self.merge.update_output_pure({}, (fwd_out, bwd_out))
+        return merged, state
+
+
+class TimeDistributed(Container):
+    """«bigdl»/nn/TimeDistributed.scala — fold time into batch, apply the
+    wrapped layer, unfold (the reference's trick for applying Linear/
+    LogSoftMax per step)."""
+
+    def __init__(self, layer: Optional[AbstractModule] = None):
+        super().__init__()
+        if layer is not None:
+            self.add(layer)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        b, t = input.shape[0], input.shape[1]
+        merged = input.reshape((b * t,) + input.shape[2:])
+        y, s = self.modules[0].apply(
+            params["0"], state["0"], merged, training=training, rng=rng
+        )
+        return y.reshape((b, t) + y.shape[1:]), {"0": s}
+
+
+class Select(AbstractModule):
+    """«bigdl»/nn/Select.scala — select one 1-based index along a 1-based
+    dim (negative index counts from the end); commonly
+    ``Select(2, -1)`` for "last timestep"."""
+
+    def __init__(self, dim: int, index: int):
+        super().__init__()
+        self._config = dict(dim=dim, index=index)
+        self.dim, self.index = dim, index
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        d = self.dim - 1 if self.dim > 0 else input.ndim + self.dim
+        i = self.index - 1 if self.index > 0 else input.shape[d] + self.index
+        return _jnp().take(input, i, axis=d)

@@ -1,0 +1,247 @@
+"""Table (multi-tensor) containers and ops.
+
+Rebuild of the reference's Table-valued layers («bigdl»/nn/ConcatTable.scala,
+CAddTable.scala, JoinTable.scala, Concat.scala...).  The reference's
+``Table`` activity type maps to Python tuples/lists of arrays, which are
+ordinary pytrees — so ``jax.vjp`` differentiates through them for free.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from bigdl_tpu.nn.module import AbstractModule, Container
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+class ConcatTable(Container):
+    """«bigdl»/nn/ConcatTable.scala — apply each child to the same input,
+    return the table of outputs."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        import jax
+
+        outs, new_state = [], {}
+        for i, m in enumerate(self.modules):
+            r = None if rng is None else jax.random.fold_in(rng, i)
+            y, s = m.apply(
+                params[str(i)], state[str(i)], input, training=training, rng=r
+            )
+            outs.append(y)
+            new_state[str(i)] = s
+        return tuple(outs), new_state
+
+
+class ParallelTable(Container):
+    """«bigdl»/nn/ParallelTable.scala — i-th child gets i-th table entry."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        import jax
+
+        outs, new_state = [], {}
+        for i, m in enumerate(self.modules):
+            r = None if rng is None else jax.random.fold_in(rng, i)
+            y, s = m.apply(
+                params[str(i)], state[str(i)], input[i], training=training, rng=r
+            )
+            outs.append(y)
+            new_state[str(i)] = s
+        return tuple(outs), new_state
+
+
+class _TableReduce(AbstractModule):
+    def __init__(self, **config):
+        super().__init__()
+        self._config = config
+
+
+class CAddTable(_TableReduce):
+    """«bigdl»/nn/CAddTable.scala — elementwise sum of a table."""
+
+    def __init__(self, inplace: bool = False):
+        super().__init__()
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        y = input[0]
+        for t in input[1:]:
+            y = y + t
+        return y
+
+
+class CSubTable(_TableReduce):
+    """«bigdl»/nn/CSubTable.scala"""
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        return input[0] - input[1]
+
+
+class CMulTable(_TableReduce):
+    """«bigdl»/nn/CMulTable.scala"""
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        y = input[0]
+        for t in input[1:]:
+            y = y * t
+        return y
+
+
+class CDivTable(_TableReduce):
+    """«bigdl»/nn/CDivTable.scala"""
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        return input[0] / input[1]
+
+
+class CMaxTable(_TableReduce):
+    """«bigdl»/nn/CMaxTable.scala"""
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        jnp = _jnp()
+        y = input[0]
+        for t in input[1:]:
+            y = jnp.maximum(y, t)
+        return y
+
+
+class CMinTable(_TableReduce):
+    """«bigdl»/nn/CMinTable.scala"""
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        jnp = _jnp()
+        y = input[0]
+        for t in input[1:]:
+            y = jnp.minimum(y, t)
+        return y
+
+
+class JoinTable(_TableReduce):
+    """«bigdl»/nn/JoinTable.scala — concat a table along 1-based dim;
+    n_input_dims handles the batch-dim shift like the reference."""
+
+    def __init__(self, dimension: int, n_input_dims: int = -1):
+        super().__init__(dimension=dimension, n_input_dims=n_input_dims)
+        self.dimension = dimension
+        self.n_input_dims = n_input_dims
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        jnp = _jnp()
+        d = self.dimension - 1
+        if self.n_input_dims > 0 and input[0].ndim > self.n_input_dims:
+            d += 1
+        return jnp.concatenate(list(input), axis=d)
+
+
+class SelectTable(_TableReduce):
+    """«bigdl»/nn/SelectTable.scala — pick 1-based entry of a table."""
+
+    def __init__(self, index: int):
+        super().__init__(index=index)
+        self.index = index
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        i = self.index - 1 if self.index > 0 else self.index
+        return input[i]
+
+
+class FlattenTable(_TableReduce):
+    """«bigdl»/nn/FlattenTable.scala — flatten nested tables."""
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        out = []
+
+        def rec(t):
+            if isinstance(t, (tuple, list)):
+                for u in t:
+                    rec(u)
+            else:
+                out.append(t)
+
+        rec(input)
+        return tuple(out)
+
+
+class MM(_TableReduce):
+    """«bigdl»/nn/MM.scala — batched matmul of a 2-table, with transpose
+    flags."""
+
+    def __init__(self, trans_a: bool = False, trans_b: bool = False):
+        super().__init__(trans_a=trans_a, trans_b=trans_b)
+        self.trans_a, self.trans_b = trans_a, trans_b
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        jnp = _jnp()
+        a, b = input
+        if self.trans_a:
+            a = jnp.swapaxes(a, -1, -2)
+        if self.trans_b:
+            b = jnp.swapaxes(b, -1, -2)
+        return jnp.matmul(a, b)
+
+
+class MV(_TableReduce):
+    """«bigdl»/nn/MV.scala — (batched) matrix-vector product."""
+
+    def __init__(self, trans: bool = False):
+        super().__init__(trans=trans)
+        self.trans = trans
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        jnp = _jnp()
+        m, v = input
+        if self.trans:
+            m = jnp.swapaxes(m, -1, -2)
+        return jnp.einsum("...ij,...j->...i", m, v)
+
+
+class DotProduct(_TableReduce):
+    """«bigdl»/nn/DotProduct.scala"""
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        jnp = _jnp()
+        a, b = input
+        return jnp.sum(a * b, axis=-1)
+
+
+class CosineDistance(_TableReduce):
+    """«bigdl»/nn/CosineDistance.scala"""
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        jnp = _jnp()
+        a, b = input
+        na = jnp.linalg.norm(a, axis=-1)
+        nb = jnp.linalg.norm(b, axis=-1)
+        return jnp.sum(a * b, axis=-1) / jnp.maximum(na * nb, 1e-12)
+
+
+class Concat(Container):
+    """«bigdl»/nn/Concat.scala — the DepthConcat-style container used by
+    Inception: run children on the same input, concat outputs along a
+    1-based dim (channel dim 2 for NCHW batches)."""
+
+    def __init__(self, dimension: int):
+        super().__init__()
+        self._config = dict(dimension=dimension)
+        self.dimension = dimension
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        import jax
+
+        jnp = _jnp()
+        outs, new_state = [], {}
+        for i, m in enumerate(self.modules):
+            r = None if rng is None else jax.random.fold_in(rng, i)
+            y, s = m.apply(
+                params[str(i)], state[str(i)], input, training=training, rng=r
+            )
+            outs.append(y)
+            new_state[str(i)] = s
+        return jnp.concatenate(outs, axis=self.dimension - 1), new_state
+
+    def __repr__(self):
+        body = " | ".join(repr(m) for m in self.modules)
+        return f"Concat(dim={self.dimension}: {body})"

@@ -1,0 +1,51 @@
+"""bigdl_tpu.optim — training runtime.
+
+Rebuild of «bigdl»/optim/ (SURVEY.md §2.1): OptimMethods, Triggers,
+ValidationMethods, LocalOptimizer, DistriOptimizer, Metrics.
+"""
+
+from bigdl_tpu.optim.optim_method import (
+    OptimMethod,
+    SGD,
+    Adam,
+    Adagrad,
+    Adadelta,
+    Adamax,
+    RMSprop,
+    Ftrl,
+    LarsSGD,
+    Default,
+    Poly,
+    Step,
+    MultiStep,
+    Exponential,
+    EpochDecay,
+    Warmup,
+    SequentialSchedule,
+    Plateau,
+)
+from bigdl_tpu.optim.regularizer import L1Regularizer, L2Regularizer, L1L2Regularizer
+from bigdl_tpu.optim.triggers import Trigger
+from bigdl_tpu.optim.validation import (
+    ValidationMethod,
+    ValidationResult,
+    Top1Accuracy,
+    Top5Accuracy,
+    Loss,
+    MAE,
+)
+from bigdl_tpu.optim.optimizer import Optimizer, LocalOptimizer
+from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
+from bigdl_tpu.optim.metrics import Metrics
+
+__all__ = [
+    "OptimMethod", "SGD", "Adam", "Adagrad", "Adadelta", "Adamax", "RMSprop",
+    "Ftrl", "LarsSGD",
+    "Default", "Poly", "Step", "MultiStep", "Exponential", "EpochDecay",
+    "Warmup", "SequentialSchedule", "Plateau",
+    "L1Regularizer", "L2Regularizer", "L1L2Regularizer",
+    "Trigger",
+    "ValidationMethod", "ValidationResult", "Top1Accuracy", "Top5Accuracy",
+    "Loss", "MAE",
+    "Optimizer", "LocalOptimizer", "DistriOptimizer", "Metrics",
+]

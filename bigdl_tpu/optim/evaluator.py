@@ -1,0 +1,73 @@
+"""Prediction & evaluation.
+
+Rebuild of the reference's Predictor / Evaluator path (SURVEY.md §3.6):
+``model.predict(rdd)`` broadcast an evaluate-mode model and ran
+forward-only per partition, folding ValidationResult monoids.  Here: one
+jitted forward, batched over the dataset; results fold on host.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+
+def _forward_fn(model):
+    import jax
+
+    # cache the jitted forward on the module so repeated validation
+    # triggers reuse the compiled program (params/state are arguments, so
+    # weight updates don't invalidate it; only new input shapes retrace)
+    fwd = getattr(model, "_jit_eval_fwd", None)
+    if fwd is None:
+        @jax.jit
+        def fwd(p, s, inp):
+            out, _ = model.apply(p, s, inp, training=False, rng=None)
+            return out
+
+        model._jit_eval_fwd = fwd
+    params = model.params()
+    state = model.state()
+    return lambda inp: fwd(params, state, inp)
+
+
+def evaluate_dataset(model, dataset, methods: Sequence):
+    """Fold validation methods over a dataset (reference:
+    model.evaluate(rdd, Array(new Top1Accuracy)))."""
+    import jax.numpy as jnp
+
+    model.evaluate()
+    fwd = _forward_fn(model)
+    results = [None] * len(methods)
+    for inp, tgt in dataset.data(train=False):
+        if isinstance(inp, (tuple, list)):
+            out = fwd(tuple(jnp.asarray(x) for x in inp))
+        else:
+            out = fwd(jnp.asarray(inp))
+        for i, m in enumerate(methods):
+            r = m.batch_result(out, tgt)
+            results[i] = r if results[i] is None else results[i] + r
+    return results
+
+
+def predict(model, features, batch_size: int = 32):
+    """Batched forward over an array of inputs; returns stacked host
+    outputs (reference: model.predict)."""
+    import jax.numpy as jnp
+
+    model.evaluate()
+    fwd = _forward_fn(model)
+    feats = np.asarray(features)
+    outs = []
+    n = feats.shape[0]
+    for b in range(0, n, batch_size):
+        chunk = feats[b : b + batch_size]
+        outs.append(np.asarray(fwd(jnp.asarray(chunk))))
+    return np.concatenate(outs, axis=0)
+
+
+def predict_class(model, features, batch_size: int = 32):
+    """Reference: predictClass — argmax + 1 (1-based labels)."""
+    out = predict(model, features, batch_size)
+    return np.argmax(out.reshape(out.shape[0], -1), axis=-1) + 1

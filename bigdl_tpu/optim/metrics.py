@@ -1,0 +1,46 @@
+"""Metrics — per-phase timers.
+
+Rebuild of «bigdl»/optim/Metrics.scala (SURVEY.md §5 "Tracing"):
+driver-side aggregated counters for "computing time average", "get weights
+average", "aggregate gradient time" etc., logged per iteration/epoch.  The
+reference aggregates via Spark accumulators; here a plain dict suffices
+(one process drives the jitted step), with the same metric names so log
+parsers carry over.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+
+
+class Metrics:
+    def __init__(self):
+        self._sums = defaultdict(float)
+        self._counts = defaultdict(int)
+
+    def add(self, name: str, value: float):
+        self._sums[name] += value
+        self._counts[name] += 1
+
+    @contextmanager
+    def timer(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add(name, time.perf_counter() - t0)
+
+    def value(self, name: str) -> float:
+        c = self._counts[name]
+        return self._sums[name] / c if c else 0.0
+
+    def summary(self) -> str:
+        return ", ".join(
+            f"{k} average: {self.value(k) * 1000:.2f}ms" for k in sorted(self._sums)
+        )
+
+    def reset(self):
+        self._sums.clear()
+        self._counts.clear()

@@ -1,0 +1,366 @@
+"""Optimizer factory + LocalOptimizer.
+
+Rebuild of «bigdl»/optim/Optimizer.scala and LocalOptimizer.scala
+(SURVEY.md §3.2).  The reference's LocalOptimizer runs multi-threaded
+model replicas over a core pool with a synchronous gradient sum; on TPU
+that intra-node replication "disappears — one XLA program per chip
+already saturates the chip" (SURVEY.md §2.4), so LocalOptimizer is a
+single jitted train step:
+
+    loss, grads = value_and_grad(model.apply + criterion.loss)
+    flat_grad -> [clipping processors] -> optim_method.step
+
+The driver loop around it keeps reference semantics: ``Trigger``-driven
+stop/validate/checkpoint, state table with epoch/neval counters, train
+summaries, hyper-parameter logging.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Optional, Sequence
+
+import numpy as np
+
+log = logging.getLogger("bigdl_tpu.optim")
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+class _GradClipper:
+    """Parameter processors («bigdl»/optim/parameters/… SURVEY.md §2.1):
+    global L2-norm clipping and constant clipping, applied to the flat
+    gradient inside the jitted step (and to the *sharded* gradient in
+    DistriOptimizer, matching the reference's sharded application)."""
+
+    def __init__(self):
+        self.l2_norm_clip: Optional[float] = None
+        self.const_clip: Optional[tuple] = None
+
+    def __call__(self, flat_grad, global_sq_norm=None):
+        jnp = _jnp()
+        g = flat_grad
+        if self.const_clip is not None:
+            lo, hi = self.const_clip
+            g = jnp.clip(g, lo, hi)
+        if self.l2_norm_clip is not None:
+            sq = global_sq_norm if global_sq_norm is not None else jnp.sum(g * g)
+            norm = jnp.sqrt(sq)
+            g = g * jnp.minimum(1.0, self.l2_norm_clip / (norm + 1e-12))
+        return g
+
+
+class BaseOptimizer:
+    """Shared builder API (reference: Optimizer's fluent setters)."""
+
+    def __init__(self, model, dataset, criterion, batch_size=32):
+        from bigdl_tpu.dataset import to_dataset
+        from bigdl_tpu.optim.optim_method import SGD
+        from bigdl_tpu.optim.triggers import Trigger
+        from bigdl_tpu.optim.metrics import Metrics
+
+        self.model = model
+        self.dataset = to_dataset(dataset, batch_size)
+        self.criterion = criterion
+        self.batch_size = batch_size
+        self.optim_method = SGD()
+        self.end_when = Trigger.max_epoch(1)
+        self.validation_trigger = None
+        self.validation_dataset = None
+        self.validation_methods = None
+        self.checkpoint_path = None
+        self.checkpoint_trigger = None
+        self.train_summary = None
+        self.val_summary = None
+        self.metrics = Metrics()
+        self._clipper = _GradClipper()
+        self.max_retry = 5
+        # reference: InternalOptimizerUtil state table
+        self.state = {"epoch": 1, "neval": 1, "loss": None, "score": None,
+                      "epoch_finished": 0}
+
+    # ---- fluent setters (camelCase parity aliases at the bottom) --------
+    def set_optim_method(self, method):
+        self.optim_method = method
+        return self
+
+    def set_end_when(self, trigger):
+        self.end_when = trigger
+        return self
+
+    def set_validation(self, trigger=None, dataset=None, methods=None, batch_size=None):
+        from bigdl_tpu.dataset import to_dataset
+
+        self.validation_trigger = trigger
+        self.validation_dataset = to_dataset(dataset, batch_size or self.batch_size)
+        self.validation_methods = methods
+        return self
+
+    def set_checkpoint(self, path, trigger=None):
+        from bigdl_tpu.optim.triggers import Trigger
+
+        os.makedirs(path, exist_ok=True)
+        self.checkpoint_path = path
+        self.checkpoint_trigger = trigger or Trigger.every_epoch()
+        return self
+
+    def set_train_summary(self, summary):
+        self.train_summary = summary
+        return self
+
+    def set_val_summary(self, summary):
+        self.val_summary = summary
+        return self
+
+    def set_gradient_clipping_by_l2_norm(self, clip_norm: float):
+        self._clipper.l2_norm_clip = clip_norm
+        return self
+
+    def set_constant_gradient_clipping(self, min_value: float, max_value: float):
+        self._clipper.const_clip = (min_value, max_value)
+        return self
+
+    def disable_gradient_clipping(self):
+        self._clipper.l2_norm_clip = None
+        self._clipper.const_clip = None
+        return self
+
+    # reference spellings
+    setOptimMethod = set_optim_method
+    setEndWhen = set_end_when
+    setValidation = set_validation
+    setCheckpoint = set_checkpoint
+    setTrainSummary = set_train_summary
+    setValSummary = set_val_summary
+    setGradientClippingByL2Norm = set_gradient_clipping_by_l2_norm
+    setConstantGradientClipping = set_constant_gradient_clipping
+
+    # ---- shared helpers -------------------------------------------------
+    def _checkpoint(self):
+        if not self.checkpoint_path:
+            return
+        from bigdl_tpu.utils.serializer import save_checkpoint
+
+        tag = f"{self.state['epoch']}_{self.state['neval']}"
+        save_checkpoint(
+            os.path.join(self.checkpoint_path, f"checkpoint_{tag}"),
+            self.model,
+            self.optim_method,
+            extra={"epoch": self.state["epoch"], "neval": self.state["neval"]},
+        )
+        log.info("checkpoint saved at epoch %s iter %s", self.state["epoch"],
+                 self.state["neval"])
+
+    def _run_validation(self, apply_fn=None):
+        if self.validation_dataset is None or not self.validation_methods:
+            return None
+        from bigdl_tpu.optim.evaluator import evaluate_dataset
+
+        results = evaluate_dataset(
+            self.model, self.validation_dataset, self.validation_methods
+        )
+        for method, res in zip(self.validation_methods, results):
+            value, _ = res.result()
+            log.info("validation %s: %.6f", method.name, value)
+            if self.val_summary is not None:
+                self.val_summary.add_scalar(method.name, value, self.state["neval"])
+        # first method's value is the reference's "score" for Trigger.maxScore
+        self.state["score"] = results[0].result()[0]
+        # Plateau schedule hook
+        sched = getattr(self.optim_method, "learningrate_schedule", None)
+        from bigdl_tpu.optim.optim_method import Plateau
+
+        if isinstance(sched, Plateau):
+            scale = sched.on_score(self.state["score"], self.optim_method.learningrate)
+            if self.optim_method.state is not None:
+                jnp = _jnp()
+                self.optim_method.state["lr_scale"] = jnp.asarray(scale, jnp.float32)
+        return results
+
+
+class LocalOptimizer(BaseOptimizer):
+    """Single-process trainer (reference: «bigdl»/optim/LocalOptimizer.scala).
+
+    The driver loop here is shared with DistriOptimizer (which overrides
+    ``_build_train_step``/``_init_opt_state``/``_put_batch`` to shard over
+    the mesh) — mirroring how the reference shares Trigger/checkpoint/
+    validation logic between its two optimizers.
+    """
+
+    def _loss_fn(self, unravel):
+        """Returns loss_fn: (flat_p, mstate, rng, inp, tgt) ->
+        (loss_for_grad, (reported_loss, new_mstate))."""
+        model, criterion = self.model, self.criterion
+
+        def loss_fn(flat_p, mstate, rng, inp, tgt):
+            p = unravel(flat_p)
+            out, new_mstate = model.apply(p, mstate, inp, training=True, rng=rng)
+            loss = criterion.loss(out, tgt) + model.regularization_loss(p)
+            return loss, (loss, new_mstate)
+
+        return loss_fn
+
+    def _init_opt_state(self, flat):
+        opt = self.optim_method
+        if opt.state is None:
+            opt.state = opt.init_state(flat)
+        return opt.state
+
+    def _build_train_step(self, unravel):
+        import jax
+
+        opt = self.optim_method
+        clipper = self._clipper
+        loss_fn = self._loss_fn(unravel)
+
+        @jax.jit
+        def train_step(flat_p, opt_st, mstate, rng, inp, tgt):
+            (_, (loss, new_mstate)), grad = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(flat_p, mstate, rng, inp, tgt)
+            grad = clipper(grad)
+            new_flat, new_opt = opt.step(grad, flat_p, opt_st)
+            return new_flat, new_opt, new_mstate, loss
+
+        return train_step
+
+    def _put_batch(self, inp, tgt):
+        jnp = _jnp()
+        return jnp.asarray(inp), jnp.asarray(tgt)
+
+    def optimize(self):
+        import jax
+        from jax.flatten_util import ravel_pytree
+
+        model = self.model
+        model.training()
+
+        params = model.params()
+        flat, unravel = ravel_pytree(params)
+        mod_state = model.state()
+        opt = self.optim_method
+        opt_state = self._init_opt_state(flat)
+        train_step = self._build_train_step(unravel)
+
+        base_key = jax.random.key(1234)
+        wall_start = time.time()
+        records_total = 0
+        stop = False
+        while not stop:
+            epoch = self.state["epoch"]
+            epoch_start = time.time()
+            for inp, tgt in self.dataset.data(train=True):
+                t0 = time.perf_counter()
+                rng = jax.random.fold_in(base_key, self.state["neval"])
+                inp_d, tgt_d = self._put_batch(inp, tgt)
+                flat, opt_state, mod_state, loss = train_step(
+                    flat, opt_state, mod_state, rng, inp_d, tgt_d
+                )
+                loss_val = float(loss)
+                self.metrics.add("computing time", time.perf_counter() - t0)
+                self.state["loss"] = loss_val
+                n = self.state["neval"]
+                bs = np.asarray(inp).shape[0]
+                records_total += bs
+                if self.train_summary is not None:
+                    self.train_summary.add_scalar("Loss", loss_val, n)
+                    self.train_summary.add_scalar(
+                        "Throughput", bs / max(1e-9, time.perf_counter() - t0), n
+                    )
+                if n % 20 == 0:
+                    log.info(
+                        "Epoch %d iter %d loss %.5f (%.1f records/s)",
+                        epoch, n, loss_val,
+                        records_total / max(1e-9, time.time() - wall_start),
+                    )
+                self.state["neval"] = n + 1
+                opt.state = opt_state
+                if self.validation_trigger is not None and self.validation_trigger(
+                    self.state
+                ):
+                    self._write_back(flat, unravel, mod_state)
+                    self._run_validation()
+                    model.training()
+                if self.checkpoint_trigger is not None and self.checkpoint_trigger(
+                    self.state
+                ):
+                    self._write_back(flat, unravel, mod_state)
+                    opt.state = opt_state
+                    self._checkpoint()
+                if self.end_when(self.state):
+                    stop = True
+                    break
+            else:
+                # epoch finished
+                self.state["epoch_finished"] = epoch
+                self.state["epoch"] = epoch + 1
+                opt_state = {**opt_state, "epoch": opt_state["epoch"] + 1.0}
+                log.info(
+                    "Epoch %d done in %.1fs", epoch, time.time() - epoch_start
+                )
+                if self.validation_trigger is not None and self.validation_trigger(
+                    self.state
+                ):
+                    self._write_back(flat, unravel, mod_state)
+                    self._run_validation()
+                    model.training()
+                if self.checkpoint_trigger is not None and self.checkpoint_trigger(
+                    self.state
+                ):
+                    self._write_back(flat, unravel, mod_state)
+                    opt.state = opt_state
+                    self._checkpoint()
+                if self.end_when(self.state):
+                    stop = True
+        self._write_back(flat, unravel, mod_state)
+        opt.state = opt_state
+        self.model.evaluate()
+        return self.model
+
+    def _write_back(self, flat, unravel, mod_state):
+        self.model.set_params(unravel(flat))
+        self.model.set_state(mod_state)
+
+
+def Optimizer(
+    model=None,
+    training_set=None,
+    criterion=None,
+    batch_size: int = 32,
+    training_rdd=None,
+    x=None,
+    y=None,
+    end_trigger=None,
+    optim_method=None,
+    distributed: Optional[bool] = None,
+):
+    """Factory (reference: Optimizer.apply dispatches Local vs Distri on
+    the dataset type — SURVEY.md §3.2).  Here: a DistributedDataSet or a
+    multi-device default mesh selects DistriOptimizer."""
+    import jax
+
+    from bigdl_tpu.dataset import DistributedDataSet, to_dataset
+
+    data = training_set if training_set is not None else training_rdd
+    if data is None and x is not None:
+        data = (x, y)
+    ds = to_dataset(data, batch_size)
+    if distributed is None:
+        distributed = isinstance(ds, DistributedDataSet) or len(jax.devices()) > 1
+    if distributed:
+        from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
+
+        opt = DistriOptimizer(model, ds, criterion, batch_size)
+    else:
+        opt = LocalOptimizer(model, ds, criterion, batch_size)
+    if optim_method is not None:
+        opt.set_optim_method(optim_method)
+    if end_trigger is not None:
+        opt.set_end_when(end_trigger)
+    return opt

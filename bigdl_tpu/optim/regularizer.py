@@ -1,0 +1,39 @@
+"""Regularizers.
+
+Rebuild of «bigdl»/optim/Regularizer.scala (L1L2Regularizer family).  The
+reference adds regularizer *gradients* inside each layer's
+accGradParameters; the rebuild adds the *penalty* to the jitted loss
+(identical gradients via autodiff, and XLA fuses the extra terms).
+"""
+
+from __future__ import annotations
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+class L1L2Regularizer:
+    def __init__(self, l1: float = 0.0, l2: float = 0.0):
+        self.l1, self.l2 = l1, l2
+
+    def __call__(self, param):
+        jnp = _jnp()
+        loss = 0.0
+        if self.l1:
+            loss = loss + self.l1 * jnp.sum(jnp.abs(param))
+        if self.l2:
+            loss = loss + 0.5 * self.l2 * jnp.sum(param * param)
+        return loss
+
+
+class L1Regularizer(L1L2Regularizer):
+    def __init__(self, l1: float):
+        super().__init__(l1=l1)
+
+
+class L2Regularizer(L1L2Regularizer):
+    def __init__(self, l2: float):
+        super().__init__(l2=l2)

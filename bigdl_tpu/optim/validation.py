@@ -1,0 +1,103 @@
+"""Validation methods & results.
+
+Rebuild of «bigdl»/optim/ValidationMethod.scala: Top1Accuracy,
+Top5Accuracy, Loss, MAE — each produces a monoid-like ValidationResult
+merged across batches/partitions with ``+`` (the reference folds them per
+partition, reduces on the driver; here they fold across device shards the
+same way — SURVEY.md §3.6).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class ValidationResult:
+    """(sum, count) monoid; ``result()`` -> (value, count)."""
+
+    def __init__(self, total: float, count: int, name: str = ""):
+        self.total = float(total)
+        self.count = int(count)
+        self.name = name
+
+    def result(self):
+        return (self.total / max(1, self.count), self.count)
+
+    def __add__(self, other):
+        return ValidationResult(
+            self.total + other.total, self.count + other.count, self.name
+        )
+
+    def __repr__(self):
+        v, c = self.result()
+        return f"{self.name or 'ValidationResult'}: {v:.6f} (count {c})"
+
+
+class ValidationMethod:
+    name = "ValidationMethod"
+
+    def batch_result(self, output, target) -> ValidationResult:
+        """Fold one batch: model output + target -> partial result.
+        Output/target are device or host arrays; folding happens on
+        host after the jitted forward."""
+        raise NotImplementedError
+
+    def __repr__(self):
+        return self.name
+
+
+class Top1Accuracy(ValidationMethod):
+    """«bigdl» Top1Accuracy — argmax+1 vs 1-based target."""
+
+    name = "Top1Accuracy"
+
+    def batch_result(self, output, target):
+        out = np.asarray(output)
+        t = np.asarray(target).reshape(-1).astype(np.int64)
+        pred = np.argmax(out.reshape(-1, out.shape[-1]), axis=-1) + 1
+        correct = int(np.sum(pred == t))
+        return ValidationResult(correct, t.size, self.name)
+
+
+class Top5Accuracy(ValidationMethod):
+    """«bigdl» Top5Accuracy"""
+
+    name = "Top5Accuracy"
+
+    def batch_result(self, output, target):
+        out = np.asarray(output)
+        out2 = out.reshape(-1, out.shape[-1])
+        t = np.asarray(target).reshape(-1).astype(np.int64)
+        k = min(5, out2.shape[-1])
+        top5 = np.argpartition(-out2, k - 1, axis=-1)[:, :k] + 1
+        correct = int(np.sum(np.any(top5 == t[:, None], axis=1)))
+        return ValidationResult(correct, t.size, self.name)
+
+
+class Loss(ValidationMethod):
+    """«bigdl» Loss validation method — average criterion value."""
+
+    name = "Loss"
+
+    def __init__(self, criterion=None):
+        from bigdl_tpu.nn.criterion import ClassNLLCriterion
+
+        self.criterion = criterion or ClassNLLCriterion()
+
+    def batch_result(self, output, target):
+        n = np.asarray(target).reshape(-1).shape[0]
+        val = float(np.asarray(self.criterion.loss(output, target)))
+        return ValidationResult(val * n, n, self.name)
+
+
+class MAE(ValidationMethod):
+    """«bigdl» MAE — mean absolute error for regression."""
+
+    name = "MAE"
+
+    def batch_result(self, output, target):
+        out = np.asarray(output)
+        t = np.asarray(target)
+        n = out.shape[0]
+        return ValidationResult(float(np.sum(np.abs(out - t))) / max(1, out[0].size),
+                                n, self.name)

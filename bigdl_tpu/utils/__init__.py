@@ -1,0 +1,1 @@
+"""bigdl_tpu.utils — persistence, summaries, interop."""

@@ -1,0 +1,132 @@
+"""Criterion specs (reference pattern: «test»/nn/<Criterion>Spec.scala)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from bigdl_tpu.nn import (
+    AbsCriterion, BCECriterion, BCECriterionWithLogits, ClassNLLCriterion,
+    CrossEntropyCriterion, DistKLDivCriterion, HingeEmbeddingCriterion,
+    L1Cost, MarginCriterion, MSECriterion, MultiCriterion,
+    ParallelCriterion, SmoothL1Criterion, TimeDistributedCriterion,
+)
+
+
+def test_class_nll_one_based():
+    logp = jnp.log(jnp.array([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1]]))
+    target = jnp.array([1.0, 2.0])  # 1-based
+    c = ClassNLLCriterion()
+    loss = float(c.forward(logp, target))
+    expected = -(np.log(0.7) + np.log(0.8)) / 2
+    np.testing.assert_allclose(loss, expected, rtol=1e-4)
+    grad = np.asarray(c.backward(logp, target))
+    assert grad.shape == logp.shape
+    # gradient only on the target entries, -1/N
+    np.testing.assert_allclose(grad[0, 0], -0.5, rtol=1e-4)
+    np.testing.assert_allclose(grad[0, 1], 0.0, atol=1e-8)
+
+
+def test_class_nll_weights_and_sum():
+    logp = jnp.log(jnp.array([[0.5, 0.5], [0.5, 0.5]]))
+    target = jnp.array([1.0, 2.0])
+    c = ClassNLLCriterion(weights=[1.0, 3.0], size_average=True)
+    loss = float(c.forward(logp, target))
+    # weighted mean: (1*log2 + 3*log2)/(1+3) = log2
+    np.testing.assert_allclose(loss, np.log(2), rtol=1e-6)
+
+
+def test_cross_entropy_equals_logsoftmax_nll():
+    logits = jnp.array([[1.0, 2.0, 0.5], [0.1, -1.0, 3.0]])
+    target = jnp.array([2.0, 3.0])
+    ce = float(CrossEntropyCriterion().forward(logits, target))
+    import jax
+
+    nll = float(
+        ClassNLLCriterion().forward(jax.nn.log_softmax(logits, -1), target)
+    )
+    np.testing.assert_allclose(ce, nll, rtol=1e-6)
+
+
+def test_mse_and_abs():
+    x = jnp.array([[1.0, 2.0]])
+    t = jnp.array([[0.0, 0.0]])
+    np.testing.assert_allclose(float(MSECriterion().forward(x, t)), 2.5)
+    np.testing.assert_allclose(float(AbsCriterion().forward(x, t)), 1.5)
+    np.testing.assert_allclose(
+        float(MSECriterion(size_average=False).forward(x, t)), 5.0
+    )
+
+
+def test_smooth_l1():
+    x = jnp.array([0.5, 3.0])
+    t = jnp.array([0.0, 0.0])
+    # 0.5*0.25 and 3-0.5 -> mean = (0.125 + 2.5)/2
+    np.testing.assert_allclose(
+        float(SmoothL1Criterion().forward(x, t)), (0.125 + 2.5) / 2, rtol=1e-6
+    )
+
+
+def test_bce_variants():
+    p = jnp.array([0.9, 0.1])
+    t = jnp.array([1.0, 0.0])
+    v = float(BCECriterion().forward(p, t))
+    np.testing.assert_allclose(v, -np.log(0.9), rtol=1e-4)
+    logits = jnp.log(p / (1 - p))
+    v2 = float(BCECriterionWithLogits().forward(logits, t))
+    np.testing.assert_allclose(v2, v, rtol=1e-4)
+
+
+def test_margin_and_hinge():
+    x = jnp.array([0.5, -0.5])
+    t = jnp.array([1.0, -1.0])
+    np.testing.assert_allclose(
+        float(MarginCriterion().forward(x, t)), 0.5, rtol=1e-6
+    )
+    h = HingeEmbeddingCriterion(margin=1.0)
+    np.testing.assert_allclose(
+        float(h.forward(jnp.array([0.3, 0.4]), jnp.array([1.0, -1.0]))),
+        (0.3 + 0.6) / 2, rtol=1e-6,
+    )
+
+
+def test_kl_div():
+    logq = jnp.log(jnp.array([[0.5, 0.5]]))
+    p = jnp.array([[0.25, 0.75]])
+    v = float(DistKLDivCriterion().forward(logq, p))
+    expected = (0.25 * np.log(0.25 / 0.5) + 0.75 * np.log(0.75 / 0.5)) / 2
+    np.testing.assert_allclose(v, expected, rtol=1e-3)
+
+
+def test_l1cost():
+    np.testing.assert_allclose(
+        float(L1Cost().forward(jnp.array([-1.0, 2.0]), None)), 3.0
+    )
+
+
+def test_multi_criterion():
+    x = jnp.array([[0.0, 1.0]])
+    t = jnp.array([[1.0, 1.0]])
+    mc = MultiCriterion().add(MSECriterion(), 0.5).add(AbsCriterion(), 2.0)
+    v = float(mc.forward(x, t))
+    np.testing.assert_allclose(v, 0.5 * 0.5 + 2.0 * 0.5, rtol=1e-6)
+
+
+def test_parallel_criterion():
+    pc = ParallelCriterion().add(MSECriterion(), 1.0).add(AbsCriterion(), 1.0)
+    inp = (jnp.array([1.0]), jnp.array([2.0]))
+    tgt = (jnp.array([0.0]), jnp.array([0.0]))
+    np.testing.assert_allclose(float(pc.forward(inp, tgt)), 1.0 + 2.0)
+    g = pc.backward(inp, tgt)
+    assert len(g) == 2
+
+
+def test_time_distributed_criterion():
+    # (batch=2, time=3, classes=2) log-probs
+    logp = jnp.log(jnp.full((2, 3, 2), 0.5))
+    target = jnp.ones((2, 3))
+    inner = ClassNLLCriterion(size_average=True)
+    c = TimeDistributedCriterion(inner, size_average=True)
+    v = float(c.forward(logp, target))
+    np.testing.assert_allclose(v, np.log(2), rtol=1e-6)
+    c2 = TimeDistributedCriterion(inner, size_average=False)
+    np.testing.assert_allclose(float(c2.forward(logp, target)), 3 * np.log(2),
+                               rtol=1e-6)

@@ -1,0 +1,137 @@
+"""DistriOptimizer specs — the real sharded step on 8 virtual devices.
+
+Mirrors the reference's DistriOptimizerSpec / AllReduceParameterSpec run
+on a local[4] Spark master (SURVEY.md §4.5): the REAL collective path
+(psum_scatter + owner update + all_gather via shard_map), no mocks.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.dataset import ArrayDataSet, DistributedDataSet
+from bigdl_tpu.engine import Engine
+from bigdl_tpu.nn import ClassNLLCriterion, Linear, LogSoftMax, ReLU, Sequential
+from bigdl_tpu.optim import (
+    DistriOptimizer, LocalOptimizer, Optimizer, SGD, Top1Accuracy, Trigger,
+)
+from bigdl_tpu.optim.evaluator import evaluate_dataset
+
+
+@pytest.fixture(autouse=True)
+def _engine():
+    Engine.reset()
+    Engine.init()
+    yield
+    Engine.reset()
+
+
+def _toy(n=512, d=16, k=4, seed=0):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(d, k)
+    x = rng.randn(n, d).astype(np.float32)
+    y = (np.argmax(x @ w, axis=1) + 1).astype(np.float32)
+    return x, y
+
+
+def _model(d=16, k=4):
+    return Sequential().add(Linear(d, 32)).add(ReLU()).add(Linear(32, k)) \
+        .add(LogSoftMax())
+
+
+def test_mesh_has_8_devices():
+    assert Engine.mesh().shape["data"] == 8
+
+
+def test_distri_optimizer_converges():
+    x, y = _toy()
+    model = _model()
+    opt = DistriOptimizer(model, (x, y), ClassNLLCriterion(), batch_size=64)
+    opt.set_optim_method(SGD(learningrate=0.5))
+    opt.set_end_when(Trigger.max_epoch(10))
+    trained = opt.optimize()
+    ds = ArrayDataSet(x, y, 64)
+    (acc,) = evaluate_dataset(trained, ds, [Top1Accuracy()])
+    value, _ = acc.result()
+    assert value > 0.9, f"accuracy {value}"
+
+
+def test_distri_matches_local_single_step():
+    """ZeRO-1 sharded update must equal the local update exactly
+    (modulo float assoc): same batch, same init, one step, compare
+    weights — the reference's semantics-parity requirement
+    (SURVEY.md §7 hard part 2)."""
+    from bigdl_tpu.common import RandomGenerator
+
+    x, y = _toy(64)
+    RandomGenerator.RNG.set_seed(7)
+    m1 = _model()
+    RandomGenerator.RNG.set_seed(7)
+    m2 = _model()
+    for a, b in zip(m1.get_weights(), m2.get_weights()):
+        np.testing.assert_allclose(a, b)
+
+    ds = ArrayDataSet(x, y, 64, shuffle=False)
+    lo = LocalOptimizer(m1, ds, ClassNLLCriterion(), batch_size=64)
+    lo.set_optim_method(SGD(learningrate=0.1))
+    lo.set_end_when(Trigger.max_iteration(1))
+    lo.optimize()
+
+    ds2 = ArrayDataSet(x, y, 64, shuffle=False)
+    do = DistriOptimizer(m2, ds2, ClassNLLCriterion(), batch_size=64,
+                         wire_dtype="none")
+    do.set_optim_method(SGD(learningrate=0.1))
+    do.set_end_when(Trigger.max_iteration(1))
+    do.optimize()
+
+    for a, b in zip(m1.get_weights(), m2.get_weights()):
+        np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5)
+
+
+def test_distri_bf16_wire_still_converges():
+    x, y = _toy(256)
+    model = _model()
+    opt = DistriOptimizer(model, (x, y), ClassNLLCriterion(), batch_size=64,
+                          wire_dtype="bfloat16")
+    opt.set_optim_method(SGD(learningrate=0.5))
+    opt.set_end_when(Trigger.max_epoch(8))
+    trained = opt.optimize()
+    ds = ArrayDataSet(x, y, 64)
+    (acc,) = evaluate_dataset(trained, ds, [Top1Accuracy()])
+    assert acc.result()[0] > 0.85
+
+
+def test_distri_gradient_clipping():
+    x, y = _toy(128)
+    model = _model()
+    opt = DistriOptimizer(model, (x, y), ClassNLLCriterion(), batch_size=64)
+    opt.set_optim_method(SGD(learningrate=0.5))
+    opt.set_gradient_clipping_by_l2_norm(0.1)
+    opt.set_end_when(Trigger.max_epoch(2))
+    opt.optimize()  # just exercises the psum-based global-norm path
+
+
+def test_optimizer_factory_dispatches_distributed():
+    x, y = _toy(64)
+    model = _model()
+    ds = DistributedDataSet(x, y, 32)
+    opt = Optimizer(model=model, training_set=ds,
+                    criterion=ClassNLLCriterion(), batch_size=32)
+    assert isinstance(opt, DistriOptimizer)
+
+
+def test_distri_momentum_state_sharded():
+    """Optimizer state must live sharded over the mesh (ZeRO-1) — check
+    the velocity buffer's sharding spec."""
+    x, y = _toy(64)
+    model = _model()
+    opt = DistriOptimizer(model, (x, y), ClassNLLCriterion(), batch_size=64)
+    opt.set_optim_method(SGD(learningrate=0.1, momentum=0.9))
+    opt.set_end_when(Trigger.max_iteration(2))
+    opt.optimize()
+    vel = opt.optim_method.state["velocity"]
+    sharding = vel.sharding
+    spec = sharding.spec
+    assert spec[0] == "data", f"velocity not sharded: {spec}"

@@ -1,0 +1,128 @@
+"""Gradient checking — numeric vs. analytic.
+
+Mirrors the reference's perturbation-based GradientChecker (SURVEY.md
+§4.2) that guards hand-written backwards.  Here backwards come from
+``jax.vjp``, so this suite instead guards the *module contract*: that
+``backward`` (vjp of the pure apply) matches finite differences through
+``forward``, including layers with custom vjps (GradientReversal,
+L1Penalty) and table inputs.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from bigdl_tpu.nn import (
+    BatchNormalization, Bilinear, CAddTable, GradientReversal, L1Penalty,
+    Linear, LogSoftMax, ReLU, Sequential, Sigmoid, SpatialConvolution,
+    SpatialMaxPooling, Tanh,
+)
+
+
+def numeric_grad(f, x, eps=1e-3):
+    x = np.asarray(x, np.float64)
+    g = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        i = it.multi_index
+        xp = x.copy(); xp[i] += eps
+        xm = x.copy(); xm[i] -= eps
+        g[i] = (f(xp) - f(xm)) / (2 * eps)
+        it.iternext()
+    return g
+
+
+@pytest.mark.parametrize("layer_fn", [
+    lambda: Linear(4, 3),
+    lambda: Sequential().add(Linear(4, 5)).add(Tanh()).add(Linear(5, 2)),
+    lambda: Sigmoid(),
+    lambda: LogSoftMax(),
+])
+def test_input_gradients_match_numeric(layer_fn):
+    m = layer_fn()
+    x = np.random.RandomState(0).randn(2, 4).astype(np.float32)
+
+    def scalar_out(xv):
+        out = m.apply(m.params(), m.state(), jnp.asarray(xv, jnp.float32),
+                      training=False)[0]
+        return float(jnp.sum(out * out))
+
+    xj = jnp.asarray(x)
+    out, _ = m.apply(m.params(), m.state(), xj, training=False)
+    m.is_training = False
+    m.forward(xj)
+    grad_in = m.backward(xj, 2 * out)
+    num = numeric_grad(scalar_out, x)
+    np.testing.assert_allclose(np.asarray(grad_in), num, rtol=1e-2, atol=1e-3)
+
+
+def test_conv_param_gradients_match_numeric():
+    m = SpatialConvolution(1, 2, 3, 3)
+    x = jnp.asarray(np.random.RandomState(1).randn(1, 1, 5, 5), jnp.float32)
+    m.zero_grad_parameters()
+    m.is_training = False
+    out = m.forward(x)
+    m.backward(x, 2 * out)
+    gw = np.asarray(m._grad_params["weight"])
+
+    w0 = np.asarray(m.weight)
+
+    def loss_at(wv):
+        p = {"weight": jnp.asarray(wv, jnp.float32), "bias": m.bias}
+        out = m.apply(p, {}, x, training=False)[0]
+        return float(jnp.sum(out * out))
+
+    num = numeric_grad(loss_at, w0)
+    np.testing.assert_allclose(gw, num, rtol=1e-2, atol=1e-2)
+
+
+def test_gradient_reversal():
+    m = GradientReversal(0.5)
+    x = jnp.array([1.0, 2.0])
+    m.forward(x)
+    g = m.backward(x, jnp.array([1.0, 1.0]))
+    np.testing.assert_allclose(np.asarray(g), [-0.5, -0.5])
+
+
+def test_l1_penalty_gradient():
+    m = L1Penalty(0.1)
+    x = jnp.array([2.0, -3.0])
+    out = m.forward(x)
+    np.testing.assert_allclose(np.asarray(out), [2.0, -3.0])
+    g = m.backward(x, jnp.array([1.0, 1.0]))
+    np.testing.assert_allclose(np.asarray(g), [1.1, 0.9], rtol=1e-6)
+
+
+def test_table_input_gradients():
+    m = CAddTable()
+    a = jnp.array([1.0, 2.0])
+    b = jnp.array([3.0, 4.0])
+    m.forward((a, b))
+    ga, gb = m.backward((a, b), jnp.array([1.0, 1.0]))
+    np.testing.assert_allclose(np.asarray(ga), [1, 1])
+    np.testing.assert_allclose(np.asarray(gb), [1, 1])
+
+    bl = Bilinear(3, 4, 2)
+    x1 = jnp.asarray(np.random.RandomState(0).randn(2, 3), jnp.float32)
+    x2 = jnp.asarray(np.random.RandomState(1).randn(2, 4), jnp.float32)
+    bl.forward((x1, x2))
+    g1, g2 = bl.backward((x1, x2), jnp.ones((2, 2)))
+    assert g1.shape == (2, 3) and g2.shape == (2, 4)
+
+
+def test_standalone_update_grad_input_vs_acc_grad():
+    """Reference users call updateGradInput / accGradParameters
+    separately (SURVEY.md §7 hard part 1)."""
+    m = Linear(3, 2)
+    x = jnp.ones((4, 3))
+    m.forward(x)
+    gi = m.update_grad_input(x, jnp.ones((4, 2)))
+    assert gi.shape == (4, 3)
+    m.zero_grad_parameters()
+    m.acc_grad_parameters(x, jnp.ones((4, 2)))
+    gw = m._grad_params["weight"]
+    np.testing.assert_allclose(np.asarray(gw), 4.0)  # sum over batch of x=1
+    # acc accumulates
+    m.acc_grad_parameters(x, jnp.ones((4, 2)))
+    np.testing.assert_allclose(np.asarray(m._grad_params["weight"]), 8.0)

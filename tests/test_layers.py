@@ -1,0 +1,258 @@
+"""Per-layer unit specs.
+
+Mirrors the reference's «test»/nn/<Layer>Spec.scala pattern (SURVEY.md
+§4.1): fixed seed, small hand-sized tensors, assert forward values (and
+backward via the gradcheck suite in test_gradcheck.py).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from bigdl_tpu.nn import (
+    Abs, AddConstant, BatchNormalization, CAdd, CMul, Dropout, ELU, HardTanh,
+    Identity, LeakyReLU, Linear, LogSoftMax, LookupTable, MulConstant,
+    Narrow, Normalize, PReLU, ReLU, ReLU6, Reshape, Select, Sequential,
+    Sigmoid, SoftMax, SoftMin, SoftPlus, SoftSign, SpatialAveragePooling,
+    SpatialBatchNormalization, SpatialConvolution, SpatialCrossMapLRN,
+    SpatialDilatedConvolution, SpatialFullConvolution, SpatialMaxPooling,
+    SpatialZeroPadding, Squeeze, Sum, Tanh, TemporalConvolution, Threshold,
+    Transpose, Unsqueeze, View,
+)
+
+
+def test_linear_forward():
+    m = Linear(3, 2, init_weight=np.array([[1., 2., 3.], [4., 5., 6.]]),
+               init_bias=np.array([0.5, -0.5]))
+    x = jnp.array([[1., 1., 1.]])
+    out = m.forward(x)
+    np.testing.assert_allclose(np.asarray(out), [[6.5, 14.5]], rtol=1e-6)
+
+
+def test_linear_shapes_and_grad_api():
+    m = Linear(4, 3)
+    x = jnp.ones((5, 4))
+    out = m.forward(x)
+    assert out.shape == (5, 3)
+    m.zero_grad_parameters()
+    grad_in = m.backward(x, jnp.ones((5, 3)))
+    assert grad_in.shape == (5, 4)
+    w, g = m.parameters()
+    assert len(w) == len(g) == 2
+
+
+def test_relu_family():
+    x = jnp.array([[-1.0, 0.0, 2.0, 7.0]])
+    np.testing.assert_allclose(np.asarray(ReLU().forward(x)), [[0, 0, 2, 7]])
+    np.testing.assert_allclose(np.asarray(ReLU6().forward(x)), [[0, 0, 2, 6]])
+    np.testing.assert_allclose(
+        np.asarray(LeakyReLU(0.1).forward(x)), [[-0.1, 0, 2, 7]], rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(HardTanh().forward(x)), [[-1, 0, 1, 1]]
+    )
+    np.testing.assert_allclose(
+        np.asarray(Threshold(1.0, -5.0).forward(x)), [[-5, -5, 2, 7]]
+    )
+
+
+def test_softmax_logsoftmax():
+    x = jnp.array([[1.0, 2.0, 3.0]])
+    sm = np.asarray(SoftMax().forward(x))
+    np.testing.assert_allclose(sm.sum(), 1.0, rtol=1e-5)
+    ls = np.asarray(LogSoftMax().forward(x))
+    np.testing.assert_allclose(np.exp(ls), sm, rtol=1e-4)
+    smin = np.asarray(SoftMin().forward(x))
+    np.testing.assert_allclose(smin, sm[:, ::-1], rtol=1e-4)
+
+
+def test_elementwise_misc():
+    x = jnp.array([[-2.0, 4.0]])
+    np.testing.assert_allclose(np.asarray(Abs().forward(x)), [[2, 4]])
+    np.testing.assert_allclose(np.asarray(AddConstant(1.0).forward(x)), [[-1, 5]])
+    np.testing.assert_allclose(np.asarray(MulConstant(2.0).forward(x)), [[-4, 8]])
+    np.testing.assert_allclose(
+        np.asarray(SoftSign().forward(x)), [[-2 / 3, 4 / 5]], rtol=1e-6
+    )
+    sp = np.asarray(SoftPlus().forward(x))
+    np.testing.assert_allclose(sp, np.log1p(np.exp([[-2.0, 4.0]])), rtol=1e-4)
+
+
+def test_spatial_convolution_known_values():
+    # 1x1x3x3 input, 1 output plane, 2x2 kernel of ones -> sums of windows
+    m = SpatialConvolution(1, 1, 2, 2, with_bias=True)
+    m.set_weights([np.ones((1, 1, 2, 2), np.float32), np.zeros(1, np.float32)])
+    x = jnp.arange(9.0).reshape(1, 1, 3, 3)
+    out = np.asarray(m.forward(x))
+    expected = np.array([[[[8.0, 12.0], [20.0, 24.0]]]])
+    np.testing.assert_allclose(out, expected)
+
+
+def test_spatial_convolution_same_padding_and_stride():
+    m = SpatialConvolution(2, 3, 3, 3, 2, 2, -1, -1)
+    x = jnp.ones((2, 2, 8, 8))
+    assert m.forward(x).shape == (2, 3, 4, 4)
+
+
+def test_spatial_convolution_groups():
+    m = SpatialConvolution(4, 4, 3, 3, 1, 1, 1, 1, n_group=2)
+    x = jnp.ones((1, 4, 5, 5))
+    assert m.forward(x).shape == (1, 4, 5, 5)
+
+
+def test_dilated_and_full_convolution_shapes():
+    d = SpatialDilatedConvolution(2, 3, 3, 3, 1, 1, 2, 2, 2, 2)
+    assert d.forward(jnp.ones((1, 2, 9, 9))).shape == (1, 3, 9, 9)
+    f = SpatialFullConvolution(3, 2, 4, 4, 2, 2, 1, 1)
+    # out = (in-1)*2 - 2 + 4 = 2*in
+    assert f.forward(jnp.ones((1, 3, 5, 5))).shape == (1, 2, 10, 10)
+
+
+def test_temporal_convolution():
+    m = TemporalConvolution(4, 6, 3)
+    out = m.forward(jnp.ones((2, 10, 4)))
+    assert out.shape == (2, 8, 6)
+
+
+def test_max_pooling_values_and_ceil():
+    m = SpatialMaxPooling(2, 2, 2, 2)
+    x = jnp.arange(16.0).reshape(1, 1, 4, 4)
+    out = np.asarray(m.forward(x))
+    np.testing.assert_allclose(out, [[[[5, 7], [13, 15]]]])
+    # 5x5 with ceil -> 3x3; floor -> 2x2
+    x5 = jnp.arange(25.0).reshape(1, 1, 5, 5)
+    assert SpatialMaxPooling(2, 2, 2, 2).forward(x5).shape == (1, 1, 2, 2)
+    assert SpatialMaxPooling(2, 2, 2, 2).ceil().forward(x5).shape == (1, 1, 3, 3)
+
+
+def test_avg_pooling_count_include_pad():
+    x = jnp.ones((1, 1, 4, 4))
+    m = SpatialAveragePooling(3, 3, 2, 2, 1, 1)
+    out = np.asarray(m.forward(x))
+    # corner window covers 4 real cells of 9 -> 4/9 with countIncludePad
+    np.testing.assert_allclose(out[0, 0, 0, 0], 4.0 / 9.0, rtol=1e-6)
+    m2 = SpatialAveragePooling(3, 3, 2, 2, 1, 1, count_include_pad=False)
+    np.testing.assert_allclose(np.asarray(m2.forward(x))[0, 0, 0, 0], 1.0, rtol=1e-6)
+
+
+def test_batchnorm_train_and_eval():
+    m = BatchNormalization(3)
+    x = jnp.asarray(np.random.RandomState(0).randn(16, 3).astype(np.float32) * 3 + 1)
+    m.training()
+    out = np.asarray(m.forward(x))
+    np.testing.assert_allclose(out.mean(axis=0), 0.0, atol=1e-5)
+    np.testing.assert_allclose(out.std(axis=0), 1.0, atol=1e-2)
+    # running stats moved toward batch stats
+    assert not np.allclose(np.asarray(m.running_mean), 0.0)
+    m.evaluate()
+    out_eval = m.forward(x)
+    assert out_eval.shape == x.shape
+
+
+def test_spatial_batchnorm():
+    m = SpatialBatchNormalization(4)
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 4, 5, 5).astype(np.float32))
+    out = np.asarray(m.forward(x))
+    np.testing.assert_allclose(out.mean(axis=(0, 2, 3)), 0.0, atol=1e-5)
+
+
+def test_dropout_train_eval():
+    m = Dropout(0.5)
+    x = jnp.ones((4, 100))
+    m.training()
+    out = np.asarray(m.forward(x))
+    zeros = (out == 0).mean()
+    assert 0.2 < zeros < 0.8
+    kept = out[out != 0]
+    np.testing.assert_allclose(kept, 2.0, rtol=1e-6)  # inverted scaling
+    m.evaluate()
+    np.testing.assert_allclose(np.asarray(m.forward(x)), 1.0)
+
+
+def test_lookup_table_one_based():
+    m = LookupTable(5, 3)
+    w = np.arange(15.0).reshape(5, 3).astype(np.float32)
+    m.set_weights([w])
+    idx = jnp.array([[1.0, 5.0]])
+    out = np.asarray(m.forward(idx))
+    np.testing.assert_allclose(out[0, 0], w[0])
+    np.testing.assert_allclose(out[0, 1], w[4])
+
+
+def test_shape_ops():
+    x = jnp.arange(24.0).reshape(2, 3, 4)
+    assert Reshape([12]).forward(x).shape == (2, 12)
+    assert Reshape([3, 4]).forward(jnp.arange(12.0)).shape == (3, 4)
+    assert View(-1, 6).forward(x).shape == (4, 6)
+    assert Squeeze(2).forward(jnp.ones((2, 1, 4))).shape == (2, 4)
+    assert Unsqueeze(2).forward(jnp.ones((2, 4))).shape == (2, 1, 4)
+    assert Transpose([(1, 2)]).forward(x).shape == (3, 2, 4)
+    assert Select(2, -1).forward(x).shape == (2, 4)
+    np.testing.assert_allclose(
+        np.asarray(Select(2, 1).forward(x)), np.asarray(x)[:, 0]
+    )
+    assert Narrow(2, 2, 2).forward(x).shape == (2, 2, 4)
+    assert Sum(2).forward(x).shape == (2, 4)
+    assert SpatialZeroPadding(1).forward(jnp.ones((1, 1, 3, 3))).shape == (1, 1, 5, 5)
+
+
+def test_learnable_elementwise():
+    c = CMul([3])
+    c.set_weights([np.array([1.0, 2.0, 3.0], np.float32)])
+    np.testing.assert_allclose(
+        np.asarray(c.forward(jnp.ones((2, 3)))), [[1, 2, 3], [1, 2, 3]]
+    )
+    a = CAdd([3])
+    a.set_weights([np.array([1.0, -1.0, 0.0], np.float32)])
+    np.testing.assert_allclose(
+        np.asarray(a.forward(jnp.zeros((1, 3)))), [[1, -1, 0]]
+    )
+
+
+def test_prelu():
+    m = PReLU()
+    x = jnp.array([[-4.0, 4.0]])
+    np.testing.assert_allclose(np.asarray(m.forward(x)), [[-1.0, 4.0]])
+
+
+def test_lrn_shape():
+    m = SpatialCrossMapLRN(5, 1.0, 0.75, 1.0)
+    assert m.forward(jnp.ones((2, 8, 4, 4))).shape == (2, 8, 4, 4)
+
+
+def test_normalize():
+    m = Normalize(2.0)
+    x = jnp.array([[3.0, 4.0]])
+    np.testing.assert_allclose(
+        np.asarray(m.forward(x)), [[0.6, 0.8]], rtol=1e-5
+    )
+
+
+def test_sequential_and_find():
+    model = Sequential().add(Linear(4, 8).set_name("l1")).add(ReLU()) \
+        .add(Linear(8, 2).set_name("l2"))
+    out = model.forward(jnp.ones((3, 4)))
+    assert out.shape == (3, 2)
+    assert model.find_module("l2") is model.modules[2]
+    # params pytree shape
+    p = model.params()
+    assert set(p.keys()) == {"0", "1", "2"}
+    assert "weight" in p["0"]
+
+
+def test_get_set_weights_roundtrip():
+    m = Sequential().add(Linear(3, 4)).add(Linear(4, 2))
+    w = m.get_weights()
+    w2 = [np.ones_like(a) for a in w]
+    m.set_weights(w2)
+    for a, b in zip(m.get_weights(), w2):
+        np.testing.assert_allclose(a, b)
+
+
+def test_identity_and_training_mode_propagation():
+    m = Sequential().add(Identity()).add(Dropout(0.9))
+    m.evaluate()
+    assert not m.modules[1].is_training
+    m.training()
+    assert m.modules[1].is_training

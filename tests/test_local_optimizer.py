@@ -1,0 +1,101 @@
+"""LocalOptimizer end-to-end + convergence smoke (SURVEY.md §4.6:
+LeNet on a small MNIST subset reaching an accuracy threshold)."""
+
+import numpy as np
+
+from bigdl_tpu.dataset import ArrayDataSet
+from bigdl_tpu.dataset.mnist import load_mnist, normalize
+from bigdl_tpu.models.lenet import build_lenet5
+from bigdl_tpu.nn import ClassNLLCriterion, Linear, LogSoftMax, Sequential
+from bigdl_tpu.optim import (
+    Loss, LocalOptimizer, Optimizer, SGD, Top1Accuracy, Trigger,
+)
+from bigdl_tpu.optim.evaluator import evaluate_dataset, predict_class
+
+
+def _toy_classification(n=256, d=8, k=3, seed=0):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(d, k)
+    x = rng.randn(n, d).astype(np.float32)
+    y = (np.argmax(x @ w, axis=1) + 1).astype(np.float32)
+    return x, y
+
+
+def test_local_optimizer_linear_converges():
+    x, y = _toy_classification()
+    model = Sequential().add(Linear(8, 3)).add(LogSoftMax())
+    opt = LocalOptimizer(model, (x, y), ClassNLLCriterion(), batch_size=32)
+    opt.set_optim_method(SGD(learningrate=0.5))
+    opt.set_end_when(Trigger.max_epoch(15))
+    trained = opt.optimize()
+    ds = ArrayDataSet(x, y, 32)
+    (acc,) = evaluate_dataset(trained, ds, [Top1Accuracy()])
+    value, count = acc.result()
+    assert count == 256
+    assert value > 0.9, f"accuracy {value}"
+
+
+def test_lenet_mnist_smoke():
+    x, y = load_mnist(None, "train", synthetic_n=512)
+    model = build_lenet5()
+    opt = LocalOptimizer(model, (normalize(x), y), ClassNLLCriterion(),
+                         batch_size=64)
+    opt.set_optim_method(SGD(learningrate=0.1))
+    opt.set_end_when(Trigger.max_epoch(3))
+    trained = opt.optimize()
+    ds = ArrayDataSet(normalize(x), y, 64)
+    (acc,) = evaluate_dataset(trained, ds, [Top1Accuracy()])
+    value, _ = acc.result()
+    assert value > 0.8, f"train accuracy {value}"
+
+
+def test_optimizer_factory_dispatch():
+    import jax
+
+    x, y = _toy_classification(64)
+    model = Sequential().add(Linear(8, 3)).add(LogSoftMax())
+    opt = Optimizer(model=model, training_set=(x, y),
+                    criterion=ClassNLLCriterion(), batch_size=16,
+                    distributed=False)
+    assert isinstance(opt, LocalOptimizer)
+
+
+def test_validation_and_loss_metric():
+    x, y = _toy_classification(128)
+    model = Sequential().add(Linear(8, 3)).add(LogSoftMax())
+    opt = LocalOptimizer(model, (x, y), ClassNLLCriterion(), batch_size=32)
+    opt.set_optim_method(SGD(learningrate=0.5))
+    opt.set_end_when(Trigger.max_epoch(5))
+    opt.set_validation(trigger=Trigger.every_epoch(), dataset=(x, y),
+                       methods=[Top1Accuracy(), Loss()])
+    opt.optimize()
+    assert opt.state["score"] is not None
+
+
+def test_predict_class():
+    x, y = _toy_classification(64)
+    model = Sequential().add(Linear(8, 3)).add(LogSoftMax())
+    preds = predict_class(model, x, batch_size=16)
+    assert preds.shape == (64,)
+    assert preds.min() >= 1 and preds.max() <= 3
+
+
+def test_checkpoint_and_resume(tmp_path):
+    from bigdl_tpu.utils.serializer import load_latest_checkpoint
+
+    x, y = _toy_classification(64)
+    model = Sequential().add(Linear(8, 3)).add(LogSoftMax())
+    opt = LocalOptimizer(model, (x, y), ClassNLLCriterion(), batch_size=32)
+    opt.set_optim_method(SGD(learningrate=0.5, momentum=0.9))
+    opt.set_end_when(Trigger.max_epoch(2))
+    opt.set_checkpoint(str(tmp_path))
+    opt.optimize()
+    files = list(tmp_path.iterdir())
+    assert any(f.name.endswith(".model.npz") for f in files)
+    # resume into a fresh model/optim
+    model2 = Sequential().add(Linear(8, 3)).add(LogSoftMax())
+    optim2 = SGD(learningrate=0.5, momentum=0.9)
+    extra = load_latest_checkpoint(str(tmp_path), model2, optim2)
+    np.testing.assert_allclose(model2.get_weights()[0], model.get_weights()[0])
+    assert optim2.state is not None
+    assert "epoch" in extra

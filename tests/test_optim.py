@@ -1,0 +1,138 @@
+"""OptimMethod + Trigger specs (reference: «test»/optim/*Spec.scala)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from bigdl_tpu.optim import (
+    Adam, Adagrad, Adadelta, Adamax, Default, Ftrl, MultiStep, Poly,
+    RMSprop, SGD, Step, Trigger,
+)
+
+
+def rosenbrock_feval(x):
+    import jax
+
+    def f(v):
+        return jnp.sum(100.0 * (v[1:] - v[:-1] ** 2) ** 2 + (1 - v[:-1]) ** 2)
+
+    return float(f(x)), jax.grad(f)(x)
+
+
+def quadratic_feval(x):
+    # f = 0.5 ||x - 1||^2
+    return float(0.5 * jnp.sum((x - 1.0) ** 2)), x - 1.0
+
+
+def _run(method, feval=quadratic_feval, steps=200, dim=4):
+    x = jnp.zeros(dim)
+    losses = []
+    for _ in range(steps):
+        x, (l,) = method.optimize(feval, x)
+        losses.append(l)
+    return x, losses
+
+
+def test_sgd_converges_on_quadratic():
+    x, losses = _run(SGD(learningrate=0.1))
+    assert losses[-1] < 1e-3 * losses[0] + 1e-6
+    np.testing.assert_allclose(np.asarray(x), 1.0, atol=1e-2)
+
+
+def test_sgd_momentum_nesterov():
+    x, losses = _run(SGD(learningrate=0.05, momentum=0.9, dampening=0.0,
+                         nesterov=True))
+    assert losses[-1] < 1e-4
+
+
+def test_sgd_weight_decay_shrinks():
+    m = SGD(learningrate=0.1, weightdecay=1.0)
+    x = jnp.ones(3) * 10.0
+    for _ in range(50):
+        x, _ = m.optimize(lambda v: (0.0, jnp.zeros_like(v)), x)
+    assert float(jnp.max(jnp.abs(x))) < 1.0  # pure decay pulls toward 0
+
+
+def test_adam_rosenbrock():
+    x, losses = _run(Adam(learningrate=0.05), rosenbrock_feval, steps=800)
+    assert losses[-1] < losses[0] * 0.05
+
+
+def test_other_methods_converge():
+    for method, steps, factor in [
+        (Adagrad(learningrate=0.5), 300, 0.05),
+        # Adadelta bootstraps its step size from eps=1e-10: correct but
+        # slow on a bare quadratic — just require steady progress
+        (Adadelta(decayrate=0.9), 2000, 0.7),
+        (Adamax(learningrate=0.1), 300, 0.05),
+        (RMSprop(learningrate=0.05), 300, 0.05),
+    ]:
+        x, losses = _run(method, steps=steps)
+        assert losses[-1] < losses[0] * factor, type(method).__name__
+
+
+def test_ftrl_sparsifies():
+    m = Ftrl(learningrate=0.5, l1_regularization_strength=2.0)
+    x = jnp.zeros(2)
+    # tiny gradients: l1 should keep weights at exactly 0
+    for _ in range(10):
+        x, _ = m.optimize(lambda v: (0.0, jnp.full_like(v, 0.01)), x)
+    np.testing.assert_allclose(np.asarray(x), 0.0)
+
+
+def test_lr_schedules():
+    state = {"neval": jnp.asarray(10.0), "epoch": jnp.asarray(0.0),
+             "lr_decay": jnp.asarray(0.1), "lr_scale": jnp.asarray(1.0)}
+    np.testing.assert_allclose(float(Default().rate(1.0, state)), 1.0 / 2.0)
+    np.testing.assert_allclose(
+        float(Poly(2.0, 100).rate(1.0, state)), (1 - 0.1) ** 2, rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        float(Step(4, 0.5).rate(1.0, state)), 0.5 ** 2, rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        float(MultiStep([5, 8, 20], 0.1).rate(1.0, state)), 0.01, rtol=1e-6
+    )
+
+
+def test_sgd_with_schedule_decays_during_optimization():
+    m = SGD(learningrate=1.0, learningrate_schedule=Step(10, 0.1))
+    x = jnp.zeros(1)
+    for i in range(25):
+        x, _ = m.optimize(lambda v: (0.0, jnp.ones_like(v)), x)
+    # steps 0-9 at lr 1, 10-19 at 0.1, 20-24 at 0.01
+    expected = -(10 * 1.0 + 10 * 0.1 + 5 * 0.01)
+    np.testing.assert_allclose(float(x[0]), expected, rtol=1e-5)
+
+
+def test_triggers():
+    t = Trigger.max_epoch(3)
+    assert not t({"epoch": 3})
+    assert t({"epoch": 4})
+    # neval is the *next* iteration number: after 10 completed steps
+    # neval == 11, which is when maxIteration(10) must fire
+    t2 = Trigger.max_iteration(10)
+    assert t2({"neval": 11}) and not t2({"neval": 10})
+    t3 = Trigger.several_iteration(5)
+    assert t3({"neval": 6}) and not t3({"neval": 5}) and not t3({"neval": 1})
+    t4 = Trigger.every_epoch()
+    assert t4({"epoch_finished": 1})
+    assert not t4({"epoch_finished": 1})  # fires once per new epoch
+    assert t4({"epoch_finished": 2})
+    t5 = Trigger.min_loss(0.1)
+    assert t5({"loss": 0.05}) and not t5({"loss": 0.5})
+    t6 = Trigger.and_(Trigger.max_epoch(1), Trigger.min_loss(1.0))
+    assert t6({"epoch": 2, "loss": 0.5})
+
+
+def test_optim_state_save_load(tmp_path):
+    m = SGD(learningrate=0.1, momentum=0.9)
+    x = jnp.zeros(3)
+    for _ in range(5):
+        x, _ = m.optimize(quadratic_feval, x)
+    arrays = m.get_state_arrays()
+    m2 = SGD(learningrate=0.1, momentum=0.9)
+    m2.load_state_arrays(arrays)
+    np.testing.assert_allclose(
+        np.asarray(m2.state["velocity"]), np.asarray(m.state["velocity"])
+    )
+    np.testing.assert_allclose(float(m2.state["neval"]), 5.0)
