@@ -1,0 +1,14 @@
+"""bigdl_tpu.dlframes — DataFrame ML-pipeline API.
+
+Rebuild of ⟦spark/dl/src/main/scala/org/apache/spark/ml/DLEstimator.scala⟧
+(DLEstimator / DLClassifier / DLModel — SURVEY.md §3.5).
+"""
+
+from bigdl_tpu.dlframes.dl_estimator import (
+    DLClassifier,
+    DLClassifierModel,
+    DLEstimator,
+    DLModel,
+)
+
+__all__ = ["DLEstimator", "DLClassifier", "DLModel", "DLClassifierModel"]

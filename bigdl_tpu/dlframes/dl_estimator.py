@@ -1,0 +1,214 @@
+"""DLEstimator / DLClassifier / DLModel.
+
+Rebuild of ⟦spark/dl/src/main/scala/org/apache/spark/ml/DLEstimator.scala⟧
+and DLClassifier.scala (SURVEY.md §3.5):
+
+    DLEstimator.fit(df):  validate schema -> rows to Samples
+                          (featureSize/labelSize reshape) -> full
+                          Optimizer path -> DLModel
+    DLModel.transform(df): batched model.forward -> prediction column
+    DLClassifier: ClassNLLCriterion convention (1-based labels),
+                  argmax in transform
+
+DataFrame backends: a pyspark DataFrame when pyspark is importable
+(rows are collected to the host — the TPU process is the math engine,
+Spark feeds arrays, mirroring the rebuild stance in SURVEY.md §7.6), a
+pandas DataFrame, or a plain dict of columns.  Column semantics follow
+the reference: featuresCol holds fixed-size numeric vectors/arrays,
+labelCol scalars or vectors.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+def _df_kind(df):
+    if hasattr(df, "rdd") and hasattr(df, "collect"):
+        return "spark"
+    if hasattr(df, "columns") and hasattr(df, "iloc"):
+        return "pandas"
+    if isinstance(df, dict):
+        return "dict"
+    raise TypeError(f"unsupported DataFrame type {type(df)}")
+
+
+def _column(df, name):
+    kind = _df_kind(df)
+    if kind == "spark":
+        return np.asarray([row[name] for row in df.select(name).collect()],
+                          dtype=np.float32)
+    if kind == "pandas":
+        return np.asarray(df[name].tolist(), dtype=np.float32)
+    return np.asarray(df[name], dtype=np.float32)
+
+
+def _with_column(df, name, values):
+    kind = _df_kind(df)
+    if kind == "spark":
+        # collect to pandas for the output frame: predictions are a
+        # host-side product (the reference returns a Spark DF; callers
+        # needing Spark can parallelize this result)
+        import pandas as pd
+
+        pdf = df.toPandas()
+        pdf[name] = list(values)
+        return pdf
+    if kind == "pandas":
+        out = df.copy()
+        out[name] = list(values)
+        return out
+    out = dict(df)
+    out[name] = values
+    return out
+
+
+class DLModel:
+    """Reference: DLModel.transform — batched predict into a prediction
+    column."""
+
+    def __init__(self, model, feature_size: Sequence[int],
+                 features_col: str = "features",
+                 prediction_col: str = "prediction",
+                 batch_size: int = 32):
+        self.model = model
+        self.feature_size = list(feature_size)
+        self.features_col = features_col
+        self.prediction_col = prediction_col
+        self.batch_size = batch_size
+
+    def set_features_col(self, name):
+        self.features_col = name
+        return self
+
+    def set_prediction_col(self, name):
+        self.prediction_col = name
+        return self
+
+    def set_batch_size(self, n):
+        self.batch_size = n
+        return self
+
+    setFeaturesCol = set_features_col
+    setPredictionCol = set_prediction_col
+    setBatchSize = set_batch_size
+
+    def _predict_raw(self, df):
+        from bigdl_tpu.optim.evaluator import predict
+
+        feats = _column(df, self.features_col)
+        feats = feats.reshape([-1] + self.feature_size)
+        return predict(self.model, feats, self.batch_size)
+
+    def transform(self, df):
+        out = self._predict_raw(df)
+        return _with_column(df, self.prediction_col,
+                            [row for row in out.reshape(out.shape[0], -1)])
+
+
+class DLClassifierModel(DLModel):
+    """Reference: DLClassifierModel — argmax + 1-based label."""
+
+    def transform(self, df):
+        out = self._predict_raw(df)
+        preds = np.argmax(out.reshape(out.shape[0], -1), axis=1) + 1.0
+        return _with_column(df, self.prediction_col, preds)
+
+
+class DLEstimator:
+    """Reference: DLEstimator[T].fit(df) wraps the full Optimizer path
+    over DataFrame columns."""
+
+    _model_cls = DLModel
+
+    def __init__(self, model, criterion, feature_size: Sequence[int],
+                 label_size: Sequence[int],
+                 features_col: str = "features", label_col: str = "label",
+                 prediction_col: str = "prediction"):
+        self.model = model
+        self.criterion = criterion
+        self.feature_size = list(feature_size)
+        self.label_size = list(label_size)
+        self.features_col = features_col
+        self.label_col = label_col
+        self.prediction_col = prediction_col
+        self.batch_size = 32
+        self.max_epoch = 10
+        self.learning_rate = 1e-3
+        self.optim_method = None
+        self.end_trigger = None
+
+    # fluent setters (reference Param spellings)
+    def set_batch_size(self, n):
+        self.batch_size = n
+        return self
+
+    def set_max_epoch(self, n):
+        self.max_epoch = n
+        return self
+
+    def set_learning_rate(self, lr):
+        self.learning_rate = lr
+        return self
+
+    def set_optim_method(self, m):
+        self.optim_method = m
+        return self
+
+    def set_end_when(self, trigger):
+        self.end_trigger = trigger
+        return self
+
+    def set_features_col(self, name):
+        self.features_col = name
+        return self
+
+    def set_label_col(self, name):
+        self.label_col = name
+        return self
+
+    setBatchSize = set_batch_size
+    setMaxEpoch = set_max_epoch
+    setLearningRate = set_learning_rate
+    setOptimMethod = set_optim_method
+    setFeaturesCol = set_features_col
+    setLabelCol = set_label_col
+
+    def fit(self, df) -> DLModel:
+        from bigdl_tpu.optim import LocalOptimizer, SGD, Trigger
+
+        feats = _column(df, self.features_col).reshape(
+            [-1] + self.feature_size
+        )
+        labels = _column(df, self.label_col).reshape([-1] + self.label_size)
+        if self.label_size == [1]:
+            labels = labels.reshape(-1)
+        opt = LocalOptimizer(self.model, (feats, labels), self.criterion,
+                             batch_size=self.batch_size)
+        opt.set_optim_method(
+            self.optim_method or SGD(learningrate=self.learning_rate)
+        )
+        opt.set_end_when(self.end_trigger or Trigger.max_epoch(self.max_epoch))
+        trained = opt.optimize()
+        return self._model_cls(
+            trained, self.feature_size, self.features_col,
+            self.prediction_col, self.batch_size,
+        )
+
+
+class DLClassifier(DLEstimator):
+    """Reference: DLClassifier — label column of 1-based class ids,
+    scalar label size."""
+
+    _model_cls = DLClassifierModel
+
+    def __init__(self, model, criterion=None, feature_size=None,
+                 features_col="features", label_col="label",
+                 prediction_col="prediction"):
+        from bigdl_tpu.nn import ClassNLLCriterion
+
+        super().__init__(model, criterion or ClassNLLCriterion(),
+                         feature_size, [1], features_col, label_col,
+                         prediction_col)

@@ -1,0 +1,43 @@
+"""bigdl_tpu.keras — Keras-1.2.2-compatible API.
+
+Rebuild of «bigdl»/nn/keras/ (Scala shape-inferring wrappers with Shape
+propagation) + «py»/nn/keras/ (SURVEY.md §2.1 / §2.2): Sequential model
+with ``input_shape`` on the first layer, automatic shape inference layer
+to layer, and the Keras training conveniences (compile/fit/evaluate/
+predict) bridging into the bigdl_tpu Optimizer runtime.
+"""
+
+from bigdl_tpu.keras.layers import (
+    Activation,
+    AveragePooling2D,
+    BatchNormalization,
+    Bidirectional,
+    Convolution2D,
+    Dense,
+    Dropout,
+    Embedding,
+    Flatten,
+    GlobalAveragePooling2D,
+    GlobalMaxPooling2D,
+    GRU,
+    InputLayer,
+    KerasLayer,
+    LSTM,
+    MaxPooling2D,
+    Permute,
+    RepeatVector,
+    Reshape,
+    SimpleRNN,
+    TimeDistributedDense,
+    ZeroPadding2D,
+)
+from bigdl_tpu.keras.models import Sequential
+
+__all__ = [
+    "Sequential", "KerasLayer", "InputLayer", "Dense", "Activation",
+    "Dropout", "Flatten", "Reshape", "Permute", "RepeatVector",
+    "Convolution2D", "MaxPooling2D", "AveragePooling2D", "ZeroPadding2D",
+    "GlobalAveragePooling2D", "GlobalMaxPooling2D", "BatchNormalization",
+    "Embedding", "LSTM", "GRU", "SimpleRNN", "Bidirectional",
+    "TimeDistributedDense",
+]

@@ -1,0 +1,422 @@
+"""Keras-style layers with shape inference.
+
+Rebuild of «bigdl»/nn/keras/ — each layer mirrors the Keras-1.2.2
+constructor surface («py»/nn/keras/layer.py spellings), infers its
+output shape from the input shape (batch dim excluded, like the
+reference's ``Shape``), and *builds* a core bigdl_tpu.nn module once the
+input shape is known.  Image layout is NCHW ("th" dim ordering, the
+reference's default).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from bigdl_tpu.nn import layers as L
+from bigdl_tpu.nn import module as M
+from bigdl_tpu.nn import recurrent as R
+
+
+_ACTIVATIONS = {
+    "relu": L.ReLU,
+    "tanh": L.Tanh,
+    "sigmoid": L.Sigmoid,
+    "hard_sigmoid": L.HardSigmoid,
+    "softmax": L.SoftMax,
+    "log_softmax": L.LogSoftMax,
+    "softplus": L.SoftPlus,
+    "softsign": L.SoftSign,
+    "elu": L.ELU,
+    "linear": M.Identity,
+}
+
+
+def _activation_module(name):
+    if name is None or name == "linear":
+        return None
+    if isinstance(name, str):
+        return _ACTIVATIONS[name]()
+    return name
+
+
+class KerasLayer:
+    """Base: ``build(input_shape) -> core module`` +
+    ``compute_output_shape(input_shape)``; shapes are tuples WITHOUT the
+    batch dim (reference Shape semantics)."""
+
+    def __init__(self, input_shape: Optional[Sequence[int]] = None, name=None):
+        self.input_shape = tuple(input_shape) if input_shape else None
+        self.name = name
+        self.output_shape: Optional[Tuple[int, ...]] = None
+        self.core = None
+
+    def build(self, input_shape):
+        raise NotImplementedError
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape)
+
+    def _built(self, input_shape):
+        self.core = self.build(tuple(input_shape))
+        if self.name:
+            self.core.set_name(self.name)
+        self.output_shape = self.compute_output_shape(tuple(input_shape))
+        return self.core
+
+
+class InputLayer(KerasLayer):
+    def __init__(self, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+
+    def build(self, input_shape):
+        return M.Identity()
+
+
+class Dense(KerasLayer):
+    """keras.layers.Dense — W x + b with optional activation."""
+
+    def __init__(self, output_dim: int, activation=None, input_dim=None,
+                 input_shape=None, b_regularizer=None, W_regularizer=None,
+                 bias=True, name=None):
+        if input_dim is not None:
+            input_shape = (input_dim,)
+        super().__init__(input_shape, name)
+        self.output_dim = output_dim
+        self.activation = activation
+        self.bias = bias
+        self.W_regularizer, self.b_regularizer = W_regularizer, b_regularizer
+
+    def build(self, input_shape):
+        core = M.Sequential()
+        core.add(L.Linear(int(input_shape[-1]), self.output_dim,
+                          with_bias=self.bias,
+                          w_regularizer=self.W_regularizer,
+                          b_regularizer=self.b_regularizer))
+        act = _activation_module(self.activation)
+        if act is not None:
+            core.add(act)
+        return core
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape[:-1]) + (self.output_dim,)
+
+
+class Activation(KerasLayer):
+    def __init__(self, activation: str, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.activation = activation
+
+    def build(self, input_shape):
+        return _activation_module(self.activation) or M.Identity()
+
+
+class Dropout(KerasLayer):
+    def __init__(self, p: float, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.p = p
+
+    def build(self, input_shape):
+        return L.Dropout(self.p)
+
+
+class Flatten(KerasLayer):
+    def build(self, input_shape):
+        return L.Reshape([int(np.prod(input_shape))], batch_mode=True)
+
+    def compute_output_shape(self, input_shape):
+        return (int(np.prod(input_shape)),)
+
+
+class Reshape(KerasLayer):
+    def __init__(self, target_shape, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.target_shape = tuple(target_shape)
+
+    def build(self, input_shape):
+        return L.Reshape(list(self.target_shape), batch_mode=True)
+
+    def compute_output_shape(self, input_shape):
+        return self.target_shape
+
+
+class Permute(KerasLayer):
+    def __init__(self, dims, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.dims = tuple(dims)  # 1-based over non-batch dims (keras)
+
+    def build(self, input_shape):
+        # express permutation as a sequence of swaps on 1-based dims
+        # counting the batch dim (core Transpose convention)
+        perm = [d + 1 for d in self.dims]
+        current = list(range(2, len(self.dims) + 2))
+        swaps = []
+        for i, want in enumerate(perm):
+            j = current.index(want)
+            if j != i:
+                swaps.append((i + 2, j + 2))
+                current[i], current[j] = current[j], current[i]
+        return L.Transpose(swaps) if swaps else M.Identity()
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape[d - 1] for d in self.dims)
+
+
+class RepeatVector(KerasLayer):
+    def __init__(self, n: int, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.n = n
+
+    def build(self, input_shape):
+        # dim counts the batch dim (core convention): insert at dim 2
+        return L.Replicate(self.n, dim=2)
+
+    def compute_output_shape(self, input_shape):
+        return (self.n,) + tuple(input_shape)
+
+
+def _pair(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+class Convolution2D(KerasLayer):
+    """keras.layers.Convolution2D — NCHW ("th") layout."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 activation=None, border_mode: str = "valid",
+                 subsample=(1, 1), input_shape=None, bias=True,
+                 W_regularizer=None, b_regularizer=None, name=None):
+        super().__init__(input_shape, name)
+        self.nb_filter, self.nb_row, self.nb_col = nb_filter, nb_row, nb_col
+        self.activation = activation
+        self.border_mode = border_mode
+        self.subsample = _pair(subsample)
+        self.bias = bias
+        self.W_regularizer, self.b_regularizer = W_regularizer, b_regularizer
+
+    def build(self, input_shape):
+        n_in = int(input_shape[0])
+        if self.border_mode == "same":
+            pw = ph = -1
+        else:
+            pw = ph = 0
+        core = M.Sequential()
+        core.add(L.SpatialConvolution(
+            n_in, self.nb_filter, self.nb_col, self.nb_row,
+            self.subsample[1], self.subsample[0], pw, ph,
+            with_bias=self.bias, w_regularizer=self.W_regularizer,
+            b_regularizer=self.b_regularizer,
+        ))
+        act = _activation_module(self.activation)
+        if act is not None:
+            core.add(act)
+        return core
+
+    def compute_output_shape(self, input_shape):
+        _, h, w = input_shape
+        sh, sw = self.subsample
+        if self.border_mode == "same":
+            oh = -(-h // sh)
+            ow = -(-w // sw)
+        else:
+            oh = (h - self.nb_row) // sh + 1
+            ow = (w - self.nb_col) // sw + 1
+        return (self.nb_filter, oh, ow)
+
+
+class MaxPooling2D(KerasLayer):
+    def __init__(self, pool_size=(2, 2), strides=None, border_mode="valid",
+                 input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.pool_size = _pair(pool_size)
+        self.strides = _pair(strides) if strides else self.pool_size
+        self.border_mode = border_mode
+
+    def _core_cls(self):
+        return L.SpatialMaxPooling
+
+    def build(self, input_shape):
+        ph, pw = self.pool_size
+        sh, sw = self.strides
+        if self._core_cls() is L.SpatialMaxPooling:
+            return L.SpatialMaxPooling(pw, ph, sw, sh)
+        return L.SpatialAveragePooling(pw, ph, sw, sh)
+
+    def compute_output_shape(self, input_shape):
+        c, h, w = input_shape
+        ph, pw = self.pool_size
+        sh, sw = self.strides
+        return (c, (h - ph) // sh + 1, (w - pw) // sw + 1)
+
+
+class AveragePooling2D(MaxPooling2D):
+    def _core_cls(self):
+        return L.SpatialAveragePooling
+
+
+class GlobalAveragePooling2D(KerasLayer):
+    def build(self, input_shape):
+        c, h, w = (int(s) for s in input_shape)
+        return M.Sequential() \
+            .add(L.SpatialAveragePooling(w, h, 1, 1)) \
+            .add(L.Reshape([c], batch_mode=True))
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0],)
+
+
+class GlobalMaxPooling2D(KerasLayer):
+    def build(self, input_shape):
+        c, h, w = (int(s) for s in input_shape)
+        return M.Sequential() \
+            .add(L.SpatialMaxPooling(w, h, 1, 1)) \
+            .add(L.Reshape([c], batch_mode=True))
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0],)
+
+
+class ZeroPadding2D(KerasLayer):
+    def __init__(self, padding=(1, 1), input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.padding = _pair(padding)
+
+    def build(self, input_shape):
+        ph, pw = self.padding
+        return L.SpatialZeroPadding(pw, pw, ph, ph)
+
+    def compute_output_shape(self, input_shape):
+        c, h, w = input_shape
+        return (c, h + 2 * self.padding[0], w + 2 * self.padding[1])
+
+
+class BatchNormalization(KerasLayer):
+    def __init__(self, epsilon=1e-3, momentum=0.99, input_shape=None,
+                 name=None):
+        super().__init__(input_shape, name)
+        self.epsilon = epsilon
+        self.momentum = momentum
+
+    def build(self, input_shape):
+        # keras momentum is the running-average keep rate; the core layer
+        # uses the update rate
+        update = 1.0 - self.momentum
+        if len(input_shape) == 3:
+            return L.SpatialBatchNormalization(int(input_shape[0]),
+                                               eps=self.epsilon,
+                                               momentum=update)
+        return L.BatchNormalization(int(input_shape[-1]), eps=self.epsilon,
+                                    momentum=update)
+
+
+class Embedding(KerasLayer):
+    def __init__(self, input_dim: int, output_dim: int, input_length=None,
+                 input_shape=None, name=None):
+        if input_shape is None and input_length is not None:
+            input_shape = (input_length,)
+        super().__init__(input_shape, name)
+        self.input_dim, self.output_dim = input_dim, output_dim
+
+    def build(self, input_shape):
+        # keras indices are 0-based; core LookupTable is 1-based
+        return M.Sequential() \
+            .add(L.AddConstant(1.0)) \
+            .add(L.LookupTable(self.input_dim, self.output_dim))
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape) + (self.output_dim,)
+
+
+class _KerasRecurrent(KerasLayer):
+    cell_cls = None
+
+    def __init__(self, output_dim: int, activation="tanh",
+                 inner_activation="hard_sigmoid", return_sequences=False,
+                 input_shape=None, input_dim=None, input_length=None,
+                 name=None):
+        if input_shape is None and input_dim is not None:
+            input_shape = (input_length, input_dim)
+        super().__init__(input_shape, name)
+        self.output_dim = output_dim
+        self.activation = activation
+        self.inner_activation = inner_activation
+        self.return_sequences = return_sequences
+
+    def _cell(self, n_in):
+        raise NotImplementedError
+
+    def build(self, input_shape):
+        n_in = int(input_shape[-1])
+        core = M.Sequential()
+        core.add(R.Recurrent().add(self._cell(n_in)))
+        if not self.return_sequences:
+            core.add(R.Select(2, -1))
+        return core
+
+    def compute_output_shape(self, input_shape):
+        if self.return_sequences:
+            return (input_shape[0], self.output_dim)
+        return (self.output_dim,)
+
+
+class LSTM(_KerasRecurrent):
+    def _cell(self, n_in):
+        return R.LSTM(n_in, self.output_dim,
+                      activation=_activation_module(self.activation),
+                      inner_activation=_activation_module(self.inner_activation))
+
+
+class GRU(_KerasRecurrent):
+    def _cell(self, n_in):
+        return R.GRU(n_in, self.output_dim)
+
+
+class SimpleRNN(_KerasRecurrent):
+    def _cell(self, n_in):
+        return R.RnnCell(n_in, self.output_dim,
+                         activation=_activation_module(self.activation)
+                         or L.Tanh())
+
+
+class Bidirectional(KerasLayer):
+    """keras.layers.wrappers.Bidirectional(merge_mode='concat')."""
+
+    def __init__(self, layer: _KerasRecurrent, merge_mode="concat",
+                 input_shape=None, name=None):
+        super().__init__(input_shape or layer.input_shape, name)
+        self.layer = layer
+        self.merge_mode = merge_mode
+
+    def build(self, input_shape):
+        n_in = int(input_shape[-1])
+        core = M.Sequential()
+        core.add(R.BiRecurrent().add(self.layer._cell(n_in)))
+        if not self.layer.return_sequences:
+            core.add(R.Select(2, -1))
+        return core
+
+    def compute_output_shape(self, input_shape):
+        d = self.layer.output_dim * (2 if self.merge_mode == "concat" else 1)
+        if self.layer.return_sequences:
+            return (input_shape[0], d)
+        return (d,)
+
+
+class TimeDistributedDense(KerasLayer):
+    def __init__(self, output_dim: int, activation=None, input_shape=None,
+                 name=None):
+        super().__init__(input_shape, name)
+        self.output_dim = output_dim
+        self.activation = activation
+
+    def build(self, input_shape):
+        inner = M.Sequential().add(L.Linear(int(input_shape[-1]),
+                                            self.output_dim))
+        act = _activation_module(self.activation)
+        if act is not None:
+            inner.add(act)
+        return R.TimeDistributed(inner)
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape[:-1]) + (self.output_dim,)

@@ -1,0 +1,178 @@
+"""Keras Sequential model + compile/fit/evaluate/predict.
+
+Rebuild of «py»/nn/keras/topology.py (Sequential with the Keras training
+verbs, dispatching into the bigdl_tpu Optimizer runtime) on top of the
+shape-inferring layers.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from bigdl_tpu.keras.layers import KerasLayer
+from bigdl_tpu.nn import module as M
+
+
+_LOSSES = {
+    "categorical_crossentropy": "_categorical",
+    "sparse_categorical_crossentropy": "_sparse",
+    "mse": "_mse",
+    "mean_squared_error": "_mse",
+    "mae": "_mae",
+    "binary_crossentropy": "_bce",
+}
+
+
+def _resolve_loss(loss):
+    from bigdl_tpu.nn import (
+        AbsCriterion, BCECriterion, CrossEntropyCriterion, MSECriterion,
+    )
+
+    if not isinstance(loss, str):
+        return loss
+    kind = _LOSSES[loss]
+    if kind in ("_categorical", "_sparse"):
+        return CrossEntropyCriterion()
+    if kind == "_mse":
+        return MSECriterion()
+    if kind == "_mae":
+        return AbsCriterion()
+    return BCECriterion()
+
+
+def _resolve_optimizer(opt):
+    from bigdl_tpu.optim import Adam, Adagrad, Adadelta, Adamax, RMSprop, SGD
+
+    if not isinstance(opt, str):
+        return opt
+    return {
+        "sgd": lambda: SGD(learningrate=0.01),
+        "adam": Adam,
+        "adagrad": Adagrad,
+        "adadelta": Adadelta,
+        "adamax": Adamax,
+        "rmsprop": RMSprop,
+    }[opt.lower()]()
+
+
+class Sequential:
+    """keras.models.Sequential — builds a core bigdl_tpu Sequential as
+    layers are added, inferring shapes."""
+
+    def __init__(self):
+        self.layers: list[KerasLayer] = []
+        self.core = M.Sequential()
+        self._shape = None  # current output shape (no batch dim)
+        self._criterion = None
+        self._optim_method = None
+        self._metrics = None
+
+    def add(self, layer: KerasLayer):
+        if self._shape is None:
+            if layer.input_shape is None:
+                raise ValueError(
+                    "first layer needs input_shape (reference behavior)"
+                )
+            self._shape = layer.input_shape
+        core = layer._built(self._shape)
+        self._shape = layer.output_shape
+        self.layers.append(layer)
+        self.core.add(core)
+        return self
+
+    @property
+    def output_shape(self):
+        return (None,) + tuple(self._shape)
+
+    def summary(self) -> str:
+        lines = ["_" * 60]
+        lines.append(f"{'Layer (type)':30s}{'Output Shape':20s}")
+        for l in self.layers:
+            lines.append(
+                f"{type(l).__name__:30s}{str((None,) + tuple(l.output_shape)):20s}"
+            )
+        total = sum(int(np.prod(w.shape)) for w in self.core.get_weights())
+        lines.append(f"Total params: {total}")
+        lines.append("_" * 60)
+        s = "\n".join(lines)
+        print(s)
+        return s
+
+    # ------------------------------------------------- keras training verbs
+    def compile(self, optimizer, loss, metrics=None):
+        self._optim_method = _resolve_optimizer(optimizer)
+        self._criterion = _resolve_loss(loss)
+        self._metrics = metrics
+        return self
+
+    def fit(self, x, y, batch_size: int = 32, nb_epoch: int = 10,
+            validation_data=None, distributed: bool = False):
+        from bigdl_tpu.optim import (
+            LocalOptimizer, Top1Accuracy, Trigger,
+        )
+        from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
+
+        if self._criterion is None:
+            raise RuntimeError("call compile() before fit()")
+        y = self._maybe_from_categorical(y)
+        cls = DistriOptimizer if distributed else LocalOptimizer
+        opt = cls(self.core, (np.asarray(x), y), self._criterion,
+                  batch_size=batch_size)
+        opt.set_optim_method(self._optim_method)
+        opt.set_end_when(Trigger.max_epoch(nb_epoch))
+        if validation_data is not None:
+            vx, vy = validation_data
+            vy = self._maybe_from_categorical(vy)
+            methods = [Top1Accuracy()] if self._metrics else None
+            if methods:
+                opt.set_validation(trigger=Trigger.every_epoch(),
+                                   dataset=(np.asarray(vx), vy),
+                                   methods=methods)
+        opt.optimize()
+        self._last_optimizer = opt
+        return self
+
+    def _maybe_from_categorical(self, y):
+        y = np.asarray(y)
+        if y.ndim == 2 and y.shape[1] > 1 and set(np.unique(y)) <= {0.0, 1.0}:
+            # one-hot -> 1-based class ids (keras categorical target)
+            return (np.argmax(y, axis=1) + 1).astype(np.float32)
+        return y.astype(np.float32)
+
+    def evaluate(self, x, y, batch_size: int = 32):
+        from bigdl_tpu.dataset import ArrayDataSet
+        from bigdl_tpu.optim import Loss, Top1Accuracy
+        from bigdl_tpu.optim.evaluator import evaluate_dataset
+
+        y = self._maybe_from_categorical(y)
+        ds = ArrayDataSet(np.asarray(x), y, batch_size)
+        methods = [Loss(self._criterion)]
+        if self._metrics:
+            methods.append(Top1Accuracy())
+        results = evaluate_dataset(self.core, ds, methods)
+        return [r.result()[0] for r in results]
+
+    def predict(self, x, batch_size: int = 32):
+        from bigdl_tpu.optim.evaluator import predict
+
+        return predict(self.core, np.asarray(x), batch_size)
+
+    def predict_classes(self, x, batch_size: int = 32):
+        from bigdl_tpu.optim.evaluator import predict_class
+
+        return predict_class(self.core, np.asarray(x), batch_size) - 1
+
+    # persistence through the core serializer
+    def save(self, path: str):
+        from bigdl_tpu.utils.serializer import save_module
+
+        return save_module(self.core, path)
+
+    def get_weights(self):
+        return self.core.get_weights()
+
+    def set_weights(self, weights):
+        self.core.set_weights(weights)
+        return self

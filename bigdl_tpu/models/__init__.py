@@ -6,3 +6,20 @@ autoencoder — each with a builder and a runnable train entry point.
 """
 
 from bigdl_tpu.models.lenet import build_lenet5
+from bigdl_tpu.models.resnet import (
+    build_resnet_cifar,
+    build_resnet_imagenet,
+    imagenet_recipe_optim,
+)
+from bigdl_tpu.models.vgg import build_vgg16, build_vgg19, build_vgg_cifar
+from bigdl_tpu.models.alexnet import build_alexnet, build_alexnet_original
+from bigdl_tpu.models.inception import build_inception_v1
+from bigdl_tpu.models.autoencoder import build_autoencoder
+from bigdl_tpu.models.rnn import build_ptb_lm
+
+__all__ = [
+    "build_lenet5", "build_resnet_cifar", "build_resnet_imagenet",
+    "imagenet_recipe_optim", "build_vgg16", "build_vgg19", "build_vgg_cifar",
+    "build_alexnet", "build_alexnet_original", "build_inception_v1",
+    "build_autoencoder", "build_ptb_lm",
+]

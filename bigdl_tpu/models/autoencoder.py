@@ -1,0 +1,37 @@
+"""MNIST autoencoder.
+
+Rebuild of «bigdl»/models/autoencoder/Autoencoder.scala (+ Train.scala):
+784 -> 32 -> 784 MLP trained with MSECriterion against the input.
+"""
+
+from __future__ import annotations
+
+from bigdl_tpu.nn import Linear, ReLU, Reshape, Sequential, Sigmoid
+
+
+def build_autoencoder(class_num: int = 32):
+    model = Sequential()
+    model.add(Reshape([28 * 28])) \
+        .add(Linear(28 * 28, class_num)) \
+        .add(ReLU()) \
+        .add(Linear(class_num, 28 * 28)) \
+        .add(Sigmoid())
+    return model
+
+
+def train_autoencoder(data_dir=None, batch_size=128, max_epoch=3,
+                      learning_rate=0.01):
+    """Reference: models/autoencoder/Train.scala — target == input/255."""
+    from bigdl_tpu.dataset import ArrayDataSet
+    from bigdl_tpu.dataset.mnist import load_mnist
+    from bigdl_tpu.nn import MSECriterion
+    from bigdl_tpu.optim import Adagrad, LocalOptimizer, Trigger
+
+    x, _ = load_mnist(data_dir, "train")
+    x = (x / 255.0).astype("float32")
+    flat_target = x.reshape(x.shape[0], -1)
+    model = build_autoencoder()
+    opt = LocalOptimizer(model, (x, flat_target), MSECriterion(), batch_size)
+    opt.set_optim_method(Adagrad(learningrate=learning_rate))
+    opt.set_end_when(Trigger.max_epoch(max_epoch))
+    return opt.optimize(), opt

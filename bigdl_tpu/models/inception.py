@@ -1,0 +1,113 @@
+"""Inception-v1 (GoogLeNet).
+
+Rebuild of «bigdl»/models/inception/Inception_v1.scala: the
+Inception_Layer_v1 module (4-branch Concat: 1x1 / 3x3-reduce+3x3 /
+5x5-reduce+5x5 / pool+proj) and the NoAuxClassifier main tower (the
+reference's primary training config).
+"""
+
+from __future__ import annotations
+
+from bigdl_tpu.nn import (
+    Concat,
+    Dropout,
+    Linear,
+    LogSoftMax,
+    ReLU,
+    Reshape,
+    Sequential,
+    SpatialAveragePooling,
+    SpatialConvolution,
+    SpatialCrossMapLRN,
+    SpatialMaxPooling,
+)
+from bigdl_tpu.nn.layers import Xavier
+
+
+def _conv_relu(n_in, n_out, kw, kh, sw=1, sh=1, pw=0, ph=0, name=""):
+    seq = Sequential()
+    seq.add(
+        SpatialConvolution(n_in, n_out, kw, kh, sw, sh, pw, ph,
+                           init_method=Xavier()).set_name(name + "conv")
+    ).add(ReLU())
+    return seq
+
+
+def inception_layer_v1(n_in, config, name_prefix=""):
+    """«bigdl» Inception_Layer_v1: config = [[1x1], [3x3 reduce, 3x3],
+    [5x5 reduce, 5x5], [pool proj]]."""
+    concat = Concat(2)
+    c1 = Sequential().add(
+        SpatialConvolution(n_in, config[0][0], 1, 1,
+                           init_method=Xavier()).set_name(name_prefix + "1x1")
+    ).add(ReLU())
+    concat.add(c1)
+    c3 = Sequential().add(
+        SpatialConvolution(n_in, config[1][0], 1, 1,
+                           init_method=Xavier()).set_name(name_prefix + "3x3_reduce")
+    ).add(ReLU()).add(
+        SpatialConvolution(config[1][0], config[1][1], 3, 3, 1, 1, 1, 1,
+                           init_method=Xavier()).set_name(name_prefix + "3x3")
+    ).add(ReLU())
+    concat.add(c3)
+    c5 = Sequential().add(
+        SpatialConvolution(n_in, config[2][0], 1, 1,
+                           init_method=Xavier()).set_name(name_prefix + "5x5_reduce")
+    ).add(ReLU()).add(
+        SpatialConvolution(config[2][0], config[2][1], 5, 5, 1, 1, 2, 2,
+                           init_method=Xavier()).set_name(name_prefix + "5x5")
+    ).add(ReLU())
+    concat.add(c5)
+    pool = Sequential().add(SpatialMaxPooling(3, 3, 1, 1, 1, 1).ceil()).add(
+        SpatialConvolution(n_in, config[3][0], 1, 1,
+                           init_method=Xavier()).set_name(name_prefix + "pool_proj")
+    ).add(ReLU())
+    concat.add(pool)
+    return concat
+
+
+def build_inception_v1(class_num: int = 1000, has_dropout: bool = True):
+    """«bigdl» Inception_v1_NoAuxClassifier (224x224 input)."""
+    model = Sequential()
+    model.add(
+        SpatialConvolution(3, 64, 7, 7, 2, 2, 3, 3,
+                           init_method=Xavier()).set_name("conv1/7x7_s2")
+    ).add(ReLU()) \
+        .add(SpatialMaxPooling(3, 3, 2, 2).ceil().set_name("pool1/3x3_s2")) \
+        .add(SpatialCrossMapLRN(5, 0.0001, 0.75).set_name("pool1/norm1")) \
+        .add(SpatialConvolution(64, 64, 1, 1,
+                                init_method=Xavier()).set_name("conv2/3x3_reduce")) \
+        .add(ReLU()) \
+        .add(SpatialConvolution(64, 192, 3, 3, 1, 1, 1, 1,
+                                init_method=Xavier()).set_name("conv2/3x3")) \
+        .add(ReLU()) \
+        .add(SpatialCrossMapLRN(5, 0.0001, 0.75).set_name("conv2/norm2")) \
+        .add(SpatialMaxPooling(3, 3, 2, 2).ceil().set_name("pool2/3x3_s2")) \
+        .add(inception_layer_v1(192, [[64], [96, 128], [16, 32], [32]],
+                                "inception_3a/")) \
+        .add(inception_layer_v1(256, [[128], [128, 192], [32, 96], [64]],
+                                "inception_3b/")) \
+        .add(SpatialMaxPooling(3, 3, 2, 2).ceil()) \
+        .add(inception_layer_v1(480, [[192], [96, 208], [16, 48], [64]],
+                                "inception_4a/")) \
+        .add(inception_layer_v1(512, [[160], [112, 224], [24, 64], [64]],
+                                "inception_4b/")) \
+        .add(inception_layer_v1(512, [[128], [128, 256], [24, 64], [64]],
+                                "inception_4c/")) \
+        .add(inception_layer_v1(512, [[112], [144, 288], [32, 64], [64]],
+                                "inception_4d/")) \
+        .add(inception_layer_v1(528, [[256], [160, 320], [32, 128], [128]],
+                                "inception_4e/")) \
+        .add(SpatialMaxPooling(3, 3, 2, 2).ceil()) \
+        .add(inception_layer_v1(832, [[256], [160, 320], [32, 128], [128]],
+                                "inception_5a/")) \
+        .add(inception_layer_v1(832, [[384], [192, 384], [48, 128], [128]],
+                                "inception_5b/")) \
+        .add(SpatialAveragePooling(7, 7, 1, 1).set_name("pool5/7x7_s1"))
+    if has_dropout:
+        model.add(Dropout(0.4))
+    model.add(Reshape([1024])) \
+        .add(Linear(1024, class_num,
+                    init_method=Xavier()).set_name("loss3/classifier")) \
+        .add(LogSoftMax())
+    return model

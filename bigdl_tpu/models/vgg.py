@@ -1,0 +1,79 @@
+"""VGG-16/19 (ImageNet) and the CIFAR VGG.
+
+Rebuild of «bigdl»/models/vgg/Vgg_16.scala / Vgg_19.scala (Caffe-layout
+conv stacks) and VggForCifar10.scala (conv+BN variant).
+"""
+
+from __future__ import annotations
+
+from bigdl_tpu.nn import (
+    Dropout,
+    Linear,
+    LogSoftMax,
+    ReLU,
+    Reshape,
+    Sequential,
+    SpatialBatchNormalization,
+    SpatialConvolution,
+    SpatialMaxPooling,
+)
+
+_VGG16 = [2, 2, 3, 3, 3]
+_VGG19 = [2, 2, 4, 4, 4]
+_WIDTHS = [64, 128, 256, 512, 512]
+
+
+def _build_vgg_imagenet(counts, class_num=1000):
+    model = Sequential()
+    n_in = 3
+    for width, n in zip(_WIDTHS, counts):
+        for _ in range(n):
+            model.add(SpatialConvolution(n_in, width, 3, 3, 1, 1, 1, 1))
+            model.add(ReLU())
+            n_in = width
+        model.add(SpatialMaxPooling(2, 2, 2, 2))
+    model.add(Reshape([512 * 7 * 7])) \
+        .add(Linear(512 * 7 * 7, 4096)).add(ReLU()).add(Dropout(0.5)) \
+        .add(Linear(4096, 4096)).add(ReLU()).add(Dropout(0.5)) \
+        .add(Linear(4096, class_num)) \
+        .add(LogSoftMax())
+    return model
+
+
+def build_vgg16(class_num: int = 1000):
+    """«bigdl»/models/vgg/Vgg_16.scala"""
+    return _build_vgg_imagenet(_VGG16, class_num)
+
+
+def build_vgg19(class_num: int = 1000):
+    """«bigdl»/models/vgg/Vgg_19.scala"""
+    return _build_vgg_imagenet(_VGG19, class_num)
+
+
+def build_vgg_cifar(class_num: int = 10):
+    """«bigdl»/models/vgg/VggForCifar10.scala — conv+BN blocks, two
+    512-wide FC heads with BatchNormalization + Dropout."""
+    from bigdl_tpu.nn import BatchNormalization
+
+    model = Sequential()
+
+    def conv_bn(n_in, n_out):
+        model.add(SpatialConvolution(n_in, n_out, 3, 3, 1, 1, 1, 1))
+        model.add(SpatialBatchNormalization(n_out))
+        model.add(ReLU())
+
+    cfg = [(3, 64), (64, 64), "M", (64, 128), (128, 128), "M",
+           (128, 256), (256, 256), (256, 256), "M",
+           (256, 512), (512, 512), (512, 512), "M",
+           (512, 512), (512, 512), (512, 512), "M"]
+    for item in cfg:
+        if item == "M":
+            model.add(SpatialMaxPooling(2, 2, 2, 2))
+        else:
+            conv_bn(*item)
+    model.add(Reshape([512])) \
+        .add(Linear(512, 512)).add(BatchNormalization(512)).add(ReLU()) \
+        .add(Dropout(0.5)) \
+        .add(Linear(512, class_num)) \
+        .add(LogSoftMax())
+    return model

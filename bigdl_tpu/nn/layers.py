@@ -1268,9 +1268,17 @@ class Reshape(AbstractModule):
 
     def update_output_pure(self, params, input, *, training=False, rng=None):
         total = int(np.prod(input.shape))
-        if self.batch_mode is True or (
-            self.batch_mode is None and total != self._nelement
-        ):
+        batched = self.batch_mode
+        if batched is None:
+            # reference auto-detect: first dim is a batch dim when the
+            # element count doesn't match, or (batch==1 case) when the
+            # remaining dims alone carry exactly nelement
+            batched = total != self._nelement or (
+                input.shape[0] == 1
+                and input.ndim > len(self.size)
+                and int(np.prod(input.shape[1:])) == self._nelement
+            )
+        if batched:
             return input.reshape((input.shape[0],) + self.size)
         return input.reshape(self.size)
 

@@ -1,0 +1,1 @@
+"""bigdl_tpu.transform — feature transform pipelines."""

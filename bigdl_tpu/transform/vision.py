@@ -1,0 +1,356 @@
+"""Vision transforms — ImageFrame / ImageFeature + augmentations.
+
+Rebuild of «bigdl»/transform/vision/image/ (SURVEY.md §2.1 "Vision
+transforms"): ImageFrame (local/distributed), ImageFeature (the mutable
+record flowing through the pipeline), and the OpenCV-backed augmentation
+ops (Resize, RandomCrop, CenterCrop, HFlip, ChannelNormalize,
+RandomTransformer, MatToTensor...).
+
+The OpenCV native library (SURVEY.md §2.3) is replaced by host-side
+numpy + PIL when available (bilinear resize falls back to a pure-numpy
+implementation otherwise).  Decode/augment stays on host CPU feeding the
+device — the same division of labor as the reference (executors decode
+on CPU cores, the device does the math).
+
+Layout convention: ImageFeature holds HWC uint8/float arrays like the
+reference's OpenCVMat; MatToTensor emits CHW float32 (the NCHW model
+input).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu.common import RandomGenerator
+
+
+class ImageFeature(dict):
+    """«bigdl»/transform/vision/image/ImageFeature.scala — a dict of
+    named slots (bytes/mat/label/path/...) mutated along the pipeline."""
+
+    MAT = "mat"          # HWC float/uint8 numpy array
+    LABEL = "label"
+    URI = "uri"
+    SAMPLE = "sample"
+
+    def __init__(self, image=None, label=None, uri=None):
+        super().__init__()
+        if image is not None:
+            self[self.MAT] = np.asarray(image)
+        if label is not None:
+            self[self.LABEL] = label
+        if uri is not None:
+            self[self.URI] = uri
+
+    @property
+    def image(self):
+        return self.get(self.MAT)
+
+
+class FeatureTransformer:
+    """«bigdl» FeatureTransformer — composable ImageFeature ->
+    ImageFeature stage; ``>>`` chains (reference ``->``)."""
+
+    def transform(self, feature: ImageFeature) -> ImageFeature:
+        raise NotImplementedError
+
+    def __call__(self, features):
+        if isinstance(features, ImageFeature):
+            return self.transform(features)
+        return (self.transform(f) for f in features)
+
+    def __rshift__(self, other: "FeatureTransformer"):
+        return _ChainedFeature(self, other)
+
+
+class _ChainedFeature(FeatureTransformer):
+    def __init__(self, a, b):
+        self.a, self.b = a, b
+
+    def transform(self, feature):
+        return self.b.transform(self.a.transform(feature))
+
+
+def _resize_bilinear(img: np.ndarray, oh: int, ow: int) -> np.ndarray:
+    """Pure-numpy bilinear resize (HWC), replacing the OpenCV JNI path."""
+    try:
+        from PIL import Image
+
+        if img.dtype != np.uint8:
+            # PIL float path: per-channel
+            chans = [
+                np.asarray(
+                    Image.fromarray(img[..., c].astype(np.float32), mode="F")
+                    .resize((ow, oh), Image.BILINEAR)
+                )
+                for c in range(img.shape[-1])
+            ]
+            return np.stack(chans, axis=-1)
+        pil = Image.fromarray(img)
+        return np.asarray(pil.resize((ow, oh), Image.BILINEAR))
+    except ImportError:
+        pass
+    h, w = img.shape[:2]
+    ys = np.linspace(0, h - 1, oh)
+    xs = np.linspace(0, w - 1, ow)
+    y0 = np.floor(ys).astype(int)
+    x0 = np.floor(xs).astype(int)
+    y1 = np.minimum(y0 + 1, h - 1)
+    x1 = np.minimum(x0 + 1, w - 1)
+    wy = (ys - y0)[:, None, None]
+    wx = (xs - x0)[None, :, None]
+    img = img.astype(np.float32)
+    top = img[y0][:, x0] * (1 - wx) + img[y0][:, x1] * wx
+    bot = img[y1][:, x0] * (1 - wx) + img[y1][:, x1] * wx
+    return top * (1 - wy) + bot * wy
+
+
+class Resize(FeatureTransformer):
+    """«bigdl» Resize.scala"""
+
+    def __init__(self, resize_h: int, resize_w: int):
+        self.resize_h, self.resize_w = resize_h, resize_w
+
+    def transform(self, feature):
+        img = feature.image
+        feature[ImageFeature.MAT] = _resize_bilinear(
+            img, self.resize_h, self.resize_w
+        )
+        return feature
+
+
+class AspectScale(FeatureTransformer):
+    """«bigdl» AspectScale — resize the short edge to ``scale``."""
+
+    def __init__(self, scale: int, max_size: int = 1000):
+        self.scale, self.max_size = scale, max_size
+
+    def transform(self, feature):
+        img = feature.image
+        h, w = img.shape[:2]
+        short, long = min(h, w), max(h, w)
+        ratio = self.scale / short
+        if long * ratio > self.max_size:
+            ratio = self.max_size / long
+        feature[ImageFeature.MAT] = _resize_bilinear(
+            img, int(round(h * ratio)), int(round(w * ratio))
+        )
+        return feature
+
+
+class CenterCrop(FeatureTransformer):
+    """«bigdl» CenterCrop.scala"""
+
+    def __init__(self, crop_width: int, crop_height: int):
+        self.cw, self.ch = crop_width, crop_height
+
+    def transform(self, feature):
+        img = feature.image
+        h, w = img.shape[:2]
+        y = (h - self.ch) // 2
+        x = (w - self.cw) // 2
+        feature[ImageFeature.MAT] = img[y : y + self.ch, x : x + self.cw]
+        return feature
+
+
+class RandomCrop(FeatureTransformer):
+    """«bigdl» RandomCrop.scala"""
+
+    def __init__(self, crop_width: int, crop_height: int):
+        self.cw, self.ch = crop_width, crop_height
+
+    def transform(self, feature):
+        img = feature.image
+        h, w = img.shape[:2]
+        y = int(RandomGenerator.RNG.randint(0, max(1, h - self.ch + 1)))
+        x = int(RandomGenerator.RNG.randint(0, max(1, w - self.cw + 1)))
+        feature[ImageFeature.MAT] = img[y : y + self.ch, x : x + self.cw]
+        return feature
+
+
+class HFlip(FeatureTransformer):
+    """«bigdl» HFlip.scala — unconditional horizontal flip."""
+
+    def transform(self, feature):
+        feature[ImageFeature.MAT] = feature.image[:, ::-1]
+        return feature
+
+
+class RandomHFlip(FeatureTransformer):
+    """«bigdl» RandomTransformer(HFlip, p)"""
+
+    def __init__(self, p: float = 0.5):
+        self.p = p
+
+    def transform(self, feature):
+        if RandomGenerator.RNG.uniform(0, 1) < self.p:
+            feature[ImageFeature.MAT] = feature.image[:, ::-1]
+        return feature
+
+
+class ChannelNormalize(FeatureTransformer):
+    """«bigdl» ChannelNormalize.scala — per-channel (x - mean) / std."""
+
+    def __init__(self, mean_r, mean_g, mean_b, std_r=1.0, std_g=1.0, std_b=1.0):
+        self.mean = np.array([mean_r, mean_g, mean_b], np.float32)
+        self.std = np.array([std_r, std_g, std_b], np.float32)
+
+    def transform(self, feature):
+        img = feature.image.astype(np.float32)
+        feature[ImageFeature.MAT] = (img - self.mean) / self.std
+        return feature
+
+
+class ChannelScaledNormalizer(FeatureTransformer):
+    """«bigdl» ChannelScaledNormalizer — mean-subtract + global scale."""
+
+    def __init__(self, mean_r, mean_g, mean_b, scale: float):
+        self.mean = np.array([mean_r, mean_g, mean_b], np.float32)
+        self.scale = scale
+
+    def transform(self, feature):
+        img = feature.image.astype(np.float32)
+        feature[ImageFeature.MAT] = (img - self.mean) * self.scale
+        return feature
+
+
+class PixelNormalizer(FeatureTransformer):
+    """«bigdl» PixelNormalizer — subtract a full mean image."""
+
+    def __init__(self, means: np.ndarray):
+        self.means = np.asarray(means, np.float32)
+
+    def transform(self, feature):
+        feature[ImageFeature.MAT] = feature.image.astype(np.float32) - self.means
+        return feature
+
+
+class Brightness(FeatureTransformer):
+    """«bigdl» Brightness.scala — random delta in [delta_low, delta_high]."""
+
+    def __init__(self, delta_low: float, delta_high: float):
+        self.lo, self.hi = delta_low, delta_high
+
+    def transform(self, feature):
+        delta = RandomGenerator.RNG.uniform(self.lo, self.hi)
+        feature[ImageFeature.MAT] = feature.image.astype(np.float32) + delta
+        return feature
+
+
+class Contrast(FeatureTransformer):
+    """«bigdl» Contrast.scala"""
+
+    def __init__(self, delta_low: float, delta_high: float):
+        self.lo, self.hi = delta_low, delta_high
+
+    def transform(self, feature):
+        f = RandomGenerator.RNG.uniform(self.lo, self.hi)
+        feature[ImageFeature.MAT] = feature.image.astype(np.float32) * f
+        return feature
+
+
+class Saturation(FeatureTransformer):
+    """«bigdl» Saturation.scala — scale distance from the grey image."""
+
+    def __init__(self, delta_low: float, delta_high: float):
+        self.lo, self.hi = delta_low, delta_high
+
+    def transform(self, feature):
+        f = RandomGenerator.RNG.uniform(self.lo, self.hi)
+        img = feature.image.astype(np.float32)
+        grey = img.mean(axis=-1, keepdims=True)
+        feature[ImageFeature.MAT] = grey + (img - grey) * f
+        return feature
+
+
+class ColorJitter(FeatureTransformer):
+    """«bigdl» ColorJitter.scala — random brightness/contrast/saturation
+    in random order."""
+
+    def __init__(self, brightness=32.0, contrast=0.5, saturation=0.5):
+        self.ops = [
+            Brightness(-brightness, brightness),
+            Contrast(1 - contrast, 1 + contrast),
+            Saturation(1 - saturation, 1 + saturation),
+        ]
+
+    def transform(self, feature):
+        order = RandomGenerator.RNG.randperm(len(self.ops))
+        for i in order:
+            feature = self.ops[i].transform(feature)
+        return feature
+
+
+class MatToTensor(FeatureTransformer):
+    """«bigdl» MatToTensor.scala — HWC -> CHW float32 model input."""
+
+    def __init__(self, to_rgb: bool = False):
+        self.to_rgb = to_rgb
+
+    def transform(self, feature):
+        img = feature.image.astype(np.float32)
+        if self.to_rgb:
+            img = img[..., ::-1]
+        feature[ImageFeature.SAMPLE] = np.ascontiguousarray(
+            np.transpose(img, (2, 0, 1))
+        )
+        return feature
+
+
+class ImageFrameToSample(FeatureTransformer):
+    """«bigdl» ImageFrameToSample.scala — wrap tensor+label as a Sample."""
+
+    def transform(self, feature):
+        from bigdl_tpu.dataset import Sample
+
+        tensor = feature.get(ImageFeature.SAMPLE)
+        if tensor is None:
+            tensor = np.transpose(feature.image.astype(np.float32), (2, 0, 1))
+        label = feature.get(ImageFeature.LABEL, np.zeros(1, np.float32))
+        label = np.atleast_1d(np.asarray(label, np.float32))
+        feature[ImageFeature.SAMPLE] = Sample(tensor, label)
+        return feature
+
+
+class ImageFrame:
+    """«bigdl» ImageFrame — a collection of ImageFeatures with
+    ``transform``.  LocalImageFrame only: the distributed variant's role
+    (RDD of features) is played by the data loader feeding the device."""
+
+    def __init__(self, features: Sequence[ImageFeature]):
+        self.features = list(features)
+
+    @staticmethod
+    def read(arrays, labels=None):
+        """Build from in-memory HWC arrays (the reference reads files /
+        bytes through OpenCV decode; file decode is PIL-backed when
+        paths are given)."""
+        feats = []
+        for i, a in enumerate(arrays):
+            if isinstance(a, str):
+                from PIL import Image
+
+                a = np.asarray(Image.open(a).convert("RGB"))
+            feats.append(
+                ImageFeature(a, None if labels is None else labels[i])
+            )
+        return ImageFrame(feats)
+
+    def transform(self, transformer: FeatureTransformer):
+        self.features = [transformer.transform(f) for f in self.features]
+        return self
+
+    def __len__(self):
+        return len(self.features)
+
+    def to_samples(self):
+        return [f[ImageFeature.SAMPLE] for f in self.features]
+
+    def to_dataset(self, batch_size: int = 32):
+        """Bridge into the training pipeline."""
+        from bigdl_tpu.dataset.dataset import SampleDataSet
+
+        self.transform(ImageFrameToSample())
+        return SampleDataSet(self.to_samples(), batch_size)

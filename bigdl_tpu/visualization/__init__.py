@@ -1,0 +1,17 @@
+"""bigdl_tpu.visualization — TensorBoard summaries.
+
+Rebuild of «bigdl»/visualization/ (SURVEY.md §2.1 "Visualization"):
+TrainSummary (loss / throughput / LR per iteration, optional parameter
+histograms) and ValidationSummary (accuracy per validation run), written
+as TensorBoard event files.  The reference links the java protobuf
+Summary/Event classes; here the event wire format is hand-encoded
+(varint protobuf + masked crc32c records) so no TF dependency is needed.
+"""
+
+from bigdl_tpu.visualization.summary import (
+    FileWriter,
+    TrainSummary,
+    ValidationSummary,
+)
+
+__all__ = ["FileWriter", "TrainSummary", "ValidationSummary"]

@@ -1,0 +1,286 @@
+"""TensorBoard event-file writer + Train/Validation summaries.
+
+Rebuild of «bigdl»/visualization/FileWriter.scala, TrainSummary.scala,
+ValidationSummary.scala.  Wire format (the TFRecord/event framing
+TensorBoard reads):
+
+    uint64 length | uint32 masked_crc32c(length) | bytes data |
+    uint32 masked_crc32c(data)
+
+with ``data`` an Event protobuf.  The two messages used are encoded by
+hand (field/varint layout below) — scalar summaries and histograms are
+all the reference emits, so a protobuf compiler would be overkill:
+
+    Event:   1: double wall_time   2: int64 step   5: Summary summary
+    Summary: 1: repeated Value value
+    Value:   1: string tag         2: float simple_value  5: HistogramProto histo
+    HistogramProto: 1: double min  2: double max  3: double num
+                    4: double sum  5: double sum_squares
+                    6: repeated double bucket_limit  7: repeated double bucket
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import time
+from typing import Optional
+
+import numpy as np
+
+# ------------------------------------------------------------------ crc32c
+_CRC_TABLE = []
+
+
+def _build_crc_table():
+    poly = 0x82F63B78  # Castagnoli, reflected
+    for n in range(256):
+        c = n
+        for _ in range(8):
+            c = (c >> 1) ^ poly if c & 1 else c >> 1
+        _CRC_TABLE.append(c)
+
+
+_build_crc_table()
+
+
+def crc32c(data: bytes) -> int:
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = _CRC_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = crc32c(data)
+    return ((crc >> 15 | crc << 17) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+# ---------------------------------------------------------------- protobuf
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint(field << 3 | wire)
+
+
+def _pb_double(field: int, v: float) -> bytes:
+    return _tag(field, 1) + struct.pack("<d", v)
+
+
+def _pb_float(field: int, v: float) -> bytes:
+    return _tag(field, 5) + struct.pack("<f", v)
+
+
+def _pb_int64(field: int, v: int) -> bytes:
+    return _tag(field, 0) + _varint(v & 0xFFFFFFFFFFFFFFFF)
+
+
+def _pb_bytes(field: int, v: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(v)) + v
+
+
+def _pb_packed_doubles(field: int, vals) -> bytes:
+    payload = b"".join(struct.pack("<d", float(v)) for v in vals)
+    return _pb_bytes(field, payload)
+
+
+def _encode_scalar_event(tag: str, value: float, step: int,
+                         wall_time: Optional[float] = None) -> bytes:
+    value_msg = _pb_bytes(1, tag.encode()) + _pb_float(2, float(value))
+    summary = _pb_bytes(1, value_msg)
+    event = (
+        _pb_double(1, wall_time if wall_time is not None else time.time())
+        + _pb_int64(2, int(step))
+        + _pb_bytes(5, summary)
+    )
+    return event
+
+
+def _encode_histogram_event(tag: str, values: np.ndarray, step: int,
+                            wall_time: Optional[float] = None) -> bytes:
+    v = np.asarray(values, np.float64).reshape(-1)
+    counts, edges = np.histogram(v, bins=30)
+    histo = (
+        _pb_double(1, float(v.min()) if v.size else 0.0)
+        + _pb_double(2, float(v.max()) if v.size else 0.0)
+        + _pb_double(3, float(v.size))
+        + _pb_double(4, float(v.sum()))
+        + _pb_double(5, float((v * v).sum()))
+        + _pb_packed_doubles(6, edges[1:])
+        + _pb_packed_doubles(7, counts)
+    )
+    value_msg = _pb_bytes(1, tag.encode()) + _pb_bytes(5, histo)
+    summary = _pb_bytes(1, value_msg)
+    return (
+        _pb_double(1, wall_time if wall_time is not None else time.time())
+        + _pb_int64(2, int(step))
+        + _pb_bytes(5, summary)
+    )
+
+
+class FileWriter:
+    """«bigdl»/visualization/tensorboard/FileWriter.scala — appends
+    framed events to an events.out.tfevents.* file."""
+
+    def __init__(self, log_dir: str):
+        os.makedirs(log_dir, exist_ok=True)
+        fname = f"events.out.tfevents.{int(time.time())}.bigdl_tpu"
+        self.path = os.path.join(log_dir, fname)
+        self._f = open(self.path, "ab")
+        # file-version header event
+        version = _pb_double(1, time.time()) + _pb_bytes(3, b"brain.Event:2")
+        self._write_record(version)
+
+    def _write_record(self, data: bytes):
+        header = struct.pack("<Q", len(data))
+        self._f.write(header)
+        self._f.write(struct.pack("<I", _masked_crc(header)))
+        self._f.write(data)
+        self._f.write(struct.pack("<I", _masked_crc(data)))
+        self._f.flush()
+
+    def add_scalar(self, tag: str, value: float, step: int):
+        self._write_record(_encode_scalar_event(tag, value, step))
+        return self
+
+    def add_histogram(self, tag: str, values, step: int):
+        self._write_record(_encode_histogram_event(tag, values, step))
+        return self
+
+    def close(self):
+        self._f.close()
+
+
+class _Summary:
+    def __init__(self, log_dir: str, app_name: str, kind: str):
+        self.log_dir = os.path.join(log_dir, app_name, kind)
+        self.writer = FileWriter(self.log_dir)
+        self._triggers = {}
+
+    def add_scalar(self, tag: str, value: float, step: int):
+        self.writer.add_scalar(tag, value, step)
+        return self
+
+    def add_histogram(self, tag: str, values, step: int):
+        self.writer.add_histogram(tag, values, step)
+        return self
+
+    def read_scalar(self, tag: str):
+        """Reference parity: TrainSummary.readScalar — read back (step,
+        value) pairs of a tag from the event files."""
+        out = []
+        for fname in sorted(os.listdir(self.log_dir)):
+            if "tfevents" not in fname:
+                continue
+            out.extend(_read_scalars(os.path.join(self.log_dir, fname), tag))
+        return out
+
+    def close(self):
+        self.writer.close()
+
+
+class TrainSummary(_Summary):
+    """«bigdl»/visualization/TrainSummary.scala — loss/throughput/LR per
+    iteration; setSummaryTrigger enables parameter histograms."""
+
+    def __init__(self, log_dir: str, app_name: str):
+        super().__init__(log_dir, app_name, "train")
+
+    def set_summary_trigger(self, name: str, trigger):
+        """name in {"Parameters", "Loss", "Throughput", "LearningRate"}"""
+        self._triggers[name] = trigger
+        return self
+
+    def get_summary_trigger(self, name: str):
+        return self._triggers.get(name)
+
+
+class ValidationSummary(_Summary):
+    """«bigdl»/visualization/ValidationSummary.scala"""
+
+    def __init__(self, log_dir: str, app_name: str):
+        super().__init__(log_dir, app_name, "validation")
+
+
+# ------------------------------------------------------------ event reader
+def _read_varint(buf: bytes, pos: int):
+    result = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _read_scalars(path: str, want_tag: str):
+    out = []
+    with open(path, "rb") as f:
+        data = f.read()
+    pos = 0
+    while pos + 12 <= len(data):
+        (length,) = struct.unpack_from("<Q", data, pos)
+        pos += 12  # len + len-crc
+        event = data[pos : pos + length]
+        pos += length + 4  # data + data-crc
+        step, summary = 0, None
+        epos = 0
+        while epos < len(event):
+            key, epos = _read_varint(event, epos)
+            field, wire = key >> 3, key & 7
+            if wire == 0:
+                val, epos = _read_varint(event, epos)
+                if field == 2:
+                    step = val
+            elif wire == 1:
+                epos += 8
+            elif wire == 5:
+                epos += 4
+            elif wire == 2:
+                ln, epos = _read_varint(event, epos)
+                if field == 5:
+                    summary = event[epos : epos + ln]
+                epos += ln
+        if summary is None:
+            continue
+        spos = 0
+        while spos < len(summary):
+            key, spos = _read_varint(summary, spos)
+            if key >> 3 == 1 and key & 7 == 2:
+                ln, spos = _read_varint(summary, spos)
+                value_msg = summary[spos : spos + ln]
+                spos += ln
+                tag, simple = None, None
+                vpos = 0
+                while vpos < len(value_msg):
+                    k2, vpos = _read_varint(value_msg, vpos)
+                    f2, w2 = k2 >> 3, k2 & 7
+                    if w2 == 2:
+                        ln2, vpos = _read_varint(value_msg, vpos)
+                        if f2 == 1:
+                            tag = value_msg[vpos : vpos + ln2].decode()
+                        vpos += ln2
+                    elif w2 == 5:
+                        if f2 == 2:
+                            (simple,) = struct.unpack_from("<f", value_msg, vpos)
+                        vpos += 4
+                    elif w2 == 1:
+                        vpos += 8
+                    elif w2 == 0:
+                        _, vpos = _read_varint(value_msg, vpos)
+                if tag == want_tag and simple is not None:
+                    out.append((step, simple))
+            else:
+                break
+    return out

@@ -1,0 +1,125 @@
+"""Keras-API specs (reference: the Keras compatibility suite, SURVEY.md
+§4.4 — here checking shape inference + training through the Keras verbs)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from bigdl_tpu.keras import (
+    Activation, AveragePooling2D, BatchNormalization, Bidirectional,
+    Convolution2D, Dense, Dropout, Embedding, Flatten, GlobalAveragePooling2D,
+    GRU, LSTM, MaxPooling2D, Permute, RepeatVector, Reshape, Sequential,
+    SimpleRNN, TimeDistributedDense, ZeroPadding2D,
+)
+
+
+def test_mlp_shapes():
+    m = Sequential()
+    m.add(Dense(32, activation="relu", input_shape=(16,)))
+    m.add(Dropout(0.5))
+    m.add(Dense(10, activation="softmax"))
+    assert m.output_shape == (None, 10)
+    out = m.core.forward(jnp.ones((4, 16)))
+    assert out.shape == (4, 10)
+
+
+def test_cnn_shape_inference():
+    m = Sequential()
+    m.add(Convolution2D(8, 3, 3, activation="relu", input_shape=(1, 28, 28)))
+    assert m.output_shape == (None, 8, 26, 26)
+    m.add(MaxPooling2D((2, 2)))
+    assert m.output_shape == (None, 8, 13, 13)
+    m.add(Convolution2D(16, 3, 3, border_mode="same", subsample=(2, 2)))
+    assert m.output_shape == (None, 16, 7, 7)
+    m.add(Flatten())
+    assert m.output_shape == (None, 16 * 49)
+    m.add(Dense(10, activation="log_softmax"))
+    out = m.core.forward(jnp.ones((2, 1, 28, 28)))
+    assert out.shape == (2, 10)
+
+
+def test_pooling_padding_reshape_layers():
+    m = Sequential()
+    m.add(ZeroPadding2D((1, 1), input_shape=(3, 8, 8)))
+    assert m.output_shape == (None, 3, 10, 10)
+    m.add(AveragePooling2D((2, 2)))
+    assert m.output_shape == (None, 3, 5, 5)
+    m.add(GlobalAveragePooling2D())
+    assert m.output_shape == (None, 3)
+    m.add(RepeatVector(4))
+    assert m.output_shape == (None, 4, 3)
+    m.add(Permute((2, 1)))
+    assert m.output_shape == (None, 3, 4)
+    m.add(Reshape((12,)))
+    out = m.core.forward(jnp.ones((2, 3, 8, 8)))
+    assert out.shape == (2, 12)
+
+
+def test_batchnorm_spatial_vs_dense():
+    m = Sequential()
+    m.add(BatchNormalization(input_shape=(4, 6, 6)))
+    out = m.core.forward(jnp.ones((2, 4, 6, 6)))
+    assert out.shape == (2, 4, 6, 6)
+    m2 = Sequential()
+    m2.add(Dense(8, input_shape=(5,)))
+    m2.add(BatchNormalization())
+    out2 = m2.core.forward(jnp.ones((3, 5)))
+    assert out2.shape == (3, 8)
+
+
+def test_embedding_zero_based():
+    m = Sequential()
+    m.add(Embedding(10, 4, input_length=5))
+    assert m.output_shape == (None, 5, 4)
+    out = m.core.forward(jnp.array([[0.0, 1.0, 9.0, 0.0, 2.0]]))
+    assert out.shape == (1, 5, 4)
+
+
+def test_recurrent_layers():
+    m = Sequential()
+    m.add(LSTM(16, input_shape=(7, 5)))
+    assert m.output_shape == (None, 16)
+    out = m.core.forward(jnp.ones((2, 7, 5)))
+    assert out.shape == (2, 16)
+
+    m2 = Sequential()
+    m2.add(GRU(8, return_sequences=True, input_shape=(7, 5)))
+    assert m2.output_shape == (None, 7, 8)
+    m2.add(TimeDistributedDense(3, activation="softmax"))
+    out2 = m2.core.forward(jnp.ones((2, 7, 5)))
+    assert out2.shape == (2, 7, 3)
+
+    m3 = Sequential()
+    m3.add(Bidirectional(SimpleRNN(6), input_shape=(4, 3)))
+    out3 = m3.core.forward(jnp.ones((2, 4, 3)))
+    assert out3.shape == (2, 12)
+
+
+def test_compile_fit_evaluate_predict():
+    rng = np.random.RandomState(0)
+    w = rng.randn(8, 3)
+    x = rng.randn(128, 8).astype(np.float32)
+    onehot = np.eye(3, dtype=np.float32)[np.argmax(x @ w, axis=1)]
+
+    from bigdl_tpu.optim import Adam
+
+    m = Sequential()
+    m.add(Dense(16, activation="relu", input_shape=(8,)))
+    m.add(Dense(3))
+    m.compile(optimizer=Adam(learningrate=0.02),
+              loss="categorical_crossentropy", metrics=["accuracy"])
+    m.fit(x, onehot, batch_size=32, nb_epoch=30)
+    loss, acc = m.evaluate(x, onehot)
+    assert acc > 0.9, acc
+    preds = m.predict(x[:10])
+    assert preds.shape == (10, 3)
+    classes = m.predict_classes(x[:10])
+    assert classes.min() >= 0 and classes.max() <= 2
+
+
+def test_summary_runs():
+    m = Sequential()
+    m.add(Dense(4, input_shape=(2,)))
+    s = m.summary()
+    assert "Total params" in s

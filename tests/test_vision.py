@@ -1,0 +1,67 @@
+"""Vision transform specs (reference: «test»/transform/vision/...)."""
+
+import numpy as np
+
+from bigdl_tpu.transform.vision import (
+    CenterCrop, ChannelNormalize, ColorJitter, HFlip, ImageFeature,
+    ImageFrame, ImageFrameToSample, MatToTensor, RandomCrop, RandomHFlip,
+    Resize,
+)
+
+
+def _img(h=8, w=10):
+    return np.arange(h * w * 3, dtype=np.uint8).reshape(h, w, 3)
+
+
+def test_resize():
+    f = ImageFeature(_img())
+    Resize(4, 5).transform(f)
+    assert f.image.shape == (4, 5, 3)
+
+
+def test_center_and_random_crop():
+    f = ImageFeature(_img(10, 10))
+    CenterCrop(4, 6).transform(f)
+    assert f.image.shape == (6, 4, 3)
+    f2 = ImageFeature(_img(10, 10))
+    RandomCrop(4, 4).transform(f2)
+    assert f2.image.shape == (4, 4, 3)
+
+
+def test_hflip():
+    img = _img(2, 3)
+    f = ImageFeature(img.copy())
+    HFlip().transform(f)
+    np.testing.assert_array_equal(f.image, img[:, ::-1])
+
+
+def test_channel_normalize():
+    f = ImageFeature(np.full((2, 2, 3), 10.0, np.float32))
+    ChannelNormalize(10, 10, 10, 2, 2, 2).transform(f)
+    np.testing.assert_allclose(f.image, 0.0)
+
+
+def test_mat_to_tensor_chw():
+    f = ImageFeature(_img(4, 5))
+    MatToTensor().transform(f)
+    assert f[ImageFeature.SAMPLE].shape == (3, 4, 5)
+
+
+def test_pipeline_chaining_and_frame():
+    pipeline = Resize(8, 8) >> RandomHFlip(0.5) >> \
+        ChannelNormalize(128, 128, 128, 64, 64, 64) >> MatToTensor()
+    frame = ImageFrame.read([_img(16, 16) for _ in range(4)],
+                            labels=[1.0, 2.0, 1.0, 2.0])
+    frame.transform(pipeline)
+    ds = frame.to_dataset(batch_size=2)
+    batches = list(ds.data(train=True))
+    assert len(batches) == 2
+    inp, tgt = batches[0]
+    assert inp.shape == (2, 3, 8, 8)
+    assert tgt.shape == (2, 1)
+
+
+def test_color_jitter_runs():
+    f = ImageFeature(_img(6, 6).astype(np.float32))
+    ColorJitter().transform(f)
+    assert f.image.shape == (6, 6, 3)

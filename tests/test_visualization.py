@@ -1,0 +1,59 @@
+"""Summary/TensorBoard writer specs (reference:
+«test»/visualization/*Spec)."""
+
+import os
+import struct
+
+import numpy as np
+
+from bigdl_tpu.visualization import TrainSummary, ValidationSummary
+from bigdl_tpu.visualization.summary import crc32c
+
+
+def test_crc32c_known_vectors():
+    # standard CRC-32C test vectors
+    assert crc32c(b"123456789") == 0xE3069283
+    assert crc32c(b"") == 0x0
+
+
+def test_scalar_write_read_roundtrip(tmp_path):
+    ts = TrainSummary(str(tmp_path), "app")
+    for i in range(5):
+        ts.add_scalar("Loss", 1.0 / (i + 1), i)
+    ts.close()
+    back = ts.read_scalar("Loss")
+    assert [s for s, _ in back] == [0, 1, 2, 3, 4]
+    np.testing.assert_allclose(
+        [v for _, v in back], [1.0, 0.5, 1 / 3, 0.25, 0.2], rtol=1e-6
+    )
+
+
+def test_validation_summary_and_histogram(tmp_path):
+    vs = ValidationSummary(str(tmp_path), "app")
+    vs.add_scalar("Top1Accuracy", 0.9, 100)
+    vs.add_histogram("weights", np.random.RandomState(0).randn(1000), 1)
+    vs.close()
+    back = vs.read_scalar("Top1Accuracy")
+    assert back == [(100, np.float32(0.9))]
+
+
+def test_optimizer_writes_summaries(tmp_path):
+    from bigdl_tpu.nn import ClassNLLCriterion, Linear, LogSoftMax, Sequential
+    from bigdl_tpu.optim import LocalOptimizer, SGD, Trigger
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 4).astype(np.float32)
+    y = (rng.randint(0, 2, 64) + 1).astype(np.float32)
+    m = Sequential().add(Linear(4, 2)).add(LogSoftMax())
+    opt = LocalOptimizer(m, (x, y), ClassNLLCriterion(), batch_size=32)
+    opt.set_optim_method(SGD(learningrate=0.1))
+    opt.set_end_when(Trigger.max_epoch(2))
+    ts = TrainSummary(str(tmp_path), "job")
+    opt.set_train_summary(ts)
+    opt.optimize()
+    ts.close()
+    losses = ts.read_scalar("Loss")
+    assert len(losses) == 4  # 2 epochs x 2 iterations
+    # event file exists where TensorBoard expects it
+    files = os.listdir(os.path.join(str(tmp_path), "job", "train"))
+    assert any("tfevents" in f for f in files)
