@@ -1,0 +1,104 @@
+"""Transformer language model — the long-context flagship.
+
+No reference analogue: classic BigDL's sequence stack tops out at
+Recurrent/LSTM BPTT windows (SURVEY.md §5 "long-context: absent").  This
+model is the rebuild's new capability and the vehicle for the
+sequence-parallel / ring-attention / tensor-parallel paths in
+``bigdl_tpu.parallel``:
+
+* token + learned positional embeddings,
+* N pre-LN TransformerBlocks (Pallas flash attention on TPU),
+* final LayerNorm + vocab projection.
+
+Tokens are 0-based int32 (unlike LookupTable's 1-based parity
+convention — this model has no reference API to mirror).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from bigdl_tpu.nn.attention import (
+    LayerNorm,
+    PositionalEmbedding,
+    TransformerBlock,
+    _Composite,
+)
+from bigdl_tpu.nn.layers import Linear, _to_device
+from bigdl_tpu.nn.module import AbstractModule
+
+
+class TokenEmbedding(AbstractModule):
+    """0-based token embedding, N(0, 0.02) init (GPT convention)."""
+
+    param_names = ("weight",)
+
+    def __init__(self, vocab_size: int, dim: int):
+        super().__init__()
+        self._config = dict(vocab_size=vocab_size, dim=dim)
+        self.vocab_size = vocab_size
+        self.dim = dim
+        self.reset()
+
+    def reset(self):
+        from bigdl_tpu.common import RandomGenerator
+
+        self.weight = _to_device(
+            RandomGenerator.RNG.normal(
+                0.0, 0.02, size=(self.vocab_size, self.dim)
+            ).astype(np.float32)
+        )
+        return self
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        import jax.numpy as jnp
+
+        return jnp.take(params["weight"], input.astype(jnp.int32), axis=0)
+
+
+class TransformerLM(_Composite):
+    """Decoder-only causal LM over (batch, seq) int tokens -> logits
+    (batch, seq, vocab)."""
+
+    def __init__(self, vocab_size: int, dim: int = 256, n_head: int = 4,
+                 n_layer: int = 4, max_len: int = 1024, mlp_ratio: int = 4,
+                 dropout: float = 0.0, attn_impl: str = "auto"):
+        super().__init__()
+        self._config = dict(vocab_size=vocab_size, dim=dim, n_head=n_head,
+                            n_layer=n_layer, max_len=max_len,
+                            mlp_ratio=mlp_ratio, dropout=dropout)
+        self.vocab_size = vocab_size
+        self.dim = dim
+        self.n_layer = n_layer
+        self._add_child("wte", TokenEmbedding(vocab_size, dim))
+        self._add_child("wpe", PositionalEmbedding(max_len, dim))
+        for i in range(n_layer):
+            self._add_child(f"h{i}", TransformerBlock(
+                dim, n_head, mlp_ratio=mlp_ratio, causal=True,
+                attn_impl=attn_impl, dropout=dropout))
+        self._add_child("ln_f", LayerNorm(dim))
+        self._add_child("head", Linear(dim, vocab_size, with_bias=False))
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        import jax
+
+        c = self._children
+        x, _ = c["wte"].apply(params["wte"], {}, input)
+        x, _ = c["wpe"].apply(params["wpe"], {}, x)
+        for i in range(self.n_layer):
+            key = None
+            if rng is not None:
+                key = jax.random.fold_in(rng, i)
+            x, _ = c[f"h{i}"].apply(params[f"h{i}"], {}, x,
+                                    training=training, rng=key)
+        x, _ = c["ln_f"].apply(params["ln_f"], {}, x)
+        logits, _ = c["head"].apply(params["head"], {}, x)
+        return logits, state
+
+    def __repr__(self):
+        return (f"TransformerLM(vocab={self.vocab_size}, dim={self.dim}, "
+                f"layers={self.n_layer})")
+
+
+def build_transformer_lm(vocab_size: int, **kw) -> TransformerLM:
+    return TransformerLM(vocab_size, **kw)

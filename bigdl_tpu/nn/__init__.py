@@ -17,6 +17,12 @@ from bigdl_tpu.nn.module import (
 from bigdl_tpu.nn.layers import *  # noqa: F401,F403
 from bigdl_tpu.nn.layers import __all__ as _layers_all
 from bigdl_tpu.nn.graph import Graph, Input, Node, Model
+from bigdl_tpu.nn.attention import (
+    LayerNorm,
+    MultiHeadAttention,
+    TransformerBlock,
+    PositionalEmbedding,
+)
 from bigdl_tpu.nn.table_ops import (
     ConcatTable,
     ParallelTable,
@@ -86,6 +92,8 @@ __all__ = (
         "MultiMarginCriterion",
         "Recurrent", "RnnCell", "LSTM", "LSTMPeephole", "GRU", "BiRecurrent",
         "TimeDistributed", "Select",
+        "LayerNorm", "MultiHeadAttention", "TransformerBlock",
+        "PositionalEmbedding",
     ]
     + list(_layers_all)
 )

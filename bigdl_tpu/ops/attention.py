@@ -1,0 +1,256 @@
+"""Scaled-dot-product attention: lax reference + Pallas flash kernel.
+
+The reference framework predates attention entirely (SURVEY.md §5
+"long-context: absent") — this op is a *new* capability, the hot inner
+op of the Transformer/long-context stack (nn/attention.py,
+parallel/ring_attention.py).
+
+Design for the MXU/VMEM (pallas_guide.md):
+
+* the Pallas kernel is a classic flash attention: grid over
+  (batch*heads, query blocks), ``lax.fori_loop`` over key blocks, online
+  softmax with running max ``m`` and normalizer ``l`` kept in VMEM
+  scratch so the (T, T) score matrix never materialises in HBM;
+* block sizes are multiples of the fp32 (8, 128) tile, MXU-sized 128
+  where the sequence allows;
+* matmuls carry ``preferred_element_type=jnp.float32`` so bf16 inputs
+  accumulate in fp32 on the MXU.
+
+``dot_product_attention`` is the public entry: it picks the Pallas
+kernel on TPU backends when shapes tile cleanly, else the lax reference
+(which XLA fuses well on CPU and still decently on TPU).  Both paths
+are differentiable — the Pallas path via ``jax.custom_vjp`` with a
+flash-style backward that recomputes scores blockwise.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+
+
+# --------------------------------------------------------------------------
+# lax reference implementation
+# --------------------------------------------------------------------------
+
+
+def _reference_attention(q, k, v, *, causal: bool, scale: float,
+                         mask=None, seq_offset: int = 0):
+    """Plain softmax(q k^T) v.  (B, H, Tq, D) x (B, H, Tk, D).
+
+    ``seq_offset`` shifts query positions for causal masking — used by
+    ring attention where the local query block starts at a nonzero
+    absolute position.
+    """
+    import jax.numpy as jnp
+
+    scores = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        tq, tk = scores.shape[-2], scores.shape[-1]
+        qpos = jnp.arange(tq)[:, None] + seq_offset
+        kpos = jnp.arange(tk)[None, :]
+        scores = jnp.where(qpos >= kpos, scores, -jnp.inf)
+    if mask is not None:
+        scores = jnp.where(mask, scores, -jnp.inf)
+    # guard fully-masked rows (ring attention partial blocks): softmax of
+    # all -inf must give zeros, not NaN
+    row_max = jnp.max(scores, axis=-1, keepdims=True)
+    row_max = jnp.where(jnp.isfinite(row_max), row_max, 0.0)
+    unnorm = jnp.exp(scores - row_max)
+    denom = jnp.sum(unnorm, axis=-1, keepdims=True)
+    probs = unnorm / jnp.maximum(denom, 1e-30)
+    out = jnp.einsum(
+        "bhqk,bhkd->bhqd", probs, v.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    return out.astype(q.dtype)
+
+
+# --------------------------------------------------------------------------
+# Pallas flash attention (TPU)
+# --------------------------------------------------------------------------
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
+                      scale: float, causal: bool, seq_len: int):
+    """One (batch*head, q-block) program: stream key blocks, online
+    softmax.  Refs are VMEM blocks: q (1, block_q, d), k/v (1, T, d)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import pallas as pl
+
+    block_q = q_ref.shape[1]
+    d = q_ref.shape[2]
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale  # (block_q, d)
+
+    m0 = jnp.full((block_q,), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+
+    def body(ki, carry):
+        m, l, acc = carry
+        ks = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        vs = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, ks, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (block_q, block_k)
+        if causal:
+            qpos = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            kpos = ki * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(qpos >= kpos, s, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        # fully-masked rows keep m=-inf; use 0 shift there to avoid NaNs
+        shift = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(s - shift[:, None])
+        alpha = jnp.exp(jnp.where(jnp.isfinite(m), m - shift, -jnp.inf))
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+            p, vs, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    if causal:
+        # process key blocks up to and including the diagonal
+        last = (qi + 1) * block_q  # exclusive end of query positions
+        nk = lax.div(last + block_k - 1, block_k)
+        m, l, acc = lax.fori_loop(0, nk, body, (m0, l0, acc0))
+    else:
+        m, l, acc = lax.fori_loop(0, seq_len // block_k, body, (m0, l0, acc0))
+
+    out = acc / jnp.maximum(l, 1e-30)[:, None]
+    o_ref[0] = out.astype(o_ref.dtype)
+
+
+def _pick_block(t: int, preferred: int = 128) -> int:
+    for b in (preferred, 64, 32, 16, 8):
+        if t % b == 0:
+            return b
+    return 0
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "scale", "interpret")
+)
+def flash_attention(q, k, v, *, causal: bool = False,
+                    scale: Optional[float] = None, interpret: bool = False):
+    """Pallas flash attention.  q/k/v: (B, H, T, D) with T a multiple of
+    8 and D a multiple of... anything (padded to 128 lanes by Mosaic).
+
+    Differentiable: the backward recomputes attention with the lax
+    reference (rematerialisation — trading FLOPs for HBM, the standard
+    TPU bargain) so only the forward needs a hand kernel.
+    """
+    return _flash_attention_vjp(q, k, v, causal,
+                                scale if scale is not None else q.shape[-1] ** -0.5,
+                                interpret)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_attention_vjp(q, k, v, causal, scale, interpret):
+    return _flash_forward(q, k, v, causal, scale, interpret)
+
+
+def _flash_forward(q, k, v, causal, scale, interpret):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    b, h, t, d = q.shape
+    block_q = _pick_block(t)
+    block_k = _pick_block(t)
+    if not block_q:
+        return _reference_attention(q, k, v, causal=causal, scale=scale)
+
+    kernel = functools.partial(
+        _flash_fwd_kernel, block_k=block_k, scale=scale, causal=causal,
+        seq_len=t,
+    )
+    qr = q.reshape(b * h, t, d)
+    kr = k.reshape(b * h, t, d)
+    vr = v.reshape(b * h, t, d)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, t // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(b, h, t, d)
+
+
+def _flash_fwd_rule(q, k, v, causal, scale, interpret):
+    out = _flash_forward(q, k, v, causal, scale, interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd_rule(causal, scale, interpret, res, g):
+    import jax
+
+    q, k, v = res
+
+    def ref(q, k, v):
+        return _reference_attention(q, k, v, causal=causal, scale=scale)
+
+    _, vjp = jax.vjp(ref, q, k, v)
+    return vjp(g)
+
+
+_flash_attention_vjp.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+# --------------------------------------------------------------------------
+# public dispatcher
+# --------------------------------------------------------------------------
+
+
+def dot_product_attention(q, k, v, *, causal: bool = False, mask=None,
+                          scale: Optional[float] = None, impl: str = "auto",
+                          seq_offset: int = 0):
+    """Attention entry point used by nn.MultiHeadAttention.
+
+    q, k, v: (batch, heads, seq, head_dim).
+
+    impl: "auto" (Pallas on TPU when shapes tile, else lax), "pallas",
+    "pallas_interpret" (testing), or "lax".
+    """
+    import jax
+
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    t = q.shape[-2]
+    if impl == "auto":
+        on_tpu = jax.default_backend() == "tpu"
+        tiles = (
+            mask is None and seq_offset == 0
+            and q.shape == k.shape == v.shape
+            and t >= 128 and t % 128 == 0
+        )
+        impl = "pallas" if (on_tpu and tiles) else "lax"
+    if impl in ("pallas", "pallas_interpret"):
+        if mask is not None or seq_offset:
+            raise ValueError(
+                "the Pallas flash kernel supports neither an explicit mask "
+                "nor seq_offset; use impl='lax' (ring attention does)"
+            )
+        return flash_attention(q, k, v, causal=causal, scale=scale,
+                               interpret=(impl == "pallas_interpret"))
+    return _reference_attention(q, k, v, causal=causal, scale=scale,
+                                mask=mask, seq_offset=seq_offset)

@@ -1,0 +1,52 @@
+"""Int8 quantized matmul — the bigquant replacement.
+
+The reference's quantized inference path rides a native int8 gemm
+(`com.intel.analytics.bigdl.bigquant.BigQuant`, SURVEY.md §2.3) with
+per-output-channel scales.  The TPU-native equivalent is
+``lax.dot_general`` on int8 operands with
+``preferred_element_type=jnp.int32`` — the MXU multiplies int8 natively
+at 2x+ the bf16 rate — followed by a per-channel rescale that XLA fuses
+into the epilogue.
+"""
+
+from __future__ import annotations
+
+
+def quantize_per_channel(w, axis: int = 0):
+    """Symmetric per-channel int8 quantization of a float weight.
+
+    Returns (w_int8, scale) with ``w ≈ w_int8 * scale`` broadcast along
+    ``axis`` — the reference bigquant convention (per output channel).
+    """
+    import jax.numpy as jnp
+
+    reduce_axes = tuple(i for i in range(w.ndim) if i != axis)
+    absmax = jnp.max(jnp.abs(w), axis=reduce_axes, keepdims=True)
+    scale = jnp.maximum(absmax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def int8_matmul(x, w_q, w_scale, x_scale=None):
+    """y = x @ w_q.T * scales.
+
+    x: float (..., K) activations — dynamically quantized per-row unless
+    ``x_scale`` is given with an already-int8 ``x``.
+    w_q: int8 (N, K); w_scale: (N, 1) float.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    if x_scale is None:
+        # dynamic per-row symmetric activation quantization
+        absmax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+        x_scale = jnp.maximum(absmax, 1e-8) / 127.0
+        x_q = jnp.clip(jnp.round(x / x_scale), -127, 127).astype(jnp.int8)
+    else:
+        x_q = x
+    acc = lax.dot_general(
+        x_q, w_q,
+        dimension_numbers=(((x_q.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    return acc.astype(jnp.float32) * x_scale * w_scale.reshape(-1)

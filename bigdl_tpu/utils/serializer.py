@@ -35,6 +35,7 @@ def _build_registry():
     if _REGISTRY:
         return _REGISTRY
     import bigdl_tpu.nn as nn_pkg
+    import bigdl_tpu.models as models_pkg  # registers model-zoo modules
     import bigdl_tpu.nn.module as m_mod
     import bigdl_tpu.nn.layers as l_mod
     import bigdl_tpu.nn.table_ops as t_mod

@@ -1,0 +1,101 @@
+"""ops/ kernel tests — lax reference vs Pallas (interpret mode on CPU).
+
+Plays the role of the reference's Torch7 oracle specs (SURVEY.md §4.3):
+the lax implementation is the oracle; the Pallas kernel must match it.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.ops import dot_product_attention, int8_matmul, quantize_per_channel
+from bigdl_tpu.ops.attention import _reference_attention, flash_attention
+
+
+def _qkv(b=2, h=2, t=64, d=16, seed=0):
+    r = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(r.randn(b, h, t, d).astype(np.float32))
+    return mk(), mk(), mk()
+
+
+class TestAttention:
+    def test_reference_matches_naive_softmax(self):
+        q, k, v = _qkv()
+        out = _reference_attention(q, k, v, causal=False, scale=0.25)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * 0.25
+        want = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1), v)
+        np.testing.assert_allclose(out, want, atol=1e-5)
+
+    def test_causal_masks_future(self):
+        q, k, v = _qkv(t=16)
+        out = _reference_attention(q, k, v, causal=True, scale=0.25)
+        # position 0 attends only to key 0
+        want0 = v[:, :, 0, :]
+        np.testing.assert_allclose(out[:, :, 0, :], want0, atol=1e-5)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_flash_matches_reference(self, causal):
+        q, k, v = _qkv(t=64, d=16)
+        scale = 1.0 / np.sqrt(16)
+        ref = _reference_attention(q, k, v, causal=causal, scale=scale)
+        got = flash_attention(q, k, v, causal=causal, interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_flash_grad_matches_reference(self):
+        q, k, v = _qkv(t=32, d=8)
+
+        def loss_flash(q, k, v):
+            return jnp.sum(
+                flash_attention(q, k, v, causal=True, interpret=True) ** 2
+            )
+
+        def loss_ref(q, k, v):
+            return jnp.sum(
+                _reference_attention(
+                    q, k, v, causal=True, scale=8 ** -0.5
+                ) ** 2
+            )
+
+        g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-4, rtol=1e-4)
+
+    def test_seq_offset_matches_full_causal(self):
+        # ring-attention building block: computing the second half of the
+        # queries with seq_offset must equal the full causal slice
+        q, k, v = _qkv(t=32, d=8)
+        full = _reference_attention(q, k, v, causal=True, scale=0.5)
+        half = _reference_attention(
+            q[:, :, 16:], k, v, causal=True, scale=0.5, seq_offset=16
+        )
+        np.testing.assert_allclose(np.asarray(half),
+                                   np.asarray(full[:, :, 16:]), atol=1e-5)
+
+    def test_dispatcher_lax_path(self):
+        q, k, v = _qkv(t=24, d=8)  # 24 not a multiple of 128 -> lax
+        out = dot_product_attention(q, k, v, causal=False)
+        assert out.shape == q.shape
+
+
+class TestInt8Matmul:
+    def test_quantize_roundtrip(self):
+        w = jnp.asarray(np.random.RandomState(0).randn(16, 32).astype(np.float32))
+        q, scale = quantize_per_channel(w, axis=0)
+        assert q.dtype == jnp.int8
+        np.testing.assert_allclose(np.asarray(q * scale), np.asarray(w),
+                                   atol=np.abs(w).max() / 100)
+
+    def test_matmul_close_to_fp32(self):
+        r = np.random.RandomState(1)
+        x = jnp.asarray(r.randn(4, 32).astype(np.float32))
+        w = jnp.asarray(r.randn(8, 32).astype(np.float32))
+        wq, ws = quantize_per_channel(w, axis=0)
+        got = int8_matmul(x, wq, ws)
+        want = x @ w.T
+        err = np.abs(np.asarray(got - want)).max()
+        assert err < 0.05 * np.abs(np.asarray(want)).max() + 0.05
