@@ -66,7 +66,8 @@ class TransformerLM(_Composite):
         super().__init__()
         self._config = dict(vocab_size=vocab_size, dim=dim, n_head=n_head,
                             n_layer=n_layer, max_len=max_len,
-                            mlp_ratio=mlp_ratio, dropout=dropout)
+                            mlp_ratio=mlp_ratio, dropout=dropout,
+                            attn_impl=attn_impl)
         self.vocab_size = vocab_size
         self.dim = dim
         self.n_layer = n_layer

@@ -86,7 +86,8 @@ class MultiHeadAttention(AbstractModule):
         if dim % n_head:
             raise ValueError(f"dim {dim} not divisible by n_head {n_head}")
         self._config = dict(dim=dim, n_head=n_head, causal=causal,
-                            with_bias=with_bias, dropout=dropout)
+                            with_bias=with_bias, dropout=dropout,
+                            attn_impl=attn_impl)
         self.dim = dim
         self.n_head = n_head
         self.head_dim = dim // n_head
@@ -112,9 +113,15 @@ class MultiHeadAttention(AbstractModule):
         b, t, _ = x.shape
         return x.reshape(b, t, self.n_head, self.head_dim).transpose(0, 2, 1, 3)
 
-    def update_output_pure(self, params, input, *, training=False, rng=None):
+    def _inner_attention(self, q, k, v):
+        """softmax(QKᵀ)V on (B, H, T, D) heads — the override seam for
+        parallel.RingMultiHeadAttention and other attention variants."""
         from bigdl_tpu.ops import dot_product_attention
 
+        return dot_product_attention(q, k, v, causal=self.causal,
+                                     impl=self.attn_impl)
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
         jnp = _jnp()
         x = input
         q = jnp.matmul(x, params["wq"].T)
@@ -123,8 +130,7 @@ class MultiHeadAttention(AbstractModule):
         if self.with_bias:
             q, k, v = q + params["bq"], k + params["bk"], v + params["bv"]
         q, k, v = self._split(q), self._split(k), self._split(v)
-        o = dot_product_attention(q, k, v, causal=self.causal,
-                                  impl=self.attn_impl)
+        o = self._inner_attention(q, k, v)
         b, h, t, hd = o.shape
         o = o.transpose(0, 2, 1, 3).reshape(b, t, h * hd)
         if training and self.dropout > 0 and rng is not None:
@@ -212,7 +218,8 @@ class TransformerBlock(_Composite):
         from bigdl_tpu.nn.layers import Linear
 
         self._config = dict(dim=dim, n_head=n_head, mlp_ratio=mlp_ratio,
-                            causal=causal, dropout=dropout)
+                            causal=causal, dropout=dropout,
+                            attn_impl=attn_impl)
         self.dim = dim
         self._add_child("ln1", LayerNorm(dim))
         self._add_child("attn", MultiHeadAttention(

@@ -1,0 +1,45 @@
+"""bigdl_tpu.parallel — parallelism strategies over a jax.sharding.Mesh.
+
+The reference implements synchronous data parallelism only (SURVEY.md
+§2.4): its `AllReduceParameter` push/pull over Spark BlockManager is
+reduce-scatter + all-gather, which `optim.DistriOptimizer` reproduces
+natively with `psum_scatter`/`all_gather` inside one jitted shard_map.
+
+This package holds everything BEYOND the reference's data parallelism —
+the TPU-first capabilities the mesh seams were left open for:
+
+* `ring` — ring attention (sequence/context parallelism): the sequence
+  axis is sharded over devices; K/V blocks rotate around the ICI ring
+  via `ppermute` while an online-softmax accumulator keeps the
+  attention exact.  Long-context training scales linearly in devices.
+* `tensor_parallel` — GSPMD-style tensor parallelism: parameter
+  PartitionSpec rules + `with_sharding_constraint` helpers.  No manual
+  collectives; XLA inserts all-gathers/reduce-scatters from the
+  shardings.
+* `pipeline` — collective-permute pipeline parallelism over identical
+  stages (scan over microbatches, activations hop stage-to-stage on
+  the ring).
+* `moe` — expert parallelism: GShard-style dense dispatch/combine
+  einsums with the expert axis sharded over the mesh (all_to_all falls
+  out of GSPMD).
+
+All strategies compose with DistriOptimizer's data axis by adding axes
+to `Engine.build_mesh({"data": ..., "seq": ..., "model": ...})`.
+"""
+
+from bigdl_tpu.parallel.ring import (  # noqa: F401
+    ring_attention,
+    ring_attention_sharded,
+    RingMultiHeadAttention,
+)
+from bigdl_tpu.parallel.tensor_parallel import (  # noqa: F401
+    shard_params,
+    constrain,
+    param_specs,
+    TRANSFORMER_TP_RULES,
+)
+from bigdl_tpu.parallel.pipeline import (  # noqa: F401
+    pipeline_apply,
+    pipelined,
+)
+from bigdl_tpu.parallel.moe import MoE  # noqa: F401

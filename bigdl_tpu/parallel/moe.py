@@ -1,0 +1,168 @@
+"""Expert parallelism — a GShard-style Mixture-of-Experts layer.
+
+New capability (nothing comparable in the reference; the nearest
+relative is `MixtureTable`'s dense gating).  TPU-first design:
+
+* routing is expressed as dense one-hot dispatch/combine einsums —
+  static shapes, no gather/scatter, so XLA tiles everything onto the
+  MXU and turns the (tokens ↔ expert-buffer) contractions into
+  `all_to_all`s when the expert dim is sharded over a mesh axis;
+* top-1 (switch) or top-2 routing with a capacity factor: each expert
+  processes at most C = ceil(cap·S·k/E) tokens, overflow tokens fall
+  through the residual (standard switch-transformer semantics);
+* an auxiliary load-balancing loss (mean gate prob × mean token
+  fraction per expert, scaled by E) is exposed via `aux_loss` from the
+  last forward.
+
+With `mesh` given, expert-indexed buffers are sharding-constrained to
+P('expert', ...) so each device owns E/n experts.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as np
+
+from bigdl_tpu.nn.module import AbstractModule
+from bigdl_tpu.nn.layers import Xavier, _to_device
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+class MoE(AbstractModule):
+    """Token-routed FFN bank: (B, T, D) -> (B, T, D)."""
+
+    param_names = ("gate", "w_in", "b_in", "w_out", "b_out")
+
+    def __init__(self, dim: int, hidden: int, n_experts: int,
+                 top_k: int = 1, capacity_factor: float = 1.25,
+                 mesh=None, expert_axis: str = "expert",
+                 aux_loss_weight: float = 0.01):
+        super().__init__()
+        if top_k not in (1, 2):
+            raise ValueError("top_k must be 1 or 2")
+        self._config = dict(dim=dim, hidden=hidden, n_experts=n_experts,
+                            top_k=top_k, capacity_factor=capacity_factor,
+                            aux_loss_weight=aux_loss_weight)
+        self.dim = dim
+        self.hidden = hidden
+        self.n_experts = n_experts
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.mesh = mesh
+        self.expert_axis = expert_axis
+        self.aux_loss_weight = aux_loss_weight
+        self._init_method = Xavier()
+        self.reset()
+
+    def reset(self):
+        from bigdl_tpu.common import RandomGenerator
+
+        e, d, h = self.n_experts, self.dim, self.hidden
+        rng = RandomGenerator.RNG
+        # gate: (D, E); experts: batched FFN weights
+        self.gate = _to_device(
+            rng.normal(0.0, math.sqrt(1.0 / d), (d, e)).astype(np.float32)
+        )
+        self.w_in = _to_device(
+            rng.normal(0.0, math.sqrt(2.0 / d), (e, d, h)).astype(np.float32)
+        )
+        self.b_in = _to_device(np.zeros((e, h), np.float32))
+        self.w_out = _to_device(
+            rng.normal(0.0, math.sqrt(1.0 / h), (e, h, d)).astype(np.float32)
+        )
+        self.b_out = _to_device(np.zeros((e, d), np.float32))
+        return self
+
+    def _constrain(self, x, *spec):
+        if self.mesh is None:
+            return x
+        from bigdl_tpu.parallel.tensor_parallel import constrain
+
+        return constrain(x, self.mesh, *spec)
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        y, _ = self.forward_with_aux(params, input, training=training,
+                                     rng=rng)
+        return y
+
+    def forward_with_aux(self, params, input, *, training=False, rng=None):
+        """Forward returning ``(output, aux_loss)``.  Use this inside a
+        jitted training loss to add the load-balancing term — the aux
+        loss is a traced value and must flow through the return, never
+        through module attributes."""
+        import jax
+        jnp = _jnp()
+
+        b, t, d = input.shape
+        s = b * t
+        e = self.n_experts
+        cap = max(1, int(math.ceil(
+            self.capacity_factor * s * self.top_k / e
+        )))
+        x = input.reshape(s, d)
+
+        logits = x @ params["gate"]                     # (S, E)
+        probs = jax.nn.softmax(logits, axis=-1)
+
+        # --- top-k expert choice -------------------------------------
+        dispatch = jnp.zeros((s, e, cap), input.dtype)
+        combine = jnp.zeros((s, e, cap), jnp.float32)
+        masked_probs = probs
+        aux_frac = jnp.zeros((e,), jnp.float32)
+        # slots already consumed in each expert's buffer by earlier
+        # routing iterations — without this, a 2nd-choice token and a
+        # 1st-choice token of the same expert land in the same slot
+        slot_base = jnp.zeros((e,), jnp.float32)
+        for _ in range(self.top_k):
+            choice = jnp.argmax(masked_probs, axis=-1)          # (S,)
+            onehot = jax.nn.one_hot(choice, e, dtype=jnp.float32)
+            # position of each token within its expert's buffer
+            pos = (jnp.cumsum(onehot, axis=0) - onehot) + slot_base
+            pos_tok = jnp.sum(pos * onehot, axis=-1)            # (S,)
+            keep = pos_tok < cap
+            gatep = jnp.sum(probs * onehot, axis=-1) * keep     # (S,)
+            poh = jax.nn.one_hot(pos_tok.astype(jnp.int32), cap,
+                                 dtype=jnp.float32)
+            d1 = onehot[:, :, None] * poh[:, None, :] * keep[:, None, None]
+            dispatch = dispatch + d1.astype(input.dtype)
+            combine = combine + gatep[:, None, None] * d1
+            aux_frac = aux_frac + jnp.mean(onehot, axis=0)
+            slot_base = slot_base + jnp.sum(onehot, axis=0)
+            masked_probs = masked_probs * (1.0 - onehot)
+
+        # load-balance aux loss (switch transformer eq. 4)
+        aux_loss = self.aux_loss_weight * e * jnp.sum(
+            aux_frac / self.top_k * jnp.mean(probs, axis=0)
+        )
+
+        # --- dispatch → expert FFN → combine -------------------------
+        xin = jnp.einsum("sec,sd->ecd", dispatch, x,
+                         preferred_element_type=jnp.float32)
+        xin = self._constrain(xin, self.expert_axis, None, None)
+        h = jax.nn.relu(
+            jnp.einsum("ecd,edh->ech", xin, params["w_in"],
+                       preferred_element_type=jnp.float32)
+            + params["b_in"][:, None, :]
+        )
+        out = jnp.einsum("ech,ehd->ecd", h, params["w_out"],
+                         preferred_element_type=jnp.float32) \
+            + params["b_out"][:, None, :]
+        out = self._constrain(out, self.expert_axis, None, None)
+        y = jnp.einsum("sec,ecd->sd", combine, out,
+                       preferred_element_type=jnp.float32)
+        # renormalize top-2 so kept gates sum to 1 (dropped → residual 0)
+        if self.top_k > 1:
+            gsum = jnp.sum(combine, axis=(1, 2))
+            y = y / jnp.maximum(gsum, 1e-9)[:, None]
+        return y.astype(input.dtype).reshape(b, t, d), aux_loss
+
+    def __repr__(self):
+        return (f"MoE(dim={self.dim}, hidden={self.hidden}, "
+                f"experts={self.n_experts}, top_k={self.top_k})")

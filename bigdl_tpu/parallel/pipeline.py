@@ -1,0 +1,93 @@
+"""Pipeline parallelism — collective-permute schedule over a mesh axis.
+
+The scaling-book recipe: N identical stages live on N devices (stage
+parameters are the SAME pytree with a leading stage dim, sharded over
+the `pipe` axis).  Microbatches stream in at stage 0; every step each
+device applies its stage and `ppermute`s the activation to the next
+device.  After M + N - 1 steps (M microbatches, N stages — the GPipe
+fill/drain bubble) the last device has produced every output.
+
+All control flow is a `lax.fori_loop` with static shapes — one XLA
+program, no per-microbatch dispatch; the ppermute rides the ICI ring.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+
+def pipeline_apply(stage_fn: Callable, stage_params, x, axis_name: str):
+    """Run the pipeline.  MUST be called inside shard_map with
+    `axis_name` bound.
+
+    Args:
+      stage_fn: (params, act) -> act, shape-preserving (a pipeline
+        stage; e.g. one TransformerBlock.apply closed over state).
+      stage_params: THIS device's stage parameters.
+      x: microbatched input (M, mb, ...), replicated on every device.
+    Returns:
+      (M, mb, ...) outputs of the final stage, replicated (psum
+      broadcast off the last device).
+    """
+    import jax
+    from jax import lax
+    import jax.numpy as jnp
+
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    m = x.shape[0]
+    perm = [(j, j + 1) for j in range(n - 1)]
+
+    ybuf = jnp.zeros_like(x)
+    recv = jnp.zeros_like(x[0])
+
+    def step(t, carry):
+        recv, ybuf = carry
+        # stage 0 injects microbatch t (clamped: the drain-phase reads
+        # feed garbage that never reaches the output buffer in time)
+        inj = lax.dynamic_index_in_dim(
+            x, jnp.clip(t, 0, m - 1), axis=0, keepdims=False
+        )
+        inp = jnp.where(idx == 0, inj, recv)
+        out = stage_fn(stage_params, inp)
+        # the last device emits microbatch t-(n-1) at step t
+        oidx = t - (n - 1)
+        upd = lax.dynamic_update_index_in_dim(
+            ybuf, out, jnp.clip(oidx, 0, m - 1), axis=0
+        )
+        ybuf = jnp.where(oidx >= 0, upd, ybuf)
+        recv = lax.ppermute(out, axis_name, perm)
+        return recv, ybuf
+
+    _, ybuf = lax.fori_loop(0, m + n - 1, step, (recv, ybuf))
+    # broadcast the last device's buffer to all (replicated output)
+    return lax.psum(jnp.where(idx == n - 1, ybuf, 0.0), axis_name)
+
+
+def pipelined(stage_fn: Callable, mesh, axis_name: str = "pipe"):
+    """shard_map wrapper.  Returns `f(stacked_params, x_microbatched)`:
+
+    * stacked_params: stage params pytree with a leading stage dim of
+      size mesh.shape[axis_name] on every leaf (stack the per-stage
+      params with `jax.tree.map(lambda *a: jnp.stack(a), *stages)`);
+    * x_microbatched: (M, mb, ...) input.
+
+    Composable under jit; the stage dim is sharded over `axis_name` so
+    each device holds exactly its own stage's weights.
+    """
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from bigdl_tpu.optim.distri_optimizer import _shard_map
+
+    def body(stacked_local, x):
+        params = jax.tree.map(lambda a: a[0], stacked_local)
+        return pipeline_apply(stage_fn, params, x, axis_name)
+
+    def run(stacked_params, x):
+        pspecs = jax.tree.map(lambda _: P(axis_name), stacked_params)
+        return _shard_map(
+            body, mesh, in_specs=(pspecs, P()), out_specs=P()
+        )(stacked_params, x)
+
+    return run

@@ -1,0 +1,157 @@
+"""Ring attention — exact attention with the sequence axis sharded.
+
+New capability (the reference has nothing past `Recurrent`'s BPTT
+windows — SURVEY.md §5 "long-context: absent"); designed TPU-first:
+
+* each device holds a (B, H, T/n, D) block of Q, K, V;
+* K/V blocks rotate around the ICI ring with `lax.ppermute` (n-1 hops,
+  each overlapping with the local block's attention compute once XLA
+  schedules the ring);
+* a flash-style online softmax (running max `m`, normalizer `l`,
+  unnormalized accumulator `acc`) combines per-block partial results,
+  so attention is *exact* — not windowed/approximate — while no device
+  ever materialises the (T, T) score matrix or the full K/V.
+
+Memory per device: O(T/n · T/n) scores + O(T/n · D) state, so max
+sequence length scales linearly with the ring size.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+from bigdl_tpu.nn.attention import MultiHeadAttention
+
+
+def _block_partials(q, k, v, scale, causal, q_off, k_off):
+    """Partial attention of a q block against one k/v block.
+
+    Returns (m, l, acc): running row max (B,H,Tq), normalizer (B,H,Tq)
+    and accumulator (B,H,Tq,D), all relative to shift `where(isfinite(m),
+    m, 0)` — the flash attention invariant.
+    """
+    import jax.numpy as jnp
+
+    q32 = q.astype(jnp.float32)
+    k32 = k.astype(jnp.float32)
+    scores = jnp.einsum(
+        "bhqd,bhkd->bhqk", q32, k32, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        tq, tk = scores.shape[-2], scores.shape[-1]
+        qpos = jnp.arange(tq)[:, None] + q_off
+        kpos = jnp.arange(tk)[None, :] + k_off
+        scores = jnp.where(qpos >= kpos, scores, -jnp.inf)
+    m = jnp.max(scores, axis=-1)
+    shift = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(scores - shift[..., None])
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    return m, l, acc
+
+
+def _combine(m, l, acc, mi, li, acci):
+    """Merge two flash-partials into one (same invariant)."""
+    import jax.numpy as jnp
+
+    m_new = jnp.maximum(m, mi)
+    shift = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    alpha = jnp.exp(jnp.where(jnp.isfinite(m), m, -jnp.inf) - shift)
+    beta = jnp.exp(jnp.where(jnp.isfinite(mi), mi, -jnp.inf) - shift)
+    l_new = l * alpha + li * beta
+    acc_new = acc * alpha[..., None] + acci * beta[..., None]
+    return m_new, l_new, acc_new
+
+
+def ring_attention(q, k, v, axis_name: str, *, causal: bool = False,
+                   scale: Optional[float] = None):
+    """Exact ring attention.  MUST run inside shard_map (or pmap) with
+    `axis_name` bound; q/k/v are the LOCAL (B, H, T/n, D) blocks, laid
+    out in ring order (device i holds positions [i·T/n, (i+1)·T/n)).
+    """
+    import jax
+    from jax import lax
+    import jax.numpy as jnp
+
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    n = lax.psum(1, axis_name)  # static: the axis size
+    idx = lax.axis_index(axis_name)
+    t_loc = q.shape[2]
+    q_off = idx * t_loc
+
+    b, h, _, d = q.shape
+    m = jnp.full((b, h, t_loc), -jnp.inf, jnp.float32)
+    l = jnp.zeros((b, h, t_loc), jnp.float32)
+    acc = jnp.zeros((b, h, t_loc, d), jnp.float32)
+
+    ks, vs = k, v
+    perm = [(j, (j + 1) % n) for j in range(n)]
+    for s in range(n):
+        # after s forward rotations, device idx holds the block that
+        # started on device (idx - s) % n
+        k_off = ((idx - s) % n) * t_loc
+        mi, li, acci = _block_partials(q, ks, vs, scale, causal, q_off, k_off)
+        m, l, acc = _combine(m, l, acc, mi, li, acci)
+        if s != n - 1:  # last hop would be a wasted full-circle rotation
+            ks = lax.ppermute(ks, axis_name, perm)
+            vs = lax.ppermute(vs, axis_name, perm)
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention_sharded(q, k, v, mesh, *, seq_axis: str = "seq",
+                           batch_axis: Optional[str] = None,
+                           causal: bool = False,
+                           scale: Optional[float] = None):
+    """shard_map wrapper: q/k/v are GLOBAL (B, H, T, D) arrays; the seq
+    dim is sharded over `seq_axis` (and optionally batch over
+    `batch_axis`).  Composable under jit — GSPMD reshards inputs to the
+    in_specs automatically.
+    """
+    from functools import partial
+
+    from jax.sharding import PartitionSpec as P
+
+    from bigdl_tpu.optim.distri_optimizer import _shard_map
+
+    spec = P(batch_axis, None, seq_axis, None)
+    f = partial(ring_attention, axis_name=seq_axis, causal=causal,
+                scale=scale)
+    return _shard_map(f, mesh, in_specs=(spec, spec, spec),
+                      out_specs=spec)(q, k, v)
+
+
+class RingMultiHeadAttention(MultiHeadAttention):
+    """MultiHeadAttention whose inner attention runs as ring attention
+    over a mesh sequence axis — drop-in for the Transformer stack when
+    sequences outgrow one device's HBM.
+
+    The module's projections stay ordinary matmuls (GSPMD shards them by
+    the activations' sequence sharding); only softmax(QKᵀ)V needs the
+    explicit ring because its reduction spans the full sequence axis.
+    """
+
+    def __init__(self, dim: int, n_head: int, mesh, *,
+                 seq_axis: str = "seq", batch_axis: Optional[str] = None,
+                 causal: bool = False, with_bias: bool = True,
+                 dropout: float = 0.0):
+        super().__init__(dim, n_head, causal=causal, with_bias=with_bias,
+                         dropout=dropout)
+        self.mesh = mesh
+        self.seq_axis = seq_axis
+        self.batch_axis = batch_axis
+
+    def _inner_attention(self, q, k, v):
+        return ring_attention_sharded(
+            q, k, v, self.mesh, seq_axis=self.seq_axis,
+            batch_axis=self.batch_axis, causal=self.causal,
+        )
+
+    def __repr__(self):
+        return (f"RingMultiHeadAttention(dim={self.dim}, heads={self.n_head},"
+                f" seq_axis={self.seq_axis!r}, causal={self.causal})")

@@ -1,0 +1,118 @@
+"""Tensor parallelism, the GSPMD way.
+
+No manual collectives: tensor parallelism on TPU is expressed by
+*placing* parameters with `NamedSharding`s and (where XLA needs a hint)
+`with_sharding_constraint` on activations; the compiler inserts the
+all-gather / reduce-scatter pairs and overlaps them with MXU work.
+
+`shard_params` walks a module's (nested-dict) param pytree and applies
+the first matching (path-regex → PartitionSpec) rule.  Megatron-style
+rules for the Transformer stack ship as `TRANSFORMER_TP_RULES`:
+
+* attention wq/wk/wv: rows (output features = heads) split over
+  `model` — each device computes its own heads;
+* attention wo: columns split over `model` — the psum after the
+  row-parallel matmul is the only cross-device hop per block;
+* MLP in/out likewise column-then-row.
+
+Weights here are (out_features, in_features), applied as `x @ W.T`
+(torch convention, matching nn.Linear / nn.MultiHeadAttention).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Iterable, Tuple
+
+
+# (path regex, spec builder) — specs as tuples of axis names / None;
+# turned into PartitionSpec at apply time so this module imports cheap.
+TRANSFORMER_TP_RULES: Tuple[Tuple[str, tuple], ...] = (
+    (r"attn/w[qkv]$", ("model", None)),
+    (r"attn/b[qkv]$", ("model",)),
+    (r"attn/wo$", (None, "model")),
+    # TransformerBlock MLP: fc1 column-parallel, fc2 row-parallel
+    (r"fc1/weight$", ("model", None)),
+    (r"fc1/bias$", ("model",)),
+    (r"fc2/weight$", (None, "model")),
+    # TransformerLM embeddings: split the feature dim
+    (r"w[tp]e/weight$", (None, "model")),
+    (r"head/weight$", ("model", None)),
+)
+
+
+def _walk(tree, prefix=""):
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            yield from _walk(v, f"{prefix}/{k}" if prefix else str(k))
+    elif tree is not None:
+        yield prefix, tree
+
+
+def _match(path: str, rules: Iterable[Tuple[str, tuple]]):
+    for pat, spec in rules:
+        if re.search(pat, path):
+            return spec
+    return None
+
+
+def param_specs(params, mesh, rules=TRANSFORMER_TP_RULES):
+    """Mirror of the param pytree with a PartitionSpec per leaf (P() —
+    replicated — where no rule matches).  Feed to jit in_shardings or
+    `shard_params`."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    flat = {p: _match(p, rules) for p, _ in _walk(params)}
+
+    def build(tree, prefix=""):
+        if isinstance(tree, dict):
+            return {
+                k: build(v, f"{prefix}/{k}" if prefix else str(k))
+                for k, v in tree.items()
+            }
+        if tree is None:
+            return None
+        spec = flat.get(prefix)
+        # drop axes that don't divide the dim (GSPMD would error)
+        if spec is not None:
+            shape = tree.shape
+            ok = all(
+                a is None or (i < len(shape)
+                              and shape[i] % mesh.shape[a] == 0)
+                for i, a in enumerate(spec)
+            )
+            if ok:
+                return P(*spec)
+        return P()
+
+    del jax
+    return build(params)
+
+
+def shard_params(params, mesh, rules=TRANSFORMER_TP_RULES):
+    """device_put every param leaf onto the mesh per the rules.  Returns
+    the sharded pytree (leaves are committed global arrays)."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    specs = param_specs(params, mesh, rules)
+    return jax.tree.map(
+        lambda x, s: x if x is None else jax.device_put(
+            x, NamedSharding(mesh, s)
+        ),
+        params, specs,
+        is_leaf=lambda x: x is None or hasattr(x, "shape"),
+    )
+
+
+def constrain(x, mesh, *spec_axes):
+    """`with_sharding_constraint` shorthand: constrain(x, mesh, 'data',
+    None, 'model') pins activation layout where XLA's propagation needs
+    the hint (typically the residual stream under dp×tp)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P(*spec_axes))
+    )
