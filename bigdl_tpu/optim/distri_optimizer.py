@@ -83,6 +83,27 @@ class DistriOptimizer(LocalOptimizer):
         self._pad = 0
 
     # ------------------------------------------------------------ sharding
+    def _init_params(self):
+        """The ZeRO-1 data plane works on the flat parameter vector (the
+        reference's AllReduceParameter flat layout); keep the unravel
+        closure for write-back."""
+        from jax.flatten_util import ravel_pytree
+
+        flat, unravel = ravel_pytree(self.model.params())
+        self._unravel = unravel
+        return flat
+
+    def _write_back(self, pvar, mod_state):
+        # unravel allocates fresh arrays; mod_state is copied so the model
+        # never aliases buffers the donated step will delete
+        import jax
+
+        jnp = _jnp()
+        self.model.set_params(self._unravel(pvar))
+        self.model.set_state(
+            jax.tree.map(lambda a: jnp.array(a, copy=True), mod_state)
+        )
+
     def _init_opt_state(self, flat):
         """Optimizer state lives only on the owner shard (reference:
         «bigdl»/parameters/AllReduceParameter.scala — "optimizer state
@@ -95,6 +116,17 @@ class DistriOptimizer(LocalOptimizer):
         self._pad = (-flat.size) % n
         shard_len = (flat.size + self._pad) // n
         opt = self.optim_method
+        if opt.state is not None:
+            # guard against an OptimMethod whose state was built by
+            # LocalOptimizer (nested pytree slots) — the ZeRO data plane
+            # needs flat shard-shaped state
+            for v in opt.state.values():
+                if not hasattr(v, "ndim"):
+                    raise ValueError(
+                        "optim_method.state was initialised for tree "
+                        "parameters (LocalOptimizer); reset it (state=None) "
+                        "before reusing the method with DistriOptimizer"
+                    )
         if opt.state is None:
             # build state against a single shard-sized template, then
             # expand vector entries across the mesh
@@ -114,14 +146,14 @@ class DistriOptimizer(LocalOptimizer):
             opt.state = sharded
         return opt.state
 
-    def _build_train_step(self, unravel):
+    def _build_train_step(self):
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         jnp = _jnp()
         opt = self.optim_method
         clipper = self._clipper
-        loss_fn = self._loss_fn(unravel)
+        loss_fn = self._loss_fn()
         n = self.n_shards
         axis = self.axis
         pad = self._pad
@@ -187,13 +219,14 @@ class DistriOptimizer(LocalOptimizer):
 
         return train_step
 
-    def _loss_fn(self, unravel):
+    def _loss_fn(self):
         """Reference semantics: sub-model gradients are *summed* then
         divided by the global batch size (SURVEY.md §7 hard part 2).  The
         criterion's sizeAverage divides by the local sub-batch; multiply
         back so psum_scatter(sum) / global_batch is exact."""
         model, criterion = self.model, self.criterion
         local_bs = self.batch_size // self.n_shards
+        unravel = self._unravel
 
         def loss_fn(flat_p, mstate, rng, inp, tgt):
             p = unravel(flat_p)

@@ -4,12 +4,14 @@ Rebuild of «bigdl»/optim/{SGD,Adam,Adagrad,Adadelta,Adamax,RMSprop,Ftrl}.scala
 (SURVEY.md §2.1 "OptimMethods": each has ``optimize(feval, x)`` mutating a
 flat parameter tensor plus its own state table).
 
-The rebuild keeps the reference's **flat-parameter** design: every method
-is a pure, jittable ``step(grad, param, state) -> (param, state)`` over
-1-D vectors.  That purity is what lets DistriOptimizer run the *same*
-method unchanged on a ZeRO-1 weight shard inside ``shard_map`` — the
+Every method is a pure, jittable ``step(grad, param, state) ->
+(param, state)`` over an arbitrary **pytree** of parameters (all update
+math is elementwise, expressed with ``jax.tree.map``).  A single flat
+vector is just a one-leaf pytree, so DistriOptimizer runs the *same*
+method unchanged on its ZeRO-1 weight shard inside ``shard_map`` — the
 owner-slice update of the reference's ``AllReduceParameter`` scheme
-(SURVEY.md §2.4 row 3).
+(SURVEY.md §2.4 row 3) — while LocalOptimizer passes the native
+parameter tree (no ravel/unravel copies on the hot path).
 
 State counters live in the state dict as JAX scalars so stepping never
 retraces.  ``optimize(feval, x)`` is kept as the BigDL-parity wrapper.
@@ -26,6 +28,21 @@ def _jnp():
     import jax.numpy as jnp
 
     return jnp
+
+
+def _tmap(f, *trees):
+    import jax
+
+    return jax.tree.map(f, *trees)
+
+
+def _global_sq_norm(tree):
+    """Sum of squares over every leaf (scalar)."""
+    import jax
+
+    jnp = _jnp()
+    leaves = jax.tree.leaves(tree)
+    return sum(jnp.sum(l * l) for l in leaves)
 
 
 # --------------------------------------------------------------------------
@@ -222,9 +239,10 @@ class Plateau(LearningRateSchedule):
 
 
 class OptimMethod:
-    """Base class.  Pure ``step`` over flat vectors; stateful
-    ``optimize(feval, x)`` for reference-API parity (mutation expressed by
-    returning the new vector and keeping state on self)."""
+    """Base class.  Pure ``step`` over parameter pytrees (a flat vector
+    is the one-leaf case); stateful ``optimize(feval, x)`` for
+    reference-API parity (mutation expressed by returning the new vector
+    and keeping state on self)."""
 
     def __init__(self):
         self.state = None  # host-side mirror of the jittable state dict
@@ -249,8 +267,8 @@ class OptimMethod:
         return sched.rate(self.learningrate, state)
 
     def step(self, grad, param, state):
-        """(flat grad, flat param, state) -> (new flat param, new state).
-        Must be pure/jittable; runs unchanged on a ZeRO-1 shard."""
+        """(grad tree, param tree, state) -> (new param tree, new state).
+        Must be pure/jittable; runs unchanged on a ZeRO-1 flat shard."""
         raise NotImplementedError
 
     # ---- reference-parity API ------------------------------------------
@@ -268,17 +286,38 @@ class OptimMethod:
     def get_hyper_parameter(self) -> str:
         return f"learningrate={getattr(self, 'learningrate', None)}"
 
-    # checkpoint support («bigdl» OptimMethod.save/load)
+    # checkpoint support («bigdl» OptimMethod.save/load).  State entries
+    # may be pytrees (nested string-keyed dicts matching the model's
+    # parameter tree); they flatten to "/"-joined keys for npz storage.
     def get_state_arrays(self):
-        import jax
-
         if self.state is None:
             return {}
-        return {k: np.asarray(v) for k, v in self.state.items()}
+        out = {}
+
+        def walk(prefix, v):
+            if isinstance(v, dict):
+                for k, sub in v.items():
+                    walk(f"{prefix}/{k}" if prefix else k, sub)
+            else:
+                out[prefix] = np.asarray(v)
+
+        walk("", self.state)
+        return out
+
+    @staticmethod
+    def _unflatten_state(arrays: dict) -> dict:
+        jnp = _jnp()
+        state: dict = {}
+        for key, v in arrays.items():
+            parts = key.split("/")
+            d = state
+            for p in parts[:-1]:
+                d = d.setdefault(p, {})
+            d[parts[-1]] = jnp.asarray(v)
+        return state
 
     def load_state_arrays(self, arrays: dict):
-        jnp = _jnp()
-        self.state = {k: jnp.asarray(v) for k, v in arrays.items()}
+        self.state = self._unflatten_state(arrays)
 
     def save(self, path: str):
         np.savez(path, __class__=type(self).__name__, **self.get_state_arrays())
@@ -286,7 +325,9 @@ class OptimMethod:
     @staticmethod
     def load_state(path: str) -> dict:
         data = np.load(path, allow_pickle=True)
-        return {k: data[k] for k in data.files if k != "__class__"}
+        return OptimMethod._unflatten_state(
+            {k: data[k] for k in data.files if k != "__class__"}
+        )
 
 
 class SGD(OptimMethod):
@@ -316,24 +357,25 @@ class SGD(OptimMethod):
         self.nesterov = nesterov
         self.learningrate_schedule = learningrate_schedule
 
-    def _extra_state(self, flat_param):
+    def _extra_state(self, param):
         jnp = _jnp()
         if self.momentum > 0:
-            return {"velocity": jnp.zeros_like(flat_param)}
+            return {"velocity": _tmap(jnp.zeros_like, param)}
         return {}
 
     def step(self, grad, param, state):
-        jnp = _jnp()
         lr = self.current_rate(state)
-        g = grad
-        if self.weightdecay > 0:
-            g = g + self.weightdecay * param
+        wd, mom, damp = self.weightdecay, self.momentum, self.dampening
+        g = _tmap(lambda gg, p: gg + wd * p, grad, param) if wd > 0 else grad
         new_state = dict(state)
-        if self.momentum > 0:
-            v = self.momentum * state["velocity"] + (1.0 - self.dampening) * g
+        if mom > 0:
+            v = _tmap(
+                lambda vv, gg: mom * vv + (1.0 - damp) * gg,
+                state["velocity"], g,
+            )
             new_state["velocity"] = v
-            g = g + self.momentum * v if self.nesterov else v
-        new_param = param - lr * g
+            g = _tmap(lambda gg, vv: gg + mom * vv, g, v) if self.nesterov else v
+        new_param = _tmap(lambda p, gg: p - lr * gg, param, g)
         new_state["neval"] = state["neval"] + 1.0
         return new_param, new_state
 
@@ -355,19 +397,26 @@ class Adam(OptimMethod):
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
         self.learningrate_schedule = None
 
-    def _extra_state(self, flat_param):
+    def _extra_state(self, param):
         jnp = _jnp()
-        return {"m": jnp.zeros_like(flat_param), "v": jnp.zeros_like(flat_param)}
+        return {
+            "m": _tmap(jnp.zeros_like, param),
+            "v": _tmap(jnp.zeros_like, param),
+        }
 
     def step(self, grad, param, state):
         jnp = _jnp()
         lr = self.current_rate(state)
+        b1, b2, eps = self.beta1, self.beta2, self.epsilon
         t = state["neval"] + 1.0
-        m = self.beta1 * state["m"] + (1 - self.beta1) * grad
-        v = self.beta2 * state["v"] + (1 - self.beta2) * grad * grad
-        m_hat = m / (1 - self.beta1 ** t)
-        v_hat = v / (1 - self.beta2 ** t)
-        new_param = param - lr * m_hat / (jnp.sqrt(v_hat) + self.epsilon)
+        m = _tmap(lambda mm, gg: b1 * mm + (1 - b1) * gg, state["m"], grad)
+        v = _tmap(lambda vv, gg: b2 * vv + (1 - b2) * gg * gg, state["v"], grad)
+        bc1 = 1 - b1 ** t
+        bc2 = 1 - b2 ** t
+        new_param = _tmap(
+            lambda p, mm, vv: p - lr * (mm / bc1) / (jnp.sqrt(vv / bc2) + eps),
+            param, m, v,
+        )
         return new_param, {**state, "m": m, "v": v, "neval": t}
 
 
@@ -381,15 +430,18 @@ class Adagrad(OptimMethod):
         self.weightdecay = weightdecay
         self.learningrate_schedule = None
 
-    def _extra_state(self, flat_param):
-        return {"accum": _jnp().zeros_like(flat_param)}
+    def _extra_state(self, param):
+        return {"accum": _tmap(_jnp().zeros_like, param)}
 
     def step(self, grad, param, state):
         jnp = _jnp()
         lr = self.current_rate(state)
-        g = grad + self.weightdecay * param if self.weightdecay > 0 else grad
-        accum = state["accum"] + g * g
-        new_param = param - lr * g / (jnp.sqrt(accum) + 1e-10)
+        wd = self.weightdecay
+        g = _tmap(lambda gg, p: gg + wd * p, grad, param) if wd > 0 else grad
+        accum = _tmap(lambda a, gg: a + gg * gg, state["accum"], g)
+        new_param = _tmap(
+            lambda p, gg, a: p - lr * gg / (jnp.sqrt(a) + 1e-10), param, g, accum
+        )
         return new_param, {**state, "accum": accum, "neval": state["neval"] + 1.0}
 
 
@@ -403,20 +455,27 @@ class Adadelta(OptimMethod):
         self.decayrate, self.epsilon = decayrate, epsilon
         self.learningrate_schedule = None
 
-    def _extra_state(self, flat_param):
+    def _extra_state(self, param):
         jnp = _jnp()
         return {
-            "accum_g": jnp.zeros_like(flat_param),
-            "accum_dx": jnp.zeros_like(flat_param),
+            "accum_g": _tmap(jnp.zeros_like, param),
+            "accum_dx": _tmap(jnp.zeros_like, param),
         }
 
     def step(self, grad, param, state):
         jnp = _jnp()
         rho, eps = self.decayrate, self.epsilon
-        ag = rho * state["accum_g"] + (1 - rho) * grad * grad
-        dx = -jnp.sqrt(state["accum_dx"] + eps) / jnp.sqrt(ag + eps) * grad
-        adx = rho * state["accum_dx"] + (1 - rho) * dx * dx
-        return param + dx, {
+        ag = _tmap(
+            lambda a, gg: rho * a + (1 - rho) * gg * gg, state["accum_g"], grad
+        )
+        dx = _tmap(
+            lambda adx, a, gg: -jnp.sqrt(adx + eps) / jnp.sqrt(a + eps) * gg,
+            state["accum_dx"], ag, grad,
+        )
+        adx = _tmap(
+            lambda a, d: rho * a + (1 - rho) * d * d, state["accum_dx"], dx
+        )
+        return _tmap(lambda p, d: p + d, param, dx), {
             **state,
             "accum_g": ag,
             "accum_dx": adx,
@@ -434,16 +493,24 @@ class Adamax(OptimMethod):
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
         self.learningrate_schedule = None
 
-    def _extra_state(self, flat_param):
+    def _extra_state(self, param):
         jnp = _jnp()
-        return {"m": jnp.zeros_like(flat_param), "u": jnp.zeros_like(flat_param)}
+        return {
+            "m": _tmap(jnp.zeros_like, param),
+            "u": _tmap(jnp.zeros_like, param),
+        }
 
     def step(self, grad, param, state):
         jnp = _jnp()
+        b1, b2, eps = self.beta1, self.beta2, self.epsilon
         t = state["neval"] + 1.0
-        m = self.beta1 * state["m"] + (1 - self.beta1) * grad
-        u = jnp.maximum(self.beta2 * state["u"], jnp.abs(grad) + self.epsilon)
-        new_param = param - (self.learningrate / (1 - self.beta1 ** t)) * m / u
+        m = _tmap(lambda mm, gg: b1 * mm + (1 - b1) * gg, state["m"], grad)
+        u = _tmap(
+            lambda uu, gg: jnp.maximum(b2 * uu, jnp.abs(gg) + eps),
+            state["u"], grad,
+        )
+        scale = self.learningrate / (1 - b1 ** t)
+        new_param = _tmap(lambda p, mm, uu: p - scale * mm / uu, param, m, u)
         return new_param, {**state, "m": m, "u": u, "neval": t}
 
 
@@ -458,14 +525,19 @@ class RMSprop(OptimMethod):
         self.decayrate, self.epsilon = decayrate, epsilon
         self.learningrate_schedule = None
 
-    def _extra_state(self, flat_param):
-        return {"accum": _jnp().zeros_like(flat_param)}
+    def _extra_state(self, param):
+        return {"accum": _tmap(_jnp().zeros_like, param)}
 
     def step(self, grad, param, state):
         jnp = _jnp()
         lr = self.current_rate(state)
-        accum = self.decayrate * state["accum"] + (1 - self.decayrate) * grad * grad
-        new_param = param - lr * grad / (jnp.sqrt(accum) + self.epsilon)
+        dr, eps = self.decayrate, self.epsilon
+        accum = _tmap(
+            lambda a, gg: dr * a + (1 - dr) * gg * gg, state["accum"], grad
+        )
+        new_param = _tmap(
+            lambda p, gg, a: p - lr * gg / (jnp.sqrt(a) + eps), param, grad, accum
+        )
         return new_param, {**state, "accum": accum, "neval": state["neval"] + 1.0}
 
 
@@ -491,27 +563,43 @@ class Ftrl(OptimMethod):
         self.l2_shrinkage = l2_shrinkage_regularization_strength
         self.learningrate_schedule = None
 
-    def _extra_state(self, flat_param):
+    def _extra_state(self, param):
         jnp = _jnp()
         return {
-            "accum": jnp.full_like(flat_param, self.init_accum),
-            "linear": jnp.zeros_like(flat_param),
+            "accum": _tmap(lambda p: jnp.full_like(p, self.init_accum), param),
+            "linear": _tmap(jnp.zeros_like, param),
         }
 
     def step(self, grad, param, state):
         jnp = _jnp()
         lr = self.learningrate
-        g = grad
-        g_shrink = g + 2 * self.l2_shrinkage * param if self.l2_shrinkage > 0 else g
-        accum_new = state["accum"] + g * g
-        sigma = (accum_new ** -self.lr_power - state["accum"] ** -self.lr_power) / lr
-        linear = state["linear"] + g_shrink - sigma * param
-        quad = accum_new ** -self.lr_power / lr + 2 * self.l2
-        l1_reg = self.l1
-        new_param = jnp.where(
-            jnp.abs(linear) > l1_reg,
-            -(linear - jnp.sign(linear) * l1_reg) / quad,
-            0.0,
+        lr_power, l1_reg, l2 = self.lr_power, self.l1, self.l2
+        shrink = self.l2_shrinkage
+
+        def leaf(g, p, accum, lin):
+            g_shrink = g + 2 * shrink * p if shrink > 0 else g
+            accum_new = accum + g * g
+            sigma = (accum_new ** -lr_power - accum ** -lr_power) / lr
+            linear = lin + g_shrink - sigma * p
+            quad = accum_new ** -lr_power / lr + 2 * l2
+            new_p = jnp.where(
+                jnp.abs(linear) > l1_reg,
+                -(linear - jnp.sign(linear) * l1_reg) / quad,
+                0.0,
+            )
+            return new_p, accum_new, linear
+
+        triples = _tmap(leaf, grad, param, state["accum"], state["linear"])
+        import jax
+
+        new_param = jax.tree.map(
+            lambda t: t[0], triples, is_leaf=lambda t: isinstance(t, tuple)
+        )
+        accum_new = jax.tree.map(
+            lambda t: t[1], triples, is_leaf=lambda t: isinstance(t, tuple)
+        )
+        linear = jax.tree.map(
+            lambda t: t[2], triples, is_leaf=lambda t: isinstance(t, tuple)
         )
         return new_param, {
             **state,
@@ -523,9 +611,10 @@ class Ftrl(OptimMethod):
 
 class LarsSGD(SGD):
     """LARS layer-wise adaptive-rate SGD («bigdl» has LarsSGD in later
-    lines; included for large-batch ImageNet recipes).  On the flat vector
-    the trust ratio is computed globally per step (single-segment
-    approximation; per-layer segments arrive with the segment map)."""
+    lines; included for large-batch ImageNet recipes).  The trust ratio
+    is computed per pytree leaf — true layer-wise LARS when given the
+    parameter tree; on a single flat vector it degenerates to one global
+    ratio (the ZeRO-shard approximation)."""
 
     def __init__(self, learningrate=1e-3, trust_coefficient=0.001, **kw):
         super().__init__(learningrate=learningrate, **kw)
@@ -533,12 +622,16 @@ class LarsSGD(SGD):
 
     def step(self, grad, param, state):
         jnp = _jnp()
-        w_norm = jnp.linalg.norm(param)
-        g_norm = jnp.linalg.norm(grad)
-        trust = jnp.where(
-            (w_norm > 0) & (g_norm > 0),
-            self.trust_coefficient * w_norm / (g_norm + self.weightdecay * w_norm + 1e-12),
-            1.0,
-        )
-        scaled_grad = grad * trust
-        return super().step(scaled_grad, param, state)
+        tc, wd = self.trust_coefficient, self.weightdecay
+
+        def scaled(gg, p):
+            w_norm = jnp.linalg.norm(p)
+            g_norm = jnp.linalg.norm(gg)
+            trust = jnp.where(
+                (w_norm > 0) & (g_norm > 0),
+                tc * w_norm / (g_norm + wd * w_norm + 1e-12),
+                1.0,
+            )
+            return gg * trust
+
+        return super().step(_tmap(scaled, grad, param), param, state)

@@ -20,6 +20,7 @@ from __future__ import annotations
 import logging
 import os
 import time
+from functools import partial
 from typing import Optional, Sequence
 
 import numpy as np
@@ -35,24 +36,32 @@ def _jnp():
 
 class _GradClipper:
     """Parameter processors («bigdl»/optim/parameters/… SURVEY.md §2.1):
-    global L2-norm clipping and constant clipping, applied to the flat
-    gradient inside the jitted step (and to the *sharded* gradient in
-    DistriOptimizer, matching the reference's sharded application)."""
+    global L2-norm clipping and constant clipping, applied to the
+    gradient pytree inside the jitted step (and to the *sharded* flat
+    gradient in DistriOptimizer, matching the reference's sharded
+    application — a flat vector is the one-leaf pytree case)."""
 
     def __init__(self):
         self.l2_norm_clip: Optional[float] = None
         self.const_clip: Optional[tuple] = None
 
-    def __call__(self, flat_grad, global_sq_norm=None):
+    def __call__(self, grad, global_sq_norm=None):
+        import jax
+
         jnp = _jnp()
-        g = flat_grad
+        g = grad
         if self.const_clip is not None:
             lo, hi = self.const_clip
-            g = jnp.clip(g, lo, hi)
+            g = jax.tree.map(lambda a: jnp.clip(a, lo, hi), g)
         if self.l2_norm_clip is not None:
-            sq = global_sq_norm if global_sq_norm is not None else jnp.sum(g * g)
-            norm = jnp.sqrt(sq)
-            g = g * jnp.minimum(1.0, self.l2_norm_clip / (norm + 1e-12))
+            if global_sq_norm is None:
+                from bigdl_tpu.optim.optim_method import _global_sq_norm
+
+                sq = _global_sq_norm(g)
+            else:
+                sq = global_sq_norm
+            scale = jnp.minimum(1.0, self.l2_norm_clip / (jnp.sqrt(sq) + 1e-12))
+            g = jax.tree.map(lambda a: a * scale, g)
         return g
 
 
@@ -193,40 +202,56 @@ class LocalOptimizer(BaseOptimizer):
     validation logic between its two optimizers.
     """
 
-    def _loss_fn(self, unravel):
-        """Returns loss_fn: (flat_p, mstate, rng, inp, tgt) ->
+    def _init_params(self):
+        """Device representation of the trainable parameters.  Local:
+        the native pytree (no ravel/unravel copies on the hot path).
+        DistriOptimizer overrides with the flat vector its ZeRO-1
+        reduce-scatter shards.
+
+        The tree is copied: the jitted step donates its input buffers,
+        and the model must never be left holding donated (deleted)
+        arrays."""
+        import jax
+
+        jnp = _jnp()
+        return jax.tree.map(lambda a: jnp.array(a, copy=True),
+                            self.model.params())
+
+    def _loss_fn(self):
+        """Returns loss_fn: (params, mstate, rng, inp, tgt) ->
         (loss_for_grad, (reported_loss, new_mstate))."""
         model, criterion = self.model, self.criterion
 
-        def loss_fn(flat_p, mstate, rng, inp, tgt):
-            p = unravel(flat_p)
+        def loss_fn(p, mstate, rng, inp, tgt):
             out, new_mstate = model.apply(p, mstate, inp, training=True, rng=rng)
             loss = criterion.loss(out, tgt) + model.regularization_loss(p)
             return loss, (loss, new_mstate)
 
         return loss_fn
 
-    def _init_opt_state(self, flat):
+    def _init_opt_state(self, pvar):
         opt = self.optim_method
         if opt.state is None:
-            opt.state = opt.init_state(flat)
+            opt.state = opt.init_state(pvar)
         return opt.state
 
-    def _build_train_step(self, unravel):
+    def _build_train_step(self):
         import jax
 
         opt = self.optim_method
         clipper = self._clipper
-        loss_fn = self._loss_fn(unravel)
+        loss_fn = self._loss_fn()
 
-        @jax.jit
-        def train_step(flat_p, opt_st, mstate, rng, inp, tgt):
+        # params/opt state/model state buffers are donated: the step
+        # updates in place on-device instead of allocating fresh HBM
+        @partial(jax.jit, donate_argnums=(0, 1, 2))
+        def train_step(p, opt_st, mstate, rng, inp, tgt):
             (_, (loss, new_mstate)), grad = jax.value_and_grad(
                 loss_fn, has_aux=True
-            )(flat_p, mstate, rng, inp, tgt)
+            )(p, mstate, rng, inp, tgt)
             grad = clipper(grad)
-            new_flat, new_opt = opt.step(grad, flat_p, opt_st)
-            return new_flat, new_opt, new_mstate, loss
+            new_p, new_opt = opt.step(grad, p, opt_st)
+            return new_p, new_opt, new_mstate, loss
 
         return train_step
 
@@ -236,17 +261,15 @@ class LocalOptimizer(BaseOptimizer):
 
     def optimize(self):
         import jax
-        from jax.flatten_util import ravel_pytree
 
         model = self.model
         model.training()
 
-        params = model.params()
-        flat, unravel = ravel_pytree(params)
+        pvar = self._init_params()
         mod_state = model.state()
         opt = self.optim_method
-        opt_state = self._init_opt_state(flat)
-        train_step = self._build_train_step(unravel)
+        opt_state = self._init_opt_state(pvar)
+        train_step = self._build_train_step()
 
         base_key = jax.random.key(1234)
         wall_start = time.time()
@@ -259,8 +282,8 @@ class LocalOptimizer(BaseOptimizer):
                 t0 = time.perf_counter()
                 rng = jax.random.fold_in(base_key, self.state["neval"])
                 inp_d, tgt_d = self._put_batch(inp, tgt)
-                flat, opt_state, mod_state, loss = train_step(
-                    flat, opt_state, mod_state, rng, inp_d, tgt_d
+                pvar, opt_state, mod_state, loss = train_step(
+                    pvar, opt_state, mod_state, rng, inp_d, tgt_d
                 )
                 loss_val = float(loss)
                 self.metrics.add("computing time", time.perf_counter() - t0)
@@ -284,13 +307,13 @@ class LocalOptimizer(BaseOptimizer):
                 if self.validation_trigger is not None and self.validation_trigger(
                     self.state
                 ):
-                    self._write_back(flat, unravel, mod_state)
+                    self._write_back(pvar, mod_state)
                     self._run_validation()
                     model.training()
                 if self.checkpoint_trigger is not None and self.checkpoint_trigger(
                     self.state
                 ):
-                    self._write_back(flat, unravel, mod_state)
+                    self._write_back(pvar, mod_state)
                     opt.state = opt_state
                     self._checkpoint()
                 if self.end_when(self.state):
@@ -307,25 +330,32 @@ class LocalOptimizer(BaseOptimizer):
                 if self.validation_trigger is not None and self.validation_trigger(
                     self.state
                 ):
-                    self._write_back(flat, unravel, mod_state)
+                    self._write_back(pvar, mod_state)
                     self._run_validation()
                     model.training()
                 if self.checkpoint_trigger is not None and self.checkpoint_trigger(
                     self.state
                 ):
-                    self._write_back(flat, unravel, mod_state)
+                    self._write_back(pvar, mod_state)
                     opt.state = opt_state
                     self._checkpoint()
                 if self.end_when(self.state):
                     stop = True
-        self._write_back(flat, unravel, mod_state)
+        self._write_back(pvar, mod_state)
         opt.state = opt_state
         self.model.evaluate()
         return self.model
 
-    def _write_back(self, flat, unravel, mod_state):
-        self.model.set_params(unravel(flat))
-        self.model.set_state(mod_state)
+    def _write_back(self, pvar, mod_state):
+        # copy: the next train_step donates pvar/mod_state buffers, and the
+        # model must keep valid arrays (validation/checkpoint read them, and
+        # the user may hold the model across an interrupted optimize())
+        import jax
+
+        jnp = _jnp()
+        copy = lambda t: jax.tree.map(lambda a: jnp.array(a, copy=True), t)
+        self.model.set_params(copy(pvar))
+        self.model.set_state(copy(mod_state))
 
 
 def Optimizer(
