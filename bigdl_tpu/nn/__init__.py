@@ -17,6 +17,11 @@ from bigdl_tpu.nn.module import (
 from bigdl_tpu.nn.layers import *  # noqa: F401,F403
 from bigdl_tpu.nn.layers import __all__ as _layers_all
 from bigdl_tpu.nn.graph import Graph, Input, Node, Model
+from bigdl_tpu.nn.quantized import (
+    QuantizedLinear,
+    QuantizedSpatialConvolution,
+    Quantizer,
+)
 from bigdl_tpu.nn.attention import (
     LayerNorm,
     MultiHeadAttention,

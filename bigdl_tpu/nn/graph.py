@@ -109,6 +109,10 @@ class Graph(Container):
                 visited.add(inp.id)
         return order
 
+    def topo_order(self) -> List[Node]:
+        """Nodes in execution order (used by the Caffe/TF exporters)."""
+        return list(self._topo)
+
     # --------------------------------------------------------------- forward
     def apply(self, params, state, input, *, training=False, rng=None):
         import jax

@@ -584,7 +584,13 @@ class TemporalConvolution(AbstractModule):
 
 def _pool_pad(in_size, k, s, pad, ceil_mode):
     """Output size + (lo, hi) padding for one spatial dim, honoring the
-    reference's floor/ceil mode («bigdl»/nn/SpatialMaxPooling.scala)."""
+    reference's floor/ceil mode («bigdl»/nn/SpatialMaxPooling.scala).
+    pad == -1 means TF-style SAME (matching the conv convention)."""
+    if pad == -1:
+        out = -(-in_size // s)
+        needed = max(0, (out - 1) * s + k - in_size)
+        lo = needed // 2
+        return out, (lo, needed - lo)
     if ceil_mode:
         out = int(math.ceil((in_size + 2 * pad - k) / s)) + 1
     else:

@@ -234,6 +234,13 @@ class AbstractModule:
         self.is_training = False
         return self
 
+    def quantize(self):
+        """Reference: AbstractModule.quantize() — swap Linear/Conv layers
+        for int8 twins («bigdl»/nn/quantized/, see nn/quantized.py)."""
+        from bigdl_tpu.nn.quantized import quantize as _q
+
+        return _q(self)
+
     def set_name(self, name: str):
         self._name = name
         return self

@@ -1,0 +1,159 @@
+"""Quantized inference — ``module.quantize()`` / Quantizer.
+
+Rebuild of «bigdl»/nn/quantized/ (SURVEY.md §2.1 "Quantized inference":
+int8 post-training quantization of Linear/Conv; ``module.quantize()``
+swaps layers; native gemm was bigquant — SURVEY.md §2.3 maps it to int8
+``lax.dot_general`` on the MXU, in :mod:`bigdl_tpu.ops.quantized_matmul`).
+
+Weights are quantized symmetrically per output channel at swap time;
+activations are quantized dynamically per row inside the op (the
+reference's bigquant does the same min/max-based online quantization).
+Quantized layers are inference-only, like the reference (backward raises).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from bigdl_tpu.nn.module import AbstractModule, Container
+from bigdl_tpu.ops.quantized_matmul import int8_matmul, quantize_per_channel
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+class _QuantizedBase(AbstractModule):
+    """Params hold the int8 weight + per-channel scale (+ float bias)."""
+
+    param_names = ("weight_q", "weight_scale", "bias")
+
+    def backward(self, input, grad_output):
+        raise RuntimeError(
+            "quantized modules are inference-only (reference: "
+            "nn/quantized layers throw on backward)"
+        )
+
+
+class QuantizedLinear(_QuantizedBase):
+    """«bigdl»/nn/quantized/Linear.scala — int8 y = x @ Wq.T * s + b."""
+
+    def __init__(self, weight, bias=None):
+        super().__init__()
+        jnp = _jnp()
+        w = jnp.asarray(weight)
+        self.weight_q, self.weight_scale = quantize_per_channel(w, axis=0)
+        self.bias = None if bias is None else jnp.asarray(bias)
+        self.in_features = int(w.shape[1])
+        self.out_features = int(w.shape[0])
+        self._config = dict()
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        y = int8_matmul(
+            input, params["weight_q"], params["weight_scale"]
+        )
+        if params.get("bias") is not None:
+            y = y + params["bias"]
+        return y
+
+    def __repr__(self):
+        return f"QuantizedLinear({self.in_features} -> {self.out_features})"
+
+
+class QuantizedSpatialConvolution(_QuantizedBase):
+    """«bigdl»/nn/quantized/SpatialConvolution.scala — im2col-free int8
+    conv: the kernel is unfolded into a matmul only when 1x1, otherwise
+    the conv runs via int8 ``lax.conv_general_dilated`` with an int32
+    accumulator and a fused per-channel rescale."""
+
+    def __init__(self, weight, bias, stride, padding, n_group=1):
+        super().__init__()
+        jnp = _jnp()
+        w = jnp.asarray(weight)  # (out, in/group, kh, kw)
+        self.weight_q, self.weight_scale = quantize_per_channel(w, axis=0)
+        self.bias = None if bias is None else jnp.asarray(bias)
+        self.stride = tuple(stride)
+        self.padding = padding
+        self.n_group = n_group
+        self._config = dict()
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        import jax
+        from jax import lax
+
+        jnp = _jnp()
+        x = input
+        # dynamic per-tensor activation quantization (conv rows aren't
+        # contiguous; per-tensor matches the reference's conv path)
+        absmax = jnp.max(jnp.abs(x))
+        x_scale = jnp.maximum(absmax, 1e-8) / 127.0
+        x_q = jnp.clip(jnp.round(x / x_scale), -127, 127).astype(jnp.int8)
+        acc = lax.conv_general_dilated(
+            x_q,
+            params["weight_q"],
+            self.stride,
+            self.padding,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            feature_group_count=self.n_group,
+            preferred_element_type=jnp.int32,
+        )
+        w_scale = params["weight_scale"].reshape(1, -1, 1, 1)
+        y = acc.astype(jnp.float32) * x_scale * w_scale
+        if params.get("bias") is not None:
+            y = y + params["bias"].reshape(1, -1, 1, 1)
+        return y
+
+
+def quantize(module: AbstractModule) -> AbstractModule:
+    """Reference: ``module.quantize()`` — returns a copy of the module
+    tree with every Linear / SpatialConvolution swapped for its int8
+    twin.  The input module is left untouched (deep-copied first), so
+    the float model stays usable for training/re-quantization."""
+    import copy as _copy
+
+    return _quantize_inplace(_copy.deepcopy(module))
+
+
+def _quantize_inplace(module: AbstractModule) -> AbstractModule:
+    from bigdl_tpu.nn import layers as L
+
+    if isinstance(module, L.Linear):
+        q = QuantizedLinear(module.weight, module.bias)
+        q.set_name(module._name) if module._name else None
+        return q
+    if type(module) is L.SpatialConvolution:
+        from bigdl_tpu.nn.layers import _conv_pads
+
+        pads = _conv_pads(
+            module.pad_h, module.pad_w, module.kernel_h, module.kernel_w,
+            1, 1,
+        )
+        q = QuantizedSpatialConvolution(
+            module.weight, module.bias,
+            (module.stride_h, module.stride_w), pads, module.n_group,
+        )
+        q.set_name(module._name) if module._name else None
+        return q
+    if isinstance(module, Container):
+        # rebuild children in place on the copied tree (graph containers
+        # keep their wiring: node.module is swapped directly)
+        if hasattr(module, "_topo"):
+            for node in module._topo:
+                node.module = _quantize_inplace(node.module)
+            module.modules = [n.module for n in module._topo]
+        else:
+            module.modules = [_quantize_inplace(m) for m in module.modules]
+        return module
+    return module
+
+
+class Quantizer:
+    """Reference spelling: Quantizer.quantize(model)."""
+
+    @staticmethod
+    def quantize(module):
+        return quantize(module)

@@ -13,6 +13,7 @@ from bigdl_tpu.optim.optim_method import (
     Adamax,
     RMSprop,
     Ftrl,
+    LBFGS,
     LarsSGD,
     Default,
     Poly,
@@ -40,7 +41,7 @@ from bigdl_tpu.optim.metrics import Metrics
 
 __all__ = [
     "OptimMethod", "SGD", "Adam", "Adagrad", "Adadelta", "Adamax", "RMSprop",
-    "Ftrl", "LarsSGD",
+    "Ftrl", "LBFGS", "LarsSGD",
     "Default", "Poly", "Step", "MultiStep", "Exponential", "EpochDecay",
     "Warmup", "SequentialSchedule", "Plateau",
     "L1Regularizer", "L2Regularizer", "L1L2Regularizer",

@@ -609,6 +609,141 @@ class Ftrl(OptimMethod):
         }
 
 
+class LBFGS(OptimMethod):
+    """«bigdl»/optim/LBFGS.scala — limited-memory BFGS with the
+    reference's default learningRate-scaled step (no line search; the
+    reference's lineSearch hook defaults to a fixed step too).
+
+    The two-loop recursion runs over a fixed ``ncorrection`` history
+    window carried as stacked arrays so the step stays jittable
+    (unrolled loops over a static history length).
+
+    Note: ``ncorrection`` is capped at 16 (the reference default is 100,
+    but the recursion unrolls into the compiled step — 2×ncorrection
+    dot-products per update — and histories beyond ~16 measurably slow
+    compilation and execution without improving convergence on the
+    models this framework targets).  A warning is emitted when the cap
+    engages.
+    """
+
+    _NCORRECTION_CAP = 16
+
+    def __init__(self, max_iter: int = 20, max_eval: Optional[float] = None,
+                 tolfun: float = 1e-5, tolx: float = 1e-9,
+                 ncorrection: int = 16, learningrate: float = 1.0,
+                 verbose: bool = False, linesearch=None):
+        super().__init__()
+        self.learningrate = learningrate
+        self.learningrate_decay = 0.0
+        self.max_iter = max_iter
+        self.tolfun, self.tolx = tolfun, tolx
+        if ncorrection > self._NCORRECTION_CAP:
+            import warnings
+
+            warnings.warn(
+                f"LBFGS ncorrection={ncorrection} capped at "
+                f"{self._NCORRECTION_CAP} (history unrolls into the "
+                "compiled step)", stacklevel=2,
+            )
+        self.ncorrection = min(ncorrection, self._NCORRECTION_CAP)
+        self.learningrate_schedule = None
+
+    def _extra_state(self, param):
+        import jax
+
+        jnp = _jnp()
+        m = self.ncorrection
+        flat_zero = _tmap(jnp.zeros_like, param)
+
+        def hist(t):
+            return jax.tree.map(
+                lambda a: jnp.zeros((m,) + a.shape, a.dtype), t
+            )
+
+        return {
+            "s_hist": hist(flat_zero),   # param deltas
+            "y_hist": hist(flat_zero),   # grad deltas
+            "rho": jnp.zeros((m,), jnp.float32),
+            "prev_param": flat_zero,
+            "prev_grad": flat_zero,
+            "hist_len": jnp.zeros((), jnp.float32),
+        }
+
+    def step(self, grad, param, state):
+        import jax
+
+        jnp = _jnp()
+        m = self.ncorrection
+        t = state["neval"]
+
+        # ---- update history with (s, y) from the previous step --------
+        s = _tmap(lambda p, pp: p - pp, param, state["prev_param"])
+        y = _tmap(lambda g, pg: g - pg, grad, state["prev_grad"])
+        sy = sum(jnp.sum(a * b) for a, b in zip(
+            jax.tree.leaves(s), jax.tree.leaves(y)
+        ))
+        valid = (t > 0) & (sy > 1e-10)
+
+        def rolled(h, new):
+            return _tmap(
+                lambda hh, nn: jnp.where(
+                    valid,
+                    jnp.concatenate([hh[1:], nn[None]], axis=0),
+                    hh,
+                ),
+                h, new,
+            )
+
+        s_hist = rolled(state["s_hist"], s)
+        y_hist = rolled(state["y_hist"], y)
+        rho = jnp.where(
+            valid,
+            jnp.concatenate([state["rho"][1:],
+                             (1.0 / jnp.maximum(sy, 1e-10))[None]]),
+            state["rho"],
+        )
+        hist_len = jnp.where(valid,
+                             jnp.minimum(state["hist_len"] + 1, m),
+                             state["hist_len"])
+
+        # ---- two-loop recursion --------------------------------------
+        q = grad
+        alphas = []
+        for i in range(m - 1, -1, -1):
+            live = (m - i) <= hist_len
+            a_i = rho[i] * sum(
+                jnp.sum(sh[i] * qq) for sh, qq in zip(
+                    jax.tree.leaves(s_hist), jax.tree.leaves(q)
+                )
+            )
+            a_i = jnp.where(live, a_i, 0.0)
+            q = _tmap(lambda qq, yh: qq - a_i * yh[i], q, y_hist)
+            alphas.append((i, a_i, live))
+        # initial Hessian scaling gamma = sy/yy of most recent pair
+        yy = sum(jnp.sum(yh[m - 1] ** 2) for yh in jax.tree.leaves(y_hist))
+        sy_last = jnp.where(rho[m - 1] > 0, 1.0 / rho[m - 1], 1.0)
+        gamma = jnp.where(hist_len > 0, sy_last / jnp.maximum(yy, 1e-10), 1.0)
+        r = _tmap(lambda qq: gamma * qq, q)
+        for i, a_i, live in reversed(alphas):
+            b_i = rho[i] * sum(
+                jnp.sum(yh[i] * rr) for yh, rr in zip(
+                    jax.tree.leaves(y_hist), jax.tree.leaves(r)
+                )
+            )
+            b_i = jnp.where(live, b_i, 0.0)
+            r = _tmap(lambda rr, sh: rr + (a_i - b_i) * sh[i], r, s_hist)
+
+        lr = self.learningrate
+        new_param = _tmap(lambda p, rr: p - lr * rr, param, r)
+        return new_param, {
+            **state,
+            "s_hist": s_hist, "y_hist": y_hist, "rho": rho,
+            "prev_param": param, "prev_grad": grad,
+            "hist_len": hist_len,
+            "neval": t + 1.0,
+        }
+
+
 class LarsSGD(SGD):
     """LARS layer-wise adaptive-rate SGD («bigdl» has LarsSGD in later
     lines; included for large-batch ImageNet recipes).  The trust ratio

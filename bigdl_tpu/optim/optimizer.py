@@ -266,9 +266,17 @@ class LocalOptimizer(BaseOptimizer):
         model.training()
 
         pvar = self._init_params()
-        mod_state = model.state()
+        # copy model/optimizer state before the first (donating) step so
+        # the model and any pre-existing opt.state never alias deleted
+        # buffers; after that, opt.state tracks the step outputs (only an
+        # exception *during* a step can catch it transiently stale)
+        copy = lambda t: jax.tree.map(
+            lambda a: a.copy() if hasattr(a, "copy") else a, t
+        )
+        mod_state = copy(model.state())
         opt = self.optim_method
-        opt_state = self._init_opt_state(pvar)
+        opt_state = copy(self._init_opt_state(pvar))
+        opt.state = opt_state
         train_step = self._build_train_step()
 
         base_key = jax.random.key(1234)

@@ -1,1 +1,38 @@
-"""bigdl_tpu.utils — persistence, summaries, interop."""
+"""bigdl_tpu.utils — persistence, summaries, interop.
+
+Reference surface («bigdl»/utils/): Module.save/loadModule (serializer),
+Module.loadCaffeModel / CaffePersister (caffe), Module.loadTF /
+TensorflowSaver (tf), File.loadTorch/saveTorch (torch_file).
+"""
+
+from bigdl_tpu.utils.serializer import (
+    load_checkpoint,
+    load_latest_checkpoint,
+    load_module,
+    save_checkpoint,
+    save_module,
+)
+from bigdl_tpu.utils.caffe import (
+    CaffeLoader,
+    CaffePersister,
+    load_caffe_model,
+    load_caffe_weights,
+)
+from bigdl_tpu.utils.tf_interop import (
+    TensorflowLoader,
+    TensorflowSaver,
+    load_tf,
+)
+from bigdl_tpu.utils.torch_file import (
+    load_t7,
+    load_torch_module,
+    save_t7,
+)
+
+__all__ = [
+    "load_checkpoint", "load_latest_checkpoint", "load_module",
+    "save_checkpoint", "save_module",
+    "CaffeLoader", "CaffePersister", "load_caffe_model", "load_caffe_weights",
+    "TensorflowLoader", "TensorflowSaver", "load_tf",
+    "load_t7", "load_torch_module", "save_t7",
+]

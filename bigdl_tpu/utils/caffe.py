@@ -1,0 +1,1072 @@
+"""Caffe interop — load/save ``.prototxt`` + ``.caffemodel``.
+
+Rebuild of «bigdl»/utils/caffe/ (SURVEY.md §2.1 "Caffe interop": reads
+``.prototxt`` + ``.caffemodel`` (V1/V2), maps Caffe layers → BigDL
+layers, also writes; used by Inception/VGG configs).
+
+No protobuf runtime dependency: the text format is parsed with a small
+recursive-descent parser and the binary format with a generic protobuf
+*wire* reader/writer (the schema is fixed by upstream Caffe and encoded
+here as field-number tables).  The converter builds a
+:class:`bigdl_tpu.nn.Graph` wired by Caffe blob names, tracking
+``(C, H, W)`` through the net so ``InnerProduct`` can size its
+``Linear`` — the same shape inference the reference performs.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+# ==========================================================================
+# protobuf text format (prototxt)
+# ==========================================================================
+
+
+def _tokenize_text(text: str):
+    out = []
+    i, n = 0, len(text)
+    while i < n:
+        c = text[i]
+        if c == "#":
+            while i < n and text[i] != "\n":
+                i += 1
+        elif c.isspace():
+            i += 1
+        elif c in "{}:":
+            out.append(c)
+            i += 1
+        elif c in "\"'":
+            j = i + 1
+            buf = []
+            while j < n and text[j] != c:
+                if text[j] == "\\":
+                    j += 1
+                buf.append(text[j])
+                j += 1
+            out.append(("STR", "".join(buf)))
+            i = j + 1
+        else:
+            j = i
+            while j < n and not text[j].isspace() and text[j] not in "{}:#":
+                j += 1
+            out.append(("TOK", text[i:j]))
+            i = j
+    return out
+
+
+def parse_prototxt(text: str) -> dict:
+    """Parse protobuf text format into nested dicts; every field maps to a
+    *list* of values (protobuf fields are conceptually repeated)."""
+    toks = _tokenize_text(text)
+    pos = 0
+
+    def parse_block():
+        nonlocal pos
+        msg: dict = {}
+        while pos < len(toks) and toks[pos] != "}":
+            name = toks[pos][1]
+            pos += 1
+            if pos < len(toks) and toks[pos] == ":":
+                pos += 1
+                kind, raw = toks[pos]
+                pos += 1
+                if kind == "STR":
+                    val = raw
+                else:
+                    val = _coerce_scalar(raw)
+                msg.setdefault(name, []).append(val)
+            elif pos < len(toks) and toks[pos] == "{":
+                pos += 1
+                sub = parse_block()
+                assert toks[pos] == "}", "unbalanced block"
+                pos += 1
+                msg.setdefault(name, []).append(sub)
+            else:
+                raise ValueError(f"bad prototxt near token {pos}: {toks[pos-1]}")
+        return msg
+
+    return parse_block()
+
+
+def _coerce_scalar(raw: str):
+    if raw in ("true", "True"):
+        return True
+    if raw in ("false", "False"):
+        return False
+    try:
+        return int(raw)
+    except ValueError:
+        pass
+    try:
+        return float(raw)
+    except ValueError:
+        return raw  # enum identifier
+
+
+def _fmt_value(v) -> str:
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, str):
+        # enums (UPPERCASE) stay bare, everything else quoted
+        if v.isupper() or v.replace("_", "").isupper():
+            return v
+        return f'"{v}"'
+    if isinstance(v, float):
+        return repr(v)
+    return str(v)
+
+
+def format_prototxt(msg: dict, indent: int = 0) -> str:
+    pad = "  " * indent
+    lines = []
+    for name, values in msg.items():
+        for v in values:
+            if isinstance(v, dict):
+                lines.append(f"{pad}{name} {{")
+                lines.append(format_prototxt(v, indent + 1))
+                lines.append(f"{pad}}}")
+            else:
+                lines.append(f"{pad}{name}: {_fmt_value(v)}")
+    return "\n".join(l for l in lines if l != "")
+
+
+# ==========================================================================
+# protobuf wire format (caffemodel)
+# ==========================================================================
+
+_WT_VARINT, _WT_FIX64, _WT_BYTES, _WT_FIX32 = 0, 1, 2, 5
+
+
+def _read_varint(buf: memoryview, pos: int) -> Tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def parse_wire(buf) -> Dict[int, list]:
+    """Decode one message into {field: [(wire_type, raw_value), ...]}."""
+    mv = memoryview(buf)
+    fields: Dict[int, list] = {}
+    pos = 0
+    end = len(mv)
+    while pos < end:
+        key, pos = _read_varint(mv, pos)
+        fno, wt = key >> 3, key & 7
+        if wt == _WT_VARINT:
+            val, pos = _read_varint(mv, pos)
+        elif wt == _WT_FIX64:
+            val = mv[pos : pos + 8].tobytes()
+            pos += 8
+        elif wt == _WT_BYTES:
+            ln, pos = _read_varint(mv, pos)
+            val = mv[pos : pos + ln].tobytes()
+            pos += ln
+        elif wt == _WT_FIX32:
+            val = mv[pos : pos + 4].tobytes()
+            pos += 4
+        else:
+            raise ValueError(f"unsupported wire type {wt} (field {fno})")
+        fields.setdefault(fno, []).append((wt, val))
+    return fields
+
+
+def _w_str(f: Dict[int, list], fno: int, default=None):
+    if fno in f:
+        return f[fno][-1][1].decode("utf-8", "replace")
+    return default
+
+
+def _w_strs(f, fno) -> List[str]:
+    return [v.decode("utf-8", "replace") for _, v in f.get(fno, [])]
+
+
+def _w_int(f, fno, default=None):
+    if fno in f:
+        return int(f[fno][-1][1])
+    return default
+
+
+def _w_ints(f, fno) -> List[int]:
+    out = []
+    for wt, v in f.get(fno, []):
+        if wt == _WT_VARINT:
+            out.append(int(v))
+        else:  # packed
+            mv = memoryview(v)
+            pos = 0
+            while pos < len(mv):
+                x, pos = _read_varint(mv, pos)
+                out.append(x)
+    return out
+
+
+def _w_float(f, fno, default=None):
+    if fno in f:
+        wt, v = f[fno][-1]
+        if wt == _WT_FIX32:
+            return struct.unpack("<f", v)[0]
+    return default
+
+
+def _w_floats(f, fno) -> np.ndarray:
+    chunks = []
+    for wt, v in f.get(fno, []):
+        if wt == _WT_FIX32:
+            chunks.append(np.frombuffer(v, dtype="<f4"))
+        elif wt == _WT_BYTES:  # packed
+            chunks.append(np.frombuffer(v, dtype="<f4"))
+    if not chunks:
+        return np.zeros((0,), np.float32)
+    return np.concatenate(chunks)
+
+
+def _w_bool(f, fno, default=None):
+    v = _w_int(f, fno, None)
+    return default if v is None else bool(v)
+
+
+def _w_msgs(f, fno) -> List[Dict[int, list]]:
+    return [parse_wire(v) for wt, v in f.get(fno, []) if wt == _WT_BYTES]
+
+
+class _WireWriter:
+    def __init__(self):
+        self.parts: List[bytes] = []
+
+    @staticmethod
+    def _varint(x: int) -> bytes:
+        out = bytearray()
+        while True:
+            b = x & 0x7F
+            x >>= 7
+            if x:
+                out.append(b | 0x80)
+            else:
+                out.append(b)
+                return bytes(out)
+
+    def varint(self, fno: int, val: int):
+        self.parts.append(self._varint(fno << 3 | _WT_VARINT))
+        self.parts.append(self._varint(int(val)))
+
+    def string(self, fno: int, s: str):
+        self.bytes_(fno, s.encode("utf-8"))
+
+    def bytes_(self, fno: int, b: bytes):
+        self.parts.append(self._varint(fno << 3 | _WT_BYTES))
+        self.parts.append(self._varint(len(b)))
+        self.parts.append(b)
+
+    def float_(self, fno: int, v: float):
+        self.parts.append(self._varint(fno << 3 | _WT_FIX32))
+        self.parts.append(struct.pack("<f", v))
+
+    def packed_floats(self, fno: int, arr: np.ndarray):
+        self.bytes_(fno, np.asarray(arr, dtype="<f4").tobytes())
+
+    def message(self, fno: int, sub: "_WireWriter"):
+        self.bytes_(fno, sub.tobytes())
+
+    def tobytes(self) -> bytes:
+        return b"".join(self.parts)
+
+
+# ==========================================================================
+# caffemodel schema slices (field-number tables from upstream caffe.proto)
+# ==========================================================================
+
+# V1LayerParameter.LayerType enum value -> V2 type string
+_V1_TYPES = {
+    1: "Accuracy", 2: "BNLL", 3: "Concat", 4: "Convolution", 5: "Data",
+    6: "Dropout", 7: "EuclideanLoss", 8: "Flatten", 14: "InnerProduct",
+    15: "LRN", 17: "Pooling", 18: "ReLU", 19: "Sigmoid", 20: "Softmax",
+    21: "SoftmaxWithLoss", 22: "Split", 23: "TanH", 25: "Eltwise",
+    26: "Power", 30: "ArgMax", 33: "Slice", 35: "AbsVal", 36: "Silence",
+    39: "Deconvolution",
+}
+
+
+def _blob_to_array(blob: Dict[int, list]) -> np.ndarray:
+    data = _w_floats(blob, 5)
+    if data.size == 0:
+        dd = blob.get(8)
+        if dd:  # double_data
+            data = np.concatenate(
+                [np.frombuffer(v, dtype="<f8") for _, v in dd]
+            ).astype(np.float32)
+    shape_msgs = _w_msgs(blob, 7)
+    if shape_msgs:
+        dims = _w_ints(shape_msgs[0], 1)
+    else:  # legacy num/channels/height/width
+        dims = [
+            _w_int(blob, 1, 1), _w_int(blob, 2, 1),
+            _w_int(blob, 3, 1), _w_int(blob, 4, 1),
+        ]
+        while len(dims) > 1 and dims[0] == 1:
+            dims = dims[1:]
+    if int(np.prod(dims)) != data.size:
+        dims = [data.size]
+    return data.reshape(dims)
+
+
+def _array_to_blob(arr: np.ndarray) -> _WireWriter:
+    w = _WireWriter()
+    shape = _WireWriter()
+    for d in arr.shape:
+        shape.varint(1, d)
+    w.message(7, shape)
+    w.packed_floats(5, arr.reshape(-1))
+    return w
+
+
+def load_caffemodel(path: str) -> Dict[str, dict]:
+    """Read a ``.caffemodel`` → {layer_name: {"type": str, "blobs": [np]}}.
+    Handles both V2 (``layer`` field 100) and legacy V1 (``layers``
+    field 2) nets."""
+    with open(path, "rb") as f:
+        net = parse_wire(f.read())
+    out: Dict[str, dict] = {}
+    for lp in _w_msgs(net, 100):  # V2 LayerParameter
+        name = _w_str(lp, 1, "")
+        out[name] = {
+            "type": _w_str(lp, 2, ""),
+            "blobs": [_blob_to_array(b) for b in _w_msgs(lp, 7)],
+        }
+    for lp in _w_msgs(net, 2):  # V1LayerParameter
+        name = _w_str(lp, 4, "")
+        if name in out:
+            continue
+        out[name] = {
+            "type": _V1_TYPES.get(_w_int(lp, 5, 0), str(_w_int(lp, 5, 0))),
+            "blobs": [_blob_to_array(b) for b in _w_msgs(lp, 6)],
+        }
+    return out
+
+
+# ==========================================================================
+# prototxt → layer descriptions (normalising V1/V2 text spellings)
+# ==========================================================================
+
+
+def _first(d: dict, key: str, default=None):
+    v = d.get(key)
+    return v[0] if v else default
+
+
+def _net_layers(net: dict) -> List[dict]:
+    layers = list(net.get("layer", [])) + list(net.get("layers", []))
+    out = []
+    for l in layers:
+        t = _first(l, "type", "")
+        if isinstance(t, str) and t.isupper():  # V1 text enum e.g. CONVOLUTION
+            t = {v.upper().replace("WITHLOSS", "_LOSS"): v
+                 for v in _V1_TYPES.values()}.get(t, t.title())
+        out.append({**l, "type": [t]})
+    return out
+
+
+def _train_only(l: dict) -> bool:
+    for inc in l.get("include", []):
+        if _first(inc, "phase") in ("TRAIN", 0):
+            return True
+    return False
+
+
+# ==========================================================================
+# shape arithmetic (caffe conventions: pooling rounds up, conv rounds down)
+# ==========================================================================
+
+
+def _conv_out(size, k, pad, stride, dil=1):
+    eff = dil * (k - 1) + 1
+    return (size + 2 * pad - eff) // stride + 1
+
+
+def _pool_out(size, k, pad, stride):
+    out = -(-(size + 2 * pad - k) // stride) + 1  # ceil
+    if pad > 0 and (out - 1) * stride >= size + pad:
+        out -= 1
+    return out
+
+
+def _kern2(p: dict, base: str, hk="_h", wk="_w"):
+    """kernel/stride/pad may be a single repeated value or _h/_w pair."""
+    h = _first(p, base + hk)
+    w = _first(p, base + wk)
+    if h is None or w is None:
+        vals = p.get(base + "_size" if base == "kernel" else base, [])
+        v = vals[0] if vals else None
+        h = h if h is not None else v
+        w = w if w is not None else v
+    return h, w
+
+
+# ==========================================================================
+# the converter
+# ==========================================================================
+
+
+class CaffeConversionException(Exception):
+    pass
+
+
+class CaffeLoader:
+    """Reference: «bigdl»/utils/caffe/CaffeLoader.scala.
+
+    ``load()`` builds a :class:`Graph` from the prototxt (inference
+    phase), then copies weights from the caffemodel by layer name.
+    """
+
+    def __init__(self, prototxt_path: Optional[str] = None,
+                 model_path: Optional[str] = None,
+                 prototxt_text: Optional[str] = None):
+        if prototxt_text is None:
+            with open(prototxt_path) as f:
+                prototxt_text = f.read()
+        self.net = parse_prototxt(prototxt_text)
+        self.model_path = model_path
+        self._blobs: Dict[str, dict] = (
+            load_caffemodel(model_path) if model_path else {}
+        )
+
+    # ------------------------------------------------------------------
+    def load(self):
+        from bigdl_tpu.nn.graph import Graph, Input
+
+        net = self.net
+        blob_node: Dict[str, object] = {}
+        blob_shape: Dict[str, tuple] = {}
+        input_nodes = []
+
+        # net-level inputs: input/input_dim or input/input_shape
+        names = [v for v in net.get("input", [])]
+        dims = net.get("input_dim", [])
+        shapes = net.get("input_shape", [])
+        for i, nm in enumerate(names):
+            node = Input(nm)
+            input_nodes.append(node)
+            blob_node[nm] = node
+            if shapes:
+                d = shapes[i].get("dim", [])
+            else:
+                d = dims[i * 4 : i * 4 + 4]
+            if len(d) >= 2:
+                blob_shape[nm] = tuple(int(x) for x in d[1:])
+
+        layers = [l for l in _net_layers(net) if not _train_only(l)]
+        merged_scales = self._find_bn_scale_merges(layers)
+
+        for l in layers:
+            ltype = _first(l, "type", "")
+            name = _first(l, "name", "")
+            bottoms = list(l.get("bottom", []))
+            tops = list(l.get("top", []))
+            if ltype in ("Input", "Data", "DummyData", "MemoryData",
+                         "ImageData", "HDF5Data"):
+                for t in tops:
+                    if t in blob_node:
+                        continue
+                    node = Input(t)
+                    input_nodes.append(node)
+                    blob_node[t] = node
+                    shp = _first(l, "input_param")
+                    if shp:
+                        d = _first(shp, "shape")
+                        if d:
+                            dd = d.get("dim", [])
+                            if len(dd) >= 2:
+                                blob_shape[t] = tuple(int(x) for x in dd[1:])
+                continue
+            if ltype in ("Accuracy", "Silence", "ArgMax"):
+                continue
+            if ltype in ("SoftmaxWithLoss", "EuclideanLoss",
+                         "SigmoidCrossEntropyLoss", "HingeLoss"):
+                # inference graph: loss becomes its activation (BigDL
+                # converts SoftmaxWithLoss bottoms[0] -> Softmax)
+                bottoms = bottoms[:1]
+                ltype = {"SoftmaxWithLoss": "Softmax"}.get(ltype)
+                if ltype is None:
+                    continue
+            if name in merged_scales:
+                # Scale folded into the preceding BatchNorm
+                src = bottoms[0]
+                for t in tops:
+                    blob_node[t] = blob_node[src]
+                    blob_shape[t] = blob_shape.get(src)
+                continue
+            if ltype == "Split":
+                for t in tops:
+                    blob_node[t] = blob_node[bottoms[0]]
+                    blob_shape[t] = blob_shape.get(bottoms[0])
+                continue
+
+            in_shapes = [blob_shape.get(b) for b in bottoms]
+            module, out_shape = self._convert_layer(
+                l, ltype, name, in_shapes, merged_scales
+            )
+            if module is None:
+                continue
+            try:
+                prev = [blob_node[b] for b in bottoms]
+            except KeyError as e:
+                raise CaffeConversionException(
+                    f"layer {name}: unknown bottom blob {e}"
+                )
+            node = module(*prev)
+            for t in tops:
+                blob_node[t] = node
+                blob_shape[t] = out_shape
+
+        produced = set()
+        consumed = set()
+        for l in layers:
+            produced.update(l.get("top", []))
+            consumed.update(l.get("bottom", []))
+        outputs = [blob_node[t] for t in blob_node
+                   if t in produced and t not in consumed]
+        if not outputs:
+            raise CaffeConversionException("no output blobs found")
+        graph = Graph(input_nodes, outputs)
+        if _first(self.net, "name"):
+            graph.set_name(_first(self.net, "name"))
+        return graph
+
+    # ------------------------------------------------------------------
+    def _find_bn_scale_merges(self, layers) -> Dict[str, str]:
+        """Scale layers that directly consume a BatchNorm top get folded
+        into the BN (the standard caffe BN+Scale idiom)."""
+        bn_tops = {}
+        for l in layers:
+            if _first(l, "type") == "BatchNorm":
+                for t in l.get("top", []):
+                    bn_tops[t] = _first(l, "name")
+        merges = {}
+        for l in layers:
+            if _first(l, "type") == "Scale":
+                b = l.get("bottom", [])
+                if len(b) == 1 and b[0] in bn_tops:
+                    merges[_first(l, "name")] = bn_tops[b[0]]
+        return merges
+
+    def _layer_blobs(self, name: str) -> List[np.ndarray]:
+        entry = self._blobs.get(name)
+        return entry["blobs"] if entry else []
+
+    # ------------------------------------------------------------------
+    def _convert_layer(self, l, ltype, name, in_shapes, merged_scales):
+        from bigdl_tpu.nn import layers as L
+        from bigdl_tpu.nn import table_ops as T
+
+        jset = _to_jax
+        shape = in_shapes[0] if in_shapes else None
+        blobs = self._layer_blobs(name)
+
+        if ltype in ("Convolution", "Deconvolution"):
+            p = _first(l, "convolution_param", {})
+            n_out = _first(p, "num_output")
+            kh, kw = _kern2(p, "kernel")
+            sh, sw = _kern2(p, "stride")
+            sh, sw = sh or 1, sw or 1
+            ph, pw = _kern2(p, "pad")
+            ph, pw = ph or 0, pw or 0
+            group = _first(p, "group", 1)
+            dil = _first(p, "dilation", 1)
+            bias = bool(_first(p, "bias_term", True))
+            if blobs:
+                w = blobs[0]
+                c_in = w.shape[1] * group if ltype == "Convolution" else w.shape[0]
+            elif shape:
+                c_in = shape[0]
+            else:
+                raise CaffeConversionException(
+                    f"{name}: cannot infer input channels (no blobs, no shape)"
+                )
+            if ltype == "Convolution":
+                if dil and dil > 1:
+                    mod = L.SpatialDilatedConvolution(
+                        c_in, n_out, kw, kh, sw, sh, pw, ph,
+                        dilation_w=dil, dilation_h=dil,
+                    ) if "dilation_w" in _sig(L.SpatialDilatedConvolution) else \
+                        L.SpatialDilatedConvolution(c_in, n_out, kw, kh, sw, sh, pw, ph, dil, dil)
+                else:
+                    mod = L.SpatialConvolution(
+                        c_in, n_out, kw, kh, sw, sh, pw, ph, group,
+                        with_bias=bias,
+                    )
+                if blobs:
+                    mod.weight = jset(blobs[0].reshape(mod.weight.shape))
+                    if bias and len(blobs) > 1:
+                        mod.bias = jset(blobs[1].reshape(mod.bias.shape))
+                out = None
+                if shape:
+                    out = (
+                        n_out,
+                        _conv_out(shape[1], kh, ph, sh, dil or 1),
+                        _conv_out(shape[2], kw, pw, sw, dil or 1),
+                    )
+                return mod, out
+            else:  # Deconvolution
+                mod = L.SpatialFullConvolution(
+                    c_in, n_out, kw, kh, sw, sh, pw, ph,
+                )
+                if blobs:
+                    # caffe deconv blob layout: (in, out/group, kh, kw)
+                    w = blobs[0].reshape(c_in, n_out, kh, kw).transpose(1, 0, 2, 3)
+                    mod.weight = jset(np.ascontiguousarray(w).reshape(mod.weight.shape))
+                    if len(blobs) > 1:
+                        mod.bias = jset(blobs[1].reshape(mod.bias.shape))
+                out = None
+                if shape:
+                    out = (
+                        n_out,
+                        (shape[1] - 1) * sh - 2 * ph + kh,
+                        (shape[2] - 1) * sw - 2 * pw + kw,
+                    )
+                return mod, out
+
+        if ltype == "InnerProduct":
+            p = _first(l, "inner_product_param", {})
+            n_out = _first(p, "num_output")
+            bias = bool(_first(p, "bias_term", True))
+            if blobs:
+                in_features = blobs[0].shape[-1] if blobs[0].ndim > 1 else (
+                    blobs[0].size // n_out
+                )
+            elif shape:
+                in_features = int(np.prod(shape))
+            else:
+                raise CaffeConversionException(
+                    f"{name}: cannot size InnerProduct (no blobs, no shape)"
+                )
+            mod = L.Linear(in_features, n_out, with_bias=bias)
+            if blobs:
+                mod.weight = jset(blobs[0].reshape(mod.weight.shape))
+                if bias and len(blobs) > 1:
+                    mod.bias = jset(blobs[1].reshape(mod.bias.shape))
+            # caffe IP implicitly flattens from axis 1
+            if shape and len(shape) > 1:
+                from bigdl_tpu.nn.module import Sequential
+
+                mod = Sequential().add(L.Reshape([in_features])).add(mod)
+            return mod, (n_out,)
+
+        if ltype == "Pooling":
+            p = _first(l, "pooling_param", {})
+            pool = _first(p, "pool", "MAX")
+            kh, kw = _kern2(p, "kernel")
+            sh, sw = _kern2(p, "stride")
+            sh, sw = sh or 1, sw or 1
+            ph, pw = _kern2(p, "pad")
+            ph, pw = ph or 0, pw or 0
+            glob = bool(_first(p, "global_pooling", False))
+            if glob and shape:
+                kh, kw = shape[1], shape[2]
+                sh = sw = 1
+                ph = pw = 0
+            if pool in ("MAX", 0):
+                mod = L.SpatialMaxPooling(kw, kh, sw, sh, pw, ph, ceil_mode=True)
+            else:
+                mod = L.SpatialAveragePooling(
+                    kw, kh, sw, sh, pw, ph, ceil_mode=True
+                )
+            out = None
+            if shape:
+                out = (
+                    shape[0],
+                    1 if glob else _pool_out(shape[1], kh, ph, sh),
+                    1 if glob else _pool_out(shape[2], kw, pw, sw),
+                )
+            return mod, out
+
+        if ltype == "ReLU":
+            p = _first(l, "relu_param", {})
+            slope = _first(p, "negative_slope", 0.0)
+            return (L.LeakyReLU(slope) if slope else L.ReLU()), shape
+        if ltype == "TanH":
+            return L.Tanh(), shape
+        if ltype == "Sigmoid":
+            return L.Sigmoid(), shape
+        if ltype == "AbsVal":
+            return L.Abs(), shape
+        if ltype == "BNLL":
+            return L.SoftPlus(), shape
+        if ltype == "ELU":
+            p = _first(l, "elu_param", {})
+            return L.ELU(_first(p, "alpha", 1.0)), shape
+        if ltype == "PReLU":
+            mod = L.PReLU(n_output_plane=shape[0] if shape else 1) if \
+                "n_output_plane" in _sig(L.PReLU) else L.PReLU()
+            if blobs:
+                try:
+                    mod.weight = _to_jax(blobs[0].reshape(mod.weight.shape))
+                except Exception:
+                    pass
+            return mod, shape
+        if ltype == "Power":
+            p = _first(l, "power_param", {})
+            return (
+                L.Power(
+                    _first(p, "power", 1.0),
+                    _first(p, "scale", 1.0),
+                    _first(p, "shift", 0.0),
+                ),
+                shape,
+            )
+        if ltype == "Exp":
+            return L.Exp(), shape
+        if ltype == "Log":
+            return L.Log(), shape
+        if ltype == "Softmax":
+            return L.SoftMax(), shape
+        if ltype == "Dropout":
+            p = _first(l, "dropout_param", {})
+            return L.Dropout(_first(p, "dropout_ratio", 0.5)), shape
+        if ltype == "LRN":
+            p = _first(l, "lrn_param", {})
+            return (
+                L.SpatialCrossMapLRN(
+                    _first(p, "local_size", 5),
+                    _first(p, "alpha", 1.0),
+                    _first(p, "beta", 0.75),
+                    _first(p, "k", 1.0),
+                ),
+                shape,
+            )
+        if ltype == "Flatten":
+            if shape:
+                n = int(np.prod(shape))
+                return L.Reshape([n]), (n,)
+            return L.Reshape([-1]), None
+        if ltype == "Reshape":
+            p = _first(l, "reshape_param", {})
+            sh = _first(p, "shape", {})
+            dims = [int(d) for d in sh.get("dim", [])]
+            body = [d for d in dims if d != 0]
+            if dims and dims[0] == 0:
+                pass  # keep batch axis: Reshape is batch-mode by default
+            out = tuple(d for d in body) if body and -1 not in body else None
+            return L.Reshape([d for d in (body or [-1])]), out
+        if ltype == "Concat":
+            p = _first(l, "concat_param", {})
+            axis = _first(p, "axis", _first(p, "concat_dim", 1))
+            # caffe axis counts the batch dim (axis 1 == channels); our
+            # JoinTable dimension is 1-based over the full tensor
+            mod = T.JoinTable(dimension=int(axis) + 1, n_input_dims=-1)
+            out = None
+            if all(s is not None for s in in_shapes) and in_shapes:
+                ax = int(axis) - 1  # axis 1 == first feature dim
+                dims = list(in_shapes[0])
+                dims[ax] = sum(s[ax] for s in in_shapes)
+                out = tuple(dims)
+            return mod, out
+        if ltype == "Eltwise":
+            p = _first(l, "eltwise_param", {})
+            op = _first(p, "operation", "SUM")
+            if op in ("SUM", 1):
+                mod = T.CAddTable()
+            elif op in ("PROD", 0):
+                mod = T.CMulTable()
+            elif op in ("MAX", 2):
+                mod = T.CMaxTable()
+            else:
+                raise CaffeConversionException(f"Eltwise op {op} unsupported")
+            return mod, shape
+        if ltype == "BatchNorm":
+            p = _first(l, "batch_norm_param", {})
+            eps = _first(p, "eps", 1e-5)
+            c = shape[0] if shape else (blobs[0].size if blobs else None)
+            if c is None:
+                raise CaffeConversionException(f"{name}: BatchNorm needs shape")
+            # is a Scale folded onto this BN?
+            scale_name = None
+            for sname, bnname in merged_scales.items():
+                if bnname == name:
+                    scale_name = sname
+            mod = L.SpatialBatchNormalization(
+                int(c), eps=eps, affine=scale_name is not None
+            )
+            if blobs:
+                sf = float(blobs[2].reshape(-1)[0]) if len(blobs) > 2 else 1.0
+                sf = 1.0 / sf if sf != 0 else 0.0
+                mod.running_mean = _to_jax(blobs[0].reshape(-1) * sf)
+                mod.running_var = _to_jax(blobs[1].reshape(-1) * sf)
+            if scale_name is not None:
+                sblobs = self._layer_blobs(scale_name)
+                if sblobs:
+                    mod.weight = _to_jax(sblobs[0].reshape(-1))
+                    if len(sblobs) > 1:
+                        mod.bias = _to_jax(sblobs[1].reshape(-1))
+            # caffe-style BN in a loaded net runs with global stats
+            mod.evaluate()
+            return mod, shape
+        if ltype == "Scale":
+            p = _first(l, "scale_param", {})
+            c = shape[0] if shape else (blobs[0].size if blobs else 1)
+            size = (int(c),) + (1,) * (len(shape) - 1 if shape else 2)
+            mod = L.Scale(size)
+            if blobs:
+                mod.weight = _to_jax(blobs[0].reshape(size))
+                if len(blobs) > 1 and bool(_first(p, "bias_term", True)):
+                    mod.bias = _to_jax(blobs[1].reshape(size))
+            return mod, shape
+        if ltype == "Slice":
+            raise CaffeConversionException(
+                "Slice layers are not supported (multi-output modules)"
+            )
+        raise CaffeConversionException(f"unsupported caffe layer type {ltype}")
+
+
+def _sig(cls):
+    import inspect
+
+    return inspect.signature(cls.__init__).parameters
+
+
+def _to_jax(a: np.ndarray):
+    import jax.numpy as jnp
+
+    return jnp.asarray(np.ascontiguousarray(a), dtype=jnp.float32)
+
+
+# ==========================================================================
+# persister
+# ==========================================================================
+
+
+class CaffePersister:
+    """Reference: «bigdl»/utils/caffe/CaffePersister.scala — writes a
+    prototxt + caffemodel for nets made of convertible layers."""
+
+    @staticmethod
+    def save(graph, prototxt_path: str, model_path: str,
+             input_shape: Optional[tuple] = None):
+        net_txt, net_bin = _export(graph, input_shape)
+        with open(prototxt_path, "w") as f:
+            f.write(net_txt)
+        with open(model_path, "wb") as f:
+            f.write(net_bin)
+
+
+def _export(graph, input_shape) -> Tuple[str, bytes]:
+    from bigdl_tpu.nn import layers as L
+    from bigdl_tpu.nn import table_ops as T
+    from bigdl_tpu.nn.graph import Graph
+
+    if not isinstance(graph, Graph):
+        graph = graph.to_graph() if hasattr(graph, "to_graph") else None
+        if graph is None:
+            raise CaffeConversionException("CaffePersister needs a Graph")
+
+    txt: dict = {"name": [graph._name or "bigdl_tpu_net"]}
+    txt_layers = []
+    net = _WireWriter()
+    counter = [0]
+
+    def blob_of(node):
+        return f"blob{node.id}"
+
+    # net inputs
+    # input_shape: one (C,H,W)-style tuple shared by all inputs, or a
+    # list with one entry per input
+    shapes = None
+    if input_shape is not None:
+        if isinstance(input_shape, list):
+            shapes = input_shape
+        else:
+            shapes = [input_shape] * len(graph.input_nodes)
+    for i, node in enumerate(graph.input_nodes):
+        txt.setdefault("input", []).append(blob_of(node))
+        if shapes is not None:
+            shp = {"dim": [1] + list(shapes[i])}
+            txt.setdefault("input_shape", []).append(shp)
+
+    order = graph.topo_order() if hasattr(graph, "topo_order") else None
+    if order is None:
+        raise CaffeConversionException("Graph.topo_order() missing")
+
+    for node in order:
+        m = node.module
+        if node in graph.input_nodes or type(m).__name__ == "_InputModule":
+            continue
+        counter[0] += 1
+        lname = m._name or f"layer{counter[0]}"
+        bottoms = [blob_of(p) for p in node.prev_nodes]
+        entry = {"name": [lname], "bottom": bottoms, "top": [blob_of(node)]}
+        blobs: List[np.ndarray] = []
+
+        if isinstance(m, L.SpatialConvolution):
+            entry["type"] = ["Convolution"]
+            cp = {
+                "num_output": [m.n_output_plane],
+                "kernel_h": [m.kernel_h], "kernel_w": [m.kernel_w],
+                "stride_h": [m.stride_h], "stride_w": [m.stride_w],
+                "pad_h": [m.pad_h], "pad_w": [m.pad_w],
+                "group": [m.n_group], "bias_term": [m.bias is not None],
+            }
+            if isinstance(m, L.SpatialDilatedConvolution):
+                dh = getattr(m, "dilation_h", 1)
+                dw = getattr(m, "dilation_w", 1)
+                if dh != dw:
+                    raise CaffeConversionException(
+                        "caffe dilation is isotropic; dilation_h != dilation_w"
+                    )
+                cp["dilation"] = [dh]
+            entry["convolution_param"] = [cp]
+            blobs.append(np.asarray(m.weight))
+            if m.bias is not None:
+                blobs.append(np.asarray(m.bias))
+        elif isinstance(m, L.Linear):
+            entry["type"] = ["InnerProduct"]
+            entry["inner_product_param"] = [{
+                "num_output": [m.output_size],
+                "bias_term": [m.bias is not None],
+            }]
+            blobs.append(np.asarray(m.weight))
+            if m.bias is not None:
+                blobs.append(np.asarray(m.bias))
+        elif isinstance(m, L.SpatialMaxPooling):
+            entry["type"] = ["Pooling"]
+            entry["pooling_param"] = [{
+                "pool": ["MAX"], "kernel_h": [m.kh], "kernel_w": [m.kw],
+                "stride_h": [m.dh], "stride_w": [m.dw],
+                "pad_h": [m.pad_h], "pad_w": [m.pad_w],
+            }]
+        elif isinstance(m, L.SpatialAveragePooling):
+            entry["type"] = ["Pooling"]
+            entry["pooling_param"] = [{
+                "pool": ["AVE"], "kernel_h": [m.kh], "kernel_w": [m.kw],
+                "stride_h": [m.dh], "stride_w": [m.dw],
+                "pad_h": [m.pad_h], "pad_w": [m.pad_w],
+            }]
+        elif isinstance(m, L.ReLU):
+            entry["type"] = ["ReLU"]
+        elif isinstance(m, L.LeakyReLU):
+            entry["type"] = ["ReLU"]
+            entry["relu_param"] = [{"negative_slope": [m.negval]}]
+        elif isinstance(m, L.Tanh):
+            entry["type"] = ["TanH"]
+        elif isinstance(m, L.Sigmoid):
+            entry["type"] = ["Sigmoid"]
+        elif isinstance(m, (L.SoftMax, L.LogSoftMax)):
+            entry["type"] = ["Softmax"]
+        elif isinstance(m, L.Dropout):
+            entry["type"] = ["Dropout"]
+            entry["dropout_param"] = [{"dropout_ratio": [m.p]}]
+        elif isinstance(m, L.SpatialCrossMapLRN):
+            entry["type"] = ["LRN"]
+            entry["lrn_param"] = [{
+                "local_size": [m.size], "alpha": [m.alpha],
+                "beta": [m.beta], "k": [m.k],
+            }]
+        elif isinstance(m, L.SpatialBatchNormalization):
+            entry["type"] = ["BatchNorm"]
+            entry["batch_norm_param"] = [{"eps": [m.eps]}]
+            blobs.append(np.asarray(m.running_mean))
+            blobs.append(np.asarray(m.running_var))
+            blobs.append(np.asarray([1.0], dtype=np.float32))
+            # affine part becomes a Scale layer in caffe; fold emitted next
+        elif isinstance(m, L.Reshape):
+            entry["type"] = ["Flatten"] if len(m.size) == 1 else ["Reshape"]
+            if entry["type"] == ["Reshape"]:
+                entry["reshape_param"] = [
+                    {"shape": [{"dim": [0] + [int(d) for d in m.size]}]}
+                ]
+        elif isinstance(m, T.JoinTable):
+            entry["type"] = ["Concat"]
+            entry["concat_param"] = [{"axis": [m.dimension - 1]}]
+        elif isinstance(m, T.CAddTable):
+            entry["type"] = ["Eltwise"]
+            entry["eltwise_param"] = [{"operation": ["SUM"]}]
+        elif isinstance(m, T.CMulTable):
+            entry["type"] = ["Eltwise"]
+            entry["eltwise_param"] = [{"operation": ["PROD"]}]
+        elif isinstance(m, T.CMaxTable):
+            entry["type"] = ["Eltwise"]
+            entry["eltwise_param"] = [{"operation": ["MAX"]}]
+        else:
+            raise CaffeConversionException(
+                f"CaffePersister: unsupported layer {type(m).__name__}"
+            )
+
+        txt_layers.append(entry)
+
+        lp = _WireWriter()
+        lp.string(1, lname)
+        lp.string(2, entry["type"][0])
+        for b in bottoms:
+            lp.string(3, b)
+        lp.string(4, blob_of(node))
+        for arr in blobs:
+            lp.message(7, _array_to_blob(arr))
+        net.message(100, lp)
+
+        # BN affine -> separate Scale layer (caffe idiom)
+        if isinstance(m, L.SpatialBatchNormalization) and m.weight is not None:
+            counter[0] += 1
+            sname = lname + "_scale"
+            sentry = {
+                "name": [sname], "type": ["Scale"],
+                "bottom": [blob_of(node)], "top": [blob_of(node)],
+                "scale_param": [{"bias_term": [m.bias is not None]}],
+            }
+            txt_layers.append(sentry)
+            sp = _WireWriter()
+            sp.string(1, sname)
+            sp.string(2, "Scale")
+            sp.string(3, blob_of(node))
+            sp.string(4, blob_of(node))
+            sp.message(7, _array_to_blob(np.asarray(m.weight)))
+            if m.bias is not None:
+                sp.message(7, _array_to_blob(np.asarray(m.bias)))
+            net.message(100, sp)
+
+    txt["layer"] = txt_layers
+    header = _WireWriter()
+    header.string(1, txt["name"][0])
+    return format_prototxt(txt), header.tobytes() + net.tobytes()
+
+
+# --------------------------------------------------------------------------
+# module-level convenience (reference: Module.loadCaffeModel / loadCaffe)
+# --------------------------------------------------------------------------
+
+
+def load_caffe_model(prototxt_path: str, model_path: str):
+    """Reference: ``Module.loadCaffeModel(defPath, modelPath)``."""
+    return CaffeLoader(prototxt_path, model_path).load()
+
+
+def load_caffe_weights(model, model_path: str, match_all: bool = True):
+    """Reference: ``Module.loadCaffe(model, defPath, modelPath)`` — copy
+    weights from a caffemodel into an existing model by layer name."""
+    blobs = load_caffemodel(model_path)
+    matched = 0
+    for m in _iter_modules(model):
+        nm = m._name
+        if nm and nm in blobs:
+            arrs = blobs[nm]["blobs"]
+            if not arrs:
+                continue
+            if getattr(m, "weight", None) is not None:
+                m.weight = _to_jax(arrs[0].reshape(np.asarray(m.weight).shape))
+            if len(arrs) > 1 and getattr(m, "bias", None) is not None:
+                m.bias = _to_jax(arrs[1].reshape(np.asarray(m.bias).shape))
+            matched += 1
+    if match_all and not matched:
+        raise CaffeConversionException("no layers matched by name")
+    return model
+
+
+def _iter_modules(m):
+    yield m
+    for child in getattr(m, "modules", []):
+        yield from _iter_modules(child)

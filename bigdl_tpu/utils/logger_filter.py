@@ -1,0 +1,61 @@
+"""LoggerFilter — console/file log routing.
+
+Rebuild of «bigdl»/utils/LoggerFilter.scala (SURVEY.md §5 "Metrics /
+logging": redirects chatty third-party loggers to a file, keeps
+bigdl_tpu INFO on the console).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional, Sequence
+
+_DEFAULT_CHATTY = ("jax", "absl", "orbax", "etils", "tensorflow")
+
+
+def redirect_spark_info_logs(
+    log_path: Optional[str] = None,
+    chatty: Sequence[str] = _DEFAULT_CHATTY,
+    keep: Sequence[str] = ("bigdl_tpu",),
+):
+    """Reference: ``LoggerFilter.redirectSparkInfoLogs`` — chatty
+    libraries log to ``bigdl.log`` (cwd by default) at INFO, only
+    warnings reach the console; ``bigdl_tpu.*`` stays on the console at
+    INFO.  Honors the reference's system-property overrides via env:
+    ``BIGDL_DISABLE_LOGGER=1`` skips everything, ``BIGDL_LOG_PATH``
+    overrides the file location."""
+    if os.environ.get("BIGDL_DISABLE_LOGGER", "").lower() in ("1", "true"):
+        return
+    log_path = log_path or os.environ.get(
+        "BIGDL_LOG_PATH", os.path.join(os.getcwd(), "bigdl.log")
+    )
+    file_handler = logging.FileHandler(log_path)
+    file_handler.setLevel(logging.INFO)
+    file_handler.setFormatter(logging.Formatter(
+        "%(asctime)s %(levelname)s %(name)s: %(message)s"
+    ))
+    for name in chatty:
+        lg = logging.getLogger(name)
+        lg.addHandler(file_handler)
+        lg.setLevel(logging.INFO)
+        lg.propagate = False
+        console = logging.StreamHandler()
+        console.setLevel(logging.WARNING)
+        lg.addHandler(console)
+    for name in keep:
+        lg = logging.getLogger(name)
+        lg.setLevel(logging.INFO)
+        if not lg.handlers:
+            h = logging.StreamHandler()
+            h.setFormatter(logging.Formatter(
+                "%(asctime)s %(levelname)s %(name)s: %(message)s"
+            ))
+            lg.addHandler(h)
+
+
+class LoggerFilter:
+    """Reference spelling."""
+
+    redirectSparkInfoLogs = staticmethod(redirect_spark_info_logs)
+    redirect_spark_info_logs = staticmethod(redirect_spark_info_logs)

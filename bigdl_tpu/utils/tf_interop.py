@@ -1,0 +1,670 @@
+"""TensorFlow interop — import/export frozen GraphDefs.
+
+Rebuild of «bigdl»/utils/tf/ (SURVEY.md §2.1 "TensorFlow interop":
+imports frozen TF GraphDefs → Graph via op-by-op converters
+(`TensorflowLoader`), exports (`TensorflowSaver`)).
+
+Like the Caffe path there is no protobuf runtime dependency: GraphDef /
+NodeDef / AttrValue / TensorProto are read and written through the
+generic wire reader/writer in :mod:`bigdl_tpu.utils.caffe`.
+
+Supported ops cover the classic frozen-inference vocabulary: Const,
+Placeholder, Identity, MatMul, BiasAdd, Add/AddV2/Sub/Mul/Maximum,
+Conv2D, DepthwiseConv2dNative, Relu, Relu6, Elu, Tanh, Sigmoid,
+Softplus, MaxPool, AvgPool, Mean (global pool), Pad, Reshape, Squeeze,
+Softmax, ConcatV2, FusedBatchNorm(V2/V3).  Data-dependent control flow
+is out of scope — under XLA the static graph is the only graph
+(SURVEY.md nn/graph rationale).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from bigdl_tpu.utils.caffe import (
+    _WireWriter,
+    _w_int,
+    _w_ints,
+    _w_msgs,
+    _w_str,
+    _w_strs,
+    parse_wire,
+)
+
+# tf DataType enum values
+_DT_FLOAT, _DT_DOUBLE, _DT_INT32, _DT_INT64 = 1, 2, 3, 9
+_DT_BOOL, _DT_HALF, _DT_BFLOAT16 = 10, 19, 14
+
+_DT_NP = {
+    _DT_FLOAT: np.float32,
+    _DT_DOUBLE: np.float64,
+    _DT_INT32: np.int32,
+    _DT_INT64: np.int64,
+    _DT_BOOL: np.bool_,
+}
+
+
+class TFConversionException(Exception):
+    pass
+
+
+# ==========================================================================
+# TensorProto / AttrValue / NodeDef decoding
+# ==========================================================================
+
+
+def _decode_tensor(tp: Dict[int, list]) -> np.ndarray:
+    dtype = _w_int(tp, 1, _DT_FLOAT)
+    np_dt = _DT_NP.get(dtype)
+    if np_dt is None:
+        raise TFConversionException(f"unsupported tensor dtype {dtype}")
+    shape_msg = _w_msgs(tp, 2)
+    dims = []
+    if shape_msg:
+        for d in _w_msgs(shape_msg[0], 2):  # TensorShapeProto.dim
+            dims.append(_w_int(d, 1, -1))
+    content = tp.get(4)
+    if content:
+        arr = np.frombuffer(content[-1][1], dtype=np_dt)
+    else:
+        # scalar/short-form repeated fields: float_val=5 double_val=6
+        # int_val=7 int64_val=10 bool_val=11 half_val=13
+        vals: List = []
+        for wt, v in tp.get(5, []):
+            vals.extend(np.frombuffer(v, "<f4") if wt == 2
+                        else [struct.unpack("<f", v)[0]])
+        for wt, v in tp.get(7, []):
+            if wt == 0:
+                vals.append(int(v))
+            else:
+                mv = memoryview(v)
+                pos = 0
+                while pos < len(mv):
+                    from bigdl_tpu.utils.caffe import _read_varint
+
+                    x, pos = _read_varint(mv, pos)
+                    vals.append(x)
+        for wt, v in tp.get(10, []):
+            if wt == 0:
+                vals.append(int(v))
+        arr = np.asarray(vals, dtype=np_dt)
+        if dims and arr.size == 1 and int(np.prod(dims)) > 1:
+            arr = np.full(dims, arr.reshape(-1)[0], dtype=np_dt)
+    if dims:
+        arr = arr.reshape(dims)
+    return arr
+
+
+def _encode_tensor(arr: np.ndarray) -> _WireWriter:
+    w = _WireWriter()
+    dt = {np.float32: _DT_FLOAT, np.float64: _DT_DOUBLE,
+          np.int32: _DT_INT32, np.int64: _DT_INT64}[arr.dtype.type]
+    w.varint(1, dt)
+    shape = _WireWriter()
+    for d in arr.shape:
+        dim = _WireWriter()
+        dim.varint(1, d)
+        shape.message(2, dim)
+    w.message(2, shape)
+    w.bytes_(4, np.ascontiguousarray(arr).tobytes())
+    return w
+
+
+class _Attr:
+    """Decoded AttrValue."""
+
+    def __init__(self, fields: Dict[int, list]):
+        self.f = fields
+
+    @property
+    def s(self) -> Optional[str]:
+        return _w_str(self.f, 2)
+
+    @property
+    def i(self) -> Optional[int]:
+        v = _w_int(self.f, 3)
+        return v
+
+    @property
+    def fl(self) -> Optional[float]:
+        if 4 in self.f:
+            return struct.unpack("<f", self.f[4][-1][1])[0]
+        return None
+
+    @property
+    def b(self) -> Optional[bool]:
+        v = _w_int(self.f, 5)
+        return None if v is None else bool(v)
+
+    @property
+    def type(self) -> Optional[int]:
+        return _w_int(self.f, 6)
+
+    @property
+    def tensor(self) -> Optional[np.ndarray]:
+        msgs = _w_msgs(self.f, 8)
+        return _decode_tensor(msgs[0]) if msgs else None
+
+    @property
+    def ints(self) -> List[int]:
+        msgs = _w_msgs(self.f, 1)  # list value
+        return _w_ints(msgs[0], 3) if msgs else []
+
+
+class _NodeDef:
+    def __init__(self, fields: Dict[int, list]):
+        self.name = _w_str(fields, 1, "")
+        self.op = _w_str(fields, 2, "")
+        self.inputs = _w_strs(fields, 3)
+        self.attrs: Dict[str, _Attr] = {}
+        for entry in _w_msgs(fields, 5):  # map<string, AttrValue>
+            k = _w_str(entry, 1, "")
+            vs = _w_msgs(entry, 2)
+            if vs:
+                self.attrs[k] = _Attr(vs[0])
+
+    def attr(self, key, default=None):
+        return self.attrs.get(key, default)
+
+
+def parse_graphdef(data: bytes) -> List[_NodeDef]:
+    g = parse_wire(data)
+    return [_NodeDef(n) for n in _w_msgs(g, 1)]
+
+
+# ==========================================================================
+# loader
+# ==========================================================================
+
+
+def _clean(name: str) -> str:
+    # drop control-dep marker and output index
+    if name.startswith("^"):
+        name = name[1:]
+    return name.split(":")[0]
+
+
+class TensorflowLoader:
+    """Reference: «bigdl»/utils/tf/TensorflowLoader.scala.
+
+    ``load(inputs=[...], outputs=[...])`` builds a Graph whose Input
+    nodes stand for the named placeholders.  NHWC tensors are converted
+    to the NCHW convention the layer library uses.
+    """
+
+    def __init__(self, path: Optional[str] = None, data: Optional[bytes] = None):
+        if data is None:
+            with open(path, "rb") as f:
+                data = f.read()
+        self.nodes = {n.name: n for n in parse_graphdef(data)}
+
+    # ------------------------------------------------------------------
+    def load(self, inputs: Optional[List[str]] = None,
+             outputs: Optional[List[str]] = None):
+        from bigdl_tpu.nn.graph import Graph, Input
+
+        if outputs is None:
+            consumed = set()
+            for n in self.nodes.values():
+                consumed.update(_clean(i) for i in n.inputs)
+            outputs = [n for n in self.nodes
+                       if n not in consumed
+                       and self.nodes[n].op not in ("Const", "Placeholder")]
+        if inputs is None:
+            inputs = [n.name for n in self.nodes.values()
+                      if n.op == "Placeholder"]
+
+        self._consts: Dict[str, np.ndarray] = {}
+        self._built: Dict[str, object] = {}
+        self._input_nodes = []
+        for name in inputs:
+            node = Input(name)
+            self._built[name] = node
+            self._input_nodes.append(node)
+
+        out_nodes = [self._build(_clean(o)) for o in outputs]
+        return Graph(self._input_nodes, out_nodes)
+
+    # ------------------------------------------------------------------
+    def _const(self, name: str) -> np.ndarray:
+        name = _clean(name)
+        if name in self._consts:
+            return self._consts[name]
+        nd = self.nodes.get(name)
+        if nd is None:
+            raise TFConversionException(f"unknown node {name}")
+        if nd.op == "Identity":
+            return self._const(nd.inputs[0])
+        if nd.op != "Const":
+            raise TFConversionException(
+                f"node {name} ({nd.op}) is not constant"
+            )
+        a = nd.attr("value")
+        arr = a.tensor if a else None
+        if arr is None:
+            raise TFConversionException(f"Const {name} has no tensor")
+        self._consts[name] = arr
+        return arr
+
+    def _data_inputs(self, nd: _NodeDef) -> List[str]:
+        return [i for i in nd.inputs if not i.startswith("^")]
+
+    def _build(self, name: str):
+        """Recursively convert node ``name``; returns a wired graph Node."""
+        name = _clean(name)
+        if name in self._built:
+            return self._built[name]
+        nd = self.nodes.get(name)
+        if nd is None:
+            raise TFConversionException(f"unknown node {name}")
+        node = self._convert(nd)
+        self._built[name] = node
+        return node
+
+    # ------------------------------------------------------------------
+    def _convert(self, nd: _NodeDef):
+        from bigdl_tpu.nn import layers as L
+        from bigdl_tpu.nn import table_ops as T
+        from bigdl_tpu.nn.graph import Input
+
+        jnp_set = _to_jax
+        op = nd.op
+        ins = self._data_inputs(nd)
+
+        if op == "Placeholder":
+            node = Input(nd.name)
+            self._input_nodes.append(node)
+            return node
+        if op in ("Identity", "StopGradient", "CheckNumerics", "NoOp"):
+            return self._build(ins[0])
+        if op == "Const":
+            raise TFConversionException(
+                f"Const {nd.name} reached graph position — only weight"
+                " positions may be constant"
+            )
+
+        if op == "MatMul":
+            w = self._const(ins[1])
+            if nd.attr("transpose_b") and nd.attr("transpose_b").b:
+                w = w.T
+            mod = L.Linear(w.shape[0], w.shape[1], with_bias=False)
+            mod.weight = jnp_set(np.ascontiguousarray(w.T))
+            return self._named(mod, nd)(self._build(ins[0]))
+
+        if op == "BiasAdd":
+            b = self._const(ins[1])
+            mod = L.CAdd(b.shape)
+            mod.bias = jnp_set(b)
+            return self._named(mod, nd)(self._build(ins[0]))
+
+        if op in ("Add", "AddV2", "Sub", "Mul", "Maximum", "RealDiv"):
+            # constant operand -> elementwise const op; else table op
+            const_idx = None
+            for i, inp in enumerate(ins):
+                try:
+                    self._const(inp)
+                    const_idx = i
+                    break
+                except TFConversionException:
+                    continue
+            if const_idx is not None:
+                c = self._const(ins[const_idx])
+                other = ins[1 - const_idx]
+                if c.size == 1:
+                    v = float(c.reshape(-1)[0])
+                    if op in ("Add", "AddV2"):
+                        mod = L.AddConstant(v)
+                    elif op == "Sub":
+                        mod = L.AddConstant(-v if const_idx == 1 else v)
+                    elif op == "Mul":
+                        mod = L.MulConstant(v)
+                    elif op == "RealDiv":
+                        mod = L.MulConstant(1.0 / v)
+                    else:
+                        mod = L.Threshold(v, v)
+                    return self._named(mod, nd)(self._build(other))
+                # broadcast add/mul with a vector -> CAdd/CMul
+                if op in ("Add", "AddV2"):
+                    mod = L.CAdd(c.shape)
+                    mod.bias = jnp_set(c)
+                elif op == "Mul":
+                    mod = L.CMul(c.shape)
+                    mod.weight = jnp_set(c)
+                else:
+                    raise TFConversionException(
+                        f"{op} with non-scalar constant unsupported"
+                    )
+                return self._named(mod, nd)(self._build(other))
+            table = {
+                "Add": T.CAddTable, "AddV2": T.CAddTable,
+                "Sub": T.CSubTable, "Mul": T.CMulTable,
+                "Maximum": T.CMaxTable, "RealDiv": T.CDivTable,
+            }[op]()
+            return self._named(table, nd)(*[self._build(i) for i in ins])
+
+        if op in ("Conv2D", "DepthwiseConv2dNative"):
+            w = self._const(ins[1])  # HWIO (or HWIM for depthwise)
+            strides = nd.attr("strides").ints if nd.attr("strides") else [1, 1, 1, 1]
+            padding = nd.attr("padding").s if nd.attr("padding") else "SAME"
+            data_format = nd.attr("data_format").s if nd.attr("data_format") else "NHWC"
+            if data_format == "NHWC":
+                sh, sw = strides[1], strides[2]
+            else:
+                sh, sw = strides[2], strides[3]
+            kh, kw, c_in, c_mult = w.shape
+            if op == "DepthwiseConv2dNative":
+                n_out = c_in * c_mult
+                group = c_in
+                # HWIM -> (out, in/group=1, kh, kw)
+                wt = w.transpose(2, 3, 0, 1).reshape(n_out, 1, kh, kw)
+            else:
+                n_out = c_mult
+                group = 1
+                wt = w.transpose(3, 2, 0, 1)  # HWIO -> OIHW
+            if padding == "SAME":
+                ph, pw = -1, -1  # layer lib: -1 means SAME
+            else:
+                ph = pw = 0
+            mod = L.SpatialConvolution(
+                c_in if group == 1 else c_in, n_out, kw, kh, sw, sh,
+                pw, ph, group, with_bias=False,
+            )
+            mod.weight = jnp_set(np.ascontiguousarray(wt).reshape(mod.weight.shape))
+            prev = self._build(ins[0])
+            return self._named(mod, nd)(prev)
+
+        if op in ("MaxPool", "AvgPool"):
+            ks = nd.attr("ksize").ints
+            strides = nd.attr("strides").ints
+            padding = nd.attr("padding").s
+            kh, kw = ks[1], ks[2]
+            sh, sw = strides[1], strides[2]
+            pad = -1 if padding == "SAME" else 0
+            if op == "MaxPool":
+                mod = L.SpatialMaxPooling(kw, kh, sw, sh, pad, pad)
+            else:
+                # TF AvgPool excludes padding from the divisor
+                mod = L.SpatialAveragePooling(
+                    kw, kh, sw, sh, pad, pad, count_include_pad=False
+                )
+            return self._named(mod, nd)(self._build(ins[0]))
+
+        if op == "Mean":
+            axes = self._const(ins[1]).reshape(-1).tolist()
+            keep = nd.attr("keep_dims")
+            keep = bool(keep.b) if keep else False
+            if sorted(axes) in ([1, 2], [2, 3]):
+                # global spatial average pool (NHWC axes [1,2]; NCHW [2,3])
+                mod = L.SpatialAveragePooling(0, 0, global_pooling=True) \
+                    if "global_pooling" in _sig(L.SpatialAveragePooling) else None
+                if mod is None:
+                    raise TFConversionException("global Mean unsupported")
+                if not keep:
+                    from bigdl_tpu.nn.module import Sequential
+
+                    mod = Sequential().add(mod).add(L.Squeeze(None))
+                return self._named(mod, nd)(self._build(ins[0]))
+            mod = L.Mean(int(axes[0]) + 1)
+            return self._named(mod, nd)(self._build(ins[0]))
+
+        if op in ("Relu", "Relu6", "Elu", "Tanh", "Sigmoid", "Softplus",
+                  "Softmax", "LogSoftmax", "Rsqrt", "Sqrt", "Square",
+                  "Exp", "Log", "Abs", "Neg"):
+            mod = {
+                "Relu": L.ReLU, "Relu6": L.ReLU6, "Elu": L.ELU,
+                "Tanh": L.Tanh, "Sigmoid": L.Sigmoid,
+                "Softplus": L.SoftPlus, "Softmax": L.SoftMax,
+                "LogSoftmax": L.LogSoftMax, "Sqrt": L.Sqrt,
+                "Square": L.Square, "Exp": L.Exp, "Log": L.Log,
+                "Abs": L.Abs, "Neg": L.Negative,
+            }.get(op)
+            if mod is None:
+                mod = L.Power(-0.5) if op == "Rsqrt" else None
+            else:
+                mod = mod()
+            return self._named(mod, nd)(self._build(ins[0]))
+
+        if op == "Reshape":
+            shape = self._const(ins[1]).reshape(-1).astype(int).tolist()
+            if shape and shape[0] == -1:
+                mod = L.Reshape(shape[1:])  # batch-preserving
+            else:
+                mod = L.View(*shape)
+            return self._named(mod, nd)(self._build(ins[0]))
+
+        if op == "Squeeze":
+            dims = nd.attr("squeeze_dims")
+            axes = sorted(dims.ints, reverse=True) if dims else []
+            if not axes:
+                mod = L.Squeeze(None)
+            elif len(axes) == 1:
+                mod = L.Squeeze(axes[0] + 1)
+            else:
+                from bigdl_tpu.nn.module import Sequential
+
+                mod = Sequential()
+                for a in axes:  # descending: later indices stay valid
+                    mod.add(L.Squeeze(a + 1))
+            return self._named(mod, nd)(self._build(ins[0]))
+
+        if op == "Pad":
+            pads = self._const(ins[1])  # (ndim, 2)
+            if int(pads[0, 0]) or int(pads[0, 1]):
+                raise TFConversionException("Pad on the batch axis unsupported")
+            from bigdl_tpu.nn.module import Sequential
+
+            n_input_dim = pads.shape[0] - 1
+            seq = Sequential()
+            for axis in range(1, pads.shape[0]):
+                before, after = int(pads[axis, 0]), int(pads[axis, 1])
+                if before:
+                    seq.add(L.Padding(axis, -before, n_input_dim))
+                if after:
+                    seq.add(L.Padding(axis, after, n_input_dim))
+            return self._named(seq, nd)(self._build(ins[0]))
+
+        if op in ("ConcatV2", "Concat"):
+            if op == "ConcatV2":
+                axis = int(self._const(ins[-1]).reshape(-1)[0])
+                data = ins[:-1]
+            else:
+                axis = int(self._const(ins[0]).reshape(-1)[0])
+                data = ins[1:]
+            mod = T.JoinTable(dimension=axis + 1, n_input_dims=-1)
+            return self._named(mod, nd)(*[self._build(i) for i in data])
+
+        if op in ("FusedBatchNorm", "FusedBatchNormV2", "FusedBatchNormV3"):
+            scale = self._const(ins[1])
+            offset = self._const(ins[2])
+            mean = self._const(ins[3])
+            var = self._const(ins[4])
+            eps = nd.attr("epsilon")
+            eps = eps.fl if eps else 1e-3
+            c = scale.size
+            mod = L.SpatialBatchNormalization(c, eps=eps, affine=True)
+            mod.weight = jnp_set(scale.reshape(-1))
+            mod.bias = jnp_set(offset.reshape(-1))
+            mod.running_mean = jnp_set(mean.reshape(-1))
+            mod.running_var = jnp_set(var.reshape(-1))
+            mod.evaluate()
+            return self._named(mod, nd)(self._build(ins[0]))
+
+        raise TFConversionException(f"unsupported TF op {op} ({nd.name})")
+
+    @staticmethod
+    def _named(mod, nd: _NodeDef):
+        mod.set_name(nd.name)
+        return mod
+
+
+def _sig(cls):
+    import inspect
+
+    return inspect.signature(cls.__init__).parameters
+
+
+def _to_jax(a: np.ndarray):
+    import jax.numpy as jnp
+
+    return jnp.asarray(np.ascontiguousarray(a), dtype=jnp.float32)
+
+
+def load_tf(path: str, inputs=None, outputs=None):
+    """Reference: ``Module.loadTF(path, inputs, outputs)``."""
+    return TensorflowLoader(path).load(inputs, outputs)
+
+
+# ==========================================================================
+# saver (GraphDef writer) + graph-builder helpers
+# ==========================================================================
+
+
+class GraphDefBuilder:
+    """Minimal GraphDef writer — builds frozen graphs for export/tests."""
+
+    def __init__(self):
+        self.nodes: List[_WireWriter] = []
+
+    def _node(self, name, op, inputs=(), attrs: Optional[dict] = None):
+        n = _WireWriter()
+        n.string(1, name)
+        n.string(2, op)
+        for i in inputs:
+            n.string(3, i)
+        for k, v in (attrs or {}).items():
+            entry = _WireWriter()
+            entry.string(1, k)
+            entry.message(2, v)
+            n.message(5, entry)
+        self.nodes.append(n)
+        return name
+
+    @staticmethod
+    def attr_tensor(arr: np.ndarray) -> _WireWriter:
+        a = _WireWriter()
+        a.message(8, _encode_tensor(arr))
+        return a
+
+    @staticmethod
+    def attr_type(dt: int) -> _WireWriter:
+        a = _WireWriter()
+        a.varint(6, dt)
+        return a
+
+    @staticmethod
+    def attr_s(s: str) -> _WireWriter:
+        a = _WireWriter()
+        a.string(2, s)
+        return a
+
+    @staticmethod
+    def attr_b(b: bool) -> _WireWriter:
+        a = _WireWriter()
+        a.varint(5, 1 if b else 0)
+        return a
+
+    @staticmethod
+    def attr_f(x: float) -> _WireWriter:
+        a = _WireWriter()
+        a.parts.append(_WireWriter._varint(4 << 3 | 5))
+        a.parts.append(struct.pack("<f", x))
+        return a
+
+    @staticmethod
+    def attr_ints(vals: List[int]) -> _WireWriter:
+        lst = _WireWriter()
+        for v in vals:
+            lst.varint(3, v)
+        a = _WireWriter()
+        a.message(1, lst)
+        return a
+
+    def placeholder(self, name, dtype=_DT_FLOAT):
+        return self._node(name, "Placeholder", attrs={"dtype": self.attr_type(dtype)})
+
+    def const(self, name, arr: np.ndarray):
+        return self._node(name, "Const", attrs={
+            "value": self.attr_tensor(arr),
+            "dtype": self.attr_type(_DT_FLOAT),
+        })
+
+    def op(self, name, op, inputs, **attrs):
+        return self._node(name, op, inputs, attrs)
+
+    def tobytes(self) -> bytes:
+        g = _WireWriter()
+        for n in self.nodes:
+            g.message(1, n)
+        return g.tobytes()
+
+
+class TensorflowSaver:
+    """Reference: «bigdl»/utils/tf/TensorflowSaver.scala — export a Graph
+    of supported layers as a frozen GraphDef."""
+
+    @staticmethod
+    def save(graph, path: str):
+        data = TensorflowSaver.to_graphdef(graph)
+        with open(path, "wb") as f:
+            f.write(data)
+
+    @staticmethod
+    def to_graphdef(graph) -> bytes:
+        from bigdl_tpu.nn import layers as L
+        from bigdl_tpu.nn import table_ops as T
+
+        b = GraphDefBuilder()
+        names: Dict[int, str] = {}
+        counter = [0]
+
+        for node in graph.topo_order():
+            m = node.module
+            if node in graph.input_nodes:
+                nm = m._name or f"input{node.id}"
+                b.placeholder(nm)
+                names[node.id] = nm
+                continue
+            counter[0] += 1
+            nm = m._name or f"{type(m).__name__.lower()}{counter[0]}"
+            prev = [names[p.id] for p in node.prev_nodes]
+
+            if isinstance(m, L.Linear):
+                w = np.asarray(m.weight).T  # (in, out)
+                b.const(nm + "/w", np.ascontiguousarray(w))
+                out = b.op(nm, "MatMul", [prev[0], nm + "/w"],
+                           transpose_a=b.attr_b(False),
+                           transpose_b=b.attr_b(False))
+                if m.bias is not None:
+                    b.const(nm + "/b", np.asarray(m.bias))
+                    out = b.op(nm + "/bias", "BiasAdd", [nm, nm + "/b"])
+                names[node.id] = out
+                continue
+            simple = {
+                L.ReLU: "Relu", L.ReLU6: "Relu6", L.Tanh: "Tanh",
+                L.Sigmoid: "Sigmoid", L.SoftMax: "Softmax",
+                L.LogSoftMax: "LogSoftmax", L.SoftPlus: "Softplus",
+                L.Abs: "Abs", L.Exp: "Exp", L.Log: "Log",
+                L.Square: "Square", L.Sqrt: "Sqrt", L.Negative: "Neg",
+            }.get(type(m))
+            if simple:
+                names[node.id] = b.op(nm, simple, prev)
+                continue
+            if isinstance(m, T.CAddTable):
+                out = prev[0]
+                for i, p in enumerate(prev[1:]):
+                    out = b.op(f"{nm}_{i}" if len(prev) > 2 else nm,
+                               "AddV2", [out, p])
+                names[node.id] = out
+                continue
+            if isinstance(m, T.JoinTable):
+                b.const(nm + "/axis", np.asarray(m.dimension - 1, np.int32))
+                names[node.id] = b.op(nm, "ConcatV2", prev + [nm + "/axis"],
+                                      N=b.attr_ints([len(prev)]))
+                continue
+            raise TFConversionException(
+                f"TensorflowSaver: unsupported layer {type(m).__name__}"
+            )
+        return b.tobytes()

@@ -1,0 +1,161 @@
+"""Caffe interop tests — prototxt parsing, caffemodel wire round-trip,
+loader graph construction (reference test analogue: CaffeLoaderSpec /
+CaffePersisterSpec)."""
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.utils.caffe import (
+    CaffeLoader,
+    CaffePersister,
+    format_prototxt,
+    load_caffe_weights,
+    load_caffemodel,
+    parse_prototxt,
+)
+
+ALEXNETISH = """
+name: "TestNet"
+input: "data"
+input_shape { dim: 1 dim: 3 dim: 32 dim: 32 }
+layer {
+  name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param { num_output: 8 kernel_size: 3 stride: 1 pad: 1 }
+}
+layer { name: "relu1" type: "ReLU" bottom: "conv1" top: "relu1" }
+layer {
+  name: "norm1" type: "LRN" bottom: "relu1" top: "norm1"
+  lrn_param { local_size: 5 alpha: 0.0001 beta: 0.75 }
+}
+layer {
+  name: "pool1" type: "Pooling" bottom: "norm1" top: "pool1"
+  pooling_param { pool: MAX kernel_size: 2 stride: 2 }
+}
+layer {
+  name: "bn1" type: "BatchNorm" bottom: "pool1" top: "bn1"
+  batch_norm_param { eps: 0.001 }
+}
+layer {
+  name: "scale1" type: "Scale" bottom: "bn1" top: "scale1"
+  scale_param { bias_term: true }
+}
+layer {
+  name: "fc" type: "InnerProduct" bottom: "scale1" top: "fc"
+  inner_product_param { num_output: 10 }
+}
+layer { name: "prob" type: "Softmax" bottom: "fc" top: "prob" }
+"""
+
+
+def test_parse_prototxt_roundtrip():
+    net = parse_prototxt(ALEXNETISH)
+    assert net["name"] == ["TestNet"]
+    assert len(net["layer"]) == 8
+    conv = net["layer"][0]
+    assert conv["type"] == ["Convolution"]
+    assert conv["convolution_param"][0]["num_output"] == [8]
+    # format -> reparse -> same structure
+    again = parse_prototxt(format_prototxt(net))
+    assert again == net
+
+
+def test_loader_builds_runnable_graph():
+    model = CaffeLoader(prototxt_text=ALEXNETISH).load()
+    model.evaluate()
+    x = np.random.RandomState(0).randn(2, 3, 32, 32).astype(np.float32)
+    out = np.asarray(model.forward(x))
+    assert out.shape == (2, 10)
+    # softmax output sums to 1
+    np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-4)
+
+
+def test_eltwise_and_concat():
+    txt = """
+    name: "Branchy"
+    input: "data"
+    input_shape { dim: 1 dim: 4 dim: 8 dim: 8 }
+    layer { name: "c1" type: "Convolution" bottom: "data" top: "c1"
+      convolution_param { num_output: 4 kernel_size: 1 } }
+    layer { name: "c2" type: "Convolution" bottom: "data" top: "c2"
+      convolution_param { num_output: 4 kernel_size: 1 } }
+    layer { name: "sum" type: "Eltwise" bottom: "c1" bottom: "c2" top: "sum"
+      eltwise_param { operation: SUM } }
+    layer { name: "cat" type: "Concat" bottom: "sum" bottom: "data" top: "cat"
+      concat_param { axis: 1 } }
+    """
+    model = CaffeLoader(prototxt_text=txt).load()
+    x = np.random.RandomState(1).randn(2, 4, 8, 8).astype(np.float32)
+    out = np.asarray(model.forward(x))
+    assert out.shape == (2, 8, 8, 8)
+
+
+def test_persister_loader_roundtrip(tmp_path):
+    from bigdl_tpu.nn import layers as L
+    from bigdl_tpu.nn.graph import Graph, Input
+
+    inp = Input("data")
+    c = L.SpatialConvolution(3, 6, 3, 3, 1, 1, 1, 1).set_name("conv1")(inp)
+    r = L.ReLU().set_name("relu1")(c)
+    p = L.SpatialMaxPooling(2, 2, 2, 2).set_name("pool1")(r)
+    bn = L.SpatialBatchNormalization(6).set_name("bn1")(p)
+    fl = L.Reshape([6 * 8 * 8]).set_name("flat")(bn)
+    fc = L.Linear(6 * 8 * 8, 5).set_name("fc")(fl)
+    g = Graph(inp, fc)
+    # make BN stats non-trivial
+    mod_bn = bn.module
+    mod_bn.running_mean = mod_bn.running_mean + 0.3
+    mod_bn.running_var = mod_bn.running_var * 2.0
+    g.evaluate()
+
+    proto = tmp_path / "net.prototxt"
+    cm = tmp_path / "net.caffemodel"
+    CaffePersister.save(g, str(proto), str(cm), input_shape=(3, 16, 16))
+
+    blobs = load_caffemodel(str(cm))
+    assert "conv1" in blobs and len(blobs["conv1"]["blobs"]) == 2
+    assert blobs["conv1"]["blobs"][0].shape == (6, 3, 3, 3)
+
+    reloaded = CaffeLoader(prototxt_path=str(proto), model_path=str(cm)).load()
+    reloaded.evaluate()
+    x = np.random.RandomState(2).randn(2, 3, 16, 16).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(reloaded.forward(x)), np.asarray(g.forward(x)),
+        rtol=2e-4, atol=2e-5,
+    )
+
+
+def test_load_weights_by_name(tmp_path):
+    from bigdl_tpu.nn import layers as L
+    from bigdl_tpu.nn.graph import Graph, Input
+
+    inp = Input("data")
+    fc = L.Linear(4, 3).set_name("ip")(inp)
+    g = Graph(inp, fc)
+    proto = tmp_path / "a.prototxt"
+    cm = tmp_path / "a.caffemodel"
+    CaffePersister.save(g, str(proto), str(cm))
+
+    inp2 = Input("data")
+    fc2 = L.Linear(4, 3).set_name("ip")(inp2)
+    g2 = Graph(inp2, fc2)
+    load_caffe_weights(g2, str(cm))
+    x = np.random.RandomState(3).randn(2, 4).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(g2.forward(x)), np.asarray(g.forward(x)), rtol=1e-5
+    )
+
+
+def test_v1_legacy_text_format():
+    txt = """
+    name: "V1Net"
+    input: "data"
+    input_dim: 1 input_dim: 2 input_dim: 6 input_dim: 6
+    layers { name: "c" type: CONVOLUTION bottom: "data" top: "c"
+      convolution_param { num_output: 3 kernel_size: 3 } }
+    layers { name: "r" type: RELU bottom: "c" top: "r" }
+    layers { name: "s" type: SOFTMAX bottom: "r" top: "s" }
+    """
+    model = CaffeLoader(prototxt_text=txt).load()
+    x = np.random.RandomState(4).randn(1, 2, 6, 6).astype(np.float32)
+    out = np.asarray(model.forward(x))
+    assert out.shape == (1, 3, 4, 4)

@@ -136,3 +136,39 @@ def test_optim_state_save_load(tmp_path):
         np.asarray(m2.state["velocity"]), np.asarray(m.state["velocity"])
     )
     np.testing.assert_allclose(float(m2.state["neval"]), 5.0)
+
+
+def test_lbfgs_quadratic():
+    """LBFGS minimises a convex quadratic far faster than SGD at lr=1
+    (reference: LBFGSSpec on rosenbrock/quadratics)."""
+    import jax.numpy as jnp
+    from bigdl_tpu.optim.optim_method import LBFGS
+
+    rs = np.random.RandomState(0)
+    A = rs.randn(6, 6).astype(np.float32)
+    A = A @ A.T + 0.5 * np.eye(6, dtype=np.float32)
+    b = rs.randn(6).astype(np.float32)
+    A_j, b_j = jnp.asarray(A), jnp.asarray(b)
+
+    opt = LBFGS(learningrate=0.5, ncorrection=8)
+    x = jnp.zeros(6)
+    state = opt.init_state(x)
+    for _ in range(40):
+        grad = A_j @ x - b_j
+        x, state = opt.step(grad, x, state)
+    expect = np.linalg.solve(A, b)
+    np.testing.assert_allclose(np.asarray(x), expect, rtol=1e-2, atol=1e-2)
+
+
+def test_lbfgs_tree_params():
+    import jax.numpy as jnp
+    from bigdl_tpu.optim.optim_method import LBFGS
+
+    opt = LBFGS(learningrate=0.5, ncorrection=4)
+    params = {"a": jnp.asarray([1.0, 2.0]), "b": jnp.asarray(3.0)}
+    state = opt.init_state(params)
+    for _ in range(30):
+        grad = {"a": params["a"] - 1.0, "b": params["b"] + 2.0}
+        params, state = opt.step(grad, params, state)
+    np.testing.assert_allclose(np.asarray(params["a"]), [1.0, 1.0], atol=1e-2)
+    np.testing.assert_allclose(float(params["b"]), -2.0, atol=1e-2)

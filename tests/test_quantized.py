@@ -1,0 +1,79 @@
+"""Quantized inference tests (reference analogue: nn/quantized specs —
+int8 outputs close to float, quantize() swaps recursively)."""
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.nn import layers as L
+from bigdl_tpu.nn.module import Sequential
+from bigdl_tpu.nn.quantized import (
+    QuantizedLinear,
+    QuantizedSpatialConvolution,
+    Quantizer,
+)
+
+
+def _rel_err(a, b):
+    return np.abs(a - b).max() / (np.abs(b).max() + 1e-8)
+
+
+def test_quantized_linear_close_to_float():
+    rs = np.random.RandomState(0)
+    lin = L.Linear(32, 16)
+    x = rs.randn(8, 32).astype(np.float32)
+    ref = np.asarray(lin.forward(x))
+    q = QuantizedLinear(lin.weight, lin.bias)
+    out = np.asarray(q.forward(x))
+    assert _rel_err(out, ref) < 0.03
+
+
+def test_quantized_conv_close_to_float():
+    rs = np.random.RandomState(1)
+    conv = L.SpatialConvolution(3, 8, 3, 3, 1, 1, 1, 1)
+    x = rs.randn(2, 3, 10, 10).astype(np.float32)
+    ref = np.asarray(conv.forward(x))
+    q = QuantizedSpatialConvolution(
+        conv.weight, conv.bias, (1, 1), [(1, 1), (1, 1)]
+    )
+    out = np.asarray(q.forward(x))
+    assert out.shape == ref.shape
+    assert _rel_err(out, ref) < 0.05
+
+
+def test_module_quantize_swaps_recursively():
+    model = Sequential() \
+        .add(L.SpatialConvolution(1, 4, 3, 3, 1, 1, 1, 1)) \
+        .add(L.ReLU()) \
+        .add(L.Reshape([4 * 8 * 8])) \
+        .add(L.Linear(4 * 8 * 8, 10))
+    rs = np.random.RandomState(2)
+    x = rs.randn(2, 1, 8, 8).astype(np.float32)
+    ref = np.asarray(model.forward(x))
+
+    qmodel = Quantizer.quantize(model)
+    types = [type(m).__name__ for m in qmodel.modules]
+    assert types == [
+        "QuantizedSpatialConvolution", "ReLU", "Reshape", "QuantizedLinear"
+    ]
+    out = np.asarray(qmodel.forward(x))
+    assert _rel_err(out, ref) < 0.05
+
+
+def test_quantized_backward_raises():
+    q = QuantizedLinear(np.ones((4, 4), np.float32))
+    with pytest.raises(RuntimeError):
+        q.backward(np.ones((2, 4), np.float32), np.ones((2, 4), np.float32))
+
+
+def test_quantize_graph_container():
+    from bigdl_tpu.nn.graph import Graph, Input
+
+    inp = Input("x")
+    fc = L.Linear(6, 3)(inp)
+    g = Graph(inp, fc)
+    rs = np.random.RandomState(3)
+    x = rs.randn(2, 6).astype(np.float32)
+    ref = np.asarray(g.forward(x))
+    qg = g.quantize()
+    out = np.asarray(qg.forward(x))
+    assert _rel_err(out, ref) < 0.03

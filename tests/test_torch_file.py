@@ -1,0 +1,97 @@
+"""Torch7 .t7 serialization tests (reference analogue: the torch/
+TorchFile specs — here the writer doubles as the Lua-side oracle)."""
+
+import numpy as np
+
+from bigdl_tpu.utils.torch_file import (
+    TorchObject,
+    load_t7,
+    load_torch_module,
+    save_t7,
+)
+
+
+def test_scalar_and_table_roundtrip(tmp_path):
+    p = str(tmp_path / "x.t7")
+    save_t7(p, {"a": 1, "b": 2.5, "c": "hi", "d": True, "e": None,
+                "nested": {"k": [1, 2, 3]}})
+    out = load_t7(p)
+    assert out["a"] == 1 and out["b"] == 2.5 and out["c"] == "hi"
+    assert out["d"] is True and out["e"] is None
+    assert out["nested"]["k"] == [1, 2, 3]
+
+
+def test_tensor_roundtrip(tmp_path):
+    p = str(tmp_path / "t.t7")
+    rs = np.random.RandomState(0)
+    arr = rs.randn(3, 4, 5).astype(np.float32)
+    save_t7(p, arr)
+    out = load_t7(p)
+    np.testing.assert_array_equal(out, arr)
+    assert out.dtype == np.float32
+
+    arrd = rs.randn(7).astype(np.float64)
+    save_t7(p, arrd)
+    np.testing.assert_array_equal(load_t7(p), arrd)
+
+    arri = np.arange(6, dtype=np.int64).reshape(2, 3)
+    save_t7(p, arri)
+    np.testing.assert_array_equal(load_t7(p), arri)
+
+
+def test_shared_reference(tmp_path):
+    p = str(tmp_path / "s.t7")
+    shared = {"v": 1}
+    save_t7(p, {"x": shared, "y": shared})
+    out = load_t7(p)
+    assert out["x"] is out["y"]
+
+
+def test_nn_module_mapping(tmp_path):
+    rs = np.random.RandomState(1)
+    w1 = rs.randn(16, 8).astype(np.float32)
+    b1 = rs.randn(16).astype(np.float32)
+    w2 = rs.randn(4, 16).astype(np.float32)
+    b2 = rs.randn(4).astype(np.float32)
+    seq = TorchObject("nn.Sequential", {"modules": [
+        TorchObject("nn.Linear", {"weight": w1, "bias": b1}),
+        TorchObject("nn.ReLU", {}),
+        TorchObject("nn.Linear", {"weight": w2, "bias": b2}),
+        TorchObject("nn.LogSoftMax", {}),
+    ]})
+    p = str(tmp_path / "m.t7")
+    save_t7(p, seq)
+
+    model = load_torch_module(p)
+    model.evaluate()
+    x = rs.randn(3, 8).astype(np.float32)
+    out = np.asarray(model.forward(x))
+
+    h = np.maximum(x @ w1.T + b1, 0)
+    logits = h @ w2.T + b2
+    expect = logits - np.log(np.exp(
+        logits - logits.max(1, keepdims=True)
+    ).sum(1, keepdims=True)) - logits.max(1, keepdims=True)
+    np.testing.assert_allclose(out, expect, rtol=2e-3, atol=1e-4)
+
+
+def test_conv_module_mapping(tmp_path):
+    rs = np.random.RandomState(2)
+    w = rs.randn(6, 3, 3, 3).astype(np.float32)
+    b = rs.randn(6).astype(np.float32)
+    obj = TorchObject("nn.Sequential", {"modules": [
+        TorchObject("nn.SpatialConvolutionMM", {
+            "nInputPlane": 3, "nOutputPlane": 6, "kW": 3, "kH": 3,
+            "dW": 1, "dH": 1, "padW": 1, "padH": 1,
+            "weight": w.reshape(6, -1), "bias": b,
+        }),
+        TorchObject("nn.ReLU", {}),
+        TorchObject("nn.SpatialMaxPooling", {"kW": 2, "kH": 2, "dW": 2,
+                                             "dH": 2}),
+    ]})
+    p = str(tmp_path / "c.t7")
+    save_t7(p, obj)
+    model = load_torch_module(p)
+    x = rs.randn(2, 3, 8, 8).astype(np.float32)
+    out = np.asarray(model.forward(x))
+    assert out.shape == (2, 6, 4, 4)
