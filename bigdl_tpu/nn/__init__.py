@@ -22,6 +22,12 @@ from bigdl_tpu.nn.quantized import (
     QuantizedSpatialConvolution,
     Quantizer,
 )
+from bigdl_tpu.nn.sparse import (
+    LookupTableSparse,
+    SparseJoinTable,
+    SparseLinear,
+    SparseTensor,
+)
 from bigdl_tpu.nn.attention import (
     LayerNorm,
     MultiHeadAttention,

@@ -1,0 +1,239 @@
+"""Sparse tensors + sparse layers — wide-and-deep inputs.
+
+Rebuild of «bigdl»/tensor/SparseTensor.scala (+ SparseTensorMath/BLAS)
+and «bigdl»/nn/{SparseLinear,LookupTableSparse,SparseJoinTable}.scala
+(SURVEY.md §2.1 "Sparse tensor": COO-ish sparse for wide-and-deep /
+embedding inputs).
+
+TPU-native design: a thin COO facade whose compute lowers to dense
+gather / segment-sum — XLA has no sparse MXU path, and for the
+wide-and-deep shapes the reference targets (batch × huge-vocab one/few-
+hot) gather+scatter on dense embeddings IS the fast path.  The facade
+interops with ``jax.experimental.sparse.BCOO`` when full sparse algebra
+is wanted.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from bigdl_tpu.nn.module import AbstractModule
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+class SparseTensor:
+    """COO sparse matrix (values + (row, col) indices + dense shape).
+
+    Reference: «bigdl»/tensor/SparseTensor.scala.  Indices are 0-based
+    here (the Scala API's 1-based surface is a Tensor-level nicety the
+    Python API never exposed).
+    """
+
+    def __init__(self, indices, values, shape: Tuple[int, ...]):
+        jnp = _jnp()
+        self.indices = jnp.asarray(indices, dtype=jnp.int32)  # (nnz, ndim)
+        if self.indices.ndim != 2:
+            raise ValueError("indices must be (nnz, ndim)")
+        self.values = jnp.asarray(values)
+        self.shape = tuple(int(s) for s in shape)
+        if self.indices.shape[1] != len(self.shape):
+            raise ValueError("indices ndim != shape ndim")
+
+    @property
+    def nnz(self) -> int:
+        return int(self.values.shape[0])
+
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def from_dense(dense) -> "SparseTensor":
+        d = np.asarray(dense)
+        idx = np.argwhere(d != 0)
+        return SparseTensor(idx, d[tuple(idx.T)], d.shape)
+
+    def to_dense(self):
+        jnp = _jnp()
+        out = jnp.zeros(self.shape, self.values.dtype)
+        return out.at[tuple(self.indices.T)].add(self.values)
+
+    def to_bcoo(self):
+        """Bridge to jax.experimental.sparse for full sparse algebra."""
+        from jax.experimental import sparse as jsparse
+
+        return jsparse.BCOO((self.values, self.indices), shape=self.shape)
+
+    def __repr__(self):
+        return f"SparseTensor(shape={self.shape}, nnz={self.nnz})"
+
+
+class SparseLinear(AbstractModule):
+    """«bigdl»/nn/SparseLinear.scala — Linear over a sparse 2-D input:
+    y = A_sparse @ W.T + b.  Lowered to gather(W cols) + segment-sum —
+    one dense (nnz, out) gather and a scatter-add, both MXU/VPU friendly
+    and O(nnz) instead of O(batch × vocab)."""
+
+    param_names = ("weight", "bias")
+
+    def __init__(self, input_size: int, output_size: int,
+                 with_bias: bool = True, backward_start: int = -1,
+                 backward_length: int = -1,
+                 w_regularizer=None, b_regularizer=None):
+        super().__init__()
+        from bigdl_tpu.nn.layers import Xavier
+
+        self._config = dict(input_size=input_size, output_size=output_size,
+                            with_bias=with_bias)
+        self.input_size, self.output_size = input_size, output_size
+        jnp = _jnp()
+        self.weight = _jnp().asarray(
+            Xavier().init((output_size, input_size), input_size, output_size)
+        )
+        self.bias = jnp.zeros(output_size) if with_bias else None
+        self._regularizers = [
+            p for p in (("weight", w_regularizer), ("bias", b_regularizer))
+            if p[1] is not None
+        ]
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        import jax
+
+        jnp = _jnp()
+        if not isinstance(input, SparseTensor):
+            y = input @ params["weight"].T
+        else:
+            rows = input.indices[:, 0]
+            cols = input.indices[:, 1]
+            contrib = params["weight"].T[cols] * input.values[:, None]
+            y = jax.ops.segment_sum(
+                contrib, rows, num_segments=input.shape[0]
+            )
+        if params.get("bias") is not None:
+            y = y + params["bias"]
+        return y
+
+    def forward(self, input):
+        # SparseTensor isn't a pytree leaf; run the pure path directly
+        self.output = self.update_output_pure(
+            self.params(), input, training=self.is_training
+        )
+        return self.output
+
+
+class LookupTableSparse(AbstractModule):
+    """«bigdl»/nn/LookupTableSparse.scala — embedding bag: looks up the
+    ids of a sparse (batch × maxlen) id matrix and combines per row
+    (sum / mean / sqrtn), with optional per-id weights."""
+
+    param_names = ("weight",)
+
+    def __init__(self, n_index: int, n_output: int, combiner: str = "sum",
+                 max_norm: float = -1.0, w_regularizer=None):
+        super().__init__()
+        if combiner not in ("sum", "mean", "sqrtn"):
+            raise ValueError("combiner must be sum|mean|sqrtn")
+        self._config = dict(n_index=n_index, n_output=n_output,
+                            combiner=combiner)
+        self.n_index, self.n_output = n_index, n_output
+        self.combiner = combiner
+        self.max_norm = max_norm
+        from bigdl_tpu.nn.layers import RandomNormal
+
+        self.weight = _jnp().asarray(
+            RandomNormal(0.0, 1.0).init((n_index, n_output), n_index, n_output)
+        )
+        self._regularizers = (
+            [("weight", w_regularizer)] if w_regularizer is not None else []
+        )
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        import jax
+
+        jnp = _jnp()
+        if isinstance(input, (tuple, list)):
+            ids, weights = input
+        else:
+            ids, weights = input, None
+        if not isinstance(ids, SparseTensor):
+            raise TypeError("LookupTableSparse expects a SparseTensor of ids")
+        rows = ids.indices[:, 0]
+        # reference: ids are 1-based (LookupTable convention)
+        emb_ids = ids.values.astype(jnp.int32) - 1
+        emb = params["weight"][emb_ids]
+        if self.max_norm > 0:
+            norms = jnp.linalg.norm(emb, axis=-1, keepdims=True)
+            emb = emb * jnp.minimum(1.0, self.max_norm / (norms + 1e-12))
+        w = None
+        if weights is not None:
+            w = (weights.values if isinstance(weights, SparseTensor)
+                 else jnp.asarray(weights))
+            emb = emb * w[:, None]
+        batch = ids.shape[0]
+        summed = jax.ops.segment_sum(emb, rows, num_segments=batch)
+        if self.combiner == "sum":
+            return summed
+        counts = jax.ops.segment_sum(
+            jnp.ones_like(rows, dtype=summed.dtype) if w is None else w,
+            rows, num_segments=batch,
+        )
+        counts = jnp.maximum(counts, 1e-12)[:, None]
+        if self.combiner == "mean":
+            return summed / counts
+        sq = jax.ops.segment_sum(
+            jnp.ones_like(rows, dtype=summed.dtype) if w is None else w * w,
+            rows, num_segments=batch,
+        )
+        return summed / jnp.sqrt(jnp.maximum(sq, 1e-12))[:, None]
+
+    def forward(self, input):
+        self.output = self.update_output_pure(
+            self.params(), input, training=self.is_training
+        )
+        return self.output
+
+
+class SparseJoinTable(AbstractModule):
+    """«bigdl»/nn/SparseJoinTable.scala — concatenate sparse matrices
+    along a dimension (wide-and-deep joins its cross-column blocks)."""
+
+    def __init__(self, dimension: int = 2):
+        super().__init__()
+        self._config = dict(dimension=dimension)
+        self.dimension = dimension  # 1-based, reference spelling
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        jnp = _jnp()
+        tensors: Sequence[SparseTensor] = list(input)
+        d = self.dimension - 1
+        offset = 0
+        idx_parts, val_parts = [], []
+        out_shape = list(tensors[0].shape)
+        out_shape[d] = 0
+        for t in tensors:
+            idx = t.indices
+            if offset:
+                idx = idx.at[:, d].add(offset)
+            idx_parts.append(idx)
+            val_parts.append(t.values)
+            offset += t.shape[d]
+            out_shape[d] += t.shape[d]
+        return SparseTensor(
+            jnp.concatenate(idx_parts, 0),
+            jnp.concatenate(val_parts, 0),
+            tuple(out_shape),
+        )
+
+    def forward(self, input):
+        self.output = self.update_output_pure(
+            self.params(), input, training=self.is_training
+        )
+        return self.output

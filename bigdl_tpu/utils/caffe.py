@@ -526,11 +526,21 @@ class CaffeLoader:
                 blob_node[t] = node
                 blob_shape[t] = out_shape
 
+        # output blobs: produced by a *converted* layer and consumed by no
+        # converted layer (skipped Accuracy/Silence layers must not count
+        # as consumers, or the real output would vanish)
+        skip_types = ("Accuracy", "Silence", "ArgMax", "Input", "Data",
+                      "DummyData", "MemoryData", "ImageData", "HDF5Data")
         produced = set()
         consumed = set()
         for l in layers:
-            produced.update(l.get("top", []))
-            consumed.update(l.get("bottom", []))
+            if _first(l, "type", "") in skip_types:
+                continue
+            tops = l.get("top", [])
+            bottoms = l.get("bottom", [])
+            produced.update(tops)
+            # in-place layers (top == bottom) must not self-consume
+            consumed.update(b for b in bottoms if b not in tops)
         outputs = [blob_node[t] for t in blob_node
                    if t in produced and t not in consumed]
         if not outputs:

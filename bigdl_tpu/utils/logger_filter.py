@@ -30,18 +30,25 @@ def redirect_spark_info_logs(
     log_path = log_path or os.environ.get(
         "BIGDL_LOG_PATH", os.path.join(os.getcwd(), "bigdl.log")
     )
+    _MARK = "_bigdl_tpu_logger_filter"
+    fmt = logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s")
     file_handler = logging.FileHandler(log_path)
     file_handler.setLevel(logging.INFO)
-    file_handler.setFormatter(logging.Formatter(
-        "%(asctime)s %(levelname)s %(name)s: %(message)s"
-    ))
+    file_handler.setFormatter(fmt)
+    setattr(file_handler, _MARK, True)
     for name in chatty:
         lg = logging.getLogger(name)
+        # idempotent: drop handlers installed by a previous call
+        for h in list(lg.handlers):
+            if getattr(h, _MARK, False):
+                lg.removeHandler(h)
         lg.addHandler(file_handler)
         lg.setLevel(logging.INFO)
         lg.propagate = False
         console = logging.StreamHandler()
         console.setLevel(logging.WARNING)
+        console.setFormatter(fmt)
+        setattr(console, _MARK, True)
         lg.addHandler(console)
     for name in keep:
         lg = logging.getLogger(name)

@@ -25,6 +25,8 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from bigdl_tpu.utils.caffe import (
+    _sig,
+    _to_jax,
     _WireWriter,
     _w_int,
     _w_ints,
@@ -219,6 +221,7 @@ class TensorflowLoader:
 
         self._consts: Dict[str, np.ndarray] = {}
         self._built: Dict[str, object] = {}
+        self._img_memo: Dict[str, bool] = {}
         self._input_nodes = []
         for name in inputs:
             node = Input(name)
@@ -251,6 +254,41 @@ class TensorflowLoader:
 
     def _data_inputs(self, nd: _NodeDef) -> List[str]:
         return [i for i in nd.inputs if not i.startswith("^")]
+
+    # NHWC graphs are converted to NCHW modules, so axis-bearing ops
+    # (Concat/Squeeze/Pad/Mean/BiasAdd) must remap their axes whenever
+    # the tensor flowing through them is an image (4-D conv-path) tensor
+    _IMG_PRODUCERS = ("Conv2D", "DepthwiseConv2dNative", "MaxPool",
+                      "AvgPool", "FusedBatchNorm", "FusedBatchNormV2",
+                      "FusedBatchNormV3")
+    _IMG_PROPAGATORS = ("Identity", "StopGradient", "CheckNumerics",
+                        "Relu", "Relu6", "Elu", "Tanh", "Sigmoid",
+                        "Softplus", "BiasAdd", "Add", "AddV2", "Sub",
+                        "Mul", "Maximum", "RealDiv", "Pad", "ConcatV2",
+                        "Concat", "Abs", "Neg", "Sqrt", "Square", "Exp",
+                        "Log")
+
+    def _is_image(self, name: str) -> bool:
+        name = _clean(name)
+        if name in self._img_memo:
+            return self._img_memo[name]
+        nd = self.nodes.get(name)
+        res = False
+        if nd is not None:
+            if nd.op in self._IMG_PRODUCERS:
+                res = True
+            elif nd.op in self._IMG_PROPAGATORS:
+                self._img_memo[name] = False  # cycle guard
+                res = any(self._is_image(i) for i in self._data_inputs(nd))
+        self._img_memo[name] = res
+        return res
+
+    @staticmethod
+    def _map_axis(axis: int, image: bool) -> int:
+        """NHWC axis -> NCHW axis for image tensors."""
+        if not image:
+            return axis
+        return {0: 0, 1: 2, 2: 3, 3: 1}[axis]
 
     def _build(self, name: str):
         """Recursively convert node ``name``; returns a wired graph Node."""
@@ -296,8 +334,13 @@ class TensorflowLoader:
 
         if op == "BiasAdd":
             b = self._const(ins[1])
-            mod = L.CAdd(b.shape)
-            mod.bias = jnp_set(b)
+            if self._is_image(ins[0]):
+                # channel bias on an NCHW tensor broadcasts as (C, 1, 1)
+                mod = L.CAdd((b.size, 1, 1))
+                mod.bias = jnp_set(b.reshape(-1, 1, 1))
+            else:
+                mod = L.CAdd(b.shape)
+                mod.bias = jnp_set(b)
             return self._named(mod, nd)(self._build(ins[0]))
 
         if op in ("Add", "AddV2", "Sub", "Mul", "Maximum", "RealDiv"):
@@ -314,15 +357,25 @@ class TensorflowLoader:
                 c = self._const(ins[const_idx])
                 other = ins[1 - const_idx]
                 if c.size == 1:
+                    from bigdl_tpu.nn.module import Sequential
+
                     v = float(c.reshape(-1)[0])
                     if op in ("Add", "AddV2"):
                         mod = L.AddConstant(v)
                     elif op == "Sub":
-                        mod = L.AddConstant(-v if const_idx == 1 else v)
+                        if const_idx == 1:  # x - c
+                            mod = L.AddConstant(-v)
+                        else:  # c - x = -(x) + c
+                            mod = Sequential().add(L.Negative()) \
+                                .add(L.AddConstant(v))
                     elif op == "Mul":
                         mod = L.MulConstant(v)
                     elif op == "RealDiv":
-                        mod = L.MulConstant(1.0 / v)
+                        if const_idx == 1:  # x / c
+                            mod = L.MulConstant(1.0 / v)
+                        else:  # c / x = c * x^-1
+                            mod = Sequential().add(L.Power(-1.0)) \
+                                .add(L.MulConstant(v))
                     else:
                         mod = L.Threshold(v, v)
                     return self._named(mod, nd)(self._build(other))
@@ -393,21 +446,26 @@ class TensorflowLoader:
             return self._named(mod, nd)(self._build(ins[0]))
 
         if op == "Mean":
-            axes = self._const(ins[1]).reshape(-1).tolist()
+            image = self._is_image(ins[0])
+            axes = sorted(
+                self._map_axis(int(a), image)
+                for a in self._const(ins[1]).reshape(-1).tolist()
+            )
             keep = nd.attr("keep_dims")
             keep = bool(keep.b) if keep else False
-            if sorted(axes) in ([1, 2], [2, 3]):
-                # global spatial average pool (NHWC axes [1,2]; NCHW [2,3])
-                mod = L.SpatialAveragePooling(0, 0, global_pooling=True) \
-                    if "global_pooling" in _sig(L.SpatialAveragePooling) else None
-                if mod is None:
-                    raise TFConversionException("global Mean unsupported")
+            if axes == [2, 3]:
+                # global spatial average pool over the NCHW image
+                mod = L.SpatialAveragePooling(0, 0, global_pooling=True)
                 if not keep:
                     from bigdl_tpu.nn.module import Sequential
 
                     mod = Sequential().add(mod).add(L.Squeeze(None))
                 return self._named(mod, nd)(self._build(ins[0]))
-            mod = L.Mean(int(axes[0]) + 1)
+            if len(axes) != 1 or keep:
+                raise TFConversionException(
+                    f"Mean over axes {axes} (keep_dims={keep}) unsupported"
+                )
+            mod = L.Mean(axes[0] + 1)
             return self._named(mod, nd)(self._build(ins[0]))
 
         if op in ("Relu", "Relu6", "Elu", "Tanh", "Sigmoid", "Softplus",
@@ -437,7 +495,11 @@ class TensorflowLoader:
 
         if op == "Squeeze":
             dims = nd.attr("squeeze_dims")
-            axes = sorted(dims.ints, reverse=True) if dims else []
+            image = self._is_image(ins[0])
+            axes = sorted(
+                (self._map_axis(int(a), image) for a in dims.ints),
+                reverse=True,
+            ) if dims else []
             if not axes:
                 mod = L.Squeeze(None)
             elif len(axes) == 1:
@@ -451,19 +513,21 @@ class TensorflowLoader:
             return self._named(mod, nd)(self._build(ins[0]))
 
         if op == "Pad":
-            pads = self._const(ins[1])  # (ndim, 2)
+            pads = self._const(ins[1])  # (ndim, 2) in graph (NHWC) order
             if int(pads[0, 0]) or int(pads[0, 1]):
                 raise TFConversionException("Pad on the batch axis unsupported")
             from bigdl_tpu.nn.module import Sequential
 
+            image = self._is_image(ins[0])
             n_input_dim = pads.shape[0] - 1
             seq = Sequential()
             for axis in range(1, pads.shape[0]):
                 before, after = int(pads[axis, 0]), int(pads[axis, 1])
+                dim = self._map_axis(axis, image)
                 if before:
-                    seq.add(L.Padding(axis, -before, n_input_dim))
+                    seq.add(L.Padding(dim, -before, n_input_dim))
                 if after:
-                    seq.add(L.Padding(axis, after, n_input_dim))
+                    seq.add(L.Padding(dim, after, n_input_dim))
             return self._named(seq, nd)(self._build(ins[0]))
 
         if op in ("ConcatV2", "Concat"):
@@ -473,6 +537,8 @@ class TensorflowLoader:
             else:
                 axis = int(self._const(ins[0]).reshape(-1)[0])
                 data = ins[1:]
+            image = any(self._is_image(i) for i in data)
+            axis = self._map_axis(axis, image)
             mod = T.JoinTable(dimension=axis + 1, n_input_dims=-1)
             return self._named(mod, nd)(*[self._build(i) for i in data])
 
@@ -498,18 +564,6 @@ class TensorflowLoader:
     def _named(mod, nd: _NodeDef):
         mod.set_name(nd.name)
         return mod
-
-
-def _sig(cls):
-    import inspect
-
-    return inspect.signature(cls.__init__).parameters
-
-
-def _to_jax(a: np.ndarray):
-    import jax.numpy as jnp
-
-    return jnp.asarray(np.ascontiguousarray(a), dtype=jnp.float32)
 
 
 def load_tf(path: str, inputs=None, outputs=None):

@@ -206,6 +206,9 @@ class _Writer:
         self.f.write(b)
 
     def value(self, v):
+        if isinstance(v, np.generic):
+            # numpy scalars serialize as Lua numbers/booleans, not tensors
+            v = v.item()
         if v is None:
             self.i32(TYPE_NIL)
         elif isinstance(v, bool):

@@ -1,0 +1,130 @@
+"""Keras 1.2.2 JSON/HDF5 converter tests (reference analogue: the
+pyspark keras converter test suite)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.keras.converter import (
+    KerasConversionException,
+    load_weights_hdf5,
+    model_from_json,
+)
+
+SEQ_JSON = json.dumps({
+    "class_name": "Sequential",
+    "config": [
+        {"class_name": "Dense", "config": {
+            "name": "d1", "output_dim": 16,
+            "batch_input_shape": [None, 8], "activation": "relu"}},
+        {"class_name": "Dropout", "config": {"name": "drop", "p": 0.5}},
+        {"class_name": "Dense", "config": {
+            "name": "d2", "output_dim": 4, "activation": "softmax"}},
+    ],
+})
+
+
+def test_sequential_from_json():
+    model = model_from_json(SEQ_JSON)
+    x = np.random.RandomState(0).randn(3, 8).astype(np.float32)
+    out = model.predict(x)
+    assert out.shape == (3, 4)
+    np.testing.assert_allclose(np.asarray(out).sum(1), 1.0, rtol=1e-4)
+
+
+def test_conv_model_from_json():
+    spec = {
+        "class_name": "Sequential",
+        "config": [
+            {"class_name": "Convolution2D", "config": {
+                "name": "c1", "nb_filter": 6, "nb_row": 3, "nb_col": 3,
+                "batch_input_shape": [None, 1, 12, 12],
+                "border_mode": "same", "activation": "relu",
+                "dim_ordering": "th"}},
+            {"class_name": "MaxPooling2D", "config": {
+                "name": "p1", "pool_size": [2, 2]}},
+            {"class_name": "Flatten", "config": {"name": "f"}},
+            {"class_name": "Dense", "config": {
+                "name": "out", "output_dim": 10,
+                "activation": "softmax"}},
+        ],
+    }
+    model = model_from_json(json.dumps(spec))
+    x = np.random.RandomState(1).randn(2, 1, 12, 12).astype(np.float32)
+    assert model.predict(x).shape == (2, 10)
+
+
+def test_functional_model_from_json():
+    spec = {
+        "class_name": "Model",
+        "config": {
+            "layers": [
+                {"class_name": "InputLayer", "name": "in1",
+                 "config": {"batch_input_shape": [None, 6]},
+                 "inbound_nodes": []},
+                {"class_name": "Dense", "name": "a",
+                 "config": {"name": "a", "output_dim": 8,
+                            "activation": "relu"},
+                 "inbound_nodes": [[["in1", 0, 0]]]},
+                {"class_name": "Dense", "name": "b",
+                 "config": {"name": "b", "output_dim": 8},
+                 "inbound_nodes": [[["in1", 0, 0]]]},
+                {"class_name": "Merge", "name": "m",
+                 "config": {"mode": "sum"},
+                 "inbound_nodes": [[["a", 0, 0], ["b", 0, 0]]]},
+                {"class_name": "Dense", "name": "out",
+                 "config": {"name": "out", "output_dim": 3},
+                 "inbound_nodes": [[["m", 0, 0]]]},
+            ],
+            "input_layers": [["in1", 0, 0]],
+            "output_layers": [["out", 0, 0]],
+        },
+    }
+    g = model_from_json(json.dumps(spec))
+    x = np.random.RandomState(2).randn(4, 6).astype(np.float32)
+    out = np.asarray(g.forward(x))
+    assert out.shape == (4, 3)
+
+
+def test_hdf5_weight_loading(tmp_path):
+    import h5py
+
+    rs = np.random.RandomState(3)
+    w1 = rs.randn(8, 16).astype(np.float32)  # keras (in, out)
+    b1 = rs.randn(16).astype(np.float32)
+    w2 = rs.randn(16, 4).astype(np.float32)
+    b2 = rs.randn(4).astype(np.float32)
+
+    path = tmp_path / "weights.h5"
+    with h5py.File(path, "w") as f:
+        f.attrs["layer_names"] = [b"d1", b"drop", b"d2"]
+        g1 = f.create_group("d1")
+        g1.attrs["weight_names"] = [b"d1_W", b"d1_b"]
+        g1.create_dataset("d1_W", data=w1)
+        g1.create_dataset("d1_b", data=b1)
+        f.create_group("drop").attrs["weight_names"] = []
+        g2 = f.create_group("d2")
+        g2.attrs["weight_names"] = [b"d2_W", b"d2_b"]
+        g2.create_dataset("d2_W", data=w2)
+        g2.create_dataset("d2_b", data=b2)
+
+    model = model_from_json(SEQ_JSON)
+    load_weights_hdf5(model, str(path))
+
+    x = rs.randn(3, 8).astype(np.float32)
+    out = np.asarray(model.predict(x))
+    h = np.maximum(x @ w1 + b1, 0)
+    logits = h @ w2 + b2
+    e = np.exp(logits - logits.max(1, keepdims=True))
+    np.testing.assert_allclose(out, e / e.sum(1, keepdims=True),
+                               rtol=2e-3, atol=1e-5)
+
+
+def test_unsupported_layer_raises():
+    bad = json.dumps({
+        "class_name": "Sequential",
+        "config": [{"class_name": "Lambda", "config": {"name": "l"}}],
+    })
+    with pytest.raises(KerasConversionException):
+        model_from_json(bad)

@@ -118,3 +118,120 @@ def test_enumerated_layer_roundtrip(tmp_path):
     ]
     for i, (m, x) in enumerate(cases):
         _roundtrip(m, x, tmp_path, f"layer{i}")
+
+
+# --------------------------------------------------------------------------
+# registry-wide round-trip (reference §4.8: enumerate EVERY registered
+# layer, serialize, reload, diff outputs)
+# --------------------------------------------------------------------------
+
+def _layer_cases():
+    """One canonical (module, input) pair per serializable layer class."""
+    import bigdl_tpu.nn as N
+    from bigdl_tpu.nn import layers as L
+    from bigdl_tpu.nn import table_ops as T
+
+    rs = np.random.RandomState(7)
+    v = rs.randn(2, 6).astype(np.float32)
+    img = rs.randn(2, 3, 8, 8).astype(np.float32)
+    seq = rs.randn(2, 5, 6).astype(np.float32)
+    pos = np.abs(v) + 0.1
+    cases = [
+        (L.Linear(6, 4), v),
+        (L.LookupTable(10, 4), np.array([[1, 2], [3, 4]], np.float32)),
+        (L.SpatialConvolution(3, 4, 3, 3, 1, 1, 1, 1), img),
+        (L.SpatialDilatedConvolution(3, 4, 3, 3, 1, 1, 2, 2, 2, 2), img),
+        (L.SpatialFullConvolution(3, 2, 3, 3), img),
+        (L.TemporalConvolution(6, 4, 3), seq),
+        (L.SpatialMaxPooling(2, 2, 2, 2), img),
+        (L.SpatialAveragePooling(2, 2, 2, 2), img),
+        (L.ReLU(), v), (L.ReLU6(), v), (L.Tanh(), v), (L.Sigmoid(), v),
+        (L.LogSoftMax(), v), (L.SoftMax(), v), (L.SoftMin(), v),
+        (L.SoftPlus(), v), (L.SoftSign(), v), (L.ELU(), v),
+        (L.LeakyReLU(0.2), v), (L.HardTanh(), v), (L.HardSigmoid(), v),
+        (L.Clamp(-1, 1), v), (L.Threshold(0.1, 0.0), v), (L.PReLU(), v),
+        (L.GELU(), v), (L.Abs(), v), (L.Square(), pos), (L.Sqrt(), pos),
+        (L.Power(2.0, 1.5, 0.1), pos), (L.Log(), pos), (L.Exp(), v),
+        (L.Negative(), v), (L.AddConstant(1.5), v), (L.MulConstant(2.0), v),
+        (L.CMul((6,)), v), (L.CAdd((6,)), v),
+        (L.Add(6), v), (L.Mul(), v),
+        (L.Scale((6,)), v),
+        (L.BatchNormalization(6), v),
+        (L.SpatialBatchNormalization(3), img),
+        (L.Normalize(2.0), v),
+        (L.SpatialCrossMapLRN(3), img),
+        (L.Dropout(0.5), v),  # eval mode = identity
+        (L.Reshape([3, 2]), v), (L.View(3, 2), v),
+        (L.Squeeze(None), v[:, :1]), (L.Unsqueeze(2), v),
+        (L.Transpose([(1, 2)]), v), (L.Contiguous(), v),
+        (L.Replicate(3), v), (L.Narrow(2, 1, 3), v),
+        (L.Padding(1, 2, 1), v),
+        (L.SpatialZeroPadding(1, 1, 1, 1), img),
+        (L.SpatialUpSamplingNearest(2), img),
+        (L.SpatialUpSamplingBilinear(16, 16), img),
+        (L.Mean(2), v), (L.Sum(2), v), (L.Max(2), v), (L.Min(2), v),
+        (L.Masking(0.0), v),
+        (L.GradientReversal(), v),
+        (L.L1Penalty(0.1), v),
+        (L.Cosine(6, 4), v), (L.Euclidean(6, 4), v),
+        (L.Bilinear(3, 3, 2), (v[:, :3], v[:, 3:])),
+        (T.CAddTable(), (v, v)), (T.CSubTable(), (v, v)),
+        (T.CMulTable(), (v, v)), (T.CDivTable(), (v, pos)),
+        (T.CMaxTable(), (v, v)), (T.CMinTable(), (v, v)),
+        (T.JoinTable(2), (v, v)), (T.SelectTable(1), (v, v)),
+        (T.MM(), (v, v.T.copy())), (T.MV(), (v, rs.randn(2, 6).astype(np.float32)[0] * 0 + 1)),
+        (T.DotProduct(), (v, v)), (T.CosineDistance(), (v, v)),
+    ]
+    return cases
+
+
+def test_registry_wide_roundtrip(tmp_path):
+    failures = []
+    for i, (mod, x) in enumerate(_layer_cases()):
+        name = type(mod).__name__
+        try:
+            mod.evaluate()
+            out1 = np.asarray(mod.forward(x))
+            path = save_module(mod, str(tmp_path / f"layer{i}"))
+            loaded = load_module(path)
+            loaded.evaluate()
+            out2 = np.asarray(loaded.forward(x))
+            np.testing.assert_allclose(out1, out2, rtol=1e-5, atol=1e-6)
+        except Exception as e:  # noqa: BLE001 - collect all failures
+            failures.append(f"{name}: {type(e).__name__}: {e}")
+    assert not failures, "round-trip failures:\n" + "\n".join(failures)
+
+
+def test_every_exported_layer_is_covered_or_known():
+    """Guard: every AbstractModule subclass exported from bigdl_tpu.nn
+    either appears in _layer_cases, is a container/recurrent/attention
+    class with its own dedicated spec, or is explicitly listed."""
+    import bigdl_tpu.nn as N
+    from bigdl_tpu.nn.module import AbstractModule
+
+    covered = {type(m).__name__ for m, _ in _layer_cases()}
+    dedicated = {
+        # containers + graph + recurrent + attention + criterions get
+        # their own round-trip specs elsewhere in this file / suite
+        "AbstractModule", "Container",  # abstract bases
+        "Sequential", "Concat", "ConcatTable", "ParallelTable", "Graph",
+        "Identity", "Echo", "Recurrent", "BiRecurrent", "RecurrentDecoder",
+        "LSTM", "LSTMPeephole", "GRU", "RnnCell", "TimeDistributed",
+        "Select", "MaskedSelect", "FlattenTable",
+        "LayerNorm", "MultiHeadAttention", "TransformerBlock",
+        "PositionalEmbedding",
+        # sparse layers operate on SparseTensor inputs (own spec)
+        "SparseLinear", "LookupTableSparse", "SparseJoinTable",
+        # quantized layers are constructed from float twins (own spec)
+        "QuantizedLinear", "QuantizedSpatialConvolution",
+        # index-input layers
+        "Index",
+    }
+    missing = []
+    for name in dir(N):
+        obj = getattr(N, name)
+        if isinstance(obj, type) and issubclass(obj, AbstractModule) \
+                and not name.startswith("_"):
+            if name not in covered and name not in dedicated:
+                missing.append(name)
+    assert not missing, f"layers with no round-trip coverage: {missing}"

@@ -1,0 +1,58 @@
+"""Text pipeline tests (reference analogue: dataset/text specs —
+Dictionary, LabeledSentence, PTB BPTT batching)."""
+
+import numpy as np
+
+from bigdl_tpu.dataset.text import (
+    Dictionary,
+    LabeledSentence,
+    ptb_bptt_batches,
+    synthetic_ptb_stream,
+)
+
+
+def test_dictionary_build_and_lookup():
+    sents = [["the", "cat", "sat"], ["the", "dog", "sat", "down"]]
+    d = Dictionary(sents, vocab_size=10)
+    assert d.vocab_size() <= 10
+    # ids are 1-based (LookupTable convention)
+    for w in ("the", "cat", "sat"):
+        idx = d.get_index(w)
+        assert idx >= 1
+        assert d.get_word(idx) == w
+    # unknown word falls into the last-id bucket
+    assert d.get_index("zebra") == d.vocab_size()
+
+
+def test_dictionary_vocab_cap():
+    sents = [["a"] * 5, ["b"] * 4, ["c"] * 3, ["d"] * 2, ["e"]]
+    d = Dictionary(sents, vocab_size=3)
+    assert d.vocab_size() == 3
+    assert d.get_index("a") == 1  # most frequent first
+
+
+def test_labeled_sentence():
+    data = [1, 2, 3, 4]
+    ls = LabeledSentence(data[:-1], data[1:])
+    np.testing.assert_array_equal(ls.data, [1, 2, 3])
+    np.testing.assert_array_equal(ls.labels, [2, 3, 4])
+
+
+def test_ptb_bptt_batches_shapes_and_shift():
+    tokens = np.arange(1000, dtype=np.int64)
+    xs, ys = ptb_bptt_batches(tokens, batch_size=4, num_steps=10)
+    assert xs.shape == ys.shape
+    assert xs.shape[1:] == (4, 10)
+    # target is input shifted by one within each stream
+    np.testing.assert_array_equal(ys[:, :, :-1], xs[:, :, 1:])
+    # stream continuity across windows (stateful BPTT, reference PTB path)
+    np.testing.assert_array_equal(xs[1, :, 0], ys[0, :, -1])
+
+
+def test_synthetic_ptb_stream():
+    tokens = synthetic_ptb_stream(n_tokens=5000, vocab_size=50)
+    assert len(tokens) == 5000
+    assert tokens.min() >= 1 and tokens.max() <= 50
+    # deterministic
+    again = synthetic_ptb_stream(n_tokens=5000, vocab_size=50)
+    np.testing.assert_array_equal(tokens, again)

@@ -136,3 +136,47 @@ def test_elementwise_const_ops():
     np.testing.assert_allclose(
         np.asarray(model.forward(x)), x * 2 + 2, rtol=1e-5
     )
+
+
+def test_nhwc_channel_concat_and_bias_remap():
+    """Conv(NHWC graph) -> BiasAdd -> ConcatV2 axis=3: channel concat in
+    the graph must become channel concat (axis 1) in the NCHW model."""
+    rs = np.random.RandomState(6)
+    b = GraphDefBuilder()
+    b.placeholder("img")
+    w = rs.randn(1, 1, 2, 3).astype(np.float32)  # HWIO: 2->3 channels
+    bias = rs.randn(3).astype(np.float32)
+    b.const("w", w)
+    b.const("bias", bias)
+    b.op("conv", "Conv2D", ["img", "w"],
+         strides=b.attr_ints([1, 1, 1, 1]), padding=b.attr_s("SAME"),
+         data_format=b.attr_s("NHWC"))
+    b.op("biased", "BiasAdd", ["conv", "bias"])
+    b.const("axis", np.asarray(3, np.int32))
+    b.op("cat", "ConcatV2", ["biased", "biased", "axis"])
+    model = TensorflowLoader(data=b.tobytes()).load(
+        inputs=["img"], outputs=["cat"]
+    )
+    x = rs.randn(2, 2, 5, 5).astype(np.float32)  # NCHW input convention
+    out = np.asarray(model.forward(x))
+    # channel concat: (2, 6, 5, 5); width concat would be (2, 3, 5, 10)
+    assert out.shape == (2, 6, 5, 5)
+    expect_half = np.einsum("nchw,co->nohw", x, w[0, 0]) + \
+        bias[None, :, None, None]
+    np.testing.assert_allclose(out[:, :3], expect_half, rtol=2e-3, atol=1e-4)
+    np.testing.assert_allclose(out[:, 3:], expect_half, rtol=2e-3, atol=1e-4)
+
+
+def test_const_first_sub_and_div():
+    b = GraphDefBuilder()
+    b.placeholder("x")
+    b.const("one", np.asarray(1.0, np.float32))
+    b.op("inv", "Sub", ["one", "x"])       # 1 - x
+    b.op("recip", "RealDiv", ["one", "inv"])  # 1 / (1 - x)
+    model = TensorflowLoader(data=b.tobytes()).load(
+        inputs=["x"], outputs=["recip"]
+    )
+    x = np.random.RandomState(7).rand(3, 4).astype(np.float32) * 0.5
+    np.testing.assert_allclose(
+        np.asarray(model.forward(x)), 1.0 / (1.0 - x), rtol=2e-3
+    )
