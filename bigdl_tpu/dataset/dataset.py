@@ -82,10 +82,20 @@ class ArrayDataSet(LocalDataSet):
             idx = RandomGenerator.RNG.randperm(self._n)
         bs = self.batch_size
         n_full = self._n // bs
+        # single float32 feature arrays assemble through the native
+        # multi-threaded row gather (bigdl_tpu/native — the BigDL-core
+        # replacement for the host data plane)
+        gather = None
+        if not self._multi and self.features.dtype == np.float32:
+            from bigdl_tpu import native as _native
+
+            gather = _native.gather_rows
         for b in range(n_full):
             sel = idx[b * bs : (b + 1) * bs]
             if self._multi:
                 inp = tuple(f[sel] for f in self.features)
+            elif gather is not None:
+                inp = gather(self.features, sel)
             else:
                 inp = self.features[sel]
             yield inp, self.labels[sel]

@@ -210,8 +210,15 @@ def _graph_from_config(cfg: dict):
                 )
                 out_shape = tuple(out_shape)
             elif mode in ("sum", "ave", "max", "mul"):
-                mod = {"sum": T.CAddTable, "max": T.CMaxTable,
-                       "mul": T.CMulTable, "ave": T.CAddTable}[mode]()
+                if mode == "ave":
+                    from bigdl_tpu.nn import layers as KLY
+                    from bigdl_tpu.nn.module import Sequential
+
+                    mod = Sequential().add(T.CAddTable()) \
+                        .add(KLY.MulConstant(1.0 / len(in_names)))
+                else:
+                    mod = {"sum": T.CAddTable, "max": T.CMaxTable,
+                           "mul": T.CMulTable}[mode]()
                 out_shape = shapes[in_names[0]]
             else:
                 raise KerasConversionException(f"Merge mode {mode}")

@@ -286,7 +286,11 @@ class LocalOptimizer(BaseOptimizer):
         while not stop:
             epoch = self.state["epoch"]
             epoch_start = time.time()
-            for inp, tgt in self.dataset.data(train=True):
+            # background host thread assembles the next minibatch while
+            # the chip runs the current step (native.PrefetchIterator)
+            from bigdl_tpu.native import PrefetchIterator
+
+            for inp, tgt in PrefetchIterator(self.dataset.data(train=True)):
                 t0 = time.perf_counter()
                 rng = jax.random.fold_in(base_key, self.state["neval"])
                 inp_d, tgt_d = self._put_batch(inp, tgt)

@@ -92,6 +92,12 @@ def _resize_bilinear(img: np.ndarray, oh: int, ow: int) -> np.ndarray:
         return np.asarray(pil.resize((ow, oh), Image.BILINEAR))
     except ImportError:
         pass
+    from bigdl_tpu import native as _native
+
+    if _native.available() and img.ndim == 3:
+        chw = np.ascontiguousarray(img.astype(np.float32).transpose(2, 0, 1))
+        out = _native.resize_bilinear(chw, oh, ow)
+        return out.transpose(1, 2, 0)
     h, w = img.shape[:2]
     ys = np.linspace(0, h - 1, oh)
     xs = np.linspace(0, w - 1, ow)

@@ -25,7 +25,6 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from bigdl_tpu.utils.caffe import (
-    _sig,
     _to_jax,
     _WireWriter,
     _w_int,
@@ -269,6 +268,9 @@ class TensorflowLoader:
                         "Log")
 
     def _is_image(self, name: str) -> bool:
+        """True when ``name`` carries an NHWC conv-path tensor whose axes
+        need remapping.  NCHW-format producers (data_format attr) are
+        already in the framework layout and must NOT be remapped."""
         name = _clean(name)
         if name in self._img_memo:
             return self._img_memo[name]
@@ -276,7 +278,8 @@ class TensorflowLoader:
         res = False
         if nd is not None:
             if nd.op in self._IMG_PRODUCERS:
-                res = True
+                fmt = nd.attr("data_format")
+                res = (fmt.s if fmt and fmt.s else "NHWC") == "NHWC"
             elif nd.op in self._IMG_PROPAGATORS:
                 self._img_memo[name] = False  # cycle guard
                 res = any(self._is_image(i) for i in self._data_inputs(nd))
@@ -285,9 +288,13 @@ class TensorflowLoader:
 
     @staticmethod
     def _map_axis(axis: int, image: bool) -> int:
-        """NHWC axis -> NCHW axis for image tensors."""
+        """NHWC axis -> NCHW axis for image tensors.  Negative axes are
+        normalised against the known rank-4 image layout; for non-image
+        tensors they pass through (numpy semantics handle them)."""
         if not image:
             return axis
+        if axis < 0:
+            axis += 4
         return {0: 0, 1: 2, 2: 3, 3: 1}[axis]
 
     def _build(self, name: str):

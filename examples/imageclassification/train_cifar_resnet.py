@@ -1,0 +1,70 @@
+"""ResNet on CIFAR-10 — runnable image-classification example.
+
+Reference analogue: «bigdl»/models/resnet/TrainCIFAR10.scala (scopt CLI
+in Utils.scala).  With no dataset on disk it trains on a deterministic
+synthetic CIFAR-shaped task so the example always runs end to end.
+
+    python examples/imageclassification/train_cifar_resnet.py \
+        --depth 20 --batch-size 128 --max-epoch 2
+"""
+
+import argparse
+import logging
+
+import numpy as np
+
+
+def synthetic_cifar(n_train=2048, n_val=512, seed=0):
+    """Class-dependent colored blobs — learnable, deterministic."""
+    rs = np.random.RandomState(seed)
+    n = n_train + n_val
+    y = rs.randint(0, 10, n)
+    x = rs.randn(n, 3, 32, 32).astype(np.float32) * 0.3
+    for i in range(n):
+        c = y[i]
+        x[i, c % 3, (c * 3) % 28 : (c * 3) % 28 + 4, :] += 1.5
+        x[i, (c + 1) % 3, :, (c * 2) % 28 : (c * 2) % 28 + 4] -= 1.2
+    labels = (y + 1).astype(np.float32)  # 1-based (ClassNLL convention)
+    return (x[:n_train], labels[:n_train]), (x[n_train:], labels[n_train:])
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--depth", type=int, default=20)
+    ap.add_argument("-b", "--batch-size", type=int, default=128)
+    ap.add_argument("-e", "--max-epoch", type=int, default=2)
+    ap.add_argument("--learning-rate", type=float, default=0.1)
+    ap.add_argument("--checkpoint", default=None)
+    ap.add_argument("--distributed", action="store_true")
+    args = ap.parse_args()
+
+    logging.basicConfig(level=logging.INFO)
+    from bigdl_tpu.models import build_resnet_cifar
+    from bigdl_tpu.nn import CrossEntropyCriterion
+    from bigdl_tpu.optim import Optimizer, SGD, Top1Accuracy, Trigger
+    from bigdl_tpu.optim.optim_method import Poly
+
+    (x, y), (vx, vy) = synthetic_cifar()
+    model = build_resnet_cifar(depth=args.depth, class_num=10)
+    n_iters = args.max_epoch * (len(x) // args.batch_size)
+    optimizer = Optimizer(
+        model=model,
+        training_set=(x, y),
+        criterion=CrossEntropyCriterion(),
+        batch_size=args.batch_size,
+        distributed=args.distributed,
+    )
+    optimizer.set_optim_method(
+        SGD(learningrate=args.learning_rate, momentum=0.9,
+            dampening=0.0, nesterov=True, weightdecay=1e-4,
+            learningrate_schedule=Poly(2.0, n_iters))
+    ).set_end_when(Trigger.max_epoch(args.max_epoch)) \
+        .set_validation(trigger=Trigger.every_epoch(), dataset=(vx, vy),
+                        methods=[Top1Accuracy()])
+    if args.checkpoint:
+        optimizer.set_checkpoint(args.checkpoint)
+    optimizer.optimize()
+
+
+if __name__ == "__main__":
+    main()

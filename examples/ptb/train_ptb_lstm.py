@@ -1,0 +1,50 @@
+"""PTB language model — LSTM with BPTT windows.
+
+Reference analogue: «bigdl»/models/rnn (SimpleRNN/LSTM PTB trainer with
+TimeDistributedCriterion).  Runs on the synthetic Markov token stream
+when no PTB file is given; reports perplexity per epoch.
+
+    python examples/ptb/train_ptb_lstm.py --max-epoch 2 --num-steps 20
+"""
+
+import argparse
+import logging
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data", default=None, help="tokenised PTB text file")
+    ap.add_argument("-b", "--batch-size", type=int, default=20)
+    ap.add_argument("--num-steps", type=int, default=20)
+    ap.add_argument("-e", "--max-epoch", type=int, default=2)
+    ap.add_argument("--learning-rate", type=float, default=0.5)
+    ap.add_argument("--vocab-size", type=int, default=100)
+    args = ap.parse_args()
+
+    logging.basicConfig(level=logging.INFO)
+    from bigdl_tpu.dataset.text import Dictionary, synthetic_ptb_stream
+    from bigdl_tpu.models.rnn import train_ptb
+
+    tokens = None
+    vocab_size = args.vocab_size
+    if args.data:
+        with open(args.data) as f:
+            words = f.read().split()
+        d = Dictionary([words], vocab_size=args.vocab_size)
+        import numpy as np
+
+        tokens = np.asarray([d.get_index(w) for w in words], np.int64)
+        vocab_size = d.vocab_size()
+    model, _opt, ppl = train_ptb(
+        data_tokens=tokens,
+        vocab_size=vocab_size,
+        batch_size=args.batch_size,
+        num_steps=args.num_steps,
+        max_epoch=args.max_epoch,
+        learning_rate=args.learning_rate,
+    )
+    print(f"final perplexity: {ppl:.2f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -180,3 +180,35 @@ def test_const_first_sub_and_div():
     np.testing.assert_allclose(
         np.asarray(model.forward(x)), 1.0 / (1.0 - x), rtol=2e-3
     )
+
+
+def test_negative_concat_axis_and_nchw_graph():
+    rs = np.random.RandomState(8)
+    # NHWC graph with axis=-1 channel concat
+    b = GraphDefBuilder()
+    b.placeholder("img")
+    w = rs.randn(1, 1, 2, 3).astype(np.float32)
+    b.const("w", w)
+    b.op("conv", "Conv2D", ["img", "w"],
+         strides=b.attr_ints([1, 1, 1, 1]), padding=b.attr_s("SAME"),
+         data_format=b.attr_s("NHWC"))
+    b.const("axis", np.asarray(-1, np.int32))
+    b.op("cat", "ConcatV2", ["conv", "conv", "axis"])
+    model = TensorflowLoader(data=b.tobytes()).load(
+        inputs=["img"], outputs=["cat"])
+    x = rs.randn(2, 2, 4, 4).astype(np.float32)
+    assert np.asarray(model.forward(x)).shape == (2, 6, 4, 4)
+
+    # NCHW graph: axes are already framework layout; no remap
+    b2 = GraphDefBuilder()
+    b2.placeholder("img")
+    b2.const("w", w)
+    b2.op("conv", "Conv2D", ["img", "w"],
+          strides=b2.attr_ints([1, 1, 1, 1]), padding=b2.attr_s("SAME"),
+          data_format=b2.attr_s("NCHW"))
+    b2.const("axes", np.asarray([2, 3], np.int32))
+    b2.op("gap", "Mean", ["conv", "axes"])
+    model2 = TensorflowLoader(data=b2.tobytes()).load(
+        inputs=["img"], outputs=["gap"])
+    out = np.asarray(model2.forward(x))
+    assert out.shape == (2, 3)
