@@ -1,0 +1,17 @@
+"""``bigdl`` — drop-in Python-BigDL API shim over bigdl_tpu.
+
+The reference's Python package («py»/bigdl, SURVEY.md §2.2) is a thin
+Py4J bridge: every layer/optimizer name resolves to a JVM object.  Here
+Python *is* the runtime (SURVEY.md §3.4 note), so the shim simply
+re-exports the bigdl_tpu implementations under the classic module paths:
+
+    from bigdl.nn.layer import Sequential, Linear, SpatialConvolution
+    from bigdl.nn.criterion import ClassNLLCriterion
+    from bigdl.optim.optimizer import Optimizer, SGD, MaxEpoch
+    from bigdl.util.common import init_engine, Sample
+
+Existing BigDL user code keeps its imports; only the spark-specific
+plumbing (JavaCreator, gateway bootstrap) becomes a no-op.
+"""
+
+__version__ = "0.1.0+tpu"

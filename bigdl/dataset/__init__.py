@@ -1,0 +1,3 @@
+from bigdl_tpu.dataset import *  # noqa: F401,F403
+from bigdl_tpu.dataset import mnist, text  # noqa: F401
+from bigdl_tpu.dataset.sample import MiniBatch, Sample  # noqa: F401

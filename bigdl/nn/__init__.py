@@ -1,0 +1,1 @@
+from bigdl.nn import criterion, layer  # noqa: F401

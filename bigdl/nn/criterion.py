@@ -1,0 +1,3 @@
+"""«py»/nn/criterion.py shim — criterions under their classic names."""
+
+from bigdl_tpu.nn.criterion import *  # noqa: F401,F403

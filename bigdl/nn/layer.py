@@ -1,0 +1,10 @@
+"""«py»/nn/layer.py shim — every layer under its classic name.
+
+The reference file defines one thin ``JavaValue`` subclass per JVM
+layer; here the real implementations are re-exported.  ``Model`` is the
+graph constructor (functional API), matching Python-BigDL.
+"""
+
+from bigdl_tpu.nn import *  # noqa: F401,F403
+from bigdl_tpu.nn import Graph, Input, Model, Sequential  # noqa: F401
+from bigdl_tpu.nn.module import AbstractModule as Layer  # noqa: F401

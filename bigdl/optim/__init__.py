@@ -1,0 +1,1 @@
+from bigdl.optim import optimizer  # noqa: F401

@@ -1,0 +1,1 @@
+from bigdl.transform import vision  # noqa: F401

@@ -1,0 +1,1 @@
+from bigdl.transform.vision import image  # noqa: F401

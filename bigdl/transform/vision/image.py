@@ -1,0 +1,3 @@
+"""«py»/transform/vision/image.py shim — vision transforms."""
+
+from bigdl_tpu.transform.vision import *  # noqa: F401,F403

@@ -1,0 +1,1 @@
+from bigdl.util import common  # noqa: F401

@@ -1142,9 +1142,13 @@ class BatchNormalization(AbstractModule):
     def apply(self, params, state, input, *, training=False, rng=None):
         jnp = _jnp()
         axes, bshape = self._axes_and_shape(input)
+        # statistics always accumulate in f32: under a bf16 compute
+        # policy the batch-mean/variance reductions would otherwise lose
+        # ~3 decimal digits and drift the running stats
+        xf = input.astype(jnp.float32)
         if training:
-            mean = jnp.mean(input, axis=axes)
-            var = jnp.var(input, axis=axes)  # biased, used for normalization
+            mean = jnp.mean(xf, axis=axes)
+            var = jnp.var(xf, axis=axes)  # biased, used for normalization
             n = 1
             for a in axes:
                 n *= input.shape[a]
@@ -1159,10 +1163,12 @@ class BatchNormalization(AbstractModule):
             mean, var = state["running_mean"], state["running_var"]
             new_state = state
         inv = 1.0 / jnp.sqrt(var + self.eps)
-        y = (input - mean.reshape(bshape)) * inv.reshape(bshape)
+        y = (xf - mean.reshape(bshape)) * inv.reshape(bshape)
         if self.affine:
-            y = y * params["weight"].reshape(bshape) + params["bias"].reshape(bshape)
-        return y, new_state
+            w = params["weight"].astype(jnp.float32)
+            b = params["bias"].astype(jnp.float32)
+            y = y * w.reshape(bshape) + b.reshape(bshape)
+        return y.astype(input.dtype), new_state
 
     def __repr__(self):
         return f"{type(self).__name__}({self.n_output})"

@@ -229,8 +229,20 @@ class DistriOptimizer(LocalOptimizer):
         unravel = self._unravel
 
         def loss_fn(flat_p, mstate, rng, inp, tgt):
+            import jax
+
+            jnp = _jnp()
             p = unravel(flat_p)
-            out, new_mstate = model.apply(p, mstate, inp, training=True, rng=rng)
+            pc, inpc = self._cast_for_compute(p, inp)
+            out, new_mstate = model.apply(pc, mstate, inpc, training=True,
+                                          rng=rng)
+            out = jax.tree.map(
+                lambda a: a.astype(jnp.float32)
+                if hasattr(a, "dtype") and jnp.issubdtype(a.dtype,
+                                                          jnp.floating)
+                else a,
+                out,
+            )
             per_mean = criterion.loss(out, tgt)
             # un-average: total local loss; grads then sum over samples, and
             # the sharded step divides by the global batch afterwards

@@ -90,6 +90,10 @@ class BaseOptimizer:
         self.metrics = Metrics()
         self._clipper = _GradClipper()
         self.max_retry = 5
+        # mixed-precision compute policy: None = full f32; "bfloat16"
+        # runs fwd/bwd in bf16 with f32 master params + f32 grads/update
+        # (the TPU-native recipe: MXU at 2x, normalizations stay f32)
+        self.compute_dtype = None
         # reference: InternalOptimizerUtil state table
         self.state = {"epoch": 1, "neval": 1, "loss": None, "score": None,
                       "epoch_finished": 0}
@@ -138,6 +142,13 @@ class BaseOptimizer:
     def disable_gradient_clipping(self):
         self._clipper.l2_norm_clip = None
         self._clipper.const_clip = None
+        return self
+
+    def set_compute_dtype(self, dtype):
+        """Mixed precision: ``"bfloat16"`` (or a jnp dtype) runs the
+        model fwd/bwd in that dtype while master params, gradients, the
+        loss, and the optimizer update stay f32.  ``None`` disables."""
+        self.compute_dtype = dtype
         return self
 
     # reference spellings
@@ -217,13 +228,43 @@ class LocalOptimizer(BaseOptimizer):
         return jax.tree.map(lambda a: jnp.array(a, copy=True),
                             self.model.params())
 
+    def _cast_for_compute(self, p, inp):
+        """Apply the mixed-precision policy: cast floating params and the
+        input to compute_dtype.  The cast sits inside the differentiated
+        function, so grads w.r.t. the f32 master params come back f32."""
+        if self.compute_dtype is None:
+            return p, inp
+        import jax
+
+        jnp = _jnp()
+        ct = jnp.dtype(self.compute_dtype)
+        cast = lambda a: (
+            a.astype(ct)
+            if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating)
+            else a
+        )
+        return jax.tree.map(cast, p), cast(inp)
+
     def _loss_fn(self):
         """Returns loss_fn: (params, mstate, rng, inp, tgt) ->
         (loss_for_grad, (reported_loss, new_mstate))."""
         model, criterion = self.model, self.criterion
 
         def loss_fn(p, mstate, rng, inp, tgt):
-            out, new_mstate = model.apply(p, mstate, inp, training=True, rng=rng)
+            import jax
+
+            jnp = _jnp()
+            pc, inpc = self._cast_for_compute(p, inp)
+            out, new_mstate = model.apply(pc, mstate, inpc, training=True,
+                                          rng=rng)
+            # the loss always evaluates in f32 (softmax/log numerics)
+            out = jax.tree.map(
+                lambda a: a.astype(jnp.float32)
+                if hasattr(a, "dtype") and jnp.issubdtype(a.dtype,
+                                                          jnp.floating)
+                else a,
+                out,
+            )
             loss = criterion.loss(out, tgt) + model.regularization_loss(p)
             return loss, (loss, new_mstate)
 
@@ -283,6 +324,24 @@ class LocalOptimizer(BaseOptimizer):
         wall_start = time.time()
         records_total = 0
         stop = False
+        from bigdl_tpu.utils.profiler import StepProfiler
+
+        profiler = StepProfiler()
+        try:
+            return self._optimize_loop(
+                model, pvar, mod_state, opt, opt_state, train_step,
+                base_key, wall_start, records_total, stop, profiler,
+            )
+        finally:
+            # an exception mid-epoch must not leak an active trace — the
+            # DistriOptimizer retry path would otherwise hit "profiler
+            # already started" on its next attempt
+            profiler.stop()
+
+    def _optimize_loop(self, model, pvar, mod_state, opt, opt_state,
+                       train_step, base_key, wall_start, records_total,
+                       stop, profiler):
+        import jax
         while not stop:
             epoch = self.state["epoch"]
             epoch_start = time.time()
@@ -291,6 +350,7 @@ class LocalOptimizer(BaseOptimizer):
             from bigdl_tpu.native import PrefetchIterator
 
             for inp, tgt in PrefetchIterator(self.dataset.data(train=True)):
+                profiler.step()
                 t0 = time.perf_counter()
                 rng = jax.random.fold_in(base_key, self.state["neval"])
                 inp_d, tgt_d = self._put_batch(inp, tgt)

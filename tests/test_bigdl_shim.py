@@ -1,0 +1,65 @@
+"""The classic Python-BigDL import surface must work verbatim
+(reference analogue: pyspark/test/bigdl/test_simple_integration.py)."""
+
+import numpy as np
+
+
+def test_classic_imports_and_training():
+    # the canonical Python-BigDL program, unchanged
+    from bigdl.nn.layer import Linear, LogSoftMax, ReLU, Sequential
+    from bigdl.nn.criterion import ClassNLLCriterion
+    from bigdl.optim.optimizer import MaxEpoch, Optimizer, SGD
+    from bigdl.util.common import init_engine
+
+    init_engine()
+    rs = np.random.RandomState(0)
+    x = rs.randn(256, 4).astype(np.float32)
+    y = (1 + (x[:, 0] > 0)).astype(np.float32)
+
+    model = Sequential().add(Linear(4, 16)).add(ReLU()) \
+        .add(Linear(16, 2)).add(LogSoftMax())
+    optimizer = Optimizer(
+        model=model, training_set=(x, y), criterion=ClassNLLCriterion(),
+        optim_method=SGD(learningrate=0.5), end_trigger=MaxEpoch(5),
+        batch_size=64, distributed=False,
+    )
+    trained = optimizer.optimize()
+
+    from bigdl_tpu.optim.evaluator import predict_class
+
+    acc = (predict_class(trained, x) == y.astype(int)).mean()
+    assert acc > 0.95
+
+
+def test_functional_model_spelling():
+    from bigdl.nn.layer import Input, Linear, Model, ReLU
+
+    inp = Input()
+    h = Linear(6, 8)(inp)
+    r = ReLU()(h)
+    out = Linear(8, 2)(r)
+    model = Model(inp, out)
+    x = np.random.RandomState(1).randn(3, 6).astype(np.float32)
+    assert np.asarray(model.forward(x)).shape == (3, 2)
+
+
+def test_jtensor_and_sample():
+    from bigdl.util.common import JTensor, Sample
+
+    a = np.arange(12, dtype=np.float32).reshape(3, 4)
+    jt = JTensor.from_ndarray(a)
+    np.testing.assert_array_equal(jt.to_ndarray(), a)
+    s = Sample.from_ndarray(a, np.asarray([1.0]))
+    np.testing.assert_array_equal(s.feature(), a)
+
+
+def test_trigger_spellings():
+    from bigdl.optim.optimizer import (
+        EveryEpoch, MaxEpoch, MaxIteration, SeveralIteration,
+    )
+
+    assert MaxEpoch(3)({"epoch": 4, "neval": 1, "epoch_finished": 3})
+    assert not MaxEpoch(3)({"epoch": 2, "neval": 1, "epoch_finished": 1})
+    assert MaxIteration(10)({"epoch": 1, "neval": 11, "epoch_finished": 0})
+    assert SeveralIteration(5)({"epoch": 1, "neval": 6, "epoch_finished": 0})
+    EveryEpoch()  # constructible

@@ -99,3 +99,32 @@ def test_checkpoint_and_resume(tmp_path):
     np.testing.assert_allclose(model2.get_weights()[0], model.get_weights()[0])
     assert optim2.state is not None
     assert "epoch" in extra
+
+
+def test_mixed_precision_bf16_converges():
+    """compute_dtype='bfloat16' trains to the same quality: bf16 fwd/bwd
+    with f32 master params (the TPU-native mixed-precision recipe)."""
+    import numpy as np
+    import jax.numpy as jnp
+    from bigdl_tpu.nn import ClassNLLCriterion, Linear, LogSoftMax, ReLU, \
+        Sequential
+    from bigdl_tpu.optim import SGD, Trigger
+    from bigdl_tpu.optim.optimizer import LocalOptimizer
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(512, 10).astype(np.float32)
+    y = (1 + (x[:, :5].sum(1) > x[:, 5:].sum(1))).astype(np.float32)
+    model = Sequential().add(Linear(10, 32)).add(ReLU()) \
+        .add(Linear(32, 2)).add(LogSoftMax())
+    opt = LocalOptimizer(model, (x, y), ClassNLLCriterion(), batch_size=64)
+    opt.set_optim_method(SGD(learningrate=0.5)) \
+        .set_end_when(Trigger.max_epoch(8)) \
+        .set_compute_dtype("bfloat16")
+    trained = opt.optimize()
+    # master params must still be f32
+    import jax
+    for leaf in jax.tree.leaves(trained.params()):
+        assert leaf.dtype == jnp.float32
+    from bigdl_tpu.optim.evaluator import predict_class
+    acc = (predict_class(trained, x) == y.astype(int)).mean()
+    assert acc > 0.95, acc

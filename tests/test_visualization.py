@@ -57,3 +57,29 @@ def test_optimizer_writes_summaries(tmp_path):
     # event file exists where TensorBoard expects it
     files = os.listdir(os.path.join(str(tmp_path), "job", "train"))
     assert any("tfevents" in f for f in files)
+
+
+def test_step_profiler_writes_trace(tmp_path, monkeypatch):
+    """BIGDL_PROFILE traces optimizer steps into a TensorBoard-readable
+    directory (SURVEY §5 tracing parity)."""
+    import numpy as np
+
+    monkeypatch.setenv("BIGDL_PROFILE", str(tmp_path))
+    from bigdl_tpu.nn import ClassNLLCriterion, Linear, LogSoftMax, Sequential
+    from bigdl_tpu.optim import SGD, Trigger
+    from bigdl_tpu.optim.optimizer import LocalOptimizer
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(128, 4).astype(np.float32)
+    y = (1 + (x[:, 0] > 0)).astype(np.float32)
+    model = Sequential().add(Linear(4, 2)).add(LogSoftMax())
+    opt = LocalOptimizer(model, (x, y), ClassNLLCriterion(), batch_size=32)
+    opt.set_optim_method(SGD(learningrate=0.1))
+    opt.set_end_when(Trigger.max_epoch(2))
+    opt.optimize()
+    import os
+
+    found = []
+    for root, _dirs, files in os.walk(tmp_path):
+        found.extend(files)
+    assert found, "no profiler trace files written"
