@@ -84,7 +84,32 @@ from bigdl_tpu.nn.recurrent import (
     BiRecurrent,
     TimeDistributed,
     Select,
+    MultiRNNCell,
+    ConvLSTMPeephole,
 )
+from bigdl_tpu.nn.table_ops import (
+    CAveTable,
+    SplitTable,
+    BifurcateSplitTable,
+    NarrowTable,
+    Pack,
+    MixtureTable,
+    MapTable,
+    Bottle,
+)
+from bigdl_tpu.nn.criterion import (
+    CosineDistanceCriterion,
+    DiceCoefficientCriterion,
+    SoftMarginCriterion,
+    MultiLabelMarginCriterion,
+    GaussianCriterion,
+    KLDCriterion,
+    L1HingeEmbeddingCriterion,
+)
+from bigdl_tpu.nn.volumetric import *  # noqa: F401,F403
+from bigdl_tpu.nn.volumetric import __all__ as _volumetric_all
+from bigdl_tpu.nn.layers_extra import *  # noqa: F401,F403
+from bigdl_tpu.nn.layers_extra import __all__ as _extra_all
 
 __all__ = (
     [
@@ -93,6 +118,8 @@ __all__ = (
         "ConcatTable", "ParallelTable", "CAddTable", "CSubTable", "CMulTable",
         "CDivTable", "CMaxTable", "CMinTable", "JoinTable", "SelectTable",
         "FlattenTable", "MM", "MV", "CosineDistance", "DotProduct", "Concat",
+        "CAveTable", "SplitTable", "BifurcateSplitTable", "NarrowTable",
+        "Pack", "MixtureTable", "MapTable", "Bottle",
         "AbstractCriterion", "ClassNLLCriterion", "CrossEntropyCriterion",
         "MSECriterion", "AbsCriterion", "SmoothL1Criterion", "BCECriterion",
         "BCECriterionWithLogits", "MultiLabelSoftMarginCriterion",
@@ -101,10 +128,15 @@ __all__ = (
         "ParallelCriterion", "TimeDistributedCriterion",
         "ClassSimplexCriterion", "L1Cost", "MarginRankingCriterion",
         "MultiMarginCriterion",
+        "CosineDistanceCriterion", "DiceCoefficientCriterion",
+        "SoftMarginCriterion", "MultiLabelMarginCriterion",
+        "GaussianCriterion", "KLDCriterion", "L1HingeEmbeddingCriterion",
         "Recurrent", "RnnCell", "LSTM", "LSTMPeephole", "GRU", "BiRecurrent",
-        "TimeDistributed", "Select",
+        "TimeDistributed", "Select", "MultiRNNCell", "ConvLSTMPeephole",
         "LayerNorm", "MultiHeadAttention", "TransformerBlock",
         "PositionalEmbedding",
     ]
     + list(_layers_all)
+    + list(_volumetric_all)
+    + list(_extra_all)
 )

@@ -14,6 +14,7 @@ Reference conventions preserved:
 
 from __future__ import annotations
 
+import math
 from typing import Optional
 
 import numpy as np
@@ -448,3 +449,134 @@ class MultiMarginCriterion(AbstractCriterion):
         mask = jnp.ones_like(v).at[jnp.arange(v.shape[0]), t].set(0.0)
         per_sample = jnp.sum(v * mask, axis=1) / x.shape[-1]
         return _reduce(per_sample, self.size_average)
+
+
+class CosineDistanceCriterion(AbstractCriterion):
+    """⟦«bigdl»/nn/CosineDistanceCriterion.scala⟧ — loss = 1 − cos(x, y)
+    per row."""
+
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def loss(self, input, target):
+        jnp = _jnp()
+        cos = jnp.sum(input * target, axis=-1) / jnp.maximum(
+            jnp.linalg.norm(input, axis=-1)
+            * jnp.linalg.norm(target, axis=-1),
+            1e-12,
+        )
+        return _reduce(1.0 - cos, self.size_average)
+
+
+class DiceCoefficientCriterion(AbstractCriterion):
+    """⟦«bigdl»/nn/DiceCoefficientCriterion.scala⟧ — 1 − Dice overlap,
+    the segmentation loss: 1 − 2·Σxy / (Σx + Σy + ε)."""
+
+    def __init__(self, size_average: bool = True, epsilon: float = 1.0):
+        super().__init__()
+        self.size_average = size_average
+        self.epsilon = epsilon
+
+    def loss(self, input, target):
+        jnp = _jnp()
+        x = input.reshape(input.shape[0], -1)
+        y = target.reshape(input.shape[0], -1).astype(x.dtype)
+        inter = jnp.sum(x * y, axis=1)
+        denom = jnp.sum(x, axis=1) + jnp.sum(y, axis=1) + self.epsilon
+        return _reduce(1.0 - 2.0 * inter / denom, self.size_average)
+
+
+class SoftMarginCriterion(AbstractCriterion):
+    """⟦«bigdl»/nn/SoftMarginCriterion.scala⟧ — mean log(1 + exp(−y·x))
+    over all elements (targets ±1)."""
+
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def loss(self, input, target):
+        import jax
+
+        v = jax.nn.softplus(-input * target)
+        return _reduce(v, self.size_average)
+
+
+class MultiLabelMarginCriterion(AbstractCriterion):
+    """⟦«bigdl»/nn/MultiLabelMarginCriterion.scala⟧ — multi-label
+    multi-class hinge: targets per row are **1-based** class indices,
+    0-padded.  loss_row = Σ_{j∉T} Σ_{i∈T} max(0, 1 − (x[t_i] − x[j]))
+    / C."""
+
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def loss(self, input, target):
+        jnp = _jnp()
+        x = input if input.ndim == 2 else input[None]
+        t = target if target.ndim == 2 else target[None]
+        t = t.astype(jnp.int32)
+        n, c = x.shape
+        # torch/BigDL contract: the target list ENDS at the first 0 —
+        # entries after it are ignored even if nonzero
+        valid = jnp.cumprod(t > 0, axis=1).astype(bool)
+        idx = jnp.clip(t - 1, 0, c - 1)
+        # member[n, j] = 1 when class j is one of row n's targets
+        member = jnp.zeros((n, c), x.dtype)
+        member = member.at[jnp.arange(n)[:, None], idx].max(
+            valid.astype(x.dtype)
+        )
+        picked = jnp.take_along_axis(x, idx, axis=1)      # x[t_i]
+        # margins[n, i, j] = 1 - (x[t_i] - x[j])
+        margins = 1.0 - picked[:, :, None] + x[:, None, :]
+        hinge = jnp.maximum(0.0, margins)
+        mask = valid[:, :, None].astype(x.dtype) \
+            * (1.0 - member)[:, None, :]
+        per_row = jnp.sum(hinge * mask, axis=(1, 2)) / c
+        return _reduce(per_row, self.size_average)
+
+
+class GaussianCriterion(AbstractCriterion):
+    """⟦«bigdl»/nn/GaussianCriterion.scala⟧ — negative log-likelihood of
+    target under N(mean, exp(log_var)); input is the (mean, log_var)
+    table (VAE reconstruction term)."""
+
+    def loss(self, input, target):
+        jnp = _jnp()
+        mean, log_var = input
+        nll = 0.5 * (
+            math.log(2 * math.pi) + log_var
+            + (target - mean) ** 2 / jnp.exp(log_var)
+        )
+        return jnp.sum(nll)
+
+
+class KLDCriterion(AbstractCriterion):
+    """⟦«bigdl»/nn/KLDCriterion.scala⟧ — KL(N(mean, exp(log_var)) ‖
+    N(0, 1)) summed; input is the (mean, log_var) table, target unused
+    (VAE regulariser, pairs with GaussianSampler)."""
+
+    def loss(self, input, target):
+        jnp = _jnp()
+        mean, log_var = input
+        kl = -0.5 * (1.0 + log_var - mean ** 2 - jnp.exp(log_var))
+        return jnp.sum(kl)
+
+
+class L1HingeEmbeddingCriterion(AbstractCriterion):
+    """⟦«bigdl»/nn/L1HingeEmbeddingCriterion.scala⟧ — table (x1, x2),
+    target ±1: d = ‖x1−x2‖₁; loss = d when y=1, max(0, margin−d) when
+    y=−1."""
+
+    def __init__(self, margin: float = 1.0):
+        super().__init__()
+        self.margin = margin
+
+    def loss(self, input, target):
+        jnp = _jnp()
+        x1, x2 = input
+        d = jnp.sum(jnp.abs(x1 - x2), axis=-1)
+        t = target.reshape(d.shape)
+        v = jnp.where(t > 0, d, jnp.maximum(0.0, self.margin - d))
+        return jnp.mean(v)

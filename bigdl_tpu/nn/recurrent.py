@@ -75,15 +75,33 @@ class Cell(AbstractModule):
     def step(self, params, carry, proj_t):
         raise NotImplementedError
 
-    def init_carry(self, batch: int, dtype):
+    def init_carry(self, batch: int, dtype, input_shape=None):
         raise NotImplementedError
+
+    def run_sequence(self, params, x, *, training=False, rng=None):
+        """(B, T, ...) -> (B, T, ...): hoisted precompute + lax.scan over
+        step.  Recurrent delegates here; composite cells (MultiRNNCell)
+        override to thread rng/dropout into every sub-cell."""
+        import jax.lax as lax
+
+        jnp = _jnp()
+        proj = self.precompute(params, x, training=training, rng=rng)
+        proj_t = jnp.swapaxes(proj, 0, 1)               # time-major for scan
+        carry0 = self.init_carry(x.shape[0], x.dtype, input_shape=x.shape)
+
+        def body(carry, p_t):
+            return self.step(params, carry, p_t)
+
+        _, ys = lax.scan(body, carry0, proj_t)
+        return jnp.swapaxes(ys, 0, 1)
 
     # a bare cell can also be applied to a single timestep; the common
     # path is through Recurrent, so apply() runs one step.
     def update_output_pure(self, params, input, *, training=False, rng=None):
         proj = self.precompute(params, input[:, None, :], training=training,
                                rng=rng)[:, 0]
-        carry = self.init_carry(input.shape[0], input.dtype)
+        carry = self.init_carry(input.shape[0], input.dtype,
+                                input_shape=input[:, None].shape)
         _, out = self.step(params, carry, proj)
         return out
 
@@ -127,7 +145,7 @@ class RnnCell(Cell):
     def precompute(self, params, x, *, training=False, rng=None):
         return x @ params["w"] + params["b"]
 
-    def init_carry(self, batch, dtype):
+    def init_carry(self, batch, dtype, input_shape=None):
         jnp = _jnp()
         return jnp.zeros((batch, self.hidden_size), dtype=dtype)
 
@@ -184,7 +202,7 @@ class LSTM(Cell):
         return _gated_projection(x, params["w"], params["b"], self.n_gates,
                                  self.hidden_size, dropped)
 
-    def init_carry(self, batch, dtype):
+    def init_carry(self, batch, dtype, input_shape=None):
         jnp = _jnp()
         z = jnp.zeros((batch, self.hidden_size), dtype=dtype)
         return (z, z)
@@ -236,7 +254,7 @@ class LSTMPeephole(Cell):
         return _gated_projection(x, params["w"], params["b"], self.n_gates,
                                  self.hidden_size, dropped)
 
-    def init_carry(self, batch, dtype):
+    def init_carry(self, batch, dtype, input_shape=None):
         jnp = _jnp()
         z = jnp.zeros((batch, self.hidden_size), dtype=dtype)
         return (z, z)
@@ -293,7 +311,7 @@ class GRU(Cell):
             hcand = dropped[2] @ params["w_h"] + params["b_h"]
         return jnp.concatenate([rz, hcand], axis=-1)
 
-    def init_carry(self, batch, dtype):
+    def init_carry(self, batch, dtype, input_shape=None):
         jnp = _jnp()
         return jnp.zeros((batch, self.hidden_size), dtype=dtype)
 
@@ -334,20 +352,10 @@ class Recurrent(Container):
         return self.modules[0]
 
     def apply(self, params, state, input, *, training=False, rng=None):
-        import jax.lax as lax
-
-        jnp = _jnp()
-        cell = self.cell
-        cparams = params["0"]
-        proj = cell.precompute(cparams, input, training=training, rng=rng)
-        proj_t = jnp.swapaxes(proj, 0, 1)               # time-major for scan
-        carry0 = cell.init_carry(input.shape[0], input.dtype)
-
-        def body(carry, p_t):
-            return cell.step(cparams, carry, p_t)
-
-        _, ys = lax.scan(body, carry0, proj_t)
-        return jnp.swapaxes(ys, 0, 1), state
+        out = self.cell.run_sequence(
+            params["0"], input, training=training, rng=rng
+        )
+        return out, state
 
     def __repr__(self):
         return f"Recurrent({self.modules[0]!r})" if self.modules else "Recurrent()"
@@ -429,3 +437,186 @@ class Select(AbstractModule):
         d = self.dim - 1 if self.dim > 0 else input.ndim + self.dim
         i = self.index - 1 if self.index > 0 else input.shape[d] + self.index
         return _jnp().take(input, i, axis=d)
+
+
+class MultiRNNCell(Cell, Container):
+    """⟦«bigdl»/nn/MultiRNNCell.scala⟧ — a vertical stack of Cells run as
+    one Cell: the output of cell *k* feeds cell *k+1* at the same
+    timestep.  There is no feedback from upper to lower cells, so the
+    stack factorizes into one scan per cell run in sequence — which lets
+    every cell hoist its full input projection (incl. per-gate input
+    dropout with its own rng) out of its scan; ``run_sequence`` does
+    exactly that.  A Container so serialization recurses into the cells
+    (params/state keyed by position, like Sequential)."""
+
+    def __init__(self, cells=None):
+        super().__init__()
+        self.modules = []
+        for c in (cells or []):
+            self.add(c)
+
+    def add(self, cell):
+        if not isinstance(cell, Cell):
+            raise TypeError("MultiRNNCell takes recurrent Cells")
+        return Container.add(self, cell)
+
+    @property
+    def cells(self):
+        return self.modules
+
+    @property
+    def hidden_size(self):
+        return self.modules[-1].hidden_size if self.modules else 0
+
+    def run_sequence(self, params, x, *, training=False, rng=None):
+        import jax
+
+        y = x
+        for i, c in enumerate(self.cells):
+            r = None if rng is None else jax.random.fold_in(rng, i)
+            y = c.run_sequence(params[str(i)], y, training=training, rng=r)
+        return y
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        # single-timestep application: chain the cells' single-step paths
+        import jax
+
+        y = input
+        for i, c in enumerate(self.cells):
+            r = None if rng is None else jax.random.fold_in(rng, i)
+            y = c.update_output_pure(params[str(i)], y, training=training,
+                                     rng=r)
+        return y
+
+    def init_carry(self, batch, dtype, input_shape=None):
+        return tuple(
+            c.init_carry(batch, dtype, input_shape=input_shape)
+            for c in self.cells
+        )
+
+    def precompute(self, params, x, *, training=False, rng=None):
+        raise NotImplementedError(
+            "MultiRNNCell runs whole sub-cell scans (run_sequence); it has "
+            "no single hoisted projection"
+        )
+
+    def step(self, params, carry, proj_t):
+        raise NotImplementedError(
+            "MultiRNNCell runs whole sub-cell scans (run_sequence)"
+        )
+
+    def __repr__(self):
+        return f"MultiRNNCell({self.cells!r})"
+
+
+class ConvLSTMPeephole(Cell):
+    """⟦«bigdl»/nn/ConvLSTMPeephole.scala⟧ — 2-D convolutional LSTM with
+    optional per-channel peephole connections.
+
+    Input per step is (B, C_in, H, W); the hoisted input projection is a
+    single conv over the folded (B*T) batch (one big MXU contraction),
+    the scan body carries only the recurrent conv.  ``stride`` must be 1
+    (the recurrent state must keep its spatial shape), matching the
+    reference's practical use.
+    """
+
+    param_names = ("w_i", "w_h", "b", "p_i", "p_f", "p_o")
+    n_gates = 4
+
+    def __init__(
+        self,
+        input_size: int,
+        output_size: int,
+        kernel_i: int = 3,
+        kernel_c: int = 3,
+        stride: int = 1,
+        with_peephole: bool = True,
+    ):
+        super().__init__()
+        if stride != 1:
+            raise ValueError("ConvLSTMPeephole supports stride=1 only")
+        self._config = dict(
+            input_size=input_size, output_size=output_size,
+            kernel_i=kernel_i, kernel_c=kernel_c, stride=stride,
+            with_peephole=with_peephole,
+        )
+        self.input_size, self.output_size = input_size, output_size
+        self.kernel_i, self.kernel_c = kernel_i, kernel_c
+        self.with_peephole = with_peephole
+        self.hidden_size = output_size
+        self.reset()
+
+    def reset(self):
+        k_i, k_c = self.kernel_i, self.kernel_c
+        fan = self.input_size * k_i * k_i
+        stdv = 1.0 / math.sqrt(max(1, fan))
+        self.w_i = _uniform(
+            (4 * self.output_size, self.input_size, k_i, k_i), stdv
+        )
+        stdv_h = 1.0 / math.sqrt(max(1, self.output_size * k_c * k_c))
+        self.w_h = _uniform(
+            (4 * self.output_size, self.output_size, k_c, k_c), stdv_h
+        )
+        self.b = _to_device(np.zeros(4 * self.output_size, dtype=np.float32))
+        if self.with_peephole:
+            self.p_i = _uniform((self.output_size,), stdv)
+            self.p_f = _uniform((self.output_size,), stdv)
+            self.p_o = _uniform((self.output_size,), stdv)
+        else:
+            self.p_i = self.p_f = self.p_o = None
+        return self
+
+    def _conv(self, x, w, dtype):
+        import jax.lax as lax
+
+        return lax.conv_general_dilated(
+            x,
+            w.astype(dtype),
+            window_strides=(1, 1),
+            padding="SAME",
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        )
+
+    def precompute(self, params, x, *, training=False, rng=None):
+        # x: (B, T, C, H, W) -> fold time into batch for one big conv
+        b, t = x.shape[0], x.shape[1]
+        merged = x.reshape((b * t,) + x.shape[2:])
+        proj = self._conv(merged, params["w_i"], x.dtype)
+        proj = proj + params["b"].astype(x.dtype).reshape(1, -1, 1, 1)
+        return proj.reshape((b, t) + proj.shape[1:])
+
+    def init_carry(self, batch, dtype, input_shape=None):
+        jnp = _jnp()
+        if input_shape is None:
+            raise ValueError("ConvLSTMPeephole needs the input shape")
+        h, w = input_shape[-2], input_shape[-1]
+        z = jnp.zeros((batch, self.output_size, h, w), dtype=dtype)
+        return (z, z)
+
+    def step(self, params, carry, proj_t):
+        jnp = _jnp()
+        h, c = carry
+        gates = proj_t + self._conv(h, params["w_h"], h.dtype)
+        i, f, g, o = jnp.split(gates, 4, axis=1)
+        if self.with_peephole:
+            pk = lambda k: params[k].astype(c.dtype).reshape(1, -1, 1, 1)
+            i = i + pk("p_i") * c
+            f = f + pk("p_f") * c
+        i = jax_sigmoid(i)
+        f = jax_sigmoid(f)
+        g = jnp.tanh(g)
+        c_new = f * c + i * g
+        if self.with_peephole:
+            o = o + params["p_o"].astype(c.dtype).reshape(1, -1, 1, 1) * c_new
+        o = jax_sigmoid(o)
+        h_new = o * jnp.tanh(c_new)
+        return (h_new, c_new), h_new
+
+    def __repr__(self):
+        return f"ConvLSTMPeephole({self.input_size}, {self.output_size})"
+
+
+def jax_sigmoid(x):
+    import jax
+
+    return jax.nn.sigmoid(x)

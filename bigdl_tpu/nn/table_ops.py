@@ -245,3 +245,164 @@ class Concat(Container):
     def __repr__(self):
         body = " | ".join(repr(m) for m in self.modules)
         return f"Concat(dim={self.dimension}: {body})"
+
+
+class CAveTable(_TableReduce):
+    """⟦«bigdl»/nn/CAveTable.scala⟧ — elementwise average of the table."""
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        total = input[0]
+        for x in input[1:]:
+            total = total + x
+        return total / len(input)
+
+
+class SplitTable(_TableReduce):
+    """⟦«bigdl»/nn/SplitTable.scala⟧ — split a tensor along 1-based
+    ``dimension`` into a table of slices (``n_input_dims`` enables the
+    reference's unbatched-input promotion)."""
+
+    def __init__(self, dimension: int, n_input_dims: int = -1):
+        super().__init__(dimension=dimension, n_input_dims=n_input_dims)
+        self.dimension = dimension
+        self.n_input_dims = n_input_dims
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        if self.dimension > 0:
+            d = self.dimension - 1
+            # batch promotion shifts positive (1-based, unbatched) dims
+            # only; negative dims already count from the end
+            if self.n_input_dims > 0 and input.ndim > self.n_input_dims:
+                d += 1
+        else:
+            d = input.ndim + self.dimension
+        jnp = _jnp()
+        return tuple(
+            jnp.squeeze(s, axis=d)
+            for s in jnp.split(input, input.shape[d], axis=d)
+        )
+
+
+class BifurcateSplitTable(_TableReduce):
+    """⟦«bigdl»/nn/BifurcateSplitTable.scala⟧ — halve a tensor along
+    1-based ``dimension`` into a 2-entry table."""
+
+    def __init__(self, dimension: int):
+        super().__init__(dimension=dimension)
+        self.dimension = dimension
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        jnp = _jnp()
+        d = self.dimension - 1
+        left, right = jnp.split(input, 2, axis=d)
+        return (left, right)
+
+
+class NarrowTable(_TableReduce):
+    """⟦«bigdl»/nn/NarrowTable.scala⟧ — table slice: ``length`` entries
+    from 1-based ``offset`` (length −1 = through the end)."""
+
+    def __init__(self, offset: int, length: int = 1):
+        super().__init__(offset=offset, length=length)
+        self.offset, self.length = offset, length
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        start = self.offset - 1
+        if self.length == -1:
+            return tuple(input[start:])
+        return tuple(input[start:start + self.length])
+
+
+class Pack(_TableReduce):
+    """⟦«bigdl»/nn/Pack.scala⟧ — stack the table's tensors along a new
+    1-based ``dim``."""
+
+    def __init__(self, dim: int = 1):
+        super().__init__(dim=dim)
+        self.dim = dim
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        xs = input if isinstance(input, (tuple, list)) else (input,)
+        return _jnp().stack(list(xs), axis=self.dim - 1)
+
+
+class MixtureTable(_TableReduce):
+    """⟦«bigdl»/nn/MixtureTable.scala⟧ — mixture-of-experts blend:
+    input is (gater (B, K), experts), experts either a table of K
+    (B, ...) tensors or one (B, K, ...) tensor; output is the
+    gater-weighted sum of experts."""
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        jnp = _jnp()
+        gater, experts = input
+        if isinstance(experts, (tuple, list)):
+            experts = jnp.stack(list(experts), axis=1)   # (B, K, ...)
+        g = gater.reshape(gater.shape + (1,) * (experts.ndim - 2))
+        return jnp.sum(g * experts, axis=1)
+
+
+class MapTable(Container):
+    """⟦«bigdl»/nn/MapTable.scala⟧ — apply ONE shared child module to
+    every entry of the input table (weights shared across entries, like
+    the reference's clone-with-shared-parameters)."""
+
+    def __init__(self, module: AbstractModule = None):
+        super().__init__()
+        if module is not None:
+            self.add(module)
+
+    def add(self, module: AbstractModule):
+        if len(self.modules) > 0:
+            raise ValueError("MapTable takes exactly one module")
+        return super().add(module)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        import jax
+
+        m = self.modules[0]
+        outs = []
+        s = state["0"]
+        for i, x in enumerate(input):
+            r = None if rng is None else jax.random.fold_in(rng, i)
+            y, s = m.apply(params["0"], s, x, training=training, rng=r)
+            outs.append(y)
+        return tuple(outs), {"0": s}
+
+
+class Bottle(Container):
+    """⟦«bigdl»/nn/Bottle.scala⟧ — fold the leading ``n_input_dim``
+    dims into one batch dim, apply the child, unfold.  The reference's
+    trick for running a 2-D layer over N-D input."""
+
+    def __init__(self, module: AbstractModule = None, n_input_dim: int = 2,
+                 n_output_dim: int = 2):
+        super().__init__()
+        self._config = dict(n_input_dim=n_input_dim,
+                            n_output_dim=n_output_dim)
+        self.n_input_dim = n_input_dim
+        self.n_output_dim = n_output_dim
+        if module is not None:
+            self.add(module)
+
+    def add(self, module: AbstractModule):
+        if len(self.modules) > 0:
+            raise ValueError("Bottle takes exactly one module")
+        return super().add(module)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        lead = input.shape[: input.ndim - self.n_input_dim + 1]
+        n = 1
+        for s in lead:
+            n *= s
+        merged = input.reshape((n,) + input.shape[input.ndim
+                                                  - self.n_input_dim + 1:])
+        y, s = self.modules[0].apply(
+            params["0"], state["0"], merged, training=training, rng=rng
+        )
+        if y.ndim != self.n_output_dim:
+            raise ValueError(
+                f"Bottle: child produced a rank-{y.ndim} output but "
+                f"n_output_dim={self.n_output_dim}"
+            )
+        out = y.reshape(lead + y.shape[1:])
+        return out, {"0": s}

@@ -1,0 +1,400 @@
+"""Volumetric (3-D) layer family.
+
+Rebuild of the reference's 3-D modules (SURVEY.md §2.1 "Layer library",
+⟦«bigdl»/nn/VolumetricConvolution.scala⟧, ⟦VolumetricFullConvolution.scala⟧,
+⟦VolumetricMaxPooling.scala⟧, ⟦VolumetricAveragePooling.scala⟧,
+⟦UpSampling3D.scala⟧, ⟦Cropping3D.scala⟧).  Input layout is NCDHW
+(batch, plane, time/depth, height, width), matching the reference's
+time-first convention; the reference's width-first argument order
+(kT, kW, kH, dT, dW, dH, padT, padW, padH) is kept.
+
+TPU notes: 3-D convs lower to one ``lax.conv_general_dilated`` with a
+3-long spatial spec — XLA tiles the contraction onto the MXU the same way
+it does 2-D convs; pooling is ``lax.reduce_window`` over three window
+dims.  No im2col / MKL path to port (SURVEY.md §2.3).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from bigdl_tpu.nn.layers import (
+    BatchNormalization,
+    InitializationMethod,
+    MsraFiller,
+    _auto_batch,
+    _pool_pad,
+    _to_device,
+)
+from bigdl_tpu.nn.module import AbstractModule
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _lax():
+    import jax.lax as lax
+
+    return lax
+
+
+_DNUMS = ("NCDHW", "OIDHW", "NCDHW")  # lax conv dimension_numbers for 3-D
+
+
+class VolumetricConvolution(AbstractModule):
+    """⟦«bigdl»/nn/VolumetricConvolution.scala⟧ — 3-D conv over NCDHW.
+
+    Reference arg order (nInputPlane, nOutputPlane, kT, kW, kH, dT, dW,
+    dH, padT, padW, padH) is kept; weight is laid out OIDHW so the kernel
+    maps straight onto ``lax.conv_general_dilated``.
+    """
+
+    param_names = ("weight", "bias")
+
+    def __init__(
+        self,
+        n_input_plane: int,
+        n_output_plane: int,
+        k_t: int,
+        k_w: int,
+        k_h: int,
+        d_t: int = 1,
+        d_w: int = 1,
+        d_h: int = 1,
+        pad_t: int = 0,
+        pad_w: int = 0,
+        pad_h: int = 0,
+        with_bias: bool = True,
+        init_method: Optional[InitializationMethod] = None,
+    ):
+        super().__init__()
+        self._config = dict(
+            n_input_plane=n_input_plane, n_output_plane=n_output_plane,
+            k_t=k_t, k_w=k_w, k_h=k_h, d_t=d_t, d_w=d_w, d_h=d_h,
+            pad_t=pad_t, pad_w=pad_w, pad_h=pad_h, with_bias=with_bias,
+        )
+        self.n_input_plane = n_input_plane
+        self.n_output_plane = n_output_plane
+        self.k_t, self.k_w, self.k_h = k_t, k_w, k_h
+        self.d_t, self.d_w, self.d_h = d_t, d_w, d_h
+        self.pad_t, self.pad_w, self.pad_h = pad_t, pad_w, pad_h
+        self.with_bias = with_bias
+        self._init_method = init_method or MsraFiller(False)
+        self.weight = None
+        self.bias = None
+        self.reset()
+
+    def reset(self):
+        k_vol = self.k_t * self.k_h * self.k_w
+        fan_in = self.n_input_plane * k_vol
+        fan_out = self.n_output_plane * k_vol
+        w = self._init_method.init(
+            (self.n_output_plane, self.n_input_plane,
+             self.k_t, self.k_h, self.k_w),
+            fan_in,
+            fan_out,
+        )
+        self.weight = _to_device(w)
+        if self.with_bias:
+            self.bias = _to_device(
+                np.zeros(self.n_output_plane, dtype=np.float32)
+            )
+        return self
+
+    def _pads(self):
+        if -1 in (self.pad_t, self.pad_h, self.pad_w):
+            return "SAME"
+        return [
+            (self.pad_t, self.pad_t),
+            (self.pad_h, self.pad_h),
+            (self.pad_w, self.pad_w),
+        ]
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        lax = _lax()
+        x, squeezed = _auto_batch(input, 5)
+        y = lax.conv_general_dilated(
+            x,
+            params["weight"].astype(x.dtype),
+            window_strides=(self.d_t, self.d_h, self.d_w),
+            padding=self._pads(),
+            dimension_numbers=_DNUMS,
+        )
+        if self.with_bias:
+            y = y + params["bias"].astype(y.dtype).reshape(1, -1, 1, 1, 1)
+        return y[0] if squeezed else y
+
+    def __repr__(self):
+        return (
+            f"VolumetricConvolution({self.n_input_plane}->"
+            f"{self.n_output_plane}, {self.k_t}x{self.k_h}x{self.k_w})"
+        )
+
+
+class VolumetricFullConvolution(VolumetricConvolution):
+    """⟦«bigdl»/nn/VolumetricFullConvolution.scala⟧ — transposed 3-D conv
+    (the gradient of VolumetricConvolution w.r.t. its input), plus the
+    reference's ``adjT/adjW/adjH`` extra output padding."""
+
+    def __init__(
+        self,
+        n_input_plane: int,
+        n_output_plane: int,
+        k_t: int,
+        k_w: int,
+        k_h: int,
+        d_t: int = 1,
+        d_w: int = 1,
+        d_h: int = 1,
+        pad_t: int = 0,
+        pad_w: int = 0,
+        pad_h: int = 0,
+        adj_t: int = 0,
+        adj_w: int = 0,
+        adj_h: int = 0,
+        with_bias: bool = True,
+        init_method: Optional[InitializationMethod] = None,
+    ):
+        super().__init__(
+            n_input_plane, n_output_plane, k_t, k_w, k_h, d_t, d_w, d_h,
+            pad_t, pad_w, pad_h, with_bias, init_method,
+        )
+        self.adj_t, self.adj_w, self.adj_h = adj_t, adj_w, adj_h
+        self._config.update(adj_t=adj_t, adj_w=adj_w, adj_h=adj_h)
+
+    def reset(self):
+        # transposed conv weight: (in, out, kT, kH, kW) — IODHW
+        k_vol = self.k_t * self.k_h * self.k_w
+        fan_in = self.n_input_plane * k_vol
+        fan_out = self.n_output_plane * k_vol
+        w = self._init_method.init(
+            (self.n_input_plane, self.n_output_plane,
+             self.k_t, self.k_h, self.k_w),
+            fan_in,
+            fan_out,
+        )
+        self.weight = _to_device(w)
+        if self.with_bias:
+            self.bias = _to_device(
+                np.zeros(self.n_output_plane, dtype=np.float32)
+            )
+        return self
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        lax = _lax()
+        x, squeezed = _auto_batch(input, 5)
+        # lhs-dilated conv == transposed conv; padding k-1-p (+adj on hi)
+        pads = [
+            (self.k_t - 1 - self.pad_t, self.k_t - 1 - self.pad_t + self.adj_t),
+            (self.k_h - 1 - self.pad_h, self.k_h - 1 - self.pad_h + self.adj_h),
+            (self.k_w - 1 - self.pad_w, self.k_w - 1 - self.pad_w + self.adj_w),
+        ]
+        jnp = _jnp()
+        w = params["weight"].astype(x.dtype)
+        # IODHW -> OIDHW with spatially flipped kernel
+        w = jnp.flip(w.transpose(1, 0, 2, 3, 4), axis=(2, 3, 4))
+        y = lax.conv_general_dilated(
+            x,
+            w,
+            window_strides=(1, 1, 1),
+            padding=pads,
+            lhs_dilation=(self.d_t, self.d_h, self.d_w),
+            dimension_numbers=_DNUMS,
+        )
+        if self.with_bias:
+            y = y + params["bias"].astype(y.dtype).reshape(1, -1, 1, 1, 1)
+        return y[0] if squeezed else y
+
+    def __repr__(self):
+        return (
+            f"VolumetricFullConvolution({self.n_input_plane}->"
+            f"{self.n_output_plane}, {self.k_t}x{self.k_h}x{self.k_w})"
+        )
+
+
+class VolumetricMaxPooling(AbstractModule):
+    """⟦«bigdl»/nn/VolumetricMaxPooling.scala⟧ — NCDHW max pooling with
+    the reference's floor/ceil output-size convention."""
+
+    def __init__(self, k_t, k_w=None, k_h=None, d_t=None, d_w=None, d_h=None,
+                 pad_t=0, pad_w=0, pad_h=0, ceil_mode=False):
+        super().__init__()
+        self.k_t = k_t
+        self.k_w = k_w if k_w is not None else k_t
+        self.k_h = k_h if k_h is not None else k_t
+        self.d_t = d_t if d_t is not None else self.k_t
+        self.d_w = d_w if d_w is not None else self.k_w
+        self.d_h = d_h if d_h is not None else self.k_h
+        self.pad_t, self.pad_w, self.pad_h = pad_t, pad_w, pad_h
+        self.ceil_mode = ceil_mode
+        self._config = dict(
+            k_t=self.k_t, k_w=self.k_w, k_h=self.k_h,
+            d_t=self.d_t, d_w=self.d_w, d_h=self.d_h,
+            pad_t=pad_t, pad_w=pad_w, pad_h=pad_h, ceil_mode=ceil_mode,
+        )
+
+    def ceil(self):
+        self.ceil_mode = True
+        self._config["ceil_mode"] = True
+        return self
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        lax = _lax()
+        jnp = _jnp()
+        x, squeezed = _auto_batch(input, 5)
+        t, h, w = x.shape[2], x.shape[3], x.shape[4]
+        _, pt = _pool_pad(t, self.k_t, self.d_t, self.pad_t, self.ceil_mode)
+        _, ph = _pool_pad(h, self.k_h, self.d_h, self.pad_h, self.ceil_mode)
+        _, pw = _pool_pad(w, self.k_w, self.d_w, self.pad_w, self.ceil_mode)
+        y = lax.reduce_window(
+            x,
+            -jnp.inf,
+            lax.max,
+            window_dimensions=(1, 1, self.k_t, self.k_h, self.k_w),
+            window_strides=(1, 1, self.d_t, self.d_h, self.d_w),
+            padding=[(0, 0), (0, 0), pt, ph, pw],
+        )
+        return y[0] if squeezed else y
+
+    def __repr__(self):
+        return f"VolumetricMaxPooling({self.k_t}x{self.k_h}x{self.k_w})"
+
+
+class VolumetricAveragePooling(AbstractModule):
+    """⟦«bigdl»/nn/VolumetricAveragePooling.scala⟧ — NCDHW average
+    pooling (countIncludePad=true default like the 2-D layer)."""
+
+    def __init__(self, k_t, k_w=None, k_h=None, d_t=None, d_w=None, d_h=None,
+                 pad_t=0, pad_w=0, pad_h=0, count_include_pad=True,
+                 ceil_mode=False):
+        super().__init__()
+        self.k_t = k_t
+        self.k_w = k_w if k_w is not None else k_t
+        self.k_h = k_h if k_h is not None else k_t
+        self.d_t = d_t if d_t is not None else self.k_t
+        self.d_w = d_w if d_w is not None else self.k_w
+        self.d_h = d_h if d_h is not None else self.k_h
+        self.pad_t, self.pad_w, self.pad_h = pad_t, pad_w, pad_h
+        self.count_include_pad = count_include_pad
+        self.ceil_mode = ceil_mode
+        self._config = dict(
+            k_t=self.k_t, k_w=self.k_w, k_h=self.k_h,
+            d_t=self.d_t, d_w=self.d_w, d_h=self.d_h,
+            pad_t=pad_t, pad_w=pad_w, pad_h=pad_h,
+            count_include_pad=count_include_pad, ceil_mode=ceil_mode,
+        )
+
+    def ceil(self):
+        self.ceil_mode = True
+        self._config["ceil_mode"] = True
+        return self
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        lax = _lax()
+        jnp = _jnp()
+        x, squeezed = _auto_batch(input, 5)
+        t, h, w = x.shape[2], x.shape[3], x.shape[4]
+        _, pt = _pool_pad(t, self.k_t, self.d_t, self.pad_t, self.ceil_mode)
+        _, ph = _pool_pad(h, self.k_h, self.d_h, self.pad_h, self.ceil_mode)
+        _, pw = _pool_pad(w, self.k_w, self.d_w, self.pad_w, self.ceil_mode)
+        dims = (1, 1, self.k_t, self.k_h, self.k_w)
+        strides = (1, 1, self.d_t, self.d_h, self.d_w)
+        pads = [(0, 0), (0, 0), pt, ph, pw]
+        summed = lax.reduce_window(x, 0.0, lax.add, dims, strides, pads)
+        if self.count_include_pad:
+            y = summed / (self.k_t * self.k_h * self.k_w)
+        else:
+            counts = lax.reduce_window(
+                jnp.ones_like(x), 0.0, lax.add, dims, strides, pads
+            )
+            y = summed / counts
+        return y[0] if squeezed else y
+
+    def __repr__(self):
+        return f"VolumetricAveragePooling({self.k_t}x{self.k_h}x{self.k_w})"
+
+
+class VolumetricBatchNormalization(BatchNormalization):
+    """3-D BN over NCDHW — per-channel statistics (the volumetric member
+    of the reference's BN family, SURVEY.md §2.1 "Layer library")."""
+
+    _feature_ndim = 5
+
+    def _axes_and_shape(self, input):
+        if input.ndim == 5:
+            return (0, 2, 3, 4), (1, self.n_output, 1, 1, 1)
+        raise ValueError(
+            f"VolumetricBatchNormalization expects 5-d input, got "
+            f"{input.ndim}-d"
+        )
+
+
+class UpSampling3D(AbstractModule):
+    """⟦«bigdl»/nn/UpSampling3D.scala⟧ — nearest-neighbour repeat of the
+    three spatial dims of an NCDHW tensor by ``size=(sT, sH, sW)``."""
+
+    def __init__(self, size=(2, 2, 2)):
+        super().__init__()
+        self.size = tuple(size)
+        self._config = dict(size=list(self.size))
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        jnp = _jnp()
+        x, squeezed = _auto_batch(input, 5)
+        st, sh, sw = self.size
+        y = jnp.repeat(jnp.repeat(jnp.repeat(x, st, 2), sh, 3), sw, 4)
+        return y[0] if squeezed else y
+
+    def __repr__(self):
+        return f"UpSampling3D({self.size})"
+
+
+class Cropping3D(AbstractModule):
+    """⟦«bigdl»/nn/Cropping3D.scala⟧ — crop (lo, hi) cells from each of
+    the three spatial dims of an NCDHW tensor."""
+
+    def __init__(self, dim1_crop=(1, 1), dim2_crop=(1, 1), dim3_crop=(1, 1)):
+        super().__init__()
+        self.dim1_crop = tuple(dim1_crop)
+        self.dim2_crop = tuple(dim2_crop)
+        self.dim3_crop = tuple(dim3_crop)
+        self._config = dict(
+            dim1_crop=list(self.dim1_crop),
+            dim2_crop=list(self.dim2_crop),
+            dim3_crop=list(self.dim3_crop),
+        )
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        x, squeezed = _auto_batch(input, 5)
+        (t0, t1), (h0, h1), (w0, w1) = (
+            self.dim1_crop, self.dim2_crop, self.dim3_crop
+        )
+        y = x[
+            :, :,
+            t0: x.shape[2] - t1 or None,
+            h0: x.shape[3] - h1 or None,
+            w0: x.shape[4] - w1 or None,
+        ]
+        return y[0] if squeezed else y
+
+    def __repr__(self):
+        return (
+            f"Cropping3D({self.dim1_crop}, {self.dim2_crop}, "
+            f"{self.dim3_crop})"
+        )
+
+
+__all__ = [
+    "VolumetricConvolution",
+    "VolumetricFullConvolution",
+    "VolumetricMaxPooling",
+    "VolumetricAveragePooling",
+    "VolumetricBatchNormalization",
+    "UpSampling3D",
+    "Cropping3D",
+]

@@ -368,8 +368,11 @@ def _net_layers(net: dict) -> List[dict]:
     for l in layers:
         t = _first(l, "type", "")
         if isinstance(t, str) and t.isupper():  # V1 text enum e.g. CONVOLUTION
-            t = {v.upper().replace("WITHLOSS", "_LOSS"): v
-                 for v in _V1_TYPES.values()}.get(t, t.title())
+            # legacy spellings use underscores (INNER_PRODUCT,
+            # EUCLIDEAN_LOSS) — strip them on both sides of the lookup
+            v1 = {v.upper().replace("WITHLOSS", "_LOSS").replace("_", ""): v
+                  for v in _V1_TYPES.values()}
+            t = v1.get(t.replace("_", ""), t.title())
         out.append({**l, "type": [t]})
     return out
 
@@ -678,7 +681,11 @@ class CaffeLoader:
             ph, pw = _kern2(p, "pad")
             ph, pw = ph or 0, pw or 0
             glob = bool(_first(p, "global_pooling", False))
-            if glob and shape:
+            if glob:
+                if shape is None:
+                    raise CaffeConversionException(
+                        "global pooling needs a known input shape"
+                    )
                 kh, kw = shape[1], shape[2]
                 sh = sw = 1
                 ph = pw = 0

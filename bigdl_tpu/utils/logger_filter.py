@@ -36,12 +36,14 @@ def redirect_spark_info_logs(
     file_handler.setLevel(logging.INFO)
     file_handler.setFormatter(fmt)
     setattr(file_handler, _MARK, True)
+    stale = []  # handlers from a previous call; close once fully detached
     for name in chatty:
         lg = logging.getLogger(name)
         # idempotent: drop handlers installed by a previous call
         for h in list(lg.handlers):
             if getattr(h, _MARK, False):
                 lg.removeHandler(h)
+                stale.append(h)
         lg.addHandler(file_handler)
         lg.setLevel(logging.INFO)
         lg.propagate = False
@@ -50,6 +52,16 @@ def redirect_spark_info_logs(
         console.setFormatter(fmt)
         setattr(console, _MARK, True)
         lg.addHandler(console)
+    # close only handlers no longer attached to ANY logger (a previous
+    # call may have installed them on loggers outside today's chatty list)
+    still_attached = set()
+    root = logging.Logger.manager.root
+    for lg in [root] + list(logging.Logger.manager.loggerDict.values()):
+        for h in getattr(lg, "handlers", ()):
+            still_attached.add(id(h))
+    for h in {id(h): h for h in stale}.values():
+        if id(h) not in still_attached:
+            h.close()
     for name in keep:
         lg = logging.getLogger(name)
         lg.setLevel(logging.INFO)

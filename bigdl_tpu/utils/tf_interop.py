@@ -341,8 +341,14 @@ class TensorflowLoader:
 
         if op == "BiasAdd":
             b = self._const(ins[1])
-            if self._is_image(ins[0]):
-                # channel bias on an NCHW tensor broadcasts as (C, 1, 1)
+            # BiasAdd always adds along the channel axis.  Two cases need
+            # the (C, 1, 1) broadcast on the converted (NCHW) tensor:
+            #  - the producer chain was NHWC and got remapped to NCHW, or
+            #  - the node itself declares data_format=NCHW (channels are
+            #    already axis 1 — a flat (C,) add would ride the W axis).
+            fmt = nd.attr("data_format")
+            fmt = fmt.s if fmt and fmt.s else "NHWC"
+            if self._is_image(ins[0]) or fmt == "NCHW":
                 mod = L.CAdd((b.size, 1, 1))
                 mod.bias = jnp_set(b.reshape(-1, 1, 1))
             else:
@@ -386,12 +392,21 @@ class TensorflowLoader:
                     else:
                         mod = L.Threshold(v, v)
                     return self._named(mod, nd)(self._build(other))
-                # broadcast add/mul with a vector -> CAdd/CMul
+                # broadcast add/mul with a vector -> CAdd/CMul.  TF
+                # broadcasts trailing axes: on an NHWC tensor a (C,) const
+                # rides the channel axis, so after the NHWC->NCHW remap it
+                # must become (C, 1, 1); non-image tensors keep TF layout
+                # and the trailing broadcast is already correct.
+                if self._is_image(other) and c.ndim == 1:
+                    cshape = (c.size, 1, 1)
+                    c = c.reshape(cshape)
+                else:
+                    cshape = c.shape
                 if op in ("Add", "AddV2"):
-                    mod = L.CAdd(c.shape)
+                    mod = L.CAdd(cshape)
                     mod.bias = jnp_set(c)
                 elif op == "Mul":
-                    mod = L.CMul(c.shape)
+                    mod = L.CMul(cshape)
                     mod.weight = jnp_set(c)
                 else:
                     raise TFConversionException(

@@ -1,0 +1,677 @@
+"""Unit tests for the breadth families added in round 2: volumetric
+(3-D) layers, locally-connected / separable convs, shrink activations,
+noise layers, spatial dropouts, crops/resizes, spatial normalizations,
+shape utilities, new table ops, new criterions, and the stacked /
+convolutional recurrent cells.
+
+Mirrors the reference's per-layer spec pattern (SURVEY.md §4.1: fixed
+seed, small hand-sized tensors, outputs vs hand-computed values) plus a
+numeric gradcheck per family (§4.2)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import bigdl_tpu.nn as N
+
+
+def _rs(seed=0):
+    return np.random.RandomState(seed)
+
+
+def numeric_grad(f, x, eps=1e-3):
+    x = np.asarray(x, np.float64)
+    g = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        i = it.multi_index
+        xp = x.copy(); xp[i] += eps
+        xm = x.copy(); xm[i] -= eps
+        g[i] = (f(xp) - f(xm)) / (2 * eps)
+        it.iternext()
+    return g
+
+
+def gradcheck(m, x, rtol=2e-2, atol=2e-3):
+    """backward (vjp) vs finite differences of sum(out^2)/2."""
+    m.evaluate()
+    xj = jnp.asarray(x, jnp.float32)
+
+    def scalar(xv):
+        out = m.apply(m.params(), m.state(), jnp.asarray(xv, jnp.float32),
+                      training=False)[0]
+        return float(jnp.sum(out * out)) / 2.0
+
+    out = m.forward(xj)
+    grad_in = m.backward(xj, out)
+    np.testing.assert_allclose(
+        np.asarray(grad_in), numeric_grad(scalar, x), rtol=rtol, atol=atol
+    )
+
+
+# ---------------------------------------------------------------- volumetric
+
+
+def test_volumetric_convolution_matches_manual():
+    rs = _rs(1)
+    m = N.VolumetricConvolution(2, 3, 2, 2, 2)
+    x = rs.randn(1, 2, 3, 4, 4).astype(np.float32)
+    y = np.asarray(m.forward(jnp.asarray(x)))
+    assert y.shape == (1, 3, 2, 3, 3)
+    w = np.asarray(m.weight)
+    b = np.asarray(m.bias)
+    # hand-compute one output element: out[0, o, 0, 0, 0]
+    for o in range(3):
+        patch = x[0, :, 0:2, 0:2, 0:2]
+        expect = (patch * w[o]).sum() + b[o]
+        np.testing.assert_allclose(y[0, o, 0, 0, 0], expect, rtol=1e-4)
+
+
+def test_volumetric_conv_gradcheck():
+    rs = _rs(2)
+    gradcheck(N.VolumetricConvolution(2, 2, 2, 2, 2),
+              rs.randn(1, 2, 3, 3, 3).astype(np.float32))
+
+
+def test_volumetric_full_convolution_inverts_stride():
+    m = N.VolumetricFullConvolution(2, 3, 2, 2, 2, 2, 2, 2)
+    x = _rs(3).randn(1, 2, 2, 3, 3).astype(np.float32)
+    y = m.forward(jnp.asarray(x))
+    # transposed conv: out = (in-1)*stride + k
+    assert y.shape == (1, 3, 4, 6, 6)
+
+
+def test_volumetric_pooling():
+    x = np.arange(2 * 1 * 2 * 4 * 4, dtype=np.float32).reshape(2, 1, 2, 4, 4)
+    mx = N.VolumetricMaxPooling(2).forward(jnp.asarray(x))
+    av = N.VolumetricAveragePooling(2).forward(jnp.asarray(x))
+    assert mx.shape == (2, 1, 1, 2, 2)
+    # max of the 2x2x2 corner block
+    np.testing.assert_allclose(
+        np.asarray(mx)[0, 0, 0, 0, 0], x[0, 0, 1, 1, 1]
+    )
+    np.testing.assert_allclose(
+        np.asarray(av)[0, 0, 0, 0, 0],
+        x[0, 0, 0:2, 0:2, 0:2].mean(),
+        rtol=1e-6,
+    )
+
+
+def test_volumetric_batchnorm_normalizes():
+    rs = _rs(4)
+    m = N.VolumetricBatchNormalization(3)
+    x = (rs.randn(4, 3, 2, 5, 5) * 3 + 1).astype(np.float32)
+    m.training()
+    y = np.asarray(m.forward(jnp.asarray(x)))
+    np.testing.assert_allclose(y.mean(axis=(0, 2, 3, 4)), 0.0, atol=1e-4)
+    np.testing.assert_allclose(y.std(axis=(0, 2, 3, 4)), 1.0, atol=1e-2)
+
+
+def test_upsampling3d_and_cropping3d_roundtrip():
+    x = _rs(5).randn(1, 2, 2, 3, 3).astype(np.float32)
+    up = N.UpSampling3D((2, 2, 2)).forward(jnp.asarray(x))
+    assert up.shape == (1, 2, 4, 6, 6)
+    np.testing.assert_allclose(np.asarray(up)[0, 0, 0, 0, 0], x[0, 0, 0, 0, 0])
+    crop = N.Cropping3D((1, 1), (2, 2), (2, 2)).forward(up)
+    assert crop.shape == (1, 2, 2, 2, 2)
+
+
+# ------------------------------------------------- locally connected / convs
+
+
+def test_locally_connected_1d_unshared():
+    rs = _rs(6)
+    m = N.LocallyConnected1D(6, 3, 2, 3)
+    x = rs.randn(2, 6, 3).astype(np.float32)
+    y = np.asarray(m.forward(jnp.asarray(x)))
+    assert y.shape == (2, 4, 2)
+    w = np.asarray(m.weight)  # (T_out, kW*F_in, F_out)
+    b = np.asarray(m.bias)
+    t = 1
+    window = x[0, t:t + 3, :].reshape(-1)
+    np.testing.assert_allclose(
+        y[0, t], window @ w[t] + b[t], rtol=1e-4, atol=1e-5
+    )
+
+
+def test_locally_connected_2d_matches_manual():
+    rs = _rs(7)
+    m = N.LocallyConnected2D(2, 4, 4, 3, 2, 2)
+    x = rs.randn(1, 2, 4, 4).astype(np.float32)
+    y = np.asarray(m.forward(jnp.asarray(x)))
+    assert y.shape == (1, 3, 3, 3)
+    w = np.asarray(m.weight)  # (O, I*kh*kw, out_h, out_w)
+    b = np.asarray(m.bias)
+    patch = x[0, :, 1:3, 2:4].reshape(-1)
+    for o in range(3):
+        np.testing.assert_allclose(
+            y[0, o, 1, 2], patch @ w[o, :, 1, 2] + b[o, 1, 2],
+            rtol=1e-4, atol=1e-5,
+        )
+
+
+def test_locally_connected_2d_gradcheck():
+    gradcheck(N.LocallyConnected2D(1, 3, 3, 2, 2, 2),
+              _rs(8).randn(1, 1, 3, 3).astype(np.float32))
+
+
+def test_separable_conv_equals_depthwise_then_pointwise():
+    rs = _rs(9)
+    m = N.SpatialSeparableConvolution(2, 3, 2, 3, 3, 1, 1, 1, 1)
+    x = rs.randn(1, 2, 5, 5).astype(np.float32)
+    y = np.asarray(m.forward(jnp.asarray(x)))
+    assert y.shape == (1, 3, 5, 5)
+    # compose the two convs manually through lax
+    import jax.lax as lax
+
+    mid = lax.conv_general_dilated(
+        jnp.asarray(x), m.depth_weight, (1, 1), [(1, 1), (1, 1)],
+        feature_group_count=2, dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )
+    expect = lax.conv_general_dilated(
+        mid, m.point_weight, (1, 1), "VALID",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    ) + np.asarray(m.bias).reshape(1, -1, 1, 1)
+    np.testing.assert_allclose(y, np.asarray(expect), rtol=1e-4, atol=1e-5)
+
+
+def test_share_convolution_is_spatial_convolution():
+    m = N.SpatialShareConvolution(2, 3, 3, 3)
+    assert isinstance(m, N.SpatialConvolution)
+    x = _rs(10).randn(1, 2, 5, 5).astype(np.float32)
+    assert m.forward(jnp.asarray(x)).shape == (1, 3, 3, 3)
+
+
+def test_convolution_map_respects_connection_table():
+    # one-to-one table: output plane i sees only input plane i
+    m = N.SpatialConvolutionMap(
+        N.SpatialConvolutionMap.one_to_one(2), 3, 3, 1, 1, 1, 1
+    )
+    x = np.zeros((1, 2, 5, 5), np.float32)
+    x[0, 0] = 1.0  # only plane 0 carries signal
+    m.bias = jnp.zeros_like(m.bias)
+    y = np.asarray(m.forward(jnp.asarray(x)))
+    assert np.abs(y[0, 1]).max() == 0.0  # plane 1 unconnected to plane 0
+    assert np.abs(y[0, 0]).max() > 0.0
+
+
+def test_temporal_max_pooling():
+    x = np.arange(12, dtype=np.float32).reshape(1, 6, 2)
+    y = np.asarray(N.TemporalMaxPooling(2).forward(jnp.asarray(x)))
+    np.testing.assert_allclose(y, x[:, 1::2, :])
+
+
+# ----------------------------------------------------------- shrink family
+
+
+def test_shrink_activations_known_values():
+    x = jnp.asarray([-2.0, -0.3, 0.0, 0.3, 2.0])
+    np.testing.assert_allclose(
+        np.asarray(N.SoftShrink(0.5).forward(x)),
+        [-1.5, 0.0, 0.0, 0.0, 1.5],
+    )
+    np.testing.assert_allclose(
+        np.asarray(N.HardShrink(0.5).forward(x)),
+        [-2.0, 0.0, 0.0, 0.0, 2.0],
+    )
+    np.testing.assert_allclose(
+        np.asarray(N.TanhShrink().forward(x)),
+        np.asarray(x) - np.tanh(np.asarray(x)),
+        rtol=1e-5, atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(N.LogSigmoid().forward(x)),
+        np.log(1.0 / (1.0 + np.exp(-np.asarray(x)))),
+        rtol=1e-5, atol=1e-6,
+    )
+
+
+def test_rrelu_train_bounds_and_eval_slope():
+    x = -np.ones((400,), np.float32)
+    m = N.RReLU(0.1, 0.4)
+    m.training()
+    y = np.asarray(m.forward(jnp.asarray(x)))
+    assert (y <= -0.1 + 1e-6).all() and (y >= -0.4 - 1e-6).all()
+    assert y.std() > 0.0  # actually random
+    m.evaluate()
+    y = np.asarray(m.forward(jnp.asarray(x)))
+    np.testing.assert_allclose(y, -0.25, rtol=1e-6)
+
+
+# -------------------------------------------------------------- noise layers
+
+
+def test_gaussian_noise_and_dropout_train_eval():
+    x = np.ones((2000,), np.float32)
+    gn = N.GaussianNoise(0.5)
+    gn.training()
+    y = np.asarray(gn.forward(jnp.asarray(x)))
+    assert abs(y.std() - 0.5) < 0.1
+    gn.evaluate()
+    np.testing.assert_allclose(np.asarray(gn.forward(jnp.asarray(x))), x)
+
+    gd = N.GaussianDropout(0.5)
+    gd.training()
+    y = np.asarray(gd.forward(jnp.asarray(x)))
+    assert abs(y.mean() - 1.0) < 0.15  # multiplicative noise, mean 1
+    gd.evaluate()
+    np.testing.assert_allclose(np.asarray(gd.forward(jnp.asarray(x))), x)
+
+
+def test_gaussian_sampler_statistics():
+    mean = np.full((4000,), 2.0, np.float32)
+    log_var = np.full((4000,), np.log(0.25), np.float32)
+    m = N.GaussianSampler()
+    m.training()
+    y = np.asarray(m.forward((jnp.asarray(mean), jnp.asarray(log_var))))
+    assert abs(y.mean() - 2.0) < 0.1
+    assert abs(y.std() - 0.5) < 0.1
+
+
+# ---------------------------------------------------------- spatial dropout
+
+
+def test_spatial_dropout2d_drops_whole_maps():
+    m = N.SpatialDropout2D(0.5)
+    m.training()
+    x = np.ones((4, 16, 5, 5), np.float32)
+    y = np.asarray(m.forward(jnp.asarray(x)))
+    # each (b, c) map is all-zero or all-2.0 (1/keep scaling)
+    per_map = y.reshape(4, 16, -1)
+    for b in range(4):
+        for c in range(16):
+            vals = np.unique(per_map[b, c])
+            assert len(vals) == 1 and vals[0] in (0.0, 2.0)
+    m.evaluate()
+    np.testing.assert_allclose(np.asarray(m.forward(jnp.asarray(x))), x)
+
+
+def test_spatial_dropout1d_shares_mask_over_time():
+    m = N.SpatialDropout1D(0.5)
+    m.training()
+    x = np.ones((2, 10, 8), np.float32)
+    y = np.asarray(m.forward(jnp.asarray(x)))
+    # mask constant along T
+    assert (y.std(axis=1) < 1e-6).all()
+
+
+# ------------------------------------------------------------ crop / resize
+
+
+def test_cropping2d():
+    x = _rs(11).randn(1, 2, 6, 8).astype(np.float32)
+    y = np.asarray(N.Cropping2D((1, 2), (3, 1)).forward(jnp.asarray(x)))
+    np.testing.assert_allclose(y, x[:, :, 1:4, 3:7])
+
+
+def test_upsampling_1d_2d():
+    x = np.arange(4, dtype=np.float32).reshape(1, 2, 2)
+    y = np.asarray(N.UpSampling1D(2).forward(jnp.asarray(x)))
+    np.testing.assert_allclose(y[0, :, 0], [0, 0, 2, 2])
+    img = np.arange(4, dtype=np.float32).reshape(1, 1, 2, 2)
+    y = np.asarray(N.UpSampling2D((2, 2)).forward(jnp.asarray(img)))
+    assert y.shape == (1, 1, 4, 4)
+    np.testing.assert_allclose(y[0, 0, :2, :2], 0.0)
+
+
+def test_resize_bilinear_align_corners_endpoints():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    y = np.asarray(
+        N.ResizeBilinear(7, 7, align_corners=True).forward(jnp.asarray(x))
+    )
+    # corners map exactly onto input corners
+    np.testing.assert_allclose(y[0, 0, 0, 0], 0.0, atol=1e-5)
+    np.testing.assert_allclose(y[0, 0, -1, -1], 15.0, atol=1e-5)
+    np.testing.assert_allclose(y[0, 0, 0, -1], 3.0, atol=1e-5)
+
+
+# ------------------------------------------------------------ normalizations
+
+
+def test_within_channel_lrn_formula():
+    rs = _rs(12)
+    x = rs.rand(1, 2, 5, 5).astype(np.float32)
+    m = N.SpatialWithinChannelLRN(3, alpha=2.0, beta=0.5)
+    y = np.asarray(m.forward(jnp.asarray(x)))
+    # center pixel: window sum of squares over 3x3
+    sq = (x[0, 0, 1:4, 1:4] ** 2).sum()
+    expect = x[0, 0, 2, 2] / np.sqrt(1.0 + (2.0 / 9) * sq)
+    np.testing.assert_allclose(y[0, 0, 2, 2], expect, rtol=1e-4)
+
+
+def test_subtractive_normalization_zeroes_constant_input():
+    x = np.full((1, 2, 7, 7), 3.25, np.float32)
+    y = np.asarray(
+        N.SpatialSubtractiveNormalization(2).forward(jnp.asarray(x))
+    )
+    np.testing.assert_allclose(y, 0.0, atol=1e-5)
+
+
+def test_divisive_normalization_scales_down():
+    rs = _rs(13)
+    x = rs.randn(1, 1, 9, 9).astype(np.float32) * 4
+    y = np.asarray(N.SpatialDivisiveNormalization(1).forward(jnp.asarray(x)))
+    assert np.abs(y).mean() < np.abs(x).mean()
+
+
+def test_contrastive_is_sub_then_div():
+    rs = _rs(14)
+    x = jnp.asarray(rs.randn(1, 1, 7, 7), jnp.float32)
+    m = N.SpatialContrastiveNormalization(1)
+    y = np.asarray(m.forward(x))
+    expect = m.div.update_output_pure({}, m.sub.update_output_pure({}, x))
+    np.testing.assert_allclose(y, np.asarray(expect), rtol=1e-6)
+
+
+# ------------------------------------------------------------- shape utils
+
+
+def test_expand_size_infer_reshape_tile_reverse():
+    v = jnp.asarray([[1.0], [2.0]])
+    y = np.asarray(N.ExpandSize([-1, 3]).forward(v))
+    np.testing.assert_allclose(y, [[1, 1, 1], [2, 2, 2]])
+
+    x = jnp.asarray(np.arange(12, dtype=np.float32).reshape(2, 6))
+    y = N.InferReshape([0, 2, 3]).forward(x)
+    assert y.shape == (2, 2, 3)
+    y = N.InferReshape([3, -1], batch_mode=True).forward(x)
+    assert y.shape == (2, 3, 2)
+
+    y = np.asarray(N.Tile(2, 2).forward(jnp.asarray([[1.0, 2.0]])))
+    np.testing.assert_allclose(y, [[1, 2, 1, 2]])
+
+    y = np.asarray(N.Reverse(2).forward(jnp.asarray([[1.0, 2.0, 3.0]])))
+    np.testing.assert_allclose(y, [[3, 2, 1]])
+
+
+def test_masked_select_eager():
+    x = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])
+    mask = jnp.asarray([[1, 0], [0, 1]])
+    y = np.asarray(N.MaskedSelect().forward((x, mask)))
+    np.testing.assert_allclose(y, [1.0, 4.0])
+
+
+def test_pairwise_distance_p1_p2():
+    a = jnp.asarray([[0.0, 0.0], [1.0, 1.0]])
+    b = jnp.asarray([[3.0, 4.0], [1.0, 1.0]])
+    np.testing.assert_allclose(
+        np.asarray(N.PairwiseDistance(2).forward((a, b))), [5.0, 0.0],
+        atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(N.PairwiseDistance(1).forward((a, b))), [7.0, 0.0],
+        atol=1e-6,
+    )
+
+
+# ---------------------------------------------------------------- table ops
+
+
+def test_new_table_ops():
+    a = jnp.asarray([[1.0, 2.0]])
+    b = jnp.asarray([[3.0, 6.0]])
+    np.testing.assert_allclose(
+        np.asarray(N.CAveTable().forward((a, b))), [[2.0, 4.0]]
+    )
+    parts = N.SplitTable(2).forward(jnp.asarray([[1.0, 2.0, 3.0]]))
+    assert len(parts) == 3 and parts[0].shape == (1,)
+    l, r = N.BifurcateSplitTable(2).forward(jnp.asarray([[1.0, 2.0, 3.0, 4.0]]))
+    np.testing.assert_allclose(np.asarray(l), [[1.0, 2.0]])
+    sel = N.NarrowTable(2, 2).forward((a, b, a))
+    assert len(sel) == 2
+    packed = N.Pack(1).forward((a[0], b[0]))
+    assert packed.shape == (2, 2)
+    stacked = N.Pack(2).forward((a, b))
+    assert stacked.shape == (1, 2, 2)
+
+
+def test_mixture_table_weights_experts():
+    g = jnp.asarray([[0.25, 0.75]])
+    e1 = jnp.asarray([[1.0, 1.0]])
+    e2 = jnp.asarray([[3.0, 5.0]])
+    y = np.asarray(N.MixtureTable().forward((g, (e1, e2))))
+    np.testing.assert_allclose(y, [[2.5, 4.0]])
+    # tensor-expert variant (B, K, F)
+    experts = jnp.stack([e1, e2], axis=1)
+    y2 = np.asarray(N.MixtureTable().forward((g, experts)))
+    np.testing.assert_allclose(y2, y)
+
+
+def test_map_table_shares_weights():
+    m = N.MapTable(N.Linear(3, 2))
+    a = jnp.ones((1, 3))
+    y1, y2 = m.forward((a, a * 2))
+    np.testing.assert_allclose(np.asarray(y2 - y1), np.asarray(y1) -
+                               np.asarray(m.modules[0].bias)[None],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_bottle_folds_leading_dims():
+    m = N.Bottle(N.Linear(4, 3), 2, 2)
+    x = jnp.asarray(_rs(15).randn(2, 5, 4), jnp.float32)
+    y = m.forward(x)
+    assert y.shape == (2, 5, 3)
+    direct = m.modules[0].forward(x.reshape(10, 4)).reshape(2, 5, 3)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(direct), rtol=1e-6)
+
+
+# --------------------------------------------------------------- criterions
+
+
+def test_cosine_distance_criterion():
+    c = N.CosineDistanceCriterion()
+    x = jnp.asarray([[1.0, 0.0]])
+    same = c.forward(x, jnp.asarray([[2.0, 0.0]]))
+    orth = c.forward(x, jnp.asarray([[0.0, 3.0]]))
+    np.testing.assert_allclose(float(same), 0.0, atol=1e-6)
+    np.testing.assert_allclose(float(orth), 1.0, atol=1e-6)
+
+
+def test_dice_criterion_perfect_overlap():
+    c = N.DiceCoefficientCriterion(epsilon=0.0)
+    x = jnp.asarray([[1.0, 1.0, 0.0]])
+    assert float(c.forward(x, x)) < 1e-6
+    disjoint = c.forward(x, jnp.asarray([[0.0, 0.0, 1.0]]))
+    np.testing.assert_allclose(float(disjoint), 1.0, atol=1e-6)
+
+
+def test_soft_margin_criterion_value():
+    c = N.SoftMarginCriterion()
+    x = jnp.asarray([[0.5, -0.5]])
+    t = jnp.asarray([[1.0, -1.0]])
+    expect = np.log(1 + np.exp(-0.5))
+    np.testing.assert_allclose(float(c.forward(x, t)), expect, rtol=1e-5)
+
+
+def test_multilabel_margin_criterion_manual():
+    c = N.MultiLabelMarginCriterion(size_average=False)
+    x = jnp.asarray([[0.1, 0.2, 0.4, 0.8]])
+    t = jnp.asarray([[3.0, 0.0, 0.0, 0.0]])  # target class 3 (1-based)
+    # loss = sum_{j != 3} max(0, 1 - (x[2] - x[j])) / 4
+    xs = np.asarray(x)[0]
+    expect = sum(max(0.0, 1.0 - (xs[2] - xs[j])) for j in (0, 1, 3)) / 4
+    np.testing.assert_allclose(float(c.forward(x, t)), expect, rtol=1e-5)
+
+
+def test_gaussian_and_kld_criterion_values():
+    mean = jnp.zeros((1, 2))
+    log_var = jnp.zeros((1, 2))
+    target = jnp.zeros((1, 2))
+    g = N.GaussianCriterion()
+    np.testing.assert_allclose(
+        float(g.forward((mean, log_var), target)),
+        0.5 * np.log(2 * np.pi) * 2,
+        rtol=1e-5,
+    )
+    k = N.KLDCriterion()
+    np.testing.assert_allclose(
+        float(k.forward((mean, log_var), target)), 0.0, atol=1e-6
+    )
+    # nonzero mean increases KL by 0.5*mean^2
+    np.testing.assert_allclose(
+        float(k.forward((mean + 2.0, log_var), target)), 4.0, atol=1e-5
+    )
+
+
+def test_l1_hinge_embedding_criterion():
+    c = N.L1HingeEmbeddingCriterion(margin=2.0)
+    x1 = jnp.asarray([[1.0, 1.0]])
+    x2 = jnp.asarray([[0.0, 0.5]])
+    d = 1.5
+    np.testing.assert_allclose(
+        float(c.forward((x1, x2), jnp.asarray([1.0]))), d, rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        float(c.forward((x1, x2), jnp.asarray([-1.0]))), 0.5, rtol=1e-6
+    )
+
+
+def test_criterion_backwards_run():
+    rs = _rs(16)
+    v = jnp.asarray(rs.randn(2, 4), jnp.float32)
+    t = jnp.asarray(rs.randn(2, 4), jnp.float32)
+    for c, inp, tgt in [
+        (N.CosineDistanceCriterion(), v, t),
+        (N.SoftMarginCriterion(), v, jnp.sign(t)),
+        (N.GaussianCriterion(), (v, t * 0), t),
+        (N.KLDCriterion(), (v, t * 0), t),
+        (N.L1HingeEmbeddingCriterion(), (v, t), jnp.asarray([1.0, -1.0])),
+    ]:
+        g = c.backward(inp, tgt)
+        flat = jax.tree.leaves(g)
+        assert all(np.isfinite(np.asarray(x)).all() for x in flat)
+
+
+# ---------------------------------------------------------------- recurrent
+
+
+def test_multi_rnn_cell_stacks():
+    rs = _rs(17)
+    cell = N.MultiRNNCell([N.LSTM(4, 6), N.GRU(6, 3)])
+    rec = N.Recurrent().add(cell)
+    x = jnp.asarray(rs.randn(2, 5, 4), jnp.float32)
+    y = rec.forward(x)
+    assert y.shape == (2, 5, 3)
+    # equals running the two Recurrents in sequence with the same weights
+    r1 = N.Recurrent().add(cell.cells[0])
+    r2 = N.Recurrent().add(cell.cells[1])
+    expect = r2.forward(r1.forward(x))
+    np.testing.assert_allclose(np.asarray(y), np.asarray(expect),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_conv_lstm_shapes_and_grad():
+    rs = _rs(18)
+    cell = N.ConvLSTMPeephole(2, 3, 3, 3)
+    rec = N.Recurrent().add(cell)
+    x = jnp.asarray(rs.randn(1, 4, 2, 5, 5), jnp.float32)
+    y = rec.forward(x)
+    assert y.shape == (1, 4, 3, 5, 5)
+    g = rec.backward(x, y)
+    assert np.isfinite(np.asarray(g)).all()
+
+
+def test_conv_lstm_no_peephole():
+    cell = N.ConvLSTMPeephole(2, 3, 3, 3, with_peephole=False)
+    assert cell.p_i is None
+    rec = N.Recurrent().add(cell)
+    x = jnp.ones((1, 2, 2, 4, 4))
+    assert rec.forward(x).shape == (1, 2, 3, 4, 4)
+
+
+def test_multi_rnn_and_conv_lstm_roundtrip(tmp_path):
+    from bigdl_tpu.utils.serializer import load_module, save_module
+
+    rs = _rs(19)
+    m = N.Sequential().add(
+        N.Recurrent().add(N.MultiRNNCell([N.LSTM(4, 6), N.GRU(6, 3)]))
+    )
+    m.evaluate()
+    x = jnp.asarray(rs.randn(2, 5, 4), jnp.float32)
+    out1 = np.asarray(m.forward(x))
+    loaded = load_module(save_module(m, str(tmp_path / "mrnn")))
+    loaded.evaluate()
+    np.testing.assert_allclose(out1, np.asarray(loaded.forward(x)),
+                               rtol=1e-5, atol=1e-6)
+
+    m2 = N.Recurrent().add(N.ConvLSTMPeephole(2, 3, 3, 3))
+    m2.evaluate()
+    xc = jnp.asarray(rs.randn(1, 3, 2, 5, 5), jnp.float32)
+    out2 = np.asarray(m2.forward(xc))
+    loaded2 = load_module(save_module(m2, str(tmp_path / "clstm")))
+    loaded2.evaluate()
+    np.testing.assert_allclose(out2, np.asarray(loaded2.forward(xc)),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_exported_module_breadth():
+    """VERDICT round-1 item 2 gate: >= 180 exported module classes."""
+    from bigdl_tpu.nn.module import AbstractModule
+    from bigdl_tpu.nn.criterion import AbstractCriterion
+
+    mods = [
+        name for name in dir(N)
+        if isinstance(getattr(N, name), type)
+        and issubclass(getattr(N, name),
+                       (AbstractModule, AbstractCriterion))
+        and not name.startswith("_")
+    ]
+    assert len(mods) >= 180, f"only {len(mods)} exported module classes"
+
+
+# ----------------------------------------------- round-2 review regressions
+
+
+def test_split_table_negative_dim():
+    x = jnp.asarray(_rs(20).randn(2, 3, 4), jnp.float32)
+    parts = N.SplitTable(-1, 2).forward(x)
+    assert len(parts) == 4 and parts[0].shape == (2, 3)
+    np.testing.assert_allclose(np.asarray(parts[1]), np.asarray(x[:, :, 1]))
+
+
+def test_multi_rnn_cell_upper_dropout_active():
+    """Per-gate input dropout of upper cells must fire in training."""
+    cell = N.MultiRNNCell([N.LSTM(4, 6), N.LSTM(6, 5, p=0.9)])
+    rec = N.Recurrent().add(cell)
+    rec.training()
+    x = jnp.asarray(_rs(21).randn(2, 5, 4), jnp.float32)
+    y1 = np.asarray(rec.forward(x))
+    y2 = np.asarray(rec.forward(x))
+    assert np.abs(y1 - y2).max() > 1e-6  # dropout varies across forwards
+    rec.evaluate()
+    e1 = np.asarray(rec.forward(x))
+    e2 = np.asarray(rec.forward(x))
+    np.testing.assert_allclose(e1, e2)
+
+
+def test_multilabel_margin_stops_at_first_zero():
+    c = N.MultiLabelMarginCriterion(size_average=False)
+    x = jnp.asarray([[0.1, 0.2, 0.4, 0.8]])
+    # torch semantics: [3, 0, 2, 0] targets only class 3 — the 2 after
+    # the terminating zero is ignored
+    t_terminated = jnp.asarray([[3.0, 0.0, 2.0, 0.0]])
+    t_clean = jnp.asarray([[3.0, 0.0, 0.0, 0.0]])
+    np.testing.assert_allclose(
+        float(c.forward(x, t_terminated)), float(c.forward(x, t_clean)),
+        rtol=1e-6,
+    )
+
+
+def test_bottle_rejects_rank_mismatch():
+    m = N.Bottle(N.Reshape([2, 2]), 2, 2)  # child outputs rank 3
+    with pytest.raises(ValueError, match="n_output_dim"):
+        m.forward(jnp.ones((3, 5, 4)))
+
+
+def test_logger_filter_keeps_shared_handler_open():
+    import logging
+    from bigdl_tpu.utils.logger_filter import redirect_spark_info_logs
+
+    redirect_spark_info_logs(chatty=("_lf_a", "_lf_b"))
+    redirect_spark_info_logs(chatty=("_lf_a",))
+    # the handler from call 1 is still attached to _lf_b: must be open
+    for h in logging.getLogger("_lf_b").handlers:
+        if isinstance(h, logging.FileHandler):
+            assert not h.stream.closed
+    logging.getLogger("_lf_b").info("must not raise on a closed stream")

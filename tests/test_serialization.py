@@ -182,6 +182,51 @@ def _layer_cases():
         (T.MM(), (v, v.T.copy())), (T.MV(), (v, rs.randn(2, 6).astype(np.float32)[0] * 0 + 1)),
         (T.DotProduct(), (v, v)), (T.CosineDistance(), (v, v)),
     ]
+    # round-2 breadth families
+    vol = rs.randn(1, 2, 4, 6, 6).astype(np.float32)
+    cases += [
+        (N.VolumetricConvolution(2, 3, 2, 2, 2), vol),
+        (N.VolumetricFullConvolution(2, 2, 2, 2, 2, 2, 2, 2), vol),
+        (N.VolumetricMaxPooling(2), vol),
+        (N.VolumetricAveragePooling(2), vol),
+        (N.VolumetricBatchNormalization(2), vol),
+        (N.UpSampling3D((2, 2, 2)), vol),
+        (N.Cropping3D((1, 1), (1, 1), (1, 1)), vol),
+        (N.LocallyConnected1D(5, 6, 4, 3), seq),
+        (N.LocallyConnected2D(3, 8, 8, 2, 3, 3), img),
+        (N.SpatialSeparableConvolution(3, 4, 2, 3, 3, 1, 1, 1, 1), img),
+        (N.SpatialShareConvolution(3, 4, 3, 3), img),
+        (N.SpatialConvolutionMap(
+            N.SpatialConvolutionMap.one_to_one(3), 3, 3, 1, 1, 1, 1), img),
+        (N.TemporalMaxPooling(2), seq),
+        (N.SoftShrink(0.4), v), (N.HardShrink(0.4), v),
+        (N.TanhShrink(), v), (N.LogSigmoid(), v),
+        (N.RReLU(), v),  # eval mode = fixed slope
+        (N.GaussianDropout(0.3), v), (N.GaussianNoise(0.2), v),
+        (N.SpatialDropout1D(0.3), seq), (N.SpatialDropout2D(0.3), img),
+        (N.SpatialDropout3D(0.3), vol),
+        (N.Cropping2D((1, 1), (1, 1)), img),
+        (N.UpSampling1D(2), seq), (N.UpSampling2D((2, 2)), img),
+        (N.ResizeBilinear(12, 12), img),
+        (N.SpatialWithinChannelLRN(3), img),
+        (N.SpatialSubtractiveNormalization(3), img),
+        (N.SpatialDivisiveNormalization(3), img),
+        (N.SpatialContrastiveNormalization(3), img),
+        (N.ExpandSize([-1, 6]), v[:, :1]),
+        (N.InferReshape([0, 3, 2]), v),
+        (N.Tile(2, 2), v), (N.Reverse(2), v),
+        (N.PairwiseDistance(2), (v, v + 1)),
+        (N.NegativeEntropyPenalty(0.1), np.abs(v)),
+        (N.GaussianSampler(), (v, v * 0)),  # eval: returns the mean
+        (N.CAveTable(), (v, v)),
+        (N.SplitTable(2), v),
+        (N.BifurcateSplitTable(2), v),
+        (N.NarrowTable(1, 2), (v, v, v)),
+        (N.Pack(1), (v, v)),
+        (N.MixtureTable(), (np.abs(v[:, :2]), (v, v))),
+        (N.MapTable(L.Linear(6, 4)), (v, v)),
+        (N.Bottle(L.Linear(6, 4), 2, 2), seq),
+    ]
     return cases
 
 
@@ -218,6 +263,7 @@ def test_every_exported_layer_is_covered_or_known():
         "Identity", "Echo", "Recurrent", "BiRecurrent", "RecurrentDecoder",
         "LSTM", "LSTMPeephole", "GRU", "RnnCell", "TimeDistributed",
         "Select", "MaskedSelect", "FlattenTable",
+        "MultiRNNCell", "ConvLSTMPeephole",  # own specs in test_layers_extra
         "LayerNorm", "MultiHeadAttention", "TransformerBlock",
         "PositionalEmbedding",
         # sparse layers operate on SparseTensor inputs (own spec)

@@ -212,3 +212,55 @@ def test_negative_concat_axis_and_nchw_graph():
         inputs=["img"], outputs=["gap"])
     out = np.asarray(model2.forward(x))
     assert out.shape == (2, 3)
+
+
+def test_biasadd_nchw_data_format():
+    """BiasAdd on an NCHW-format conv graph must bias channels (axis 1),
+    not the trailing W axis (ADVICE r1 regression)."""
+    rs = np.random.RandomState(7)
+    b = GraphDefBuilder()
+    b.placeholder("img")
+    w = rs.randn(1, 1, 3, 3).astype(np.float32)  # HWIO 1x1
+    bias = rs.randn(3).astype(np.float32)
+    b.const("w", w)
+    b.const("b", bias)
+    b.op("conv", "Conv2D", ["img", "w"],
+         strides=b.attr_ints([1, 1, 1, 1]), padding=b.attr_s("SAME"),
+         data_format=b.attr_s("NCHW"))
+    b.op("out", "BiasAdd", ["conv", "b"], data_format=b.attr_s("NCHW"))
+    model = TensorflowLoader(data=b.tobytes()).load(
+        inputs=["img"], outputs=["out"]
+    )
+    model.evaluate()
+    # W == C == 3 so a wrong trailing-axis broadcast would be silent
+    x = rs.randn(2, 3, 5, 3).astype(np.float32)
+    out = np.asarray(model.forward(x))
+    kernel = w[0, 0]  # (I, O)
+    expect = np.einsum("nihw,io->nohw", x, kernel) + bias[None, :, None, None]
+    np.testing.assert_allclose(out, expect, rtol=2e-3, atol=1e-5)
+
+
+def test_const_add_vector_on_image():
+    """Vector-const Add against an NHWC image tensor biases channels after
+    the NHWC->NCHW remap (ADVICE r1 regression)."""
+    rs = np.random.RandomState(8)
+    b = GraphDefBuilder()
+    b.placeholder("img")
+    w = rs.randn(1, 1, 2, 4).astype(np.float32)
+    c = rs.randn(4).astype(np.float32)
+    b.const("w", w)
+    b.const("c", c)
+    b.op("conv", "Conv2D", ["img", "w"],
+         strides=b.attr_ints([1, 1, 1, 1]), padding=b.attr_s("SAME"),
+         data_format=b.attr_s("NHWC"))
+    b.op("out", "Add", ["conv", "c"])
+    model = TensorflowLoader(data=b.tobytes()).load(
+        inputs=["img"], outputs=["out"]
+    )
+    model.evaluate()
+    # framework tensors are NCHW; W == C == 4 makes a wrong axis silent
+    x = rs.randn(2, 2, 6, 4).astype(np.float32)
+    out = np.asarray(model.forward(x))
+    kernel = w[0, 0]
+    expect = np.einsum("nihw,io->nohw", x, kernel) + c[None, :, None, None]
+    np.testing.assert_allclose(out, expect, rtol=2e-3, atol=1e-5)
