@@ -1,22 +1,36 @@
-"""Benchmark — ResNet-50 training throughput on the real chip.
+"""Benchmark — ResNet-50 training throughput + MFU on the real chip.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "images/sec", "vs_baseline": R}
+  {"metric": ..., "value": N, "unit": "images/sec", "vs_baseline": R,
+   "mfu": M, "platform": ..., "device_kind": ..., "extras": {...},
+   "error": null | "..."}
 
-The metric is the BASELINE.json headline (ResNet-50 ImageNet
-images/sec/chip).  ``vs_baseline`` is measured against a hand-written
-plain-JAX ResNet-50 train step defined in this file (independent of the
-framework: raw pytree params, inline conv/BN calls, direct SGD tree
-update).  The reference repo ships no locally citable numbers
-(BASELINE.md), so raw JAX on the same chip is the honest baseline: the
-ratio isolates framework overhead — >= 1.0 means the bigdl_tpu module
-system, flat-parameter optimizer, and driver loop cost nothing over
-hand-rolled JAX.
+Robustness contract (VERDICT round-1 item 1 — the round must never be
+blind again):
+  * the measurement runs in a CHILD process with a hard deadline, so a
+    hanging TPU bring-up (observed: jax.devices() blocking >9 min when
+    the tunnel is down) cannot eat the bench;
+  * TPU init failure/timeout is retried once, then the bench falls back
+    to CPU with tiny shapes — clearly labelled via "platform" and
+    "error" — and still exits 0 with a full JSON line;
+  * every failure path emits JSON with an "error" field.
+
+The headline metric is BASELINE.json's (ResNet-50 ImageNet images/sec/
+chip).  ``vs_baseline`` compares against a hand-written plain-JAX
+ResNet-50 train step in this file (raw pytree params, inline conv/BN,
+direct SGD tree update): the reference repo ships no locally citable
+numbers (BASELINE.md), so raw JAX on the same chip is the honest
+baseline and the ratio isolates framework overhead.  ``mfu`` uses an
+analytic conv/fc FLOPs model (2*K*K*Cin*Cout*Hout*Wout MACs counted as
+2 flops, backward = 2x forward) against the chip's peak bf16 FLOPs.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
@@ -24,8 +38,72 @@ import numpy as np
 BATCH = 32
 IMG = 224
 N_CLASSES = 1000
-WARMUP = 3
 ITERS = 10
+
+# CPU fallback must finish on one core: tiny shapes, clearly labelled
+CPU_BATCH = 4
+CPU_IMG = 64
+CPU_ITERS = 3
+
+# peak dense bf16 FLOPs/s per chip generation (public spec sheets);
+# override with BENCH_PEAK_FLOPS when the kind is missing or wrong
+_PEAK_BF16 = {
+    "v4": 275e12,
+    "v5 lite": 197e12,
+    "v5litepod": 197e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v5": 459e12,
+    "v6 lite": 918e12,
+    "v6e": 918e12,
+}
+
+
+def _peak_flops(device_kind: str):
+    env = os.environ.get("BENCH_PEAK_FLOPS")
+    if env:
+        return float(env)
+    kind = (device_kind or "").lower()
+    for k in sorted(_PEAK_BF16, key=len, reverse=True):
+        if k in kind:
+            return _PEAK_BF16[k]
+    return None
+
+
+def _resnet50_cfg():
+    return [(64, 3, 1), (128, 4, 2), (256, 6, 2), (512, 3, 2)]
+
+
+def resnet50_flops_per_image(img: int = IMG) -> float:
+    """Analytic forward FLOPs (2*MACs) for the ResNet-50 in this file."""
+    flops = 0.0
+
+    def conv(cin, cout, k, h_in, stride):
+        nonlocal flops
+        h_out = -(-h_in // stride)  # SAME padding
+        flops += 2.0 * k * k * cin * cout * h_out * h_out
+        return h_out
+
+    h = conv(3, 64, 7, img, 2)          # stem
+    h = -(-h // 2)                       # 3x3/2 maxpool
+    cin = 64
+    for w, n, stride in _resnet50_cfg():
+        for i in range(n):
+            st = stride if i == 0 else 1
+            conv(cin, w, 1, h, 1)
+            h2 = conv(w, w, 3, h, st)
+            conv(w, w * 4, 1, h2, 1)
+            if i == 0:
+                conv(cin, w * 4, 1, h, st)
+            h = h2
+            cin = w * 4
+    flops += 2.0 * cin * N_CLASSES       # fc
+    return flops
+
+
+def train_step_flops_per_image(img: int = IMG) -> float:
+    """fwd + bwd; backward of a conv/matmul is ~2x its forward."""
+    return 3.0 * resnet50_flops_per_image(img)
 
 
 # --------------------------------------------------------------------------
@@ -61,9 +139,8 @@ def _baseline_resnet50_init(rng):
 
     conv_p("stem", 3, 64, 7)
     bn_p("stem_bn", 64)
-    cfg = [(64, 3, 1), (128, 4, 2), (256, 6, 2), (512, 3, 2)]
     cin = 64
-    for s, (w, n, stride) in enumerate(cfg):
+    for s, (w, n, stride) in enumerate(_resnet50_cfg()):
         for i in range(n):
             pfx = f"s{s}b{i}"
             conv_p(pfx + "c1", cin, w, 1)
@@ -118,8 +195,7 @@ def _baseline_forward(params, x):
         x, -jnp.inf, lax.max, (1, 1, 3, 3), (1, 1, 2, 2),
         [(0, 0), (0, 0), (1, 1), (1, 1)],
     )
-    cfg = [(64, 3, 1), (128, 4, 2), (256, 6, 2), (512, 3, 2)]
-    for s, (w, n, stride) in enumerate(cfg):
+    for s, (w, n, stride) in enumerate(_resnet50_cfg()):
         for i in range(n):
             pfx = f"s{s}b{i}"
             st = stride if i == 0 else 1
@@ -135,9 +211,9 @@ def _baseline_forward(params, x):
     return x @ params["fc"]["w"] + params["fc"]["b"]
 
 
-def _timed_scan_throughput(step_fn, carry, x, y):
-    """Run ITERS steps inside ONE jitted lax.scan and time the call: the
-    relay between this host and the chip adds per-call and per-buffer
+def _timed_scan_throughput(step_fn, carry, x, y, batch, iters):
+    """Run ``iters`` steps inside ONE jitted lax.scan and time the call:
+    the relay between this host and the chip adds per-call and per-buffer
     overheads that would otherwise dominate; a single call with one
     scalar output measures pure device throughput for both contenders.
     ``float()`` on the result is the barrier (block_until_ready returns
@@ -151,17 +227,17 @@ def _timed_scan_throughput(step_fn, carry, x, y):
             c, loss = step_fn(c, x, y)
             return c, loss
 
-        _, losses = lax.scan(body, carry, None, length=ITERS)
+        _, losses = lax.scan(body, carry, None, length=iters)
         return losses[-1]
 
     float(run(carry, x, y))  # compile + warmup
     t0 = time.perf_counter()
     float(run(carry, x, y))
     dt = time.perf_counter() - t0
-    return BATCH * ITERS / dt
+    return batch * iters / dt, dt / iters
 
 
-def _bench_baseline(x, y, compute_dtype=None):
+def _bench_baseline(x, y, batch, iters, compute_dtype=None):
     import jax
     import jax.numpy as jnp
 
@@ -187,10 +263,12 @@ def _bench_baseline(x, y, compute_dtype=None):
         p = jax.tree.map(lambda w, gw: w - 0.1 * gw, p, g)
         return p, loss
 
-    return _timed_scan_throughput(step, params, jnp.asarray(x), jnp.asarray(y))
+    return _timed_scan_throughput(
+        step, params, jnp.asarray(x), jnp.asarray(y), batch, iters
+    )
 
 
-def _bench_framework(x, y, compute_dtype=None):
+def _bench_framework(x, y, batch, iters, compute_dtype=None):
     import jax
 
     from bigdl_tpu.models import build_resnet_imagenet
@@ -203,7 +281,7 @@ def _bench_framework(x, y, compute_dtype=None):
     # the baseline's fused log_softmax)
     model.modules = model.modules[:-1]
     crit = CrossEntropyCriterion()
-    opt = LocalOptimizer(model, (x, y), crit, batch_size=BATCH)
+    opt = LocalOptimizer(model, (x, y), crit, batch_size=batch)
     opt.set_optim_method(SGD(learningrate=0.1))
     if compute_dtype is not None:
         opt.set_compute_dtype(compute_dtype)
@@ -232,30 +310,197 @@ def _bench_framework(x, y, compute_dtype=None):
         return (new_p, new_opt, new_mstate), loss
 
     return _timed_scan_throughput(
-        step, (params, opt_state, mod_state), jnp.asarray(x), jnp.asarray(y)
+        step, (params, opt_state, mod_state), jnp.asarray(x), jnp.asarray(y),
+        batch, iters,
     )
 
 
-def main():
-    x = np.random.RandomState(0).randn(BATCH, 3, IMG, IMG).astype(np.float32)
-    y = (np.random.RandomState(1).randint(0, N_CLASSES, BATCH) + 1).astype(
+def _bench_lenet(platform_batch=256, iters=20):
+    """Secondary config (BASELINE.md table): LeNet-5 / LocalOptimizer."""
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.models.lenet import build_lenet5
+    from bigdl_tpu.nn import ClassNLLCriterion
+    from bigdl_tpu.optim import SGD
+    from bigdl_tpu.optim.optimizer import LocalOptimizer
+
+    rs = np.random.RandomState(0)
+    x = rs.rand(platform_batch, 28, 28).astype(np.float32)
+    y = (rs.randint(0, 10, platform_batch) + 1).astype(np.float32)
+    model = build_lenet5()
+    opt = LocalOptimizer(model, (x, y), ClassNLLCriterion(),
+                         batch_size=platform_batch)
+    opt.set_optim_method(SGD(learningrate=0.05))
+    params = opt._init_params()
+    mod_state = model.state()
+    opt_state = opt._init_opt_state(params)
+    loss_fn = opt._loss_fn()
+    method = opt.optim_method
+    clipper = opt._clipper
+    rng = jax.random.key(0)
+
+    def step(carry, x, y):
+        p, opt_st, mstate = carry
+        (_, (loss, new_mstate)), grad = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(p, mstate, rng, x, y)
+        grad = clipper(grad)
+        new_p, new_opt = method.step(grad, p, opt_st)
+        return (new_p, new_opt, new_mstate), loss
+
+    ips, _ = _timed_scan_throughput(
+        step, (params, opt_state, mod_state), jnp.asarray(x), jnp.asarray(y),
+        platform_batch, iters,
+    )
+    return ips
+
+
+# --------------------------------------------------------------------------
+# child-process measurement
+# --------------------------------------------------------------------------
+
+
+def _run_child(platform: str):
+    """--run mode: initialize the requested platform and measure.
+    Prints the result JSON (marker-prefixed) on success; exits nonzero
+    with the error JSON on failure."""
+    import jax
+
+    if platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+        batch, img, iters = CPU_BATCH, CPU_IMG, CPU_ITERS
+    else:
+        # pin to the accelerator platform: never let a silent CPU
+        # fallback run full shapes and report them as the TPU headline
+        tpu_platform = os.environ.get("BENCH_TPU_PLATFORM")
+        if tpu_platform is None:
+            registered = "axon" if os.environ.get(
+                "JAX_PLATFORMS", ""
+            ).startswith("axon") else "tpu"
+            tpu_platform = registered
+        jax.config.update("jax_platforms", tpu_platform)
+        batch, img, iters = BATCH, IMG, ITERS
+
+    t0 = time.time()
+    devices = jax.devices()  # may raise / hang — parent enforces deadline
+    dev = devices[0]
+    init_s = round(time.time() - t0, 1)
+    if platform != "cpu" and dev.platform == "cpu":
+        raise RuntimeError(
+            f"requested accelerator platform but got {dev.platform!r}"
+        )
+
+    x = np.random.RandomState(0).randn(batch, 3, img, img).astype(np.float32)
+    y = (np.random.RandomState(1).randint(0, N_CLASSES, batch) + 1).astype(
         np.float32
     )
     # headline: the TPU-native recipe — bf16 fwd/bwd, f32 master params —
     # on both contenders; the ratio still isolates framework overhead
-    fw = _bench_framework(x, y, compute_dtype="bfloat16")
-    bl = _bench_baseline(x, y, compute_dtype="bfloat16")
-    print(
-        json.dumps(
-            {
-                "metric": "resnet50_train_images_per_sec_per_chip",
-                "value": round(fw, 2),
-                "unit": "images/sec",
-                "vs_baseline": round(fw / bl, 4),
-            }
+    fw, step_s = _bench_framework(x, y, batch, iters,
+                                  compute_dtype="bfloat16")
+    bl, _ = _bench_baseline(x, y, batch, iters, compute_dtype="bfloat16")
+
+    peak = _peak_flops(dev.device_kind)
+    mfu = None
+    if peak and dev.platform != "cpu":
+        mfu = round(train_step_flops_per_image(img) * fw / peak, 4)
+
+    try:
+        lenet_ips = _bench_lenet()
+    except Exception:  # secondary metric must not sink the bench
+        lenet_ips = None
+
+    result = {
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(fw, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(fw / bl, 4),
+        "mfu": mfu,
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "extras": {
+            "baseline_images_per_sec": round(bl, 2),
+            "step_time_s": round(step_s, 4),
+            "batch": batch,
+            "image_size": img,
+            "backend_init_s": init_s,
+            "train_flops_per_image": train_step_flops_per_image(img),
+            "lenet_local_images_per_sec":
+                round(lenet_ips, 1) if lenet_ips else None,
+        },
+        "error": None,
+    }
+    print("@@BENCH_RESULT@@" + json.dumps(result), flush=True)
+
+
+def _spawn(platform: str, timeout_s: float):
+    """Run the child; returns (result_dict | None, error_string | None)."""
+    cmd = [sys.executable, os.path.abspath(__file__), "--run", platform]
+    try:
+        proc = subprocess.run(
+            cmd,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            timeout=timeout_s,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            text=True,
         )
-    )
+    except subprocess.TimeoutExpired:
+        return None, f"{platform} child timed out after {int(timeout_s)}s"
+    for line in proc.stdout.splitlines():
+        if line.startswith("@@BENCH_RESULT@@"):
+            return json.loads(line[len("@@BENCH_RESULT@@"):]), None
+    tail = "\n".join(proc.stdout.splitlines()[-8:])
+    return None, f"{platform} child rc={proc.returncode}: {tail[-800:]}"
+
+
+def main():
+    deadline = float(os.environ.get("BENCH_TIMEOUT", "3300"))
+    t0 = time.time()
+    errors = []
+
+    # attempt 1 + 2: the real chip (retry once on transient bring-up
+    # failure — observed UNAVAILABLE from a down tunnel)
+    tpu_budget = float(os.environ.get("BENCH_TPU_TIMEOUT", "1200"))
+    result = None
+    for attempt in (1, 2):
+        remaining = deadline - (time.time() - t0) - 300  # reserve CPU time
+        if remaining < 120:
+            errors.append("no time left for TPU attempt")
+            break
+        result, err = _spawn("tpu", min(tpu_budget, remaining))
+        if result:
+            break
+        errors.append(f"attempt {attempt}: {err}")
+        time.sleep(15)
+
+    if result is None:
+        # CPU fallback: tiny shapes, labelled, still a full JSON line
+        remaining = max(120.0, deadline - (time.time() - t0) - 30)
+        result, err = _spawn("cpu", remaining)
+        if result:
+            result["error"] = "TPU unavailable — CPU fallback with tiny " \
+                "shapes (batch %d, %dpx): " % (CPU_BATCH, CPU_IMG) \
+                + " | ".join(errors)
+        else:
+            errors.append(err)
+            result = {
+                "metric": "resnet50_train_images_per_sec_per_chip",
+                "value": None,
+                "unit": "images/sec",
+                "vs_baseline": None,
+                "mfu": None,
+                "platform": None,
+                "device_kind": None,
+                "extras": {},
+                "error": " | ".join(errors),
+            }
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--run":
+        _run_child(sys.argv[2])
+    else:
+        main()

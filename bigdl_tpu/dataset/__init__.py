@@ -13,6 +13,7 @@ from bigdl_tpu.dataset.dataset import (
     LocalDataSet,
     ArrayDataSet,
     DistributedDataSet,
+    PartitionStreamDataSet,
     to_dataset,
 )
 from bigdl_tpu.dataset.sample import Sample, MiniBatch
@@ -25,6 +26,7 @@ from bigdl_tpu.dataset.transformer import (
 
 __all__ = [
     "DataSet", "LocalDataSet", "ArrayDataSet", "DistributedDataSet",
+    "PartitionStreamDataSet",
     "to_dataset", "Sample", "MiniBatch", "Transformer", "SampleToMiniBatch",
     "Shuffle", "Normalizer",
 ]

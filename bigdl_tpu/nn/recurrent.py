@@ -598,25 +598,21 @@ class ConvLSTMPeephole(Cell):
         h, c = carry
         gates = proj_t + self._conv(h, params["w_h"], h.dtype)
         i, f, g, o = jnp.split(gates, 4, axis=1)
+        import jax
+
         if self.with_peephole:
             pk = lambda k: params[k].astype(c.dtype).reshape(1, -1, 1, 1)
             i = i + pk("p_i") * c
             f = f + pk("p_f") * c
-        i = jax_sigmoid(i)
-        f = jax_sigmoid(f)
+        i = jax.nn.sigmoid(i)
+        f = jax.nn.sigmoid(f)
         g = jnp.tanh(g)
         c_new = f * c + i * g
         if self.with_peephole:
             o = o + params["p_o"].astype(c.dtype).reshape(1, -1, 1, 1) * c_new
-        o = jax_sigmoid(o)
+        o = jax.nn.sigmoid(o)
         h_new = o * jnp.tanh(c_new)
         return (h_new, c_new), h_new
 
     def __repr__(self):
         return f"ConvLSTMPeephole({self.input_size}, {self.output_size})"
-
-
-def jax_sigmoid(x):
-    import jax
-
-    return jax.nn.sigmoid(x)

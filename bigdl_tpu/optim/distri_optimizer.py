@@ -81,6 +81,7 @@ class DistriOptimizer(LocalOptimizer):
         # gradient blocks; bf16 is the TPU-native equivalent
         self.wire_dtype = wire_dtype
         self._pad = 0
+        self._warned_batch_sizes = set()
 
     # ------------------------------------------------------------ sharding
     def _init_params(self):
@@ -257,12 +258,60 @@ class DistriOptimizer(LocalOptimizer):
 
         return loss_fn
 
+    def _prepare_batch(self, inp, tgt):
+        """The P(data) input sharding needs the batch divisible by the
+        mesh; trim the remainder with a (once-per-size) warning, exactly
+        scaled: each shard keeps the same sample count, so the
+        mean-of-shard-means loss/grad stays the true batch mean.  A batch
+        smaller than the mesh is dropped outright."""
+        import logging
+
+        bs = np.asarray(inp).shape[0]
+        # per-process datasets yield LOCAL slices: divisibility is
+        # against this process's device count, not the global mesh
+        divisor = self.n_shards
+        if getattr(self.dataset, "per_process", False):
+            import jax
+
+            divisor = max(1, self.n_shards // jax.process_count())
+        rem = bs % divisor
+        if rem == 0:
+            return inp, tgt
+        log = logging.getLogger("bigdl_tpu.optim")
+        keep = bs - rem
+        warned = self._warned_batch_sizes
+        if bs not in warned:
+            warned.add(bs)
+            if keep == 0:
+                log.warning(
+                    "DistriOptimizer: dropping batch of %d samples — "
+                    "smaller than the %d-way device split", bs, divisor,
+                )
+            else:
+                log.warning(
+                    "DistriOptimizer: batch of %d not divisible by the "
+                    "%d-way device split — training on the first %d "
+                    "samples (last-partial-batch trim)", bs, divisor, keep,
+                )
+        if keep == 0:
+            return None
+        return inp[:keep], tgt[:keep]
+
     def _put_batch(self, inp, tgt):
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         jnp = _jnp()
         sh = NamedSharding(self.mesh, P(self.axis))
+        if getattr(self.dataset, "per_process", False) \
+                and jax.process_count() > 1:
+            # per-process shard -> global array without any host holding
+            # the full batch (reference: executors feed their own cached
+            # partition only)
+            return (
+                jax.make_array_from_process_local_data(sh, np.asarray(inp)),
+                jax.make_array_from_process_local_data(sh, np.asarray(tgt)),
+            )
         return (
             jax.device_put(jnp.asarray(inp), sh),
             jax.device_put(jnp.asarray(tgt), sh),

@@ -177,6 +177,12 @@ class BaseOptimizer:
         log.info("checkpoint saved at epoch %s iter %s", self.state["epoch"],
                  self.state["neval"])
 
+    def _prepare_batch(self, inp, tgt):
+        """Hook: adjust a host batch before device transfer, or return
+        None to drop it.  DistriOptimizer overrides to enforce mesh
+        divisibility."""
+        return inp, tgt
+
     def _run_validation(self, apply_fn=None):
         if self.validation_dataset is None or not self.validation_methods:
             return None
@@ -349,11 +355,30 @@ class LocalOptimizer(BaseOptimizer):
             # the chip runs the current step (native.PrefetchIterator)
             from bigdl_tpu.native import PrefetchIterator
 
-            for inp, tgt in PrefetchIterator(self.dataset.data(train=True)):
+            batches = iter(PrefetchIterator(self.dataset.data(train=True)))
+            batch_exhausted = False
+            while True:
+                # reference Metrics phases: the fused XLA step folds the
+                # collective phases ("put gradient"/"aggregate"/"send
+                # weights") into "computing time"; the host-side phases
+                # stay separately visible (SURVEY.md §5 Tracing)
+                t_wait = time.perf_counter()
+                try:
+                    inp, tgt = next(batches)
+                except StopIteration:
+                    batch_exhausted = True
+                    break
+                self.metrics.add("data wait time",
+                                 time.perf_counter() - t_wait)
+                prepared = self._prepare_batch(inp, tgt)
+                if prepared is None:
+                    continue  # dropped (e.g. sub-mesh partial batch)
+                inp, tgt = prepared
                 profiler.step()
-                t0 = time.perf_counter()
                 rng = jax.random.fold_in(base_key, self.state["neval"])
-                inp_d, tgt_d = self._put_batch(inp, tgt)
+                with self.metrics.timer("put batch time"):
+                    inp_d, tgt_d = self._put_batch(inp, tgt)
+                t0 = time.perf_counter()
                 pvar, opt_state, mod_state, loss = train_step(
                     pvar, opt_state, mod_state, rng, inp_d, tgt_d
                 )
@@ -379,23 +404,29 @@ class LocalOptimizer(BaseOptimizer):
                 if self.validation_trigger is not None and self.validation_trigger(
                     self.state
                 ):
-                    self._write_back(pvar, mod_state)
+                    with self.metrics.timer("write back time"):
+                        self._write_back(pvar, mod_state)
                     self._run_validation()
                     model.training()
                 if self.checkpoint_trigger is not None and self.checkpoint_trigger(
                     self.state
                 ):
-                    self._write_back(pvar, mod_state)
+                    with self.metrics.timer("write back time"):
+                        self._write_back(pvar, mod_state)
                     opt.state = opt_state
                     self._checkpoint()
                 if self.end_when(self.state):
                     stop = True
                     break
-            else:
+            if batch_exhausted and not stop:
                 # epoch finished
                 self.state["epoch_finished"] = epoch
                 self.state["epoch"] = epoch + 1
-                opt_state = {**opt_state, "epoch": opt_state["epoch"] + 1.0}
+                # in place: opt.state must stay the SAME dict object so a
+                # Plateau lr_scale poke from the validation below is seen
+                # by the next epoch's train_step
+                opt_state["epoch"] = opt_state["epoch"] + 1.0
+                opt.state = opt_state
                 log.info(
                     "Epoch %d done in %.1fs", epoch, time.time() - epoch_start
                 )
@@ -455,6 +486,16 @@ def Optimizer(
     ds = to_dataset(data, batch_size)
     if distributed is None:
         distributed = isinstance(ds, DistributedDataSet) or len(jax.devices()) > 1
+        if distributed and not isinstance(ds, DistributedDataSet):
+            # auto-promotion on device count alone can surprise on dev
+            # boxes with forced host devices — say so (the reference
+            # dispatches on dataset type only)
+            log.warning(
+                "Optimizer: %d devices visible — auto-selecting "
+                "DistriOptimizer; pass distributed=False (or a local "
+                "dataset on one device) for LocalOptimizer",
+                len(jax.devices()),
+            )
     if distributed:
         from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
 

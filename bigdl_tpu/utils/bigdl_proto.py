@@ -1,0 +1,539 @@
+"""bigdl.proto module interchange — ModulePersister / ModuleLoader.
+
+Rebuild of ⟦«bigdl»/utils/serializer/⟧ (ModuleSerializer, ModuleLoader,
+ModulePersister) against the reference protobuf schema
+⟦spark/dl/src/main/resources/serialization/bigdl.proto⟧ (SURVEY.md §2.1
+"Module serialization"; VERDICT round-1 item 3).
+
+The reference persists a module graph as one ``BigDLModule`` protobuf:
+``moduleType`` is the Scala class FQN, constructor arguments live in the
+``attr`` map (reflection-derived, Scala camelCase names), containers
+recurse through ``subModules``, parameters ride as ``BigDLTensor``s, and
+graph wiring uses ``preModules``/``nextModules`` name lists.  This file
+speaks that wire format with the generic protobuf codec from
+``utils/caffe.py`` — no generated code, no protoc.
+
+Name bridge: the rebuild's constructor args are snake_case spellings of
+the reference's camelCase (n_input_plane ⇄ nInputPlane), so attr names
+convert mechanically both ways; values that have no typed slot fall back
+to a STRING attr with ``subType="json"`` (a documented extension — a
+real BigDL reader would skip them, our loader round-trips them).
+
+⚠ Field numbers below are the upstream 0.x layout as best reconstructible
+with the reference mount empty this round (SURVEY.md evidence-status
+preamble); re-verify against the real bigdl.proto when the mount is
+populated (SURVEY.md §8).
+"""
+
+from __future__ import annotations
+
+import inspect
+import json
+import struct
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from bigdl_tpu.utils.caffe import (
+    _WireWriter,
+    _w_bool,
+    _w_float,
+    _w_floats,
+    _w_int,
+    _w_ints,
+    _w_msgs,
+    _w_str,
+    _w_strs,
+    parse_wire,
+)
+
+# ---------------------------------------------------------------- schema
+# DataType enum (bigdl.proto)
+DT_INT32 = 0
+DT_INT64 = 1
+DT_FLOAT = 2
+DT_DOUBLE = 3
+DT_STRING = 4
+DT_BOOL = 5
+DT_TENSOR = 10
+DT_MODULE = 13
+DT_ARRAY_VALUE = 15
+
+# BigDLModule fields
+_M_NAME = 1
+_M_SUBMODULES = 2
+_M_WEIGHT = 3
+_M_BIAS = 4
+_M_PREMODULES = 5
+_M_NEXTMODULES = 6
+_M_MODULETYPE = 7
+_M_ATTR = 8            # map<string, AttrValue>
+_M_VERSION = 9
+_M_TRAIN = 10
+_M_NAMEPOSTFIX = 11
+_M_ID = 12
+_M_HASPARAMETERS = 15
+_M_PARAMETERS = 16
+
+# BigDLTensor fields
+_T_DATATYPE = 1
+_T_SIZE = 2
+_T_STRIDE = 3
+_T_OFFSET = 4
+_T_DIMENSION = 5
+_T_NELEMENTS = 6
+_T_ISSCALAR = 7
+_T_STORAGE = 8
+_T_ID = 9
+_T_TENSORTYPE = 10
+
+# TensorStorage fields
+_S_DATATYPE = 1
+_S_FLOAT_DATA = 2
+_S_DOUBLE_DATA = 3
+_S_INT32_DATA = 4
+_S_INT64_DATA = 5
+_S_ID = 9
+
+# AttrValue fields
+_A_DATATYPE = 1
+_A_SUBTYPE = 2
+_A_INT32 = 3
+_A_INT64 = 4
+_A_FLOAT = 5
+_A_DOUBLE = 6
+_A_STRING = 7
+_A_BOOL = 8
+_A_TENSOR = 10
+_A_MODULE = 13
+_A_ARRAY = 15
+
+# ArrayValue fields
+_AR_SIZE = 1
+_AR_DATATYPE = 2
+_AR_I32 = 3
+_AR_I64 = 4
+_AR_FLT = 5
+_AR_DBL = 6
+_AR_STR = 7
+_AR_BOOL = 8
+
+_SCALA_PKG = "com.intel.analytics.bigdl.nn."
+_VERSION = "0.13.0"
+
+
+# ---------------------------------------------------------- name bridge
+def snake_to_camel(name: str) -> str:
+    parts = name.split("_")
+    return parts[0] + "".join(p.title() for p in parts[1:])
+
+
+def camel_to_snake(name: str) -> str:
+    out = []
+    for ch in name:
+        if ch.isupper():
+            out.append("_")
+            out.append(ch.lower())
+        else:
+            out.append(ch)
+    return "".join(out)
+
+
+# reference attr spellings that are not mechanical camelCase of ours
+_TO_SCALA = {
+    "n_input_plane": "nInputPlane",
+    "n_output_plane": "nOutputPlane",
+    "n_group": "nGroup",
+    "n_output": "nOutput",
+    "input_size": "inputSize",
+    "hidden_size": "hiddenSize",
+    "output_size": "outputSize",
+    "init_p": "initP",
+    # Python keyword collision: SoftShrink/HardShrink's Scala arg
+    "lambda_": "lambda",
+}
+_FROM_SCALA = {v: k for k, v in _TO_SCALA.items()}
+
+
+def _attr_to_scala(name: str) -> str:
+    return _TO_SCALA.get(name, snake_to_camel(name))
+
+
+def _attr_from_scala(name: str) -> str:
+    return _FROM_SCALA.get(name, camel_to_snake(name))
+
+
+# ------------------------------------------------------------- tensors
+def _write_tensor(arr: np.ndarray) -> _WireWriter:
+    arr = np.asarray(arr)
+    t = _WireWriter()
+    t.varint(_T_DATATYPE, DT_FLOAT)
+    for s in arr.shape:
+        t.varint(_T_SIZE, int(s))
+    # torch-style contiguous strides
+    stride = []
+    acc = 1
+    for s in reversed(arr.shape):
+        stride.insert(0, acc)
+        acc *= int(s)
+    for s in stride:
+        t.varint(_T_STRIDE, int(s))
+    t.varint(_T_OFFSET, 1)  # reference tensors are 1-based offset
+    t.varint(_T_DIMENSION, arr.ndim)
+    t.varint(_T_NELEMENTS, int(arr.size))
+    if arr.ndim == 0:
+        t.varint(_T_ISSCALAR, 1)
+    st = _WireWriter()
+    st.varint(_S_DATATYPE, DT_FLOAT)
+    st.packed_floats(_S_FLOAT_DATA, np.asarray(arr, "<f4").reshape(-1))
+    t.message(_T_STORAGE, st)
+    t.varint(_T_TENSORTYPE, 0)  # DENSE
+    return t
+
+
+def _read_tensor(msg: Dict[int, list]) -> Optional[np.ndarray]:
+    storage = _w_msgs(msg, _T_STORAGE)
+    if not storage:
+        return None
+    data = _w_floats(storage[0], _S_FLOAT_DATA)
+    if data.size == 0:
+        dd = storage[0].get(_S_DOUBLE_DATA)
+        if dd:
+            data = np.concatenate(
+                [np.frombuffer(v, "<f8") for _, v in dd]
+            ).astype(np.float32)
+    size = _w_ints(msg, _T_SIZE)
+    if size and int(np.prod(size)) == data.size:
+        data = data.reshape(size)
+    return data
+
+
+# ---------------------------------------------------------- attr values
+def _write_attr(value) -> _WireWriter:
+    a = _WireWriter()
+    if isinstance(value, bool):
+        a.varint(_A_DATATYPE, DT_BOOL)
+        a.varint(_A_BOOL, int(value))
+    elif isinstance(value, (int, np.integer)):
+        a.varint(_A_DATATYPE, DT_INT32)
+        a.varint(_A_INT32, int(value))
+    elif isinstance(value, (float, np.floating)):
+        a.varint(_A_DATATYPE, DT_DOUBLE)
+        a.parts.append(a._varint(_A_DOUBLE << 3 | 1))  # fixed64
+        a.parts.append(struct.pack("<d", float(value)))
+    elif isinstance(value, str):
+        a.varint(_A_DATATYPE, DT_STRING)
+        a.string(_A_STRING, value)
+    elif isinstance(value, np.ndarray):
+        a.varint(_A_DATATYPE, DT_TENSOR)
+        a.message(_A_TENSOR, _write_tensor(value))
+    elif isinstance(value, (list, tuple)) and _is_flat_numeric(value):
+        a.varint(_A_DATATYPE, DT_ARRAY_VALUE)
+        arr = _WireWriter()
+        arr.varint(_AR_SIZE, len(value))
+        if all(isinstance(v, (int, np.integer))
+               and not isinstance(v, bool) for v in value):
+            arr.varint(_AR_DATATYPE, DT_INT32)
+            for v in value:
+                arr.varint(_AR_I32, int(v))
+        else:
+            arr.varint(_AR_DATATYPE, DT_DOUBLE)
+            for v in value:
+                arr.parts.append(arr._varint(_AR_DBL << 3 | 1))
+                arr.parts.append(struct.pack("<d", float(v)))
+        a.message(_A_ARRAY, arr)
+    else:
+        # documented extension: JSON spill for configs with no typed slot
+        a.varint(_A_DATATYPE, DT_STRING)
+        a.string(_A_SUBTYPE, "json")
+        a.string(_A_STRING, json.dumps(value))
+    return a
+
+
+def _is_flat_numeric(value) -> bool:
+    return all(
+        isinstance(v, (int, float, np.integer, np.floating))
+        for v in value
+    ) and len(value) > 0
+
+
+def _read_attr(msg: Dict[int, list]):
+    dt = _w_int(msg, _A_DATATYPE, DT_STRING)
+    if dt == DT_BOOL:
+        return bool(_w_int(msg, _A_BOOL, 0))
+    if dt == DT_INT32:
+        return _w_int(msg, _A_INT32, 0)
+    if dt == DT_INT64:
+        return _w_int(msg, _A_INT64, 0)
+    if dt == DT_FLOAT:
+        return _w_float(msg, _A_FLOAT, 0.0)
+    if dt == DT_DOUBLE:
+        raws = msg.get(_A_DOUBLE)
+        if raws:
+            return struct.unpack("<d", raws[-1][1])[0]
+        return 0.0
+    if dt == DT_STRING:
+        s = _w_str(msg, _A_STRING, "")
+        if _w_str(msg, _A_SUBTYPE) == "json":
+            return json.loads(s)
+        return s
+    if dt == DT_TENSOR:
+        tensors = _w_msgs(msg, _A_TENSOR)
+        return _read_tensor(tensors[0]) if tensors else None
+    if dt == DT_ARRAY_VALUE:
+        arrays = _w_msgs(msg, _A_ARRAY)
+        if not arrays:
+            return []
+        arr = arrays[0]
+        adt = _w_int(arr, _AR_DATATYPE, DT_INT32)
+        if adt == DT_INT32:
+            return _w_ints(arr, _AR_I32)
+        if adt == DT_DOUBLE:
+            out = []
+            for wt, v in arr.get(_AR_DBL, []):
+                if wt == 1:  # fixed64
+                    out.append(struct.unpack("<d", v)[0])
+                else:  # packed
+                    out.extend(np.frombuffer(v, "<f8").tolist())
+            return out
+        if adt == DT_FLOAT:
+            return _w_floats(arr, _AR_FLT).tolist()
+        if adt == DT_STRING:
+            return _w_strs(arr, _AR_STR)
+    return None
+
+
+# ------------------------------------------------------------ persister
+class ModulePersister:
+    """Reference: ModulePersister.saveToFile — serialize a module tree to
+    the bigdl.proto wire format."""
+
+    @staticmethod
+    def save(module, path: str) -> str:
+        data = ModulePersister.to_bytes(module)
+        with open(path, "wb") as f:
+            f.write(data)
+        return path
+
+    @staticmethod
+    def to_bytes(module) -> bytes:
+        return _module_to_writer(module).tobytes()
+
+
+def _module_to_writer(module, name_counts=None) -> _WireWriter:
+    from bigdl_tpu.nn.graph import Graph
+    from bigdl_tpu.nn.module import Container
+
+    w = _WireWriter()
+    w.string(_M_NAME, module.get_name())
+    w.string(_M_MODULETYPE, _SCALA_PKG + type(module).__name__)
+    w.string(_M_VERSION, _VERSION)
+    w.varint(_M_TRAIN, int(module.is_training))
+
+    # constructor attrs
+    for key, value in module.get_config().items():
+        entry = _WireWriter()
+        entry.string(1, _attr_to_scala(key))
+        entry.message(2, _write_attr(value))
+        w.message(_M_ATTR, entry)
+
+    if isinstance(module, Graph):
+        _write_graph(w, module)
+        return w
+
+    if isinstance(module, Container):
+        for child in module.modules:
+            w.message(_M_SUBMODULES, _module_to_writer(child))
+        return w
+
+    # leaf parameters: weight/bias ride the dedicated fields when the
+    # module uses the classic pair; everything else via `parameters`
+    params = [(n, getattr(module, n)) for n in module.param_names
+              if getattr(module, n, None) is not None]
+    if params:
+        w.varint(_M_HASPARAMETERS, 1)
+    for pname, arr in params:
+        if pname == "weight":
+            w.message(_M_WEIGHT, _write_tensor(np.asarray(arr)))
+        elif pname == "bias":
+            w.message(_M_BIAS, _write_tensor(np.asarray(arr)))
+        else:
+            w.message(_M_PARAMETERS, _write_tensor(np.asarray(arr)))
+    return w
+
+
+def _write_graph(w: _WireWriter, graph) -> None:
+    """Graph wiring via preModules/nextModules name lists (reference:
+    StaticGraph serialization)."""
+    # assign unique names
+    names = {}
+    for i, node in enumerate(graph._topo):
+        base = node.module.get_name()
+        names[node.id] = f"{base}#{i}"
+    for node in graph._topo:
+        sub = _module_to_writer(node.module)
+        sub.string(_M_NAMEPOSTFIX, names[node.id])
+        for p in node.prev_nodes:
+            sub.string(_M_PREMODULES, names[p.id])
+        for nxt in getattr(node, "next_nodes", []):
+            sub.string(_M_NEXTMODULES, names[nxt.id])
+        w.message(_M_SUBMODULES, sub)
+    # record input/output node names as attrs
+    for key, nodes in (("graphInputs", graph.input_nodes),
+                       ("graphOutputs", graph.output_nodes)):
+        entry = _WireWriter()
+        entry.string(1, key)
+        val = _WireWriter()
+        val.varint(_A_DATATYPE, DT_ARRAY_VALUE)
+        arr = _WireWriter()
+        arr.varint(_AR_SIZE, len(nodes))
+        arr.varint(_AR_DATATYPE, DT_STRING)
+        for n in nodes:
+            arr.string(_AR_STR, names[n.id])
+        val.message(_A_ARRAY, arr)
+        entry.message(2, val)
+        w.message(_M_ATTR, entry)
+
+
+# -------------------------------------------------------------- loader
+class ModuleLoader:
+    """Reference: ModuleLoader.loadFromFile — parse the bigdl.proto wire
+    format back into a live module tree."""
+
+    @staticmethod
+    def load(path: str):
+        with open(path, "rb") as f:
+            data = f.read()
+        return ModuleLoader.from_bytes(data)
+
+    @staticmethod
+    def from_bytes(data: bytes):
+        return _module_from_fields(parse_wire(data))
+
+
+def _class_for(module_type: str):
+    from bigdl_tpu.utils.serializer import _build_registry
+
+    cls_name = module_type.rsplit(".", 1)[-1]
+    reg = _build_registry()
+    if cls_name not in reg:
+        raise KeyError(
+            f"unknown module type {module_type!r}; register_module() "
+            "custom layers before loading"
+        )
+    return reg[cls_name]
+
+
+def _construct(cls, attrs: dict):
+    """Build cls from the attr map, keeping only args the constructor
+    knows (the reference's reflection does the same per converter)."""
+    sig = inspect.signature(cls.__init__)
+    accepted = {
+        k for k in sig.parameters if k not in ("self", "args", "kwargs")
+    }
+    var_kw = any(
+        p.kind == inspect.Parameter.VAR_KEYWORD
+        for p in sig.parameters.values()
+    )
+    kwargs = {}
+    for k, v in attrs.items():
+        if k in accepted or var_kw:
+            kwargs[k] = v
+    return cls(**kwargs)
+
+
+def _module_from_fields(f: Dict[int, list]):
+    from bigdl_tpu.nn.graph import Graph, Node
+    from bigdl_tpu.nn.module import Container
+
+    module_type = _w_str(f, _M_MODULETYPE, "")
+    cls = _class_for(module_type)
+    attrs = {}
+    raw_attrs = {}
+    for entry in _w_msgs(f, _M_ATTR):
+        key = _w_str(entry, 1, "")
+        vals = _w_msgs(entry, 2)
+        if not vals:
+            continue
+        raw_attrs[key] = _read_attr(vals[0])
+        attrs[_attr_from_scala(key)] = raw_attrs[key]
+
+    subs = _w_msgs(f, _M_SUBMODULES)
+    if issubclass(cls, Graph):
+        module = _graph_from_fields(f, subs, raw_attrs)
+    else:
+        module = _construct(cls, attrs)
+        if issubclass(cls, Container) and subs:
+            module.modules = []
+            for sub in subs:
+                module.modules.append(_module_from_fields(sub))
+
+    name = _w_str(f, _M_NAME)
+    if name and "@" not in name:
+        module.set_name(name)
+    if not _w_bool(f, _M_TRAIN, True):
+        module.evaluate()
+
+    # parameters back in declaration order
+    if not issubclass(cls, (Container, Graph)):
+        import jax.numpy as jnp
+
+        for pname in getattr(module, "param_names", ()):
+            cur = getattr(module, pname, None)
+            if cur is None:
+                continue
+            if pname == "weight":
+                msgs = _w_msgs(f, _M_WEIGHT)
+            elif pname == "bias":
+                msgs = _w_msgs(f, _M_BIAS)
+            else:
+                msgs = None
+            if msgs:
+                arr = _read_tensor(msgs[0])
+                if arr is not None:
+                    setattr(module, pname, jnp.asarray(
+                        arr.reshape(np.asarray(cur).shape)))
+        others = [n for n in getattr(module, "param_names", ())
+                  if n not in ("weight", "bias")
+                  and getattr(module, n, None) is not None]
+        extra = _w_msgs(f, _M_PARAMETERS)
+        for pname, msg in zip(others, extra):
+            arr = _read_tensor(msg)
+            if arr is not None:
+                cur = getattr(module, pname)
+                setattr(module, pname, jnp.asarray(
+                    arr.reshape(np.asarray(cur).shape)))
+    return module
+
+
+def _graph_from_fields(f, subs, raw_attrs):
+    from bigdl_tpu.nn.graph import Graph, Node
+
+    nodes = {}
+    order = []
+    wiring = []
+    for sub in subs:
+        mod = _module_from_fields(sub)
+        post = _w_str(sub, _M_NAMEPOSTFIX, "")
+        prevs = _w_strs(sub, _M_PREMODULES)
+        nodes[post] = Node(mod, [])
+        order.append(post)
+        wiring.append((post, prevs))
+    for post, prevs in wiring:
+        node = nodes[post]
+        for p in prevs:
+            node.prev_nodes.append(nodes[p])
+    inputs = [nodes[n] for n in raw_attrs.get("graphInputs", [])]
+    outputs = [nodes[n] for n in raw_attrs.get("graphOutputs", [])]
+    return Graph(inputs, outputs)
+
+
+# -------------------------------------------------------- parity names
+def save_module_proto(module, path: str) -> str:
+    """Reference spelling: Module.saveModule(path) (protobuf format)."""
+    return ModulePersister.save(module, path)
+
+
+def load_module_proto(path: str):
+    """Reference spelling: Module.loadModule(path)."""
+    return ModuleLoader.load(path)

@@ -117,7 +117,15 @@ def spec_to_module(spec: dict) -> AbstractModule:
 
 # ------------------------------------------------------------- save / load
 def save_module(module: AbstractModule, path: str):
-    """Reference: Module.saveModule(path) via ModulePersister."""
+    """Reference: Module.saveModule(path) via ModulePersister.
+
+    ``.bigdl`` paths write the reference's protobuf interchange format
+    (utils/bigdl_proto.py); anything else uses the fast native JSON+NPZ
+    container."""
+    if path.endswith(".bigdl"):
+        from bigdl_tpu.utils.bigdl_proto import ModulePersister
+
+        return ModulePersister.save(module, path)
     import jax
 
     spec = module_to_spec(module)
@@ -135,12 +143,19 @@ def save_module(module: AbstractModule, path: str):
 
 
 def load_module(path: str) -> AbstractModule:
-    """Reference: Module.loadModule(path) via ModuleLoader."""
+    """Reference: Module.loadModule(path) via ModuleLoader.  Sniffs the
+    container: zip magic = JSON+NPZ, anything else = bigdl.proto."""
     import jax
     import jax.numpy as jnp
 
     if not path.endswith(".npz") and os.path.exists(path + ".npz"):
         path = path + ".npz"
+    with open(path, "rb") as fh:
+        magic = fh.read(2)
+    if magic != b"PK":  # not a zip -> protobuf interchange
+        from bigdl_tpu.utils.bigdl_proto import ModuleLoader
+
+        return ModuleLoader.load(path)
     data = np.load(path)
     spec = json.loads(bytes(data["__spec__"]).decode("utf-8"))
     module = spec_to_module(spec)

@@ -1,0 +1,217 @@
+"""bigdl.proto interchange specs (VERDICT r1 item 3).
+
+Round-trips the module tree through the reference protobuf wire format
+(utils/bigdl_proto.py) and — key — loads a HAND-BUILT fixture whose
+bytes are written by an independent encoder in this file using the
+reference's Scala attribute spellings (nInputPlane, inputSize, ...), the
+closest available stand-in for a real BigDL 0.x saved model while the
+reference mount is empty (SURVEY.md evidence-status preamble).
+"""
+
+import struct
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import bigdl_tpu.nn as N
+from bigdl_tpu.utils.bigdl_proto import (
+    ModuleLoader,
+    ModulePersister,
+    load_module_proto,
+    save_module_proto,
+)
+
+
+def _roundtrip(module, x, tmp_path, name="m.bigdl"):
+    module.evaluate()
+    out1 = np.asarray(module.forward(x))
+    path = save_module_proto(module, str(tmp_path / name))
+    loaded = load_module_proto(path)
+    loaded.evaluate()
+    out2 = np.asarray(loaded.forward(x))
+    np.testing.assert_allclose(out1, out2, rtol=1e-5, atol=1e-6)
+    return loaded
+
+
+def test_roundtrip_mlp(tmp_path):
+    m = N.Sequential().add(N.Linear(4, 8)).add(N.ReLU()) \
+        .add(N.Linear(8, 2)).add(N.LogSoftMax())
+    _roundtrip(m, jnp.ones((3, 4)), tmp_path)
+
+
+def test_roundtrip_convnet(tmp_path):
+    m = N.Sequential().add(N.SpatialConvolution(1, 4, 3, 3)) \
+        .add(N.ReLU()).add(N.SpatialMaxPooling(2, 2, 2, 2)) \
+        .add(N.Reshape([4 * 3 * 3])).add(N.Linear(36, 2))
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 1, 8, 8), jnp.float32)
+    _roundtrip(m, x, tmp_path)
+
+
+def test_roundtrip_graph(tmp_path):
+    inp = N.Input()
+    a = N.Linear(4, 8)(inp)
+    b1 = N.ReLU()(a)
+    b2 = N.Tanh()(a)
+    merged = N.CAddTable()(b1, b2)
+    out = N.Linear(8, 2)(merged)
+    g = N.Graph(inp, out)
+    _roundtrip(g, jnp.ones((3, 4)), tmp_path)
+
+
+def test_roundtrip_recurrent(tmp_path):
+    m = N.Sequential().add(N.Recurrent().add(N.LSTM(4, 6))) \
+        .add(N.TimeDistributed(N.Linear(6, 3)))
+    _roundtrip(m, jnp.ones((2, 5, 4)), tmp_path)
+
+
+def test_parity_name_dispatch(tmp_path):
+    """save_module/load_module route .bigdl paths through the proto
+    format (Module.saveModule / Module.loadModule parity)."""
+    from bigdl_tpu.utils.serializer import load_module, save_module
+
+    m = N.Sequential().add(N.Linear(3, 2))
+    x = jnp.ones((1, 3))
+    m.evaluate()
+    out1 = np.asarray(m.forward(x))
+    path = save_module(m, str(tmp_path / "model.bigdl"))
+    with open(path, "rb") as f:
+        assert f.read(2) != b"PK"  # protobuf, not npz
+    loaded = load_module(path)
+    loaded.evaluate()
+    np.testing.assert_allclose(out1, np.asarray(loaded.forward(x)),
+                               rtol=1e-6)
+
+
+def test_registry_sample_roundtrip(tmp_path):
+    """A broad sample of the layer registry survives the proto wire."""
+    rs = np.random.RandomState(3)
+    v = jnp.asarray(rs.randn(2, 6), jnp.float32)
+    img = jnp.asarray(rs.randn(2, 3, 8, 8), jnp.float32)
+    cases = [
+        (N.Linear(6, 4), v),
+        (N.SpatialConvolution(3, 4, 3, 3, 1, 1, 1, 1), img),
+        (N.SpatialDilatedConvolution(3, 4, 3, 3, 1, 1, 2, 2, 2, 2), img),
+        (N.BatchNormalization(6), v),
+        (N.SpatialBatchNormalization(3), img),
+        (N.LookupTable(10, 4), jnp.asarray([[1.0, 2.0]])),
+        (N.PReLU(), v),
+        (N.CMul((6,)), v),
+        (N.CAdd((6,)), v),
+        (N.SoftShrink(0.3), v),
+        (N.VolumetricConvolution(2, 3, 2, 2, 2),
+         jnp.asarray(rs.randn(1, 2, 4, 5, 5), jnp.float32)),
+        (N.LocallyConnected1D(5, 6, 4, 3),
+         jnp.asarray(rs.randn(2, 5, 6), jnp.float32)),
+        (N.Reshape([3, 2]), v),
+        (N.Dropout(0.5), v),
+    ]
+    for i, (mod, x) in enumerate(cases):
+        _roundtrip(mod, x, tmp_path, f"layer{i}.bigdl")
+
+
+# --------------------------------------------------------------------------
+# hand-built fixture with reference Scala spellings
+# --------------------------------------------------------------------------
+
+
+def _vint(x):
+    out = bytearray()
+    while True:
+        b = x & 0x7F
+        x >>= 7
+        if x:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _field(fno, wt, payload):
+    return _vint(fno << 3 | wt) + payload
+
+
+def _bytes_field(fno, b):
+    return _field(fno, 2, _vint(len(b)) + b)
+
+
+def _str_field(fno, s):
+    return _bytes_field(fno, s.encode())
+
+
+def _varint_field(fno, v):
+    return _field(fno, 0, _vint(v))
+
+
+def _tensor_msg(arr):
+    arr = np.asarray(arr, np.float32)
+    storage = _varint_field(1, 2)  # datatype FLOAT
+    storage += _bytes_field(2, arr.astype("<f4").tobytes())  # packed floats
+    t = _varint_field(1, 2)  # datatype FLOAT
+    for s in arr.shape:
+        t += _varint_field(2, s)
+    t += _varint_field(5, arr.ndim)
+    t += _varint_field(6, arr.size)
+    t += _bytes_field(8, storage)
+    return t
+
+
+def _attr_int(v):
+    return _varint_field(1, 0) + _varint_field(3, v)  # INT32
+
+
+def _attr_bool(v):
+    return _varint_field(1, 5) + _varint_field(8, int(v))  # BOOL
+
+
+def _attr_entry(key, attr_bytes):
+    return _bytes_field(8, _str_field(1, key) + _bytes_field(2, attr_bytes))
+
+
+def test_hand_built_scala_fixture_loads(tmp_path):
+    """A Sequential(Linear(3,2)) written byte-by-byte here, with the
+    reference's Scala attr names — the loader must reconstruct it and
+    match a manual matmul."""
+    rs = np.random.RandomState(7)
+    w = rs.randn(2, 3).astype(np.float32)  # reference layout (out, in)
+    b = rs.randn(2).astype(np.float32)
+
+    linear = b""
+    linear += _str_field(1, "fc1")                         # name
+    linear += _str_field(
+        7, "com.intel.analytics.bigdl.nn.Linear")          # moduleType
+    linear += _str_field(9, "0.13.0")                      # version
+    linear += _attr_entry("inputSize", _attr_int(3))
+    linear += _attr_entry("outputSize", _attr_int(2))
+    linear += _attr_entry("withBias", _attr_bool(True))
+    linear += _bytes_field(3, _tensor_msg(w))              # weight
+    linear += _bytes_field(4, _tensor_msg(b))              # bias
+    linear += _varint_field(15, 1)                         # hasParameters
+
+    seq = b""
+    seq += _str_field(1, "seq")
+    seq += _str_field(7, "com.intel.analytics.bigdl.nn.Sequential")
+    seq += _str_field(9, "0.13.0")
+    seq += _bytes_field(2, linear)                         # subModules
+
+    path = tmp_path / "scala_fixture.bigdl"
+    path.write_bytes(seq)
+
+    model = ModuleLoader.load(str(path))
+    model.evaluate()
+    assert type(model).__name__ == "Sequential"
+    fc = model.modules[0]
+    assert type(fc).__name__ == "Linear"
+    assert fc.get_name() == "fc1"
+
+    x = rs.randn(4, 3).astype(np.float32)
+    out = np.asarray(model.forward(jnp.asarray(x)))
+    np.testing.assert_allclose(out, x @ w.T + b, rtol=1e-5, atol=1e-6)
+
+
+def test_unknown_module_type_raises(tmp_path):
+    msg = _str_field(7, "com.intel.analytics.bigdl.nn.NoSuchLayer")
+    p = tmp_path / "bad.bigdl"
+    p.write_bytes(msg)
+    with pytest.raises(KeyError, match="NoSuchLayer"):
+        ModuleLoader.load(str(p))

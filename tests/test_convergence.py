@@ -1,0 +1,109 @@
+"""Convergence gates (VERDICT r1 item 8; SURVEY.md §4.6).
+
+The reference pins end-to-end training quality with LeNet-on-MNIST
+accuracy-threshold specs and PTB perplexity-decreasing specs.  This box
+has zero egress, so:
+
+* the LeNet gate trains on REAL handwritten digits — sklearn's bundled
+  load_digits scans (1797 genuine 8x8 handwriting samples, upscaled to
+  28x28) — written to genuine MNIST idx files and ingested through the
+  ``load_mnist`` idx reader, so the real-file path is exercised
+  end-to-end (VERDICT r1 weak 5);
+* the PTB gate trains the LSTM LM on the deterministic Markov stream and
+  must beat a fixed perplexity bar far below the uniform baseline.
+
+Both are tagged slow (reference: integration-tagged specs, §4.7).
+"""
+
+import gzip
+import os
+import struct
+
+import numpy as np
+import pytest
+
+
+def _write_idx(dirname, images, labels, prefix):
+    """Write genuine MNIST idx3/idx1 (gzip) files."""
+    names = {
+        "train": ("train-images-idx3-ubyte", "train-labels-idx1-ubyte"),
+        "test": ("t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte"),
+    }[prefix]
+    img_p = os.path.join(dirname, names[0] + ".gz")
+    lbl_p = os.path.join(dirname, names[1] + ".gz")
+    n, rows, cols = images.shape
+    with gzip.open(img_p, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, n, rows, cols))
+        f.write(np.ascontiguousarray(images, np.uint8).tobytes())
+    with gzip.open(lbl_p, "wb") as f:
+        f.write(struct.pack(">II", 2049, n))
+        f.write(np.ascontiguousarray(labels, np.uint8).tobytes())
+
+
+def _digits_as_mnist():
+    """Real handwriting (sklearn load_digits) -> 28x28 uint8 MNIST-alikes."""
+    from sklearn.datasets import load_digits
+
+    d = load_digits()
+    imgs = d.images  # (1797, 8, 8) float 0..16
+    up = np.repeat(np.repeat(imgs, 4, axis=1), 4, axis=2)  # 32x32
+    up = up[:, 2:-2, 2:-2]                                 # center 28x28
+    up = np.clip(up * (255.0 / 16.0), 0, 255).astype(np.uint8)
+    return up, d.target.astype(np.uint8)
+
+
+@pytest.mark.slow
+def test_lenet_real_digit_idx_convergence(tmp_path):
+    """LeNet-5 on real handwritten digits through the idx-file reader
+    must reach >= 97% val accuracy in bounded steps."""
+    from bigdl_tpu.common import RandomGenerator
+    from bigdl_tpu.dataset import ArrayDataSet
+    from bigdl_tpu.dataset.mnist import load_mnist, normalize
+    from bigdl_tpu.models.lenet import build_lenet5
+    from bigdl_tpu.nn import ClassNLLCriterion
+    from bigdl_tpu.optim import (
+        LocalOptimizer, SGD, Top1Accuracy, Trigger,
+    )
+    from bigdl_tpu.optim.evaluator import evaluate_dataset
+
+    RandomGenerator.RNG.set_seed(1)
+    images, labels = _digits_as_mnist()
+    rs = np.random.RandomState(0)
+    order = rs.permutation(len(images))
+    images, labels = images[order], labels[order]
+    n_train = 1500
+    _write_idx(str(tmp_path), images[:n_train], labels[:n_train], "train")
+    _write_idx(str(tmp_path), images[n_train:], labels[n_train:], "test")
+
+    # through the real idx ingestion path
+    x_train, y_train = load_mnist(str(tmp_path), "train")
+    x_test, y_test = load_mnist(str(tmp_path), "test")
+    assert x_train.shape == (n_train, 28, 28)
+    x_train, x_test = normalize(x_train), normalize(x_test)
+
+    model = build_lenet5()
+    opt = LocalOptimizer(model, (x_train, y_train), ClassNLLCriterion(),
+                         batch_size=128)
+    opt.set_optim_method(SGD(learningrate=0.15, momentum=0.9))
+    opt.set_end_when(Trigger.max_epoch(25))
+    trained = opt.optimize()
+
+    val_ds = ArrayDataSet(x_test, y_test, 128)
+    (acc,) = evaluate_dataset(trained, val_ds, [Top1Accuracy()])
+    value, _ = acc.result()
+    assert value >= 0.97, f"val accuracy {value:.4f} < 0.97"
+
+
+@pytest.mark.slow
+def test_ptb_lstm_perplexity_gate():
+    """The PTB LSTM recipe must push perplexity far below the uniform
+    baseline (vocab 100 -> uniform ppl 100) within 3 epochs."""
+    from bigdl_tpu.common import RandomGenerator
+    from bigdl_tpu.models.rnn import train_ptb
+
+    RandomGenerator.RNG.set_seed(2)
+    _, _, ppl = train_ptb(max_epoch=3, vocab_size=100, hidden_size=96,
+                          learning_rate=1.0)
+    # the 80/20 Markov stream's entropy floor is ~8-9 ppl; 35 is a
+    # stable-but-meaningful bar (uniform = 100, unigram ~ 70)
+    assert ppl < 35.0, f"perplexity {ppl:.2f} >= 35"

@@ -77,3 +77,95 @@ def test_feature_reshape_to_image():
     fitted = clf.fit(df)
     out = fitted.transform(df)
     assert len(out["prediction"]) == 32
+
+
+# --------------------------------------------------------------------------
+# partition-streamed spark path (VERDICT r1 item 4) — fake-RDD shim
+# --------------------------------------------------------------------------
+
+
+class _FakeRdd:
+    """Implements the pyspark RDD protocol subset _RddPartitionSource
+    needs, and counts full collect()s so the test can prove streaming."""
+
+    def __init__(self, rows, n_parts=4, stats=None):
+        self.rows = rows
+        self.n_parts = n_parts
+        self.stats = stats if stats is not None else {"max_collect": 0}
+        self._fn = None
+
+    def getNumPartitions(self):
+        return self.n_parts
+
+    def mapPartitionsWithIndex(self, fn):
+        out = _FakeRdd(self.rows, self.n_parts, self.stats)
+        out._fn = fn
+        return out
+
+    def _partitions(self):
+        per = (len(self.rows) + self.n_parts - 1) // self.n_parts
+        for i in range(self.n_parts):
+            yield i, iter(self.rows[i * per: (i + 1) * per])
+
+    def collect(self):
+        out = []
+        for i, it in self._partitions():
+            if self._fn is not None:
+                out.extend(self._fn(i, it))
+            else:
+                out.extend(it)
+        self.stats["max_collect"] = max(self.stats["max_collect"], len(out))
+        return out
+
+
+class _FakeSparkDF:
+    def __init__(self, feats, labels, n_parts=4):
+        self.feats, self.labels = feats, labels
+        self.n_parts = n_parts
+        self.stats = {"max_collect": 0}
+
+    # duck-typing hooks _df_kind sniffs
+    @property
+    def rdd(self):
+        rows = list(zip(self.feats.tolist(), self.labels.tolist()))
+        return _FakeRdd(rows, self.n_parts, self.stats)
+
+    def collect(self):
+        raise AssertionError("full DataFrame collect() must not happen")
+
+    def select(self, *cols):
+        return self
+
+    def toPandas(self):
+        import pandas as pd
+
+        return pd.DataFrame(
+            {"features": list(self.feats), "label": self.labels}
+        )
+
+
+def test_dl_classifier_spark_partition_streamed():
+    """fit() on a spark-protocol frame streams per-partition: no single
+    collect materializes more rows than one partition."""
+    from bigdl_tpu.dlframes import DLClassifier
+    from bigdl_tpu.nn import Linear, LogSoftMax, Sequential
+
+    rs = np.random.RandomState(0)
+    w = rs.randn(6, 3)
+    feats = rs.randn(240, 6).astype(np.float32)
+    labels = (np.argmax(feats @ w, axis=1) + 1).astype(np.float32)
+    df = _FakeSparkDF(feats, labels, n_parts=6)
+
+    model = Sequential().add(Linear(6, 3)).add(LogSoftMax())
+    est = DLClassifier(model, feature_size=[6]) \
+        .set_batch_size(20).set_max_epoch(30).set_learning_rate(0.5)
+    fitted = est.fit(df)
+
+    # streamed: the largest single collect is one partition (40 rows),
+    # never the whole 240-row dataset
+    assert df.stats["max_collect"] == 40, df.stats
+
+    out = fitted.transform(df)
+    preds = np.asarray(out["prediction"], np.float32)
+    acc = float(np.mean(preds == labels))
+    assert acc > 0.9, f"accuracy {acc}"
