@@ -40,6 +40,12 @@ IMG = 224
 N_CLASSES = 1000
 ITERS = 10
 
+# batch sweep (VERDICT r2 #2): batch 32 underfeeds the MXU; measure a
+# sweep and report the best operating point as the headline
+SWEEP_BATCHES = tuple(
+    int(b) for b in os.environ.get("BENCH_BATCHES", "32,64,128,256").split(",")
+)
+
 # CPU fallback must finish on one core: tiny shapes, clearly labelled
 CPU_BATCH = 4
 CPU_IMG = 64
@@ -391,17 +397,44 @@ def _run_child(platform: str):
             f"requested accelerator platform but got {dev.platform!r}"
         )
 
+    # batch sweep (VERDICT r2 #2): measure the framework at each batch,
+    # keep the best operating point as the headline; a batch that OOMs
+    # records its error and is skipped
+    batches = SWEEP_BATCHES if platform != "cpu" else (batch,)
+    peak = _peak_flops(dev.device_kind)
+    sweep = {}
+    best = None  # (ips, step_s, batch)
+    for b in batches:
+        xb = np.random.RandomState(0).randn(b, 3, img, img).astype(np.float32)
+        yb = (np.random.RandomState(1).randint(0, N_CLASSES, b) + 1).astype(
+            np.float32
+        )
+        try:
+            fw_b, step_b = _bench_framework(xb, yb, b, iters,
+                                            compute_dtype="bfloat16")
+        except Exception as e:  # OOM at large batch: record + continue
+            sweep[str(b)] = {"error": f"{type(e).__name__}"}
+            continue
+        entry = {"images_per_sec": round(fw_b, 2),
+                 "step_time_s": round(step_b, 4)}
+        if peak and dev.platform != "cpu":
+            entry["mfu"] = round(
+                train_step_flops_per_image(img) * fw_b / peak, 4)
+        sweep[str(b)] = entry
+        if best is None or fw_b > best[0]:
+            best = (fw_b, step_b, b)
+    if best is None:
+        raise RuntimeError(f"all sweep batches failed: {sweep}")
+    fw, step_s, batch = best
+
+    # baseline contender at the framework's best batch only (the ratio
+    # isolates framework overhead at the headline operating point)
     x = np.random.RandomState(0).randn(batch, 3, img, img).astype(np.float32)
     y = (np.random.RandomState(1).randint(0, N_CLASSES, batch) + 1).astype(
         np.float32
     )
-    # headline: the TPU-native recipe — bf16 fwd/bwd, f32 master params —
-    # on both contenders; the ratio still isolates framework overhead
-    fw, step_s = _bench_framework(x, y, batch, iters,
-                                  compute_dtype="bfloat16")
     bl, _ = _bench_baseline(x, y, batch, iters, compute_dtype="bfloat16")
 
-    peak = _peak_flops(dev.device_kind)
     mfu = None
     if peak and dev.platform != "cpu":
         mfu = round(train_step_flops_per_image(img) * fw / peak, 4)
@@ -426,6 +459,7 @@ def _run_child(platform: str):
             "image_size": img,
             "backend_init_s": init_s,
             "train_flops_per_image": train_step_flops_per_image(img),
+            "batch_sweep": sweep,
             "lenet_local_images_per_sec":
                 round(lenet_ips, 1) if lenet_ips else None,
         },

@@ -16,7 +16,15 @@ from bigdl_tpu.nn.module import (
 )
 from bigdl_tpu.nn.layers import *  # noqa: F401,F403
 from bigdl_tpu.nn.layers import __all__ as _layers_all
-from bigdl_tpu.nn.graph import Graph, Input, Node, Model
+from bigdl_tpu.nn.graph import DynamicGraph, Graph, Input, Node, Model
+from bigdl_tpu.nn.control_ops import (
+    IfElse,
+    LoopCondition,
+    MergeOps,
+    NextIteration,
+    SwitchOps,
+    WhileLoop,
+)
 from bigdl_tpu.nn.quantized import (
     QuantizedLinear,
     QuantizedSpatialConvolution,
@@ -114,7 +122,9 @@ from bigdl_tpu.nn.layers_extra import __all__ as _extra_all
 __all__ = (
     [
         "AbstractModule", "Container", "Sequential", "Identity", "Echo",
-        "Graph", "Input", "Node", "Model",
+        "Graph", "DynamicGraph", "Input", "Node", "Model",
+        "SwitchOps", "MergeOps", "IfElse", "WhileLoop", "LoopCondition",
+        "NextIteration",
         "ConcatTable", "ParallelTable", "CAddTable", "CSubTable", "CMulTable",
         "CDivTable", "CMaxTable", "CMinTable", "JoinTable", "SelectTable",
         "FlattenTable", "MM", "MV", "CosineDistance", "DotProduct", "Concat",

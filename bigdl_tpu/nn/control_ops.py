@@ -1,0 +1,183 @@
+"""Control-flow ops + dynamic execution support.
+
+Rebuild of the reference's DynamicGraph control-flow surface
+(⟦«bigdl»/nn/Graph.scala⟧ DynamicGraph + ⟦«bigdl»/nn/ops/⟧ control ops:
+SwitchOps/MergeOps/LoopCondition/NextIteration, used by the TF loader —
+SURVEY.md §2.1 "Graph container", VERDICT r2 #6).
+
+TPU-first design note.  The reference executes control flow *eagerly*
+on the JVM: Switch routes a tensor to one of two live branches and the
+dead branch never runs.  Under XLA everything is traced once, so the
+rebuild lowers the same ops to compiler-friendly primitives instead of
+an eager scheduler:
+
+* ``SwitchOps``/``MergeOps`` use **select semantics**: both branches
+  trace, ``Merge`` keeps the branch chosen by the predicate
+  (``jnp.where``).  For the pure modules the loader builds, this is
+  observationally equivalent to branch pruning, fuses into the
+  surrounding HLO, and is differentiable.  (XLA itself lowers small TF
+  conds exactly this way.)
+* ``IfElse`` maps to ``lax.cond`` — a *real* short-circuit when the
+  branches are expensive; also differentiable.
+* Cycles (``NextIteration`` feedback + ``LoopCondition``) lower to a
+  fixed-length masked ``lax.scan`` in ``DynamicGraph`` — reverse-mode
+  differentiable, static shapes, no data-dependent trip count in the
+  compiled program (the mask freezes the carry once the condition goes
+  false).  ``WhileLoop`` offers the unbounded ``lax.while_loop``
+  variant for forward-only use.
+"""
+
+from __future__ import annotations
+
+from bigdl_tpu.nn.module import AbstractModule, Container
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+class SwitchOps(AbstractModule):
+    """Reference: ⟦«bigdl»/nn/ops/Switch⟧ (TF ``Switch``).
+
+    Input ``(data, pred)`` -> output ``(data, data)``: element 0 feeds
+    the false branch, element 1 the true branch.  Select semantics:
+    both branches receive (and compute on) the live tensor; the
+    matching :class:`MergeOps` — wired with the same predicate —
+    selects the taken branch's result (see module docstring)."""
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        data, pred = input
+        return (data, data)
+
+
+class MergeOps(AbstractModule):
+    """Reference: ⟦«bigdl»/nn/ops/Merge⟧ (TF ``Merge``).
+
+    Input ``(false_data, true_data, pred)`` — the two branch results
+    plus the controlling Switch's predicate — returns
+    ``where(pred, true_data, false_data)``.  (TF's Merge has no pred
+    input — it takes whichever branch is live; under select semantics
+    both are live, so the predicate is wired explicitly.  The TF
+    loader finds it by walking to the controlling Switch.)"""
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        jnp = _jnp()
+        false_data, true_data, pred = input
+        return jnp.where(pred, true_data, false_data)
+
+
+class IfElse(Container):
+    """``lax.cond`` over two child modules (the short-circuit variant).
+
+    Input ``(pred, data)``; runs ``then_module(data)`` when ``pred``
+    else ``else_module(data)``.  Branches must produce matching
+    shapes/dtypes (an XLA requirement the reference never had — its
+    eager scheduler allowed ragged branches)."""
+
+    def __init__(self, then_module: AbstractModule = None,
+                 else_module: AbstractModule = None):
+        # default-None constructor keeps the generic serializer path
+        # (construct empty, then graft children) working
+        super().__init__()
+        self._config = {}
+        if then_module is not None:
+            self.add(then_module)
+        if else_module is not None:
+            self.add(else_module)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        import jax
+        from jax import lax
+
+        pred, data = input
+        then_m, else_m = self.modules
+
+        def run_then(operand):
+            p, s, x, r = operand
+            out, _ = then_m.apply(p["0"], s["0"], x, training=training, rng=r)
+            return out
+
+        def run_else(operand):
+            p, s, x, r = operand
+            out, _ = else_m.apply(p["1"], s["1"], x, training=training, rng=r)
+            return out
+
+        r = rng if rng is None else jax.random.fold_in(rng, 0)
+        jnp = _jnp()
+        out = lax.cond(
+            jnp.asarray(pred, bool).reshape(()),
+            run_then, run_else, (params, state, data, r),
+        )
+        # branch-local state (e.g. BN running stats) cannot cross a cond
+        # with divergent structures; state passes through unchanged —
+        # use stateless branches (the reference's control ops are too)
+        return out, dict(state)
+
+    def __repr__(self):
+        return f"IfElse({self.modules[0]!r}, {self.modules[1]!r})"
+
+
+class WhileLoop(Container):
+    """``lax.while_loop`` over a condition module and a body module.
+
+    Input = initial loop carry.  ``cond_module(carry)`` must return a
+    scalar bool; ``body_module(carry)`` the next carry (same pytree
+    structure/shapes — XLA requirement).  Forward-only: reverse-mode
+    through an unbounded while is undefined; use :class:`DynamicGraph`
+    with ``max_iterations`` (masked scan) when gradients are needed."""
+
+    def __init__(self, cond_module: AbstractModule = None,
+                 body_module: AbstractModule = None):
+        super().__init__()
+        self._config = {}
+        if cond_module is not None:
+            self.add(cond_module)
+        if body_module is not None:
+            self.add(body_module)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        from jax import lax
+
+        cond_m, body_m = self.modules
+        jnp = _jnp()
+
+        def cond_fn(carry):
+            out, _ = cond_m.apply(params["0"], state["0"], carry,
+                                  training=training, rng=None)
+            return jnp.asarray(out, bool).reshape(())
+
+        def body_fn(carry):
+            out, _ = body_m.apply(params["1"], state["1"], carry,
+                                  training=training, rng=None)
+            return out
+
+        return lax.while_loop(cond_fn, body_fn, input), dict(state)
+
+    def __repr__(self):
+        return f"WhileLoop({self.modules[0]!r}, {self.modules[1]!r})"
+
+
+class LoopCondition(AbstractModule):
+    """Reference: ⟦«bigdl»/nn/ops/LoopCondition⟧ (TF ``LoopCond``).
+
+    Marks its (scalar-bool) input as the continue-condition of the
+    enclosing :class:`DynamicGraph` iteration.  Passes the value
+    through so it can also be consumed downstream."""
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        return input
+
+
+class NextIteration(AbstractModule):
+    """Reference: TF ``NextIteration`` — the feedback edge of a cycle.
+
+    Wired with its *initial value* node as the ordinary predecessor and
+    the *feedback source* attached after the fact via
+    ``node.feedback_from(src_node)`` (a back-edge the topological sort
+    must not follow).  On iteration 0 it emits the initial value; on
+    iteration t>0, the feedback source's value from iteration t-1."""
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        return input

@@ -94,6 +94,11 @@ class DistriOptimizer(LocalOptimizer):
         self._unravel = unravel
         return flat
 
+    def _params_tree(self, pvar):
+        # unravel on device: the flat ZeRO vector -> params pytree with
+        # no host round-trip (the unravel closure is a pure jax fn)
+        return self._unravel(pvar)
+
     def _write_back(self, pvar, mod_state):
         # unravel allocates fresh arrays; mod_state is copied so the model
         # never aliases buffers the donated step will delete
@@ -163,32 +168,41 @@ class DistriOptimizer(LocalOptimizer):
         global_batch = self.batch_size
 
         def sharded_step(flat_p, opt_st, mstate, rng, inp, tgt):
-            # ---- local replica compute (reference: per-core fwd/bwd) ----
-            (_, (loss, new_mstate)), grad = jax.value_and_grad(
-                loss_fn, has_aux=True
-            )(flat_p, mstate, rng, inp, tgt)
-            # ---- putGradients + aggregateGradientPartition --------------
-            g = jnp.pad(grad, (0, pad))
-            if wire is not None and wire != g.dtype:
-                g = g.astype(wire)
-            gshard = jax.lax.psum_scatter(g, axis, scatter_dimension=0, tiled=True)
-            gshard = gshard.astype(flat_p.dtype)
-            # reference: gradient /= numSamples (global batch)
-            gshard = gshard / global_batch
-            # ParameterProcessors on the *sharded* gradient, with the
-            # global norm via psum — matching L2NormClippingProcessor
-            sq = jax.lax.psum(jnp.sum(gshard * gshard), axis)
-            gshard = clipper(gshard, global_sq_norm=sq)
-            # ---- owner-slice weight update (ZeRO-1) ---------------------
-            idx = jax.lax.axis_index(axis)
-            shard_len = (flat_p.size + pad) // n
-            wshard = jax.lax.dynamic_slice(
-                jnp.pad(flat_p, (0, pad)), (idx * shard_len,), (shard_len,)
-            )
-            new_wshard, new_opt = opt.step(gshard, wshard, opt_st)
-            # ---- sendWeightPartition + getWeights -----------------------
-            new_flat = jax.lax.all_gather(new_wshard, axis, tiled=True)
-            new_flat = new_flat[: flat_p.size]
+            # named_scopes carry the reference's Metrics phase names into
+            # profiler traces / HLO metadata (SURVEY.md §5 Tracing)
+            with jax.named_scope("computing"):
+                # ---- local replica compute (per-core fwd/bwd) -----------
+                (_, (loss, new_mstate)), grad = jax.value_and_grad(
+                    loss_fn, has_aux=True
+                )(flat_p, mstate, rng, inp, tgt)
+            with jax.named_scope("put_gradient"):
+                # ---- putGradients + aggregateGradientPartition ----------
+                g = jnp.pad(grad, (0, pad))
+                if wire is not None and wire != g.dtype:
+                    g = g.astype(wire)
+                gshard = jax.lax.psum_scatter(
+                    g, axis, scatter_dimension=0, tiled=True)
+            with jax.named_scope("aggregate_gradient"):
+                gshard = gshard.astype(flat_p.dtype)
+                # reference: gradient /= numSamples (global batch)
+                gshard = gshard / global_batch
+                # ParameterProcessors on the *sharded* gradient, with the
+                # global norm via psum — matching L2NormClippingProcessor
+                sq = jax.lax.psum(jnp.sum(gshard * gshard), axis)
+                gshard = clipper(gshard, global_sq_norm=sq)
+            with jax.named_scope("optimizer_update"):
+                # ---- owner-slice weight update (ZeRO-1) -----------------
+                idx = jax.lax.axis_index(axis)
+                shard_len = (flat_p.size + pad) // n
+                wshard = jax.lax.dynamic_slice(
+                    jnp.pad(flat_p, (0, pad)), (idx * shard_len,),
+                    (shard_len,)
+                )
+                new_wshard, new_opt = opt.step(gshard, wshard, opt_st)
+            with jax.named_scope("send_weights"):
+                # ---- sendWeightPartition + getWeights -------------------
+                new_flat = jax.lax.all_gather(new_wshard, axis, tiled=True)
+                new_flat = new_flat[: flat_p.size]
             # keep BN running stats in sync across replicas (the reference
             # leaves them per-replica; pmean is strictly better and free)
             new_mstate = jax.tree.map(

@@ -2,8 +2,16 @@
 
 Rebuild of the reference's Predictor / Evaluator path (SURVEY.md §3.6):
 ``model.predict(rdd)`` broadcast an evaluate-mode model and ran
-forward-only per partition, folding ValidationResult monoids.  Here: one
-jitted forward, batched over the dataset; results fold on host.
+forward-only per partition over the executors, folding ValidationResult
+monoids per partition and reducing on the driver.
+
+TPU-native equivalent (VERDICT r2 #3): the forward jits once with the
+minibatch sharded ``P(data)`` over the Engine mesh — every chip
+evaluates its slice of the batch, exactly like the executor-local
+replicas — and the ValidationResult monoids fold on host after a
+device->host gather of the (small) output logits.  Ragged tail batches
+are padded to the mesh divisor on host and the padding sliced off the
+output, so results are bit-identical to single-device evaluation.
 """
 
 from __future__ import annotations
@@ -13,7 +21,19 @@ from typing import Sequence
 import numpy as np
 
 
-def _forward_fn(model):
+def _mesh_usable(mesh):
+    """The sharded path needs a single-process mesh (multi-process
+    gathers are per-host; the caller keeps the per-partition fold)."""
+    import jax
+
+    return (
+        mesh is not None
+        and mesh.devices.size > 1
+        and jax.process_count() == 1
+    )
+
+
+def _forward_fn(model, params=None, state=None, mesh=None):
     import jax
 
     # cache the jitted forward on the module so repeated validation
@@ -27,47 +47,92 @@ def _forward_fn(model):
             return out
 
         model._jit_eval_fwd = fwd
-    params = model.params()
-    state = model.state()
-    return lambda inp: fwd(params, state, inp)
+    if params is None:
+        params = model.params()
+    if state is None:
+        state = model.state()
+
+    if not _mesh_usable(mesh):
+        return lambda inp: fwd(params, state, inp), 1
+
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    axis = mesh.axis_names[0]
+    n = int(mesh.shape[axis])
+    data_sh = NamedSharding(mesh, P(axis))
+    repl = NamedSharding(mesh, P())
+    # params/state replicate once; batches shard along the leading axis
+    params = jax.device_put(params, repl)
+    state = jax.device_put(state, repl)
+
+    def sharded(inp):
+        if isinstance(inp, tuple):
+            inp = tuple(jax.device_put(jnp.asarray(x), data_sh) for x in inp)
+        else:
+            inp = jax.device_put(jnp.asarray(inp), data_sh)
+        return fwd(params, state, inp)
+
+    return sharded, n
 
 
-def evaluate_dataset(model, dataset, methods: Sequence):
+def _pad_batch(arr, divisor):
+    """Pad the leading axis up to a multiple of ``divisor`` by repeating
+    the last row; returns (padded, true_batch)."""
+    arr = np.asarray(arr)
+    b = arr.shape[0]
+    pad = (-b) % divisor
+    if pad:
+        arr = np.concatenate([arr, np.repeat(arr[-1:], pad, axis=0)], axis=0)
+    return arr, b
+
+
+def evaluate_dataset(model, dataset, methods: Sequence, mesh=None,
+                     params=None, state=None):
     """Fold validation methods over a dataset (reference:
-    model.evaluate(rdd, Array(new Top1Accuracy)))."""
+    model.evaluate(rdd, Array(new Top1Accuracy))).
+
+    ``mesh`` shards each batch ``P(data)`` across the devices;
+    ``params``/``state`` accept device-resident pytrees so a distributed
+    trainer can validate without a host weight copy."""
     import jax.numpy as jnp
 
     model.evaluate()
-    fwd = _forward_fn(model)
+    fwd, divisor = _forward_fn(model, params=params, state=state, mesh=mesh)
     results = [None] * len(methods)
     for inp, tgt in dataset.data(train=False):
         if isinstance(inp, (tuple, list)):
-            out = fwd(tuple(jnp.asarray(x) for x in inp))
+            padded, b = zip(*[_pad_batch(x, divisor) for x in inp])
+            true_b = b[0]
+            out = fwd(tuple(jnp.asarray(x) for x in padded))
         else:
-            out = fwd(jnp.asarray(inp))
+            padded, true_b = _pad_batch(inp, divisor)
+            out = fwd(jnp.asarray(padded))
+        out = np.asarray(out)[:true_b]
         for i, m in enumerate(methods):
             r = m.batch_result(out, tgt)
             results[i] = r if results[i] is None else results[i] + r
     return results
 
 
-def predict(model, features, batch_size: int = 32):
+def predict(model, features, batch_size: int = 32, mesh=None):
     """Batched forward over an array of inputs; returns stacked host
-    outputs (reference: model.predict)."""
+    outputs (reference: model.predict).  With ``mesh``, each batch
+    shards ``P(data)`` over the devices."""
     import jax.numpy as jnp
 
     model.evaluate()
-    fwd = _forward_fn(model)
+    fwd, divisor = _forward_fn(model, mesh=mesh)
     feats = np.asarray(features)
     outs = []
     n = feats.shape[0]
     for b in range(0, n, batch_size):
-        chunk = feats[b : b + batch_size]
-        outs.append(np.asarray(fwd(jnp.asarray(chunk))))
+        chunk, true_b = _pad_batch(feats[b : b + batch_size], divisor)
+        outs.append(np.asarray(fwd(jnp.asarray(chunk)))[:true_b])
     return np.concatenate(outs, axis=0)
 
 
-def predict_class(model, features, batch_size: int = 32):
+def predict_class(model, features, batch_size: int = 32, mesh=None):
     """Reference: predictClass — argmax + 1 (1-based labels)."""
-    out = predict(model, features, batch_size)
+    out = predict(model, features, batch_size, mesh=mesh)
     return np.argmax(out.reshape(out.shape[0], -1), axis=-1) + 1

@@ -321,6 +321,7 @@ class ModulePersister:
 
 
 def _module_to_writer(module, name_counts=None) -> _WireWriter:
+    from bigdl_tpu.nn.attention import _Composite
     from bigdl_tpu.nn.graph import Graph
     from bigdl_tpu.nn.module import Container
 
@@ -346,6 +347,17 @@ def _module_to_writer(module, name_counts=None) -> _WireWriter:
             w.message(_M_SUBMODULES, _module_to_writer(child))
         return w
 
+    if isinstance(module, _Composite):
+        # Named-children modules (TransformerBlock, TransformerLM, …):
+        # each child rides as a subModule tagged with its slot name in
+        # namePostfix so load can restore weights into the right slot
+        # (reference containers do the same via subModule names).
+        for key, child in module._children.items():
+            sub = _module_to_writer(child)
+            sub.string(_M_NAMEPOSTFIX, key)
+            w.message(_M_SUBMODULES, sub)
+        return w
+
     # leaf parameters: weight/bias ride the dedicated fields when the
     # module uses the classic pair; everything else via `parameters`
     params = [(n, getattr(module, n)) for n in module.param_names
@@ -364,7 +376,9 @@ def _module_to_writer(module, name_counts=None) -> _WireWriter:
 
 def _write_graph(w: _WireWriter, graph) -> None:
     """Graph wiring via preModules/nextModules name lists (reference:
-    StaticGraph serialization)."""
+    StaticGraph serialization).  DynamicGraph extras (feedback
+    back-edges, condition node) ride as named attrs — a documented
+    extension a real BigDL reader would skip."""
     # assign unique names
     names = {}
     for i, node in enumerate(graph._topo):
@@ -377,6 +391,11 @@ def _write_graph(w: _WireWriter, graph) -> None:
             sub.string(_M_PREMODULES, names[p.id])
         for nxt in getattr(node, "next_nodes", []):
             sub.string(_M_NEXTMODULES, names[nxt.id])
+        if node.feedback_node is not None:
+            entry = _WireWriter()
+            entry.string(1, "feedbackFrom")
+            entry.message(2, _write_attr(names[node.feedback_node.id]))
+            sub.message(_M_ATTR, entry)
         w.message(_M_SUBMODULES, sub)
     # record input/output node names as attrs
     for key, nodes in (("graphInputs", graph.input_nodes),
@@ -392,6 +411,12 @@ def _write_graph(w: _WireWriter, graph) -> None:
             arr.string(_AR_STR, names[n.id])
         val.message(_A_ARRAY, arr)
         entry.message(2, val)
+        w.message(_M_ATTR, entry)
+    cond = getattr(graph, "_condition_node", None)
+    if cond is not None:
+        entry = _WireWriter()
+        entry.string(1, "dynamicCondition")
+        entry.message(2, _write_attr(names[cond.id]))
         w.message(_M_ATTR, entry)
 
 
@@ -412,16 +437,16 @@ class ModuleLoader:
 
 
 def _class_for(module_type: str):
-    from bigdl_tpu.utils.serializer import _build_registry
+    from bigdl_tpu.utils.serializer import lookup_module_class
 
     cls_name = module_type.rsplit(".", 1)[-1]
-    reg = _build_registry()
-    if cls_name not in reg:
+    try:
+        return lookup_module_class(cls_name)
+    except KeyError:
         raise KeyError(
             f"unknown module type {module_type!r}; register_module() "
             "custom layers before loading"
-        )
-    return reg[cls_name]
+        ) from None
 
 
 def _construct(cls, attrs: dict):
@@ -443,6 +468,7 @@ def _construct(cls, attrs: dict):
 
 
 def _module_from_fields(f: Dict[int, list]):
+    from bigdl_tpu.nn.attention import _Composite
     from bigdl_tpu.nn.graph import Graph, Node
     from bigdl_tpu.nn.module import Container
 
@@ -460,13 +486,19 @@ def _module_from_fields(f: Dict[int, list]):
 
     subs = _w_msgs(f, _M_SUBMODULES)
     if issubclass(cls, Graph):
-        module = _graph_from_fields(f, subs, raw_attrs)
+        module = _graph_from_fields(f, subs, raw_attrs, cls)
     else:
         module = _construct(cls, attrs)
         if issubclass(cls, Container) and subs:
             module.modules = []
             for sub in subs:
                 module.modules.append(_module_from_fields(sub))
+        elif isinstance(module, _Composite) and subs:
+            # restore named children by slot name (written in namePostfix)
+            for sub in subs:
+                key = _w_str(sub, _M_NAMEPOSTFIX, "")
+                if key and key in module._children:
+                    module._children[key] = _module_from_fields(sub)
 
     name = _w_str(f, _M_NAME)
     if name and "@" not in name:
@@ -506,8 +538,17 @@ def _module_from_fields(f: Dict[int, list]):
     return module
 
 
-def _graph_from_fields(f, subs, raw_attrs):
-    from bigdl_tpu.nn.graph import Graph, Node
+def _sub_attr(sub, key: str):
+    """Read one named attr from a subModule message."""
+    for entry in _w_msgs(sub, _M_ATTR):
+        if _w_str(entry, 1, "") == key:
+            vals = _w_msgs(entry, 2)
+            return _read_attr(vals[0]) if vals else None
+    return None
+
+
+def _graph_from_fields(f, subs, raw_attrs, cls=None):
+    from bigdl_tpu.nn.graph import DynamicGraph, Graph, Node
 
     nodes = {}
     order = []
@@ -523,8 +564,19 @@ def _graph_from_fields(f, subs, raw_attrs):
         node = nodes[post]
         for p in prevs:
             node.prev_nodes.append(nodes[p])
+    for sub, post in zip(subs, order):
+        fb = _sub_attr(sub, "feedbackFrom")
+        if fb:
+            nodes[post].feedback_from(nodes[fb])
     inputs = [nodes[n] for n in raw_attrs.get("graphInputs", [])]
     outputs = [nodes[n] for n in raw_attrs.get("graphOutputs", [])]
+    if cls is not None and issubclass(cls, DynamicGraph):
+        cond_name = raw_attrs.get("dynamicCondition")
+        return cls(
+            inputs, outputs,
+            max_iterations=int(raw_attrs.get("maxIterations", 32)),
+            condition=nodes.get(cond_name) if cond_name else None,
+        )
     return Graph(inputs, outputs)
 
 

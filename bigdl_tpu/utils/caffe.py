@@ -152,6 +152,12 @@ def _read_varint(buf: memoryview, pos: int) -> Tuple[int, int]:
         shift += 7
 
 
+def _signed(v: int) -> int:
+    """Interpret a decoded varint as a protobuf int32/int64 (negatives
+    ride as 64-bit two's complement on the wire)."""
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
 def parse_wire(buf) -> Dict[int, list]:
     """Decode one message into {field: [(wire_type, raw_value), ...]}."""
     mv = memoryview(buf)
@@ -191,7 +197,7 @@ def _w_strs(f, fno) -> List[str]:
 
 def _w_int(f, fno, default=None):
     if fno in f:
-        return int(f[fno][-1][1])
+        return _signed(int(f[fno][-1][1]))
     return default
 
 
@@ -199,13 +205,13 @@ def _w_ints(f, fno) -> List[int]:
     out = []
     for wt, v in f.get(fno, []):
         if wt == _WT_VARINT:
-            out.append(int(v))
+            out.append(_signed(int(v)))
         else:  # packed
             mv = memoryview(v)
             pos = 0
             while pos < len(mv):
                 x, pos = _read_varint(mv, pos)
-                out.append(x)
+                out.append(_signed(x))
     return out
 
 
@@ -244,6 +250,8 @@ class _WireWriter:
 
     @staticmethod
     def _varint(x: int) -> bytes:
+        if x < 0:  # protobuf int32/int64: 64-bit two's complement
+            x += 1 << 64
         out = bytearray()
         while True:
             b = x & 0x7F

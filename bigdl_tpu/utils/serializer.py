@@ -31,8 +31,8 @@ from bigdl_tpu.nn.graph import Graph, Node, _InputModule
 _REGISTRY: Dict[str, type] = {}
 
 
-def _build_registry():
-    if _REGISTRY:
+def _build_registry(rescan: bool = False):
+    if _REGISTRY and not rescan:
         return _REGISTRY
     import bigdl_tpu.nn as nn_pkg
     import bigdl_tpu.models as models_pkg  # registers model-zoo modules
@@ -43,13 +43,29 @@ def _build_registry():
     import bigdl_tpu.nn.graph as g_mod
 
     def scan(cls):
-        _REGISTRY[cls.__name__] = cls
+        # first registration wins on rescan: explicit register_module
+        # overrides must not be clobbered
+        _REGISTRY.setdefault(cls.__name__, cls)
         for sub in cls.__subclasses__():
             scan(sub)
 
     scan(AbstractModule)
     _REGISTRY["_InputModule"] = _InputModule
     return _REGISTRY
+
+
+def lookup_module_class(name: str) -> type:
+    """Resolve a class name, rescanning the subclass tree once for
+    classes defined after the first registry build."""
+    reg = _build_registry()
+    if name not in reg:
+        reg = _build_registry(rescan=True)
+    if name not in reg:
+        raise KeyError(
+            f"unknown module class {name!r}; use register_module() for "
+            "custom layers"
+        )
+    return reg[name]
 
 
 def register_module(cls):
@@ -70,39 +86,51 @@ def module_to_spec(module: AbstractModule) -> dict:
         nodes = []
         id_to_idx = {n.id: i for i, n in enumerate(module._topo)}
         for n in module._topo:
-            nodes.append(
-                {
-                    "module": module_to_spec(n.module),
-                    "prev": [id_to_idx[p.id] for p in n.prev_nodes],
-                }
-            )
+            nd = {
+                "module": module_to_spec(n.module),
+                "prev": [id_to_idx[p.id] for p in n.prev_nodes],
+            }
+            if n.feedback_node is not None:
+                nd["feedback"] = id_to_idx[n.feedback_node.id]
+            nodes.append(nd)
         spec["graph"] = {
             "nodes": nodes,
             "inputs": [id_to_idx[n.id] for n in module.input_nodes],
             "outputs": [id_to_idx[n.id] for n in module.output_nodes],
         }
+        cond = getattr(module, "_condition_node", None)
+        if cond is not None:
+            spec["graph"]["condition"] = id_to_idx[cond.id]
     elif isinstance(module, Container):
         spec["children"] = [module_to_spec(m) for m in module.modules]
     return spec
 
 
 def spec_to_module(spec: dict) -> AbstractModule:
-    reg = _build_registry()
     name = spec["class"]
-    if name not in reg:
-        raise KeyError(
-            f"unknown module class {name!r}; use register_module() for custom layers"
-        )
-    cls = reg[name]
+    cls = lookup_module_class(name)
     if "graph" in spec:
+        from bigdl_tpu.nn.graph import DynamicGraph
+
         g = spec["graph"]
         nodes = []
         for nd in g["nodes"]:
             mod = spec_to_module(nd["module"])
             nodes.append(Node(mod, [nodes[i] for i in nd["prev"]]))
-        module = Graph(
-            [nodes[i] for i in g["inputs"]], [nodes[i] for i in g["outputs"]]
-        )
+        for nd, node in zip(g["nodes"], nodes):
+            if "feedback" in nd:
+                node.feedback_from(nodes[nd["feedback"]])
+        inputs = [nodes[i] for i in g["inputs"]]
+        outputs = [nodes[i] for i in g["outputs"]]
+        if issubclass(cls, DynamicGraph):
+            module = cls(
+                inputs, outputs,
+                condition=(nodes[g["condition"]] if "condition" in g
+                           else None),
+                **spec.get("config", {}),
+            )
+        else:
+            module = Graph(inputs, outputs)
     else:
         module = cls(**spec.get("config", {}))
         if "children" in spec:

@@ -297,9 +297,92 @@ class TensorflowLoader:
             axis += 4
         return {0: 0, 1: 2, 2: 3, 3: 1}[axis]
 
+    # ops whose consumers select an output by ":idx" (TF multi-output);
+    # the converted module returns a tuple, picked via SelectTable
+    _MULTI_OUTPUT_OPS = ("Switch",)
+
+    def _switch_ancestors(self, name: str, _depth: int = 0):
+        """All Switch ancestors reachable from ``name``:
+        {pred_base_name: {"ports": {0|1,...}, "depth": min, "ref": pred}}
+        where a port is the Switch output the path rode (0=false,
+        1=true).  Used to find a Merge's *controlling* Switch: for
+        nested conds, the controlling predicate is the one common to
+        both Merge inputs with a distinct single port on each side."""
+        result: Dict[str, dict] = {}
+        if _depth > 64:
+            return result
+        raw = name[1:] if name.startswith("^") else name
+        base, _, idx = raw.partition(":")
+        port = int(idx) if idx else 0
+        nd = self.nodes.get(base)
+        if nd is None:
+            return result
+        if nd.op == "Switch":
+            data_in, pred_in = self._data_inputs(nd)[:2]
+            key = _clean(pred_in)
+            entry = result.setdefault(
+                key, {"ports": set(), "depth": _depth, "ref": pred_in})
+            entry["ports"].add(port)
+            entry["depth"] = min(entry["depth"], _depth)
+            ups = [data_in]  # outer switches sit above this one's data
+        else:
+            ups = self._data_inputs(nd)
+        for i in ups:
+            for k, v in self._switch_ancestors(i, _depth + 1).items():
+                if k in result:
+                    result[k]["ports"] |= v["ports"]
+                    result[k]["depth"] = min(result[k]["depth"], v["depth"])
+                else:
+                    result[k] = v
+        return result
+
+    def _merge_wiring(self, ins):
+        """Resolve a Merge's (false_input, true_input, pred_ref) under
+        select semantics.  The controlling Switch is the common
+        ancestor predicate whose port differs between the two inputs
+        (disambiguates nested conds and input order)."""
+        a0 = self._switch_ancestors(ins[0])
+        a1 = self._switch_ancestors(ins[1])
+        best = None
+        for p in set(a0) & set(a1):
+            p0, p1 = a0[p]["ports"], a1[p]["ports"]
+            if len(p0) == 1 and len(p1) == 1 and p0 != p1:
+                d = a0[p]["depth"] + a1[p]["depth"]
+                if best is None or d < best[0]:
+                    best = (d, p)
+        if best is not None:
+            p = best[1]
+            if a0[p]["ports"] == {0}:
+                return ins[0], ins[1], a0[p]["ref"]
+            return ins[1], ins[0], a0[p]["ref"]
+        # fallback: any ancestor pred, keep the given (false, true) order
+        for side in (a0, a1):
+            if side:
+                p = min(side, key=lambda q: side[q]["depth"])
+                return ins[0], ins[1], side[p]["ref"]
+        return None
+
     def _build(self, name: str):
         """Recursively convert node ``name``; returns a wired graph Node."""
-        name = _clean(name)
+        raw = name[1:] if name.startswith("^") else name
+        base, _, idx = raw.partition(":")
+        out_idx = int(idx) if idx else 0
+        src_nd = self.nodes.get(base)
+        if src_nd is not None and src_nd.op in self._MULTI_OUTPUT_OPS:
+            # TF refs output 0 as "name", output k as "name:k"; the
+            # converted module emits a tuple -> SelectTable per consumer
+            key = f"{base}:{out_idx}"
+            if key in self._built:
+                return self._built[key]
+            rawkey = base + ":__raw__"
+            if rawkey not in self._built:
+                self._built[rawkey] = self._convert(src_nd)
+            from bigdl_tpu.nn.table_ops import SelectTable
+
+            node = SelectTable(out_idx + 1)(self._built[rawkey])  # 1-based
+            self._built[key] = node
+            return node
+        name = base
         if name in self._built:
             return self._built[name]
         nd = self.nodes.get(name)
@@ -325,6 +408,34 @@ class TensorflowLoader:
             return node
         if op in ("Identity", "StopGradient", "CheckNumerics", "NoOp"):
             return self._build(ins[0])
+
+        # control flow (VERDICT r2 #6): select-semantics lowering — see
+        # nn/control_ops.py.  Switch(data, pred) -> ((data,pred) x2),
+        # consumers pick a branch via the _build multi-output path;
+        # Merge selects by the predicate riding alongside each branch.
+        if op == "Switch":
+            from bigdl_tpu.nn import control_ops as C
+
+            return self._named(C.SwitchOps(), nd)(
+                self._build(ins[0]), self._build(ins[1])
+            )
+        if op == "Merge":
+            from bigdl_tpu.nn import control_ops as C
+
+            wiring = self._merge_wiring(ins)
+            if wiring is None:
+                raise TFConversionException(
+                    f"Merge {nd.name}: no controlling Switch found"
+                )
+            false_in, true_in, pred_name = wiring
+            return self._named(C.MergeOps(), nd)(
+                self._build(false_in), self._build(true_in),
+                self._build(pred_name),
+            )
+        if op == "LoopCond":
+            from bigdl_tpu.nn import control_ops as C
+
+            return self._named(C.LoopCondition(), nd)(self._build(ins[0]))
         if op == "Const":
             raise TFConversionException(
                 f"Const {nd.name} reached graph position — only weight"

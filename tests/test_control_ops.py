@@ -1,0 +1,278 @@
+"""DynamicGraph + control-flow op specs (VERDICT r2 #6).
+
+The reference's DynamicGraph executes control flow eagerly; the rebuild
+lowers it to XLA-friendly primitives (select semantics, lax.cond, a
+masked lax.scan for cycles) — see nn/control_ops.py.  These specs check
+fwd AND bwd through conditionals and a cyclic graph.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as N
+
+
+class TestSwitchMerge:
+    def test_switch_merge_selects_branch(self):
+        inp = N.Input()
+        pred = N.Input()
+        sw = N.SwitchOps()(inp, pred)
+        f_br = N.MulConstant(2.0)(N.SelectTable(1)(sw))
+        t_br = N.AddConstant(10.0)(N.SelectTable(2)(sw))
+        out = N.MergeOps()(f_br, t_br, pred)
+        g = N.Graph([inp, pred], out)
+        x = jnp.asarray([[1.0, 2.0]])
+        np.testing.assert_allclose(
+            np.asarray(g.forward((x, jnp.asarray(True)))), [[11.0, 12.0]])
+        np.testing.assert_allclose(
+            np.asarray(g.forward((x, jnp.asarray(False)))), [[2.0, 4.0]])
+
+    def test_switch_merge_backward(self):
+        """Gradients flow through the selected branch only."""
+        inp = N.Input()
+        pred = N.Input()
+        sw = N.SwitchOps()(inp, pred)
+        f_br = N.MulConstant(2.0)(N.SelectTable(1)(sw))
+        t_br = N.MulConstant(5.0)(N.SelectTable(2)(sw))
+        out = N.MergeOps()(f_br, t_br, pred)
+        g = N.Graph([inp, pred], out)
+
+        def fn(x, p):
+            y, _ = g.apply(g.params(), g.state(), (x, p))
+            return jnp.sum(y)
+
+        x = jnp.ones((2, 3))
+        gx = jax.grad(fn)(x, jnp.asarray(True))
+        np.testing.assert_allclose(np.asarray(gx), 5.0 * np.ones((2, 3)))
+        gx = jax.grad(fn)(x, jnp.asarray(False))
+        np.testing.assert_allclose(np.asarray(gx), 2.0 * np.ones((2, 3)))
+
+
+class TestIfElse:
+    def test_ifelse_cond(self):
+        m = N.IfElse(N.AddConstant(1.0), N.MulConstant(3.0))
+        x = jnp.asarray([2.0, 4.0])
+        np.testing.assert_allclose(
+            np.asarray(m.forward((jnp.asarray(True), x))), [3.0, 5.0])
+        np.testing.assert_allclose(
+            np.asarray(m.forward((jnp.asarray(False), x))), [6.0, 12.0])
+
+    def test_ifelse_with_params_backward(self):
+        then_m = N.Linear(4, 4)
+        else_m = N.Linear(4, 4)
+        m = N.IfElse(then_m, else_m)
+        params = m.params()
+
+        def fn(p, pred, x):
+            y, _ = m.apply(p, m.state(), (pred, x))
+            return jnp.sum(y * y)
+
+        x = jnp.ones((2, 4))
+        g_true = jax.grad(fn)(params, jnp.asarray(True), x)
+        # gradient lands on the taken branch; untaken branch gets zeros
+        assert float(jnp.sum(jnp.abs(g_true["0"]["weight"]))) > 0
+        np.testing.assert_allclose(np.asarray(g_true["1"]["weight"]), 0.0)
+
+    def test_ifelse_serialization(self, tmp_path):
+        from bigdl_tpu.utils.serializer import load_module, save_module
+
+        m = N.IfElse(N.Linear(3, 2), N.Linear(3, 2))
+        x = jnp.ones((1, 3))
+        out1 = np.asarray(m.forward((jnp.asarray(True), x)))
+        path = save_module(m, str(tmp_path / "if"))
+        m2 = load_module(path)
+        np.testing.assert_allclose(
+            out1, np.asarray(m2.forward((jnp.asarray(True), x))), rtol=1e-6)
+
+
+class TestWhileLoop:
+    def test_while_counts(self):
+        """carry = (i, acc): double acc while i < 5."""
+        class Cond(N.AbstractModule):
+            def update_output_pure(self, params, input, **kw):
+                i, acc = input
+                return i < 5
+
+        class Body(N.AbstractModule):
+            def update_output_pure(self, params, input, **kw):
+                i, acc = input
+                return (i + 1, acc * 2.0)
+
+        m = N.WhileLoop(Cond(), Body())
+        i, acc = m.forward((jnp.asarray(0), jnp.asarray(1.0)))
+        assert int(i) == 5
+        assert float(acc) == 32.0
+
+
+class TestDynamicGraph:
+    def _counter_graph(self, max_iterations=16):
+        """Cyclic graph: x doubles each iteration while iter < 4.
+
+        Wiring: init -> NextIteration -> double -> (feedback)
+        plus a counter cycle driving LoopCondition.
+        """
+        class Counter(N.AbstractModule):
+            def update_output_pure(self, params, input, **kw):
+                return input + 1.0
+
+        class LessThan4(N.AbstractModule):
+            def update_output_pure(self, params, input, **kw):
+                return input < 4.0
+
+        x_in = N.Input()
+        cnt_in = N.Input()
+        x_feed = N.NextIteration()(x_in)
+        cnt_feed = N.NextIteration()(cnt_in)
+        doubled = N.MulConstant(2.0)(x_feed)
+        cnt_next = Counter()(cnt_feed)
+        cond = N.LoopCondition()(LessThan4()(cnt_next))
+        x_feed.feedback_from(doubled)
+        cnt_feed.feedback_from(cnt_next)
+        g = N.DynamicGraph([x_in, cnt_in], doubled,
+                           max_iterations=max_iterations, condition=cond)
+        return g
+
+    def test_cyclic_forward(self):
+        g = self._counter_graph()
+        # iterations with cnt starting at 0: cnt_next=1,2,3,4 -> cond
+        # false after the 4th; x doubles once per executed iteration
+        out = g.forward((jnp.asarray(1.0), jnp.asarray(0.0)))
+        assert float(out) == 16.0
+
+    def test_cyclic_backward(self):
+        g = self._counter_graph()
+
+        def fn(x):
+            y, _ = g.apply(g.params(), g.state(), (x, jnp.asarray(0.0)))
+            return y
+
+        gx = jax.grad(fn)(jnp.asarray(1.0))
+        assert float(gx) == 16.0  # d(16x)/dx
+
+    def test_max_iterations_cap(self):
+        # cond never goes false within the cap: doubles (cap) times
+        g = self._counter_graph(max_iterations=2)
+        out = g.forward((jnp.asarray(1.0), jnp.asarray(-100.0)))
+        assert float(out) == 4.0  # 2 iterations only
+
+    def test_acyclic_dynamic_matches_static(self):
+        inp = N.Input()
+        h = N.AddConstant(3.0)(inp)
+        out = N.MulConstant(2.0)(h)
+        g_static = N.Graph(inp, out)
+
+        inp2 = N.Input()
+        h2 = N.AddConstant(3.0)(inp2)
+        out2 = N.MulConstant(2.0)(h2)
+        g_dyn = N.DynamicGraph(inp2, out2)
+        x = jnp.asarray([1.0, 2.0])
+        np.testing.assert_allclose(
+            np.asarray(g_static.forward(x)), np.asarray(g_dyn.forward(x)))
+
+    def test_jit_compatible(self):
+        g = self._counter_graph()
+
+        @jax.jit
+        def run(x, c):
+            y, _ = g.apply(g.params(), g.state(), (x, c))
+            return y
+
+        assert float(run(jnp.asarray(1.0), jnp.asarray(0.0))) == 16.0
+
+
+class TestTFControlFlowImport:
+    def test_switch_merge_graphdef(self):
+        """A TF cond subgraph (Switch/Merge) imports and selects."""
+        from bigdl_tpu.utils.tf_interop import GraphDefBuilder, TensorflowLoader
+
+        b = GraphDefBuilder()
+        b.placeholder("x")
+        b.placeholder("p")
+        b.op("sw", "Switch", ["x", "p"])
+        b.op("neg", "Neg", ["sw"])            # false branch (output 0)
+        b.op("rel", "Relu", ["sw:1"])         # true branch (output 1)
+        b.op("out", "Merge", ["neg", "rel"])
+        g = TensorflowLoader(data=b.tobytes()).load(
+            inputs=["x", "p"], outputs=["out"])
+        x = jnp.asarray([-1.0, 2.0])
+        np.testing.assert_allclose(
+            np.asarray(g.forward((x, jnp.asarray(True)))), [0.0, 2.0])
+        np.testing.assert_allclose(
+            np.asarray(g.forward((x, jnp.asarray(False)))), [1.0, -2.0])
+
+    def test_nested_cond_graphdef(self):
+        """Nested tf.cond: the OUTER Merge must select on the outer
+        predicate (regression: depth-first pred search grabbed the
+        inner Switch)."""
+        from bigdl_tpu.utils.tf_interop import GraphDefBuilder, TensorflowLoader
+
+        b = GraphDefBuilder()
+        b.placeholder("x")
+        b.placeholder("p1")
+        b.placeholder("p2")
+        b.op("sw1", "Switch", ["x", "p1"])
+        # outer false branch contains an inner cond on p2
+        b.op("sw2", "Switch", ["sw1", "p2"])
+        b.op("neg2", "Neg", ["sw2"])
+        b.op("rel2", "Relu", ["sw2:1"])
+        b.op("m2", "Merge", ["neg2", "rel2"])
+        # outer true branch
+        b.op("rel1", "Relu", ["sw1:1"])
+        b.op("out", "Merge", ["m2", "rel1"])
+        g = TensorflowLoader(data=b.tobytes()).load(
+            inputs=["x", "p1", "p2"], outputs=["out"])
+        x = jnp.asarray([-1.0, 2.0])
+        t, f = jnp.asarray(True), jnp.asarray(False)
+        # p1 True -> outer true branch: relu(x), regardless of p2
+        np.testing.assert_allclose(
+            np.asarray(g.forward((x, t, f))), [0.0, 2.0])
+        np.testing.assert_allclose(
+            np.asarray(g.forward((x, t, t))), [0.0, 2.0])
+        # p1 False, p2 False -> neg(x); p2 True -> relu(x)
+        np.testing.assert_allclose(
+            np.asarray(g.forward((x, f, f))), [1.0, -2.0])
+        np.testing.assert_allclose(
+            np.asarray(g.forward((x, f, t))), [0.0, 2.0])
+
+    def test_merge_inputs_swapped_order(self):
+        """A GraphDef listing the true branch first must still select
+        correctly (branch parity resolved by Switch port, not input
+        order)."""
+        from bigdl_tpu.utils.tf_interop import GraphDefBuilder, TensorflowLoader
+
+        b = GraphDefBuilder()
+        b.placeholder("x")
+        b.placeholder("p")
+        b.op("sw", "Switch", ["x", "p"])
+        b.op("neg", "Neg", ["sw"])
+        b.op("rel", "Relu", ["sw:1"])
+        b.op("out", "Merge", ["rel", "neg"])  # true branch listed first
+        g = TensorflowLoader(data=b.tobytes()).load(
+            inputs=["x", "p"], outputs=["out"])
+        x = jnp.asarray([-1.0, 2.0])
+        np.testing.assert_allclose(
+            np.asarray(g.forward((x, jnp.asarray(True)))), [0.0, 2.0])
+        np.testing.assert_allclose(
+            np.asarray(g.forward((x, jnp.asarray(False)))), [1.0, -2.0])
+
+
+class TestDynamicGraphSerialization:
+    def test_dynamic_graph_roundtrip_both_formats(self, tmp_path):
+        """A cyclic DynamicGraph must survive BOTH persistence formats
+        with its feedback edges, condition node and max_iterations
+        (regression: the proto path silently degraded it to a one-pass
+        static Graph)."""
+        from bigdl_tpu.utils.serializer import load_module, save_module
+
+        g = TestDynamicGraph()._counter_graph()
+        args = (jnp.asarray(1.0), jnp.asarray(0.0))
+        out1 = float(g.forward(args))
+        assert out1 == 16.0
+        for name in ("dyn.npz", "dyn.bigdl"):
+            path = save_module(g, str(tmp_path / name))
+            g2 = load_module(path)
+            assert type(g2).__name__ == "DynamicGraph", name
+            assert float(g2.forward(args)) == out1, name

@@ -264,3 +264,126 @@ def test_distributed_dataset_trains_single_process():
     (acc,) = evaluate_dataset(trained, ArrayDataSet(x, y, 64),
                               [Top1Accuracy()])
     assert acc.result()[0] > 0.9
+
+
+def test_sharded_evaluate_matches_single_device():
+    """Distributed evaluate (VERDICT r2 #3): the P(data)-sharded eval
+    forward over the 8-device mesh must reproduce single-device results
+    exactly, including a ragged tail batch (padded + sliced)."""
+    x, y = _toy(100)  # 100 % 8 != 0: exercises the pad/slice path
+    model = _model()
+    model.evaluate()
+    ds = ArrayDataSet(x, y, 32, shuffle=False)
+    (single,) = evaluate_dataset(model, ds, [Top1Accuracy()])
+    (sharded,) = evaluate_dataset(model, ds, [Top1Accuracy()],
+                                  mesh=Engine.mesh())
+    assert single.result() == sharded.result()
+
+
+def test_sharded_predict_matches_single_device():
+    from bigdl_tpu.optim.evaluator import predict
+
+    x, _ = _toy(37)
+    model = _model()
+    np.testing.assert_allclose(
+        predict(model, x, batch_size=16),
+        predict(model, x, batch_size=16, mesh=Engine.mesh()),
+        rtol=1e-6,
+    )
+
+
+def test_distri_validation_uses_device_resident_params():
+    """_run_validation must not round-trip weights through the host:
+    _write_back is only called at the end of optimize(), not per
+    validation trigger."""
+    x, y = _toy(256)
+    model = _model()
+    opt = DistriOptimizer(model, (x, y), ClassNLLCriterion(), batch_size=64)
+    opt.set_optim_method(SGD(learningrate=0.5))
+    opt.set_end_when(Trigger.max_epoch(3))
+    opt.set_validation(Trigger.every_epoch(), (x, y), [Top1Accuracy()])
+
+    calls = {"write_back": 0, "validate": 0}
+    orig_wb = opt._write_back
+    orig_rv = opt._run_validation
+
+    def counting_wb(pvar, mstate):
+        calls["write_back"] += 1
+        return orig_wb(pvar, mstate)
+
+    def counting_rv(pvar=None, mstate=None):
+        calls["validate"] += 1
+        assert pvar is not None, "validation must receive device params"
+        return orig_rv(pvar, mstate)
+
+    opt._write_back = counting_wb
+    opt._run_validation = counting_rv
+    opt.optimize()
+    assert calls["validate"] >= 3
+    assert calls["write_back"] == 1, calls  # only the final write-back
+    assert opt.state["score"] is not None
+
+
+def test_distri_retry_from_checkpoint(tmp_path):
+    """Failure semantics (VERDICT r2 #4; SURVEY.md §5): inject a failure
+    mid-training; DistriOptimizer must reload the last checkpoint, rewind
+    epoch/neval, and converge to EXACTLY the same weights as an
+    uninterrupted run (same data order, same per-step RNG folding)."""
+    from bigdl_tpu.common import RandomGenerator
+
+    x, y = _toy(256)
+    ds = ArrayDataSet(x, y, 64, shuffle=False)  # 4 iterations / epoch
+
+    def build(seed=11):
+        RandomGenerator.RNG.set_seed(seed)
+        return _model()
+
+    # --- uninterrupted reference run ---
+    m_ref = build()
+    ref = DistriOptimizer(m_ref, ds, ClassNLLCriterion(), batch_size=64)
+    ref.set_optim_method(SGD(learningrate=0.2, momentum=0.9))
+    ref.set_end_when(Trigger.max_epoch(3))
+    ref.optimize()
+
+    # --- run with injected failure at epoch 2, first batch ---
+    m = build()
+    opt = DistriOptimizer(m, ds, ClassNLLCriterion(), batch_size=64)
+    opt.set_optim_method(SGD(learningrate=0.2, momentum=0.9))
+    opt.set_end_when(Trigger.max_epoch(3))
+    opt.set_checkpoint(str(tmp_path), Trigger.every_epoch())
+
+    armed = {"on": True}
+    orig_put = opt._put_batch
+
+    def poisoned_put(inp, tgt):
+        if armed["on"] and opt.state["neval"] == 5:
+            armed["on"] = False
+            raise RuntimeError("injected executor loss")
+        return orig_put(inp, tgt)
+
+    opt._put_batch = poisoned_put
+    opt.optimize()
+
+    assert not armed["on"], "failure was never injected"
+    # resumed counters continued correctly (3 epochs * 4 iters + 1)
+    assert opt.state["neval"] == 13, opt.state
+    for a, b in zip(m.get_weights(), m_ref.get_weights()):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+
+
+def test_metrics_logged_per_epoch(caplog):
+    """VERDICT r2 #7: metrics.summary() phase averages must appear in
+    the training log each epoch, with the reference's metric names."""
+    import logging
+
+    x, y = _toy(128)
+    model = _model()
+    opt = DistriOptimizer(model, (x, y), ClassNLLCriterion(), batch_size=64)
+    opt.set_optim_method(SGD(learningrate=0.1))
+    opt.set_end_when(Trigger.max_epoch(1))
+    with caplog.at_level(logging.INFO, logger="bigdl_tpu.optim"):
+        opt.optimize()
+    lines = [r.message for r in caplog.records if r.message.startswith("Metrics:")]
+    assert lines, "no Metrics summary line logged"
+    assert "computing time average" in lines[-1]
+    assert "data wait time average" in lines[-1]

@@ -266,6 +266,9 @@ def test_every_exported_layer_is_covered_or_known():
         "MultiRNNCell", "ConvLSTMPeephole",  # own specs in test_layers_extra
         "LayerNorm", "MultiHeadAttention", "TransformerBlock",
         "PositionalEmbedding",
+        # control flow: own specs in test_control_ops.py
+        "DynamicGraph", "SwitchOps", "MergeOps", "IfElse", "WhileLoop",
+        "LoopCondition", "NextIteration",
         # sparse layers operate on SparseTensor inputs (own spec)
         "SparseLinear", "LookupTableSparse", "SparseJoinTable",
         # quantized layers are constructed from float twins (own spec)
