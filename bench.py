@@ -413,7 +413,7 @@ def _run_child(platform: str):
             fw_b, step_b = _bench_framework(xb, yb, b, iters,
                                             compute_dtype="bfloat16")
         except Exception as e:  # OOM at large batch: record + continue
-            sweep[str(b)] = {"error": f"{type(e).__name__}"}
+            sweep[str(b)] = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
             continue
         entry = {"images_per_sec": round(fw_b, 2),
                  "step_time_s": round(step_b, 4)}

@@ -22,5 +22,7 @@ upstream 0.x layout).
 
 from bigdl_tpu.engine import Engine
 from bigdl_tpu.common import RandomGenerator
+from bigdl_tpu.config import config, configure
+from bigdl_tpu.tensor import Tensor
 
 __version__ = "0.1.0"

@@ -1,0 +1,129 @@
+"""Unified configuration (VERDICT r2 missing #5; SURVEY.md §5 "Config").
+
+The reference spreads configuration across three tiers — a required
+Spark-conf file (⟦dist/conf/spark-bigdl.conf⟧), ``bigdl.*`` JVM system
+properties (bigdl.engineType, bigdl.coreNumber, bigdl.check.singleton,
+…), and per-app scopt CLIs — with *no unified typed object*.  SURVEY §5
+prescribes the rebuild use "one dataclass-based config + absl-style
+flags; keep bigdl.* spellings as env aliases only where examples need
+them".
+
+This is that object.  One process-global :class:`BigDLConfig`, resolved
+once from (highest wins): explicit ``configure(...)`` calls → ``BIGDL_*``
+environment variables → dataclass defaults.  Every ``BIGDL_*`` env var
+the framework honours is declared here — subsystems read the config
+object, not ``os.environ`` — so ``python -c "import bigdl_tpu;
+print(bigdl_tpu.config.describe())"`` is the single source of truth.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+
+def _env_bool(name: str, default: bool) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    return default if v is None else int(v)
+
+
+def _env_str(name: str, default):
+    return os.environ.get(name, default)
+
+
+@dataclasses.dataclass
+class BigDLConfig:
+    """Process-global framework configuration.
+
+    Fields map 1:1 onto the reference's ``bigdl.*`` properties where one
+    exists; the env alias is the ``BIGDL_*`` spelling shown per field.
+    """
+
+    # --- engine (reference: bigdl.check.singleton, Engine.init) ---------
+    # refuse a second Engine.init in one process [BIGDL_CHECK_SINGLETON]
+    check_singleton: bool = False
+    # multi-host coordinator for jax.distributed.initialize
+    # [BIGDL_COORDINATOR_ADDRESS / BIGDL_NUM_PROCESSES / BIGDL_PROCESS_ID]
+    coordinator_address: Optional[str] = None
+    num_processes: int = 1
+    process_id: int = 0
+
+    # --- native host library [BIGDL_TPU_NO_NATIVE] ----------------------
+    # skip loading the C++ host data-plane .so (numpy fallback)
+    no_native: bool = False
+
+    # --- logging (reference: LoggerFilter) ------------------------------
+    # [BIGDL_DISABLE_LOGGER] / [BIGDL_LOG_PATH]
+    disable_logger: bool = False
+    log_path: Optional[str] = None
+
+    # --- profiling [BIGDL_PROFILE] --------------------------------------
+    # directory for a jax.profiler trace of the first optimizer steps
+    profile_dir: Optional[str] = None
+
+    # --- benchmarking [BENCH_* kept for bench.py compat] ----------------
+
+    @classmethod
+    def from_env(cls) -> "BigDLConfig":
+        return cls(
+            check_singleton=_env_bool("BIGDL_CHECK_SINGLETON", False),
+            coordinator_address=_env_str("BIGDL_COORDINATOR_ADDRESS", None),
+            num_processes=_env_int("BIGDL_NUM_PROCESSES", 1),
+            process_id=_env_int("BIGDL_PROCESS_ID", 0),
+            no_native=_env_bool("BIGDL_TPU_NO_NATIVE", False),
+            disable_logger=_env_bool("BIGDL_DISABLE_LOGGER", False),
+            log_path=_env_str("BIGDL_LOG_PATH", None),
+            profile_dir=_env_str("BIGDL_PROFILE", None),
+        )
+
+    def describe(self) -> str:
+        lines = [f"{f.name} = {getattr(self, f.name)!r}"
+                 for f in dataclasses.fields(self)]
+        return "BigDLConfig:\n  " + "\n  ".join(lines)
+
+
+# the process-global instance (resolved from env at import)
+config = BigDLConfig.from_env()
+
+# fields pinned by an explicit configure() call: env refreshes skip them
+_explicit: set = set()
+
+
+def configure(**kwargs) -> BigDLConfig:
+    """Override config fields programmatically (highest-priority tier).
+    Returns the global config for chaining/inspection."""
+    for k, v in kwargs.items():
+        if not hasattr(config, k):
+            raise AttributeError(f"unknown config field {k!r}; fields: "
+                                 + ", ".join(f.name for f in
+                                             dataclasses.fields(config)))
+        setattr(config, k, v)
+        _explicit.add(k)
+    return config
+
+
+def refresh_from_env() -> BigDLConfig:
+    """Re-read ``BIGDL_*`` env vars for every field NOT pinned by
+    configure().  Subsystems with a read-at-call-time contract (e.g.
+    ``Engine.init`` honoring a coordinator exported after import) call
+    this before reading the config."""
+    fresh = BigDLConfig.from_env()
+    for f in dataclasses.fields(fresh):
+        if f.name not in _explicit:
+            setattr(config, f.name, getattr(fresh, f.name))
+    return config
+
+
+def reload_from_env() -> BigDLConfig:
+    """Re-resolve everything from the environment, dropping configure()
+    overrides (tests mutate os.environ)."""
+    _explicit.clear()
+    return refresh_from_env()

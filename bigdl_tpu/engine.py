@@ -63,9 +63,12 @@ class Engine:
         """
         import jax
 
-        if cls._state.initialized and os.environ.get(
-            "BIGDL_CHECK_SINGLETON", "false"
-        ).lower() in ("true", "1"):
+        from bigdl_tpu.config import config, refresh_from_env
+
+        # launchers export BIGDL_* after import but before init — honor
+        # them (read-at-call-time contract; configure() overrides win)
+        refresh_from_env()
+        if cls._state.initialized and config.check_singleton:
             # bigdl.check.singleton analogue
             raise RuntimeError(
                 "Engine.init called twice with BIGDL_CHECK_SINGLETON set; "
@@ -75,12 +78,11 @@ class Engine:
         # spark-submit compatibility: if the launcher exported coordinator
         # env vars, join the multi-host world (SURVEY.md §2.5 "TPU-native
         # equivalent").
-        coord = os.environ.get("BIGDL_COORDINATOR_ADDRESS")
-        if coord and not cls._state.initialized:
+        if config.coordinator_address and not cls._state.initialized:
             jax.distributed.initialize(
-                coordinator_address=coord,
-                num_processes=int(os.environ.get("BIGDL_NUM_PROCESSES", "1")),
-                process_id=int(os.environ.get("BIGDL_PROCESS_ID", "0")),
+                coordinator_address=config.coordinator_address,
+                num_processes=config.num_processes,
+                process_id=config.process_id,
             )
 
         devices = jax.devices(backend) if backend else jax.devices()

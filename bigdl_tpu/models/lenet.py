@@ -76,7 +76,8 @@ def train_lenet(
     return trained, optimizer
 
 
-if __name__ == "__main__":
+def main(argv=None):
+    """Console entry (reference: models/lenet Train.scala CLI)."""
     import argparse
     import logging
 
@@ -88,6 +89,10 @@ if __name__ == "__main__":
     ap.add_argument("--learning-rate", type=float, default=0.05)
     ap.add_argument("--checkpoint", default=None)
     ap.add_argument("--distributed", action="store_true")
-    args = ap.parse_args()
+    args = ap.parse_args(argv)
     train_lenet(args.data_dir, args.batch_size, args.max_epoch,
                 args.learning_rate, args.checkpoint, args.distributed)
+
+
+if __name__ == "__main__":
+    main()

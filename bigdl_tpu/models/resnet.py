@@ -158,3 +158,43 @@ def imagenet_recipe_optim(batch_size: int, n_epochs: int = 90,
     return SGD(learningrate=0.1 if warm_iters > 0 else base_lr,
                momentum=0.9, dampening=0.0, nesterov=True,
                weightdecay=1e-4, learningrate_schedule=sched)
+
+
+def main(argv=None):
+    """Console entry (reference: models/resnet TrainCIFAR10/TrainImageNet
+    Train.scala CLI).  Trains the CIFAR variant; with no CIFAR data on
+    disk a separable synthetic task stands in (examples/ has the full
+    pipeline)."""
+    import argparse
+    import logging
+
+    import numpy as np
+
+    from bigdl_tpu.nn import ClassNLLCriterion
+    from bigdl_tpu.optim import Optimizer, SGD, Top1Accuracy, Trigger
+
+    logging.basicConfig(level=logging.INFO)
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--depth", type=int, default=20)
+    ap.add_argument("-b", "--batch-size", type=int, default=128)
+    ap.add_argument("-e", "--max-epoch", type=int, default=1)
+    ap.add_argument("--learning-rate", type=float, default=0.1)
+    ap.add_argument("-n", "--num-samples", type=int, default=1024)
+    ap.add_argument("--distributed", action="store_true")
+    args = ap.parse_args(argv)
+
+    model = build_resnet_cifar(depth=args.depth)
+    rs = np.random.RandomState(0)
+    x = rs.rand(args.num_samples, 3, 32, 32).astype(np.float32)
+    y = (rs.randint(0, 10, args.num_samples) + 1).astype(np.float32)
+    opt = Optimizer(model, (x, y), ClassNLLCriterion(),
+                    batch_size=args.batch_size,
+                    distributed=args.distributed or None)
+    opt.set_optim_method(SGD(learningrate=args.learning_rate, momentum=0.9))
+    opt.set_end_when(Trigger.max_epoch(args.max_epoch))
+    opt.set_validation(Trigger.every_epoch(), (x, y), [Top1Accuracy()])
+    opt.optimize()
+
+
+if __name__ == "__main__":
+    main()

@@ -84,9 +84,20 @@ def train_ptb(data_tokens=None, vocab_size: int = 100, batch_size: int = 20,
     return trained, opt, ppl
 
 
-if __name__ == "__main__":
+def main(argv=None):
+    """Console entry (reference: models/rnn Train.scala — PTB LM)."""
+    import argparse
     import logging
 
     logging.basicConfig(level=logging.INFO)
-    model, opt, ppl = train_ptb()
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-b", "--batch-size", type=int, default=20)
+    ap.add_argument("-e", "--max-epoch", type=int, default=2)
+    args = ap.parse_args(argv)
+    model, opt, ppl = train_ptb(batch_size=args.batch_size,
+                                max_epoch=args.max_epoch)
     print(f"final train perplexity: {ppl:.2f}")
+
+
+if __name__ == "__main__":
+    main()

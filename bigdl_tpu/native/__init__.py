@@ -45,7 +45,10 @@ def _try_build() -> bool:
     if _build_attempted:
         return os.path.exists(_SO_PATH)
     _build_attempted = True
-    if os.environ.get("BIGDL_TPU_NO_NATIVE"):
+    from bigdl_tpu.config import config, refresh_from_env
+
+    refresh_from_env()
+    if config.no_native:
         return False
     try:
         subprocess.run(

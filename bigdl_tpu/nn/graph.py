@@ -193,6 +193,13 @@ class DynamicGraph(Graph):
     MXU-friendly.  ``max_iterations`` bounds the unroll (the compiled
     program always scans that many steps; masked steps are cheap).
 
+    ⚠ Loop semantics are **do-while**: the body executes at least once
+    (the graph's outputs only exist downstream of the body, so a
+    zero-trip result is undefinable here), and the condition — computed
+    within the same pass — gates every subsequent iteration.  A loop
+    whose trip count can be zero needs :class:`WhileLoop`
+    (``lax.while_loop``), which pre-checks the condition like TF.
+
     Acyclic DynamicGraphs (e.g. Switch/Merge conditionals) execute
     exactly like the static Graph — select semantics make the DAG
     engine sufficient.

@@ -375,6 +375,45 @@ class Sequential(Container):
             new_state[str(i)] = s
         return x, new_state
 
+    def to_graph(self, input_node=None):
+        """Convert this chain (incl. nested Sequential/Concat branches —
+        the Inception shape) into a node Graph.  The Graph shares the
+        child module objects, so weights stay live; interop exporters
+        (CaffePersister, TensorflowSaver) need the node topology.
+        Reference analogue: StaticGraph conversion (toGraph) in
+        ⟦«bigdl»/nn/Graph.scala⟧."""
+        from bigdl_tpu.nn.graph import Graph, Input
+        from bigdl_tpu.nn.table_ops import Concat, JoinTable
+
+        root = input_node if input_node is not None else Input("data")
+
+        def chain(seq, node):
+            for m in seq.modules:
+                if isinstance(m, Sequential):
+                    node = chain(m, node)
+                elif isinstance(m, Concat):
+                    tails = []
+                    for branch in m.modules:
+                        if isinstance(branch, Sequential):
+                            tails.append(chain(branch, node))
+                        else:
+                            tails.append(branch(node))
+                    join = JoinTable(m.dimension)
+                    if m._name:
+                        join.set_name(m._name)
+                    node = join(*tails)
+                else:
+                    node = m(node)
+            return node
+
+        out = chain(self, root)
+        if input_node is not None:
+            return out  # caller wires the enclosing graph
+        g = Graph(root, out)
+        if self._name:
+            g.set_name(self._name)
+        return g
+
     def __repr__(self):
         body = "\n".join(f"  ({i}): {m!r}" for i, m in enumerate(self.modules))
         return f"Sequential {{\n{body}\n}}"

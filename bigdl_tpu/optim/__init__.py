@@ -34,6 +34,9 @@ from bigdl_tpu.optim.validation import (
     Top5Accuracy,
     Loss,
     MAE,
+    TreeNNAccuracy,
+    HitRatio,
+    NDCG,
 )
 from bigdl_tpu.optim.optimizer import Optimizer, LocalOptimizer
 from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
@@ -47,6 +50,6 @@ __all__ = [
     "L1Regularizer", "L2Regularizer", "L1L2Regularizer",
     "Trigger",
     "ValidationMethod", "ValidationResult", "Top1Accuracy", "Top5Accuracy",
-    "Loss", "MAE",
+    "Loss", "MAE", "TreeNNAccuracy", "HitRatio", "NDCG",
     "Optimizer", "LocalOptimizer", "DistriOptimizer", "Metrics",
 ]

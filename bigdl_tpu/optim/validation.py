@@ -101,3 +101,58 @@ class MAE(ValidationMethod):
         n = out.shape[0]
         return ValidationResult(float(np.sum(np.abs(out - t))) / max(1, out[0].size),
                                 n, self.name)
+
+
+class TreeNNAccuracy(ValidationMethod):
+    """⟦«bigdl»/optim/ValidationMethod.scala⟧ TreeNNAccuracy — accuracy
+    for tree-structured outputs (Tree-LSTM sentiment): the prediction
+    is the argmax of the ROOT node's distribution, i.e. the first slice
+    along the node axis of a (batch, nodes, classes) output."""
+
+    name = "TreeNNAccuracy"
+
+    def batch_result(self, output, target):
+        out = np.asarray(output)
+        t = np.asarray(target)
+        if out.ndim >= 3:
+            out = out[:, 0]  # root node distribution
+        if t.ndim >= 2:
+            t = t[:, 0]
+        t = t.reshape(-1).astype(np.int64)
+        pred = np.argmax(out.reshape(-1, out.shape[-1]), axis=-1) + 1
+        correct = int(np.sum(pred == t))
+        return ValidationResult(correct, t.size, self.name)
+
+
+class HitRatio(ValidationMethod):
+    """⟦«bigdl»⟧ HitRatio@k (recommender evaluation): fraction of
+    positives ranked inside the top k of their negative pool."""
+
+    name = "HitRatio"
+
+    def __init__(self, k: int = 10, neg_num: int = 99):
+        self.k = k
+        self.neg_num = neg_num
+
+    def batch_result(self, output, target):
+        out = np.asarray(output).reshape(-1, self.neg_num + 1)
+        # item 0 of each group is the positive; hit if within top-k
+        rank = np.sum(out > out[:, :1], axis=1) + 1
+        hits = int(np.sum(rank <= self.k))
+        return ValidationResult(hits, out.shape[0], self.name)
+
+
+class NDCG(ValidationMethod):
+    """⟦«bigdl»⟧ NDCG@k for the same positive-vs-negatives layout."""
+
+    name = "NDCG"
+
+    def __init__(self, k: int = 10, neg_num: int = 99):
+        self.k = k
+        self.neg_num = neg_num
+
+    def batch_result(self, output, target):
+        out = np.asarray(output).reshape(-1, self.neg_num + 1)
+        rank = np.sum(out > out[:, :1], axis=1) + 1
+        gain = np.where(rank <= self.k, 1.0 / np.log2(rank + 1.0), 0.0)
+        return ValidationResult(float(np.sum(gain)), out.shape[0], self.name)

@@ -25,11 +25,13 @@ def redirect_spark_info_logs(
     INFO.  Honors the reference's system-property overrides via env:
     ``BIGDL_DISABLE_LOGGER=1`` skips everything, ``BIGDL_LOG_PATH``
     overrides the file location."""
-    if os.environ.get("BIGDL_DISABLE_LOGGER", "").lower() in ("1", "true"):
+    from bigdl_tpu.config import config, refresh_from_env
+
+    refresh_from_env()
+    if config.disable_logger:
         return
-    log_path = log_path or os.environ.get(
-        "BIGDL_LOG_PATH", os.path.join(os.getcwd(), "bigdl.log")
-    )
+    log_path = log_path or config.log_path \
+        or os.path.join(os.getcwd(), "bigdl.log")
     _MARK = "_bigdl_tpu_logger_filter"
     fmt = logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s")
     file_handler = logging.FileHandler(log_path)

@@ -57,7 +57,9 @@ class StepProfiler:
 
     def __init__(self, log_dir: Optional[str] = None, skip: int = 1,
                  steps: int = PROFILE_STEPS):
-        self.log_dir = log_dir or os.environ.get(PROFILE_ENV)
+        from bigdl_tpu.config import config
+
+        self.log_dir = log_dir or config.profile_dir
         self.skip = skip
         self.steps = steps
         self._n = 0

@@ -301,22 +301,29 @@ class TensorflowLoader:
     # the converted module returns a tuple, picked via SelectTable
     _MULTI_OUTPUT_OPS = ("Switch",)
 
-    def _switch_ancestors(self, name: str, _depth: int = 0):
+    def _switch_ancestors(self, name: str, _depth: int = 0, _memo=None):
         """All Switch ancestors reachable from ``name``:
         {pred_base_name: {"ports": {0|1,...}, "depth": min, "ref": pred}}
         where a port is the Switch output the path rode (0=false,
         1=true).  Used to find a Merge's *controlling* Switch: for
         nested conds, the controlling predicate is the one common to
-        both Merge inputs with a distinct single port on each side."""
-        result: Dict[str, dict] = {}
-        if _depth > 64:
-            return result
+        both Merge inputs with a distinct single port on each side.
+        Memoized per raw ref so reconvergent (residual/diamond) graphs
+        stay linear instead of enumerating every path."""
+        if _memo is None:
+            _memo = {}
         raw = name[1:] if name.startswith("^") else name
+        if raw in _memo:
+            return _memo[raw]
+        result: Dict[str, dict] = {}
+        if _depth > 256:
+            return result
         base, _, idx = raw.partition(":")
         port = int(idx) if idx else 0
         nd = self.nodes.get(base)
         if nd is None:
             return result
+        _memo[raw] = result  # cycle guard; filled in place below
         if nd.op == "Switch":
             data_in, pred_in = self._data_inputs(nd)[:2]
             key = _clean(pred_in)
@@ -328,7 +335,7 @@ class TensorflowLoader:
         else:
             ups = self._data_inputs(nd)
         for i in ups:
-            for k, v in self._switch_ancestors(i, _depth + 1).items():
+            for k, v in self._switch_ancestors(i, _depth + 1, _memo).items():
                 if k in result:
                     result[k]["ports"] |= v["ports"]
                     result[k]["depth"] = min(result[k]["depth"], v["depth"])

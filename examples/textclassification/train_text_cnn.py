@@ -15,17 +15,6 @@ import logging
 import numpy as np
 
 
-def synthetic_corpus(n_docs=1536, n_classes=4, vocab=200, doc_len=32, seed=0):
-    rs = np.random.RandomState(seed)
-    x = rs.randint(6, vocab, size=(n_docs, doc_len))
-    y = rs.randint(0, n_classes, n_docs)
-    for i in range(n_docs):
-        # plant 1-based signature tokens (ids 1..n_classes) for the class
-        pos = rs.choice(doc_len, size=6, replace=False)
-        x[i, pos] = y[i] + 1
-    return x.astype(np.float32), (y + 1).astype(np.float32)
-
-
 def build_text_cnn(vocab, embed=32, n_classes=4, doc_len=32):
     from bigdl_tpu.nn import (
         Linear, LogSoftMax, LookupTable, Max, ReLU, Sequential,
@@ -43,20 +32,59 @@ def build_text_cnn(vocab, embed=32, n_classes=4, doc_len=32):
     )
 
 
+def tokenize_corpus(docs, doc_len=128, vocab_limit=20000):
+    """[(text, label)] -> padded id matrix via the Dictionary pipeline
+    (reference: news20 GloVe+CNN example preprocessing)."""
+    from bigdl_tpu.dataset.text import Dictionary
+
+    tokenized = [d.lower().split() for d, _ in docs]
+    dic = Dictionary(tokenized, vocab_size=vocab_limit)
+    x = np.zeros((len(docs), doc_len), np.float32)
+    for i, toks in enumerate(tokenized):
+        for j, tok in enumerate(toks[:doc_len]):
+            # ids are 1-based for LookupTable; 0 stays padding
+            x[i, j] = dic.get_index(tok, 0) + 1
+    y = np.asarray([label for _, label in docs], np.float32)
+    return x, y, dic
+
+
+def load_corpus(data_dir=None, doc_len=128):
+    """news20 from disk when present (bigdl_tpu.dataset.news20), else
+    the deterministic synthetic stand-in — same pipeline either way."""
+    from bigdl_tpu.dataset.news20 import get_news20, synthetic_news20
+
+    try:
+        docs = get_news20(data_dir) if data_dir else get_news20()
+        n_classes = 20
+    except FileNotFoundError:
+        logging.getLogger(__name__).info(
+            "no news20 corpus on disk; using the synthetic stand-in")
+        docs = synthetic_news20(1536, class_num=4)
+        n_classes = 4
+    x, y, dic = tokenize_corpus(docs, doc_len)
+    return x, y, len(dic) + 1, n_classes
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("-b", "--batch-size", type=int, default=128)
     ap.add_argument("-e", "--max-epoch", type=int, default=3)
     ap.add_argument("--learning-rate", type=float, default=0.05)
+    ap.add_argument("-f", "--data-dir", default=None,
+                    help="dir containing 20news-18828 (else synthetic)")
+    ap.add_argument("--doc-len", type=int, default=32)
     args = ap.parse_args()
 
     logging.basicConfig(level=logging.INFO)
     from bigdl_tpu.nn import ClassNLLCriterion
     from bigdl_tpu.optim import Adam, Optimizer, Top1Accuracy, Trigger
 
-    x, y = synthetic_corpus()
-    n_val = 256
-    model = build_text_cnn(vocab=200)
+    x, y, vocab, n_classes = load_corpus(args.data_dir, args.doc_len)
+    perm = np.random.RandomState(0).permutation(len(x))
+    x, y = x[perm], y[perm]
+    n_val = max(64, len(x) // 8)
+    model = build_text_cnn(vocab=vocab, n_classes=n_classes,
+                           doc_len=args.doc_len)
     optimizer = Optimizer(
         model=model,
         training_set=(x[:-n_val], y[:-n_val]),

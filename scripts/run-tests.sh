@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Test runner (VERDICT r2 #9; reference: dl/src/test run-tests*.sh).
+# Forces the 8-virtual-device CPU backend the suite expects (the
+# reference's local[4]-Spark-master trick, SURVEY.md §4.5) and runs
+# pytest.  Usage: scripts/run-tests.sh [pytest args]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
+export JAX_PLATFORMS=cpu
+
+exec python -m pytest tests/ -q "$@"

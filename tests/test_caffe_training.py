@@ -1,0 +1,195 @@
+"""BASELINE parity config 3 (VERDICT r2 #5): Inception-v1 and VGG-16
+through the Caffe loader as a TRAINING entry — persist with
+CaffePersister, reload with CaffeLoader, train under DistriOptimizer,
+assert the loss decreases.  Reference: ⟦«bigdl»/models/inception⟧,
+⟦«bigdl»/utils/caffe/⟧.
+
+The always-on tests use reduced geometries (full 224px Inception/VGG
+fwd+bwd on the 1-core CPU box would take minutes); the full-size
+builders go through the same export/load code path in a slow-tagged
+spec.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from bigdl_tpu.engine import Engine
+from bigdl_tpu.nn import CrossEntropyCriterion
+from bigdl_tpu.optim import DistriOptimizer, SGD, Trigger
+from bigdl_tpu.utils.caffe import CaffeLoader, CaffePersister
+
+
+@pytest.fixture(autouse=True)
+def _engine():
+    Engine.reset()
+    Engine.init()
+    yield
+    Engine.reset()
+
+
+def _train_caffe_roundtrip(model, input_shape, tmp_path, n_classes,
+                           batch=16, steps=20, lr=0.2):
+    """Persist -> reload -> DistriOptimizer for `steps`; return losses."""
+    g = model.to_graph()
+    g.evaluate()
+    proto = str(tmp_path / "net.prototxt")
+    cm = str(tmp_path / "net.caffemodel")
+    CaffePersister.save(g, proto, cm, input_shape=input_shape)
+
+    loaded = CaffeLoader(prototxt_path=proto, model_path=cm).load()
+    loaded.evaluate()  # parity check must not sample Dropout
+
+    # fwd parity first: the reloaded net IS the exported net
+    x0 = np.random.RandomState(0).randn(2, *input_shape).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(loaded.forward(jnp.asarray(x0))),
+        np.asarray(g.forward(jnp.asarray(x0))),
+        rtol=2e-4, atol=2e-5,
+    )
+
+    rs = np.random.RandomState(1)
+    n = batch * 2
+    x = rs.rand(n, *input_shape).astype(np.float32)
+    y = (rs.randint(0, n_classes, n) + 1).astype(np.float32)
+
+    losses = []
+    loaded.training()
+    # Caffe training idiom: net emits logits, the loss fuses
+    # softmax+NLL (SoftmaxWithLoss) — CrossEntropyCriterion here
+    opt = DistriOptimizer(loaded, (x, y), CrossEntropyCriterion(),
+                          batch_size=batch)
+    opt.set_optim_method(SGD(learningrate=lr, momentum=0.9))
+    opt.set_end_when(Trigger.max_iteration(steps))
+
+    # record per-step losses via the state hook
+    class LossTap:
+        def __init__(self):
+            self.vals = []
+
+        def __call__(self, state):
+            # end_when fires more than once per iteration (loop + epoch
+            # checks): key on neval so each step records once
+            if state["loss"] is not None and state["neval"] != getattr(
+                    self, "_last", None):
+                self._last = state["neval"]
+                self.vals.append(state["loss"])
+            return False
+
+    tap = LossTap()
+    end_when = opt.end_when
+    opt.set_end_when(lambda s: (tap(s) or end_when(s)))
+    opt.optimize()
+    return tap.vals
+
+
+def _tiny_inception(n_classes=5):
+    """Inception-v1's exact module shape at reduced width/geometry:
+    stem conv + LRN + two inception_layer_v1 blocks + avgpool head."""
+    from bigdl_tpu.models.inception import inception_layer_v1
+    from bigdl_tpu.nn import (
+        Dropout, Linear, ReLU, Reshape, Sequential,
+        SpatialAveragePooling, SpatialConvolution, SpatialCrossMapLRN,
+        SpatialMaxPooling,
+    )
+
+    return (
+        Sequential()
+        .add(SpatialConvolution(3, 16, 3, 3, 1, 1, 1, 1).set_name("conv1"))
+        .add(ReLU())
+        .add(SpatialMaxPooling(2, 2, 2, 2).ceil().set_name("pool1"))
+        .add(SpatialCrossMapLRN(5, 0.0001, 0.75).set_name("norm1"))
+        .add(inception_layer_v1(16, [[8], [8, 12], [4, 6], [6]], "inc_a/"))
+        .add(inception_layer_v1(32, [[12], [8, 16], [4, 8], [8]], "inc_b/"))
+        .add(SpatialAveragePooling(8, 8, 1, 1).set_name("pool5"))
+        .add(Dropout(0.05))
+        .add(Reshape([44]))
+        .add(Linear(44, n_classes).set_name("fc"))
+    )
+
+
+def _tiny_vgg(n_classes=5):
+    """VGG-16's conv-conv-pool pattern at 16px/reduced width."""
+    from bigdl_tpu.nn import (
+        Linear, ReLU, Reshape, Sequential, SpatialConvolution,
+        SpatialMaxPooling,
+    )
+
+    def block(seq, n_in, n_out, convs):
+        for i in range(convs):
+            seq.add(SpatialConvolution(n_in if i == 0 else n_out, n_out,
+                                       3, 3, 1, 1, 1, 1))
+            seq.add(ReLU())
+        seq.add(SpatialMaxPooling(2, 2, 2, 2))
+        return seq
+
+    m = Sequential()
+    block(m, 3, 8, 2)     # 16 -> 8
+    block(m, 8, 16, 2)    # 8 -> 4
+    block(m, 16, 32, 3)   # 4 -> 2
+    m.add(Reshape([32 * 2 * 2])) \
+        .add(Linear(32 * 2 * 2, 64)).add(ReLU()) \
+        .add(Linear(64, n_classes))
+    return m
+
+
+def test_inception_caffe_training_loss_decreases(tmp_path):
+    losses = _train_caffe_roundtrip(
+        _tiny_inception(), (3, 16, 16), tmp_path, n_classes=5)
+    assert len(losses) >= 10
+    # dropout keeps per-step loss noisy: compare leading vs trailing mean
+    assert np.mean(losses[-5:]) < np.mean(losses[:3]), losses
+
+
+def test_vgg_caffe_training_loss_decreases(tmp_path):
+    losses = _train_caffe_roundtrip(
+        _tiny_vgg(), (3, 16, 16), tmp_path, n_classes=5)
+    assert len(losses) >= 10
+    assert np.mean(losses[-5:]) < np.mean(losses[:3]), losses
+
+
+@pytest.mark.slow
+def test_full_inception_v1_caffe_roundtrip(tmp_path):
+    """The real build_inception_v1 exports + reloads (224px, forward
+    parity on one sample)."""
+    from bigdl_tpu.models.inception import build_inception_v1
+
+    model = build_inception_v1(class_num=1000, has_dropout=False)
+    g = model.to_graph()
+    g.evaluate()
+    proto = str(tmp_path / "inception.prototxt")
+    cm = str(tmp_path / "inception.caffemodel")
+    CaffePersister.save(g, proto, cm, input_shape=(3, 224, 224))
+    loaded = CaffeLoader(prototxt_path=proto, model_path=cm).load()
+    loaded.evaluate()
+    x = np.random.RandomState(0).randn(1, 3, 224, 224).astype(np.float32)
+    # caffe has no LogSoftmax type: the exported tail round-trips as
+    # Softmax, so compare in log space
+    np.testing.assert_allclose(
+        np.log(np.asarray(loaded.forward(jnp.asarray(x))) + 1e-30),
+        np.asarray(g.forward(jnp.asarray(x))),
+        rtol=2e-3, atol=2e-3,
+    )
+
+
+@pytest.mark.slow
+def test_full_vgg16_caffe_roundtrip(tmp_path):
+    from bigdl_tpu.models.vgg import build_vgg16
+
+    model = build_vgg16(class_num=1000)
+    g = model.to_graph()
+    g.evaluate()
+    proto = str(tmp_path / "vgg16.prototxt")
+    cm = str(tmp_path / "vgg16.caffemodel")
+    CaffePersister.save(g, proto, cm, input_shape=(3, 224, 224))
+    loaded = CaffeLoader(prototxt_path=proto, model_path=cm).load()
+    loaded.evaluate()
+    x = np.random.RandomState(0).randn(1, 3, 224, 224).astype(np.float32)
+    # caffe has no LogSoftmax type: the exported tail round-trips as
+    # Softmax, so compare in log space
+    np.testing.assert_allclose(
+        np.log(np.asarray(loaded.forward(jnp.asarray(x))) + 1e-30),
+        np.asarray(g.forward(jnp.asarray(x))),
+        rtol=2e-3, atol=2e-3,
+    )

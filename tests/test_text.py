@@ -2,6 +2,7 @@
 Dictionary, LabeledSentence, PTB BPTT batching)."""
 
 import numpy as np
+import pytest
 
 from bigdl_tpu.dataset.text import (
     Dictionary,
@@ -56,3 +57,69 @@ def test_synthetic_ptb_stream():
     # deterministic
     again = synthetic_ptb_stream(n_tokens=5000, vocab_size=50)
     np.testing.assert_array_equal(tokens, again)
+
+
+# ---------------------------------------------------------------- news20
+class TestNews20:
+    def test_synthetic_news20_learnable_structure(self):
+        from bigdl_tpu.dataset.news20 import synthetic_news20
+
+        docs = synthetic_news20(100, class_num=4)
+        assert len(docs) == 100
+        labels = {label for _, label in docs}
+        assert labels == {1, 2, 3, 4}
+        # class-1 docs use the word0..word11 block dominantly
+        text, label = docs[0]
+        assert label == 1
+        assert "word" in text
+
+    def test_synthetic_glove_deterministic(self):
+        from bigdl_tpu.dataset.news20 import synthetic_glove
+
+        v1 = synthetic_glove(["alpha", "beta"], dim=16)
+        v2 = synthetic_glove(["alpha"], dim=16)
+        np.testing.assert_allclose(v1["alpha"], v2["alpha"])
+        assert v1["alpha"].shape == (16,)
+
+    def test_get_news20_reads_extracted_tree(self, tmp_path):
+        from bigdl_tpu.dataset.news20 import get_news20
+
+        root = tmp_path / "20news-18828"
+        for group in ("alt.atheism", "sci.space"):
+            d = root / group
+            d.mkdir(parents=True)
+            for i in range(3):
+                (d / f"{i}").write_text(f"{group} post {i}")
+        docs = get_news20(str(tmp_path))
+        assert len(docs) == 6
+        assert {label for _, label in docs} == {1, 2}
+
+    def test_get_news20_missing_raises_with_url(self, tmp_path):
+        from bigdl_tpu.dataset.news20 import get_news20
+
+        with pytest.raises(FileNotFoundError, match="20-Newsgroups"):
+            get_news20(str(tmp_path / "nope"))
+
+    def test_get_glove_reads_txt(self, tmp_path):
+        from bigdl_tpu.dataset.news20 import get_glove_w2v
+
+        (tmp_path / "glove.6B.50d.txt").write_text(
+            "hello " + " ".join(["0.1"] * 50) + "\n"
+            "world " + " ".join(["0.2"] * 50) + "\n")
+        w2v = get_glove_w2v(str(tmp_path), dim=50)
+        assert set(w2v) == {"hello", "world"}
+        np.testing.assert_allclose(w2v["hello"], 0.1)
+
+    def test_text_cnn_example_pipeline(self):
+        """The example's tokenize path over the synthetic corpus."""
+        import importlib.util as iu
+
+        spec = iu.spec_from_file_location(
+            "ttc", "examples/textclassification/train_text_cnn.py")
+        mod = iu.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        x, y, vocab, n_classes = mod.load_corpus(None, doc_len=16)
+        assert x.shape[1] == 16
+        assert n_classes == 4
+        assert vocab > 10
+        assert x.max() <= vocab
