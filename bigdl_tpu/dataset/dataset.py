@@ -142,6 +142,25 @@ class SampleDataSet(LocalDataSet):
             yield mb.input, mb.target
 
 
+def iter_process_batches(n: int, batch_size: int, pid: int, nproc: int,
+                         shuffle: bool):
+    """The per-process batch-slicing contract shared by every
+    distributed dataset: derive the SAME global epoch permutation on
+    every process (seeded global RNG), then yield this process's
+    contiguous ``batch_size // nproc`` index slice of each full global
+    batch.  DistriOptimizer assembles the global device array from
+    these shards via ``make_array_from_process_local_data``."""
+    if batch_size % nproc:
+        raise ValueError(
+            f"global batch {batch_size} not divisible by {nproc} processes"
+        )
+    local = batch_size // nproc
+    idx = RandomGenerator.RNG.randperm(n) if shuffle else np.arange(n)
+    for b in range(n // batch_size):
+        globl = idx[b * batch_size: (b + 1) * batch_size]
+        yield globl[pid * local: (pid + 1) * local]
+
+
 class DistributedDataSet(ArrayDataSet):
     """Per-process distributed dataset (reference: DistributedDataSet
     wraps an RDD coalesced to nodeNumber — SURVEY.md §3.2 job 0).
@@ -177,21 +196,10 @@ class DistributedDataSet(ArrayDataSet):
 
     def data(self, train: bool = True):
         pid, nproc = self._world()
-        bs = self.batch_size
-        if bs % nproc:
-            raise ValueError(
-                f"global batch {bs} not divisible by {nproc} processes"
-            )
-        local = bs // nproc
-        idx = np.arange(self._n)
-        if train and self.shuffle:
-            # the seeded global RNG is identical on every process, so the
-            # permutation (and therefore the global batch order) agrees
-            idx = RandomGenerator.RNG.randperm(self._n)
-        n_full = self._n // bs
-        for b in range(n_full):
-            globl = idx[b * bs: (b + 1) * bs]
-            mine = globl[pid * local: (pid + 1) * local]
+        for mine in iter_process_batches(
+            self._n, self.batch_size, pid, nproc,
+            shuffle=train and self.shuffle,
+        ):
             if self._multi:
                 feats = tuple(f[mine] for f in self.features)
             else:

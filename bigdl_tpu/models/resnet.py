@@ -162,26 +162,70 @@ def imagenet_recipe_optim(batch_size: int, n_epochs: int = 90,
 
 def main(argv=None):
     """Console entry (reference: models/resnet TrainCIFAR10/TrainImageNet
-    Train.scala CLI).  Trains the CIFAR variant; with no CIFAR data on
-    disk a separable synthetic task stands in (examples/ has the full
-    pipeline)."""
+    Train.scala CLI).
+
+    With ``-f/--data-dir`` pointing at an ImageNet-style tree
+    (``<dir>/train/<wnid>/*.JPEG``) this is the TrainImageNet path:
+    ResNet-50 + the reference warmup/multistep recipe, file-backed
+    distributed ingestion (dataset/imagenet.py) under DistriOptimizer.
+    Without a data dir, the CIFAR variant trains on a synthetic task
+    (examples/ has the full CIFAR pipeline)."""
     import argparse
     import logging
 
     import numpy as np
 
     from bigdl_tpu.nn import ClassNLLCriterion
-    from bigdl_tpu.optim import Optimizer, SGD, Top1Accuracy, Trigger
+    from bigdl_tpu.optim import (
+        DistriOptimizer, Optimizer, SGD, Top1Accuracy, Top5Accuracy, Trigger,
+    )
 
     logging.basicConfig(level=logging.INFO)
     ap = argparse.ArgumentParser()
+    ap.add_argument("-f", "--data-dir", default=None,
+                    help="ImageNet-style dir (train/<cls>/*.jpg); "
+                         "absent = synthetic CIFAR task")
     ap.add_argument("--depth", type=int, default=20)
     ap.add_argument("-b", "--batch-size", type=int, default=128)
     ap.add_argument("-e", "--max-epoch", type=int, default=1)
-    ap.add_argument("--learning-rate", type=float, default=0.1)
+    ap.add_argument("--learning-rate", type=float, default=None,
+                    help="base LR (ImageNet default: linear-scaled "
+                         "0.1*batch/256; CIFAR default: 0.1)")
     ap.add_argument("-n", "--num-samples", type=int, default=1024)
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--checkpoint", default=None)
     ap.add_argument("--distributed", action="store_true")
     args = ap.parse_args(argv)
+
+    if args.data_dir:
+        # ----- TrainImageNet path: real files, distributed ingestion ----
+        from bigdl_tpu.dataset.imagenet import ImageFolderDataSet
+
+        train_ds = ImageFolderDataSet(
+            args.data_dir, batch_size=args.batch_size, train=True,
+            image_size=args.image_size)
+        depth = args.depth if args.depth in _IMAGENET_CFG else 50
+        model = build_resnet_imagenet(depth=depth,
+                                      class_num=train_ds.class_num())
+        iters = max(1, train_ds.size() // args.batch_size)
+        opt = DistriOptimizer(model, train_ds, ClassNLLCriterion(),
+                              batch_size=args.batch_size)
+        opt.set_optim_method(imagenet_recipe_optim(
+            args.batch_size, n_epochs=args.max_epoch,
+            iterations_per_epoch=iters, base_lr=args.learning_rate))
+        opt.set_end_when(Trigger.max_epoch(args.max_epoch))
+        try:
+            val_ds = ImageFolderDataSet(
+                args.data_dir, batch_size=args.batch_size, train=False,
+                image_size=args.image_size)
+            opt.set_validation(Trigger.every_epoch(), val_ds,
+                               [Top1Accuracy(), Top5Accuracy()])
+        except FileNotFoundError:
+            pass  # no val split
+        if args.checkpoint:
+            opt.set_checkpoint(args.checkpoint, Trigger.every_epoch())
+        opt.optimize()
+        return
 
     model = build_resnet_cifar(depth=args.depth)
     rs = np.random.RandomState(0)
@@ -190,7 +234,8 @@ def main(argv=None):
     opt = Optimizer(model, (x, y), ClassNLLCriterion(),
                     batch_size=args.batch_size,
                     distributed=args.distributed or None)
-    opt.set_optim_method(SGD(learningrate=args.learning_rate, momentum=0.9))
+    opt.set_optim_method(SGD(learningrate=args.learning_rate or 0.1,
+                             momentum=0.9))
     opt.set_end_when(Trigger.max_epoch(args.max_epoch))
     opt.set_validation(Trigger.every_epoch(), (x, y), [Top1Accuracy()])
     opt.optimize()

@@ -112,7 +112,31 @@ def evaluate_dataset(model, dataset, methods: Sequence, mesh=None,
         for i, m in enumerate(methods):
             r = m.batch_result(out, tgt)
             results[i] = r if results[i] is None else results[i] + r
-    return results
+    return _allreduce_results(results, dataset)
+
+
+def _allreduce_results(results, dataset):
+    """Multi-host: a per-process dataset yields only this host's shard,
+    so the ValidationResult monoids must sum across processes before
+    anyone reads a score (reference: per-partition fold + driver reduce
+    — SURVEY.md §3.6).  Single-process: no-op."""
+    import jax
+
+    if jax.process_count() == 1 or not getattr(dataset, "per_process", False):
+        return results
+    from jax.experimental import multihost_utils
+
+    out = []
+    for r in results:
+        if r is None:
+            out.append(r)
+            continue
+        gathered = multihost_utils.process_allgather(
+            np.asarray([r.total, float(r.count)], np.float64))
+        total = float(gathered[:, 0].sum())
+        count = int(gathered[:, 1].sum())
+        out.append(type(r)(total, count, r.name))
+    return out
 
 
 def predict(model, features, batch_size: int = 32, mesh=None):

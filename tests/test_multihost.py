@@ -53,6 +53,19 @@ _WORKER = textwrap.dedent(
     opt.set_end_when(Trigger.max_epoch(3))
     opt.optimize()
     print("FINAL_LOSS %.9f" % opt.state["loss"], flush=True)
+
+    # distributed evaluation: each process folds only its shard of the
+    # per-process dataset; the monoids must allreduce so every host
+    # reports the GLOBAL accuracy (VERDICT r3 review finding)
+    from bigdl_tpu.dataset import DistributedDataSet
+    from bigdl_tpu.optim import Top1Accuracy
+    from bigdl_tpu.optim.evaluator import evaluate_dataset
+
+    val = DistributedDataSet(x, y, 32, shuffle=False)
+    (acc,) = evaluate_dataset(model, val, [Top1Accuracy()])
+    value, count = acc.result()
+    assert count == 128, count  # global sample count, not the local 64
+    print("VAL_ACC %.9f" % value, flush=True)
     """
 )
 
@@ -100,10 +113,16 @@ def test_two_process_distri_fit_agrees(tmp_path):
             pytest.fail("multi-host worker timed out")
         outs.append(out)
     losses = []
+    accs = []
     for i, out in enumerate(outs):
         assert procs[i].returncode == 0, f"worker {i} failed:\n{out[-2000:]}"
         line = [l for l in out.splitlines() if l.startswith("FINAL_LOSS")]
         assert line, f"worker {i} printed no FINAL_LOSS:\n{out[-2000:]}"
         losses.append(line[-1].split()[1])
+        aline = [l for l in out.splitlines() if l.startswith("VAL_ACC")]
+        assert aline, f"worker {i} printed no VAL_ACC:\n{out[-2000:]}"
+        accs.append(aline[-1].split()[1])
     # both processes drive the same global computation: exact agreement
     assert losses[0] == losses[1], losses
+    # every host reports the same GLOBAL validation accuracy
+    assert accs[0] == accs[1], accs
