@@ -321,23 +321,18 @@ def _bench_framework(x, y, batch, iters, compute_dtype=None):
     )
 
 
-def _bench_lenet(platform_batch=256, iters=20):
-    """Secondary config (BASELINE.md table): LeNet-5 / LocalOptimizer."""
+def _bench_local_optimizer(model, x, y, criterion, batch, iters, lr=0.05):
+    """Shared harness: a LocalOptimizer's exact step recipe timed inside
+    one scan (both secondary configs use this so they measure the SAME
+    code path)."""
     import jax
     import jax.numpy as jnp
 
-    from bigdl_tpu.models.lenet import build_lenet5
-    from bigdl_tpu.nn import ClassNLLCriterion
     from bigdl_tpu.optim import SGD
     from bigdl_tpu.optim.optimizer import LocalOptimizer
 
-    rs = np.random.RandomState(0)
-    x = rs.rand(platform_batch, 28, 28).astype(np.float32)
-    y = (rs.randint(0, 10, platform_batch) + 1).astype(np.float32)
-    model = build_lenet5()
-    opt = LocalOptimizer(model, (x, y), ClassNLLCriterion(),
-                         batch_size=platform_batch)
-    opt.set_optim_method(SGD(learningrate=0.05))
+    opt = LocalOptimizer(model, (x, y), criterion, batch_size=batch)
+    opt.set_optim_method(SGD(learningrate=lr))
     params = opt._init_params()
     mod_state = model.state()
     opt_state = opt._init_opt_state(params)
@@ -357,9 +352,36 @@ def _bench_lenet(platform_batch=256, iters=20):
 
     ips, _ = _timed_scan_throughput(
         step, (params, opt_state, mod_state), jnp.asarray(x), jnp.asarray(y),
-        platform_batch, iters,
+        batch, iters,
     )
     return ips
+
+
+def _bench_ptb(batch=64, num_steps=20, iters=20):
+    """Parity config 4 (BASELINE.md): PTB LSTM LM — tokens/sec/chip."""
+    from bigdl_tpu.models.rnn import build_ptb_lm
+    from bigdl_tpu.nn import TimeDistributedCriterion, ClassNLLCriterion
+
+    vocab, hidden = 10000, 256
+    rs = np.random.RandomState(0)
+    x = rs.randint(1, vocab + 1, (batch, num_steps)).astype(np.float32)
+    y = rs.randint(1, vocab + 1, (batch, num_steps)).astype(np.float32)
+    model = build_ptb_lm(vocab, hidden_size=hidden)
+    crit = TimeDistributedCriterion(ClassNLLCriterion(), size_average=True)
+    ips = _bench_local_optimizer(model, x, y, crit, batch, iters, lr=0.1)
+    return ips * num_steps  # tokens/sec
+
+
+def _bench_lenet(platform_batch=256, iters=20):
+    """Secondary config (BASELINE.md table): LeNet-5 / LocalOptimizer."""
+    from bigdl_tpu.models.lenet import build_lenet5
+    from bigdl_tpu.nn import ClassNLLCriterion
+
+    rs = np.random.RandomState(0)
+    x = rs.rand(platform_batch, 28, 28).astype(np.float32)
+    y = (rs.randint(0, 10, platform_batch) + 1).astype(np.float32)
+    return _bench_local_optimizer(
+        build_lenet5(), x, y, ClassNLLCriterion(), platform_batch, iters)
 
 
 # --------------------------------------------------------------------------
@@ -443,6 +465,10 @@ def _run_child(platform: str):
         lenet_ips = _bench_lenet()
     except Exception:  # secondary metric must not sink the bench
         lenet_ips = None
+    try:
+        ptb_tps = _bench_ptb()
+    except Exception:
+        ptb_tps = None
 
     result = {
         "metric": "resnet50_train_images_per_sec_per_chip",
@@ -462,6 +488,8 @@ def _run_child(platform: str):
             "batch_sweep": sweep,
             "lenet_local_images_per_sec":
                 round(lenet_ips, 1) if lenet_ips else None,
+            "ptb_lstm_tokens_per_sec":
+                round(ptb_tps, 1) if ptb_tps else None,
         },
         "error": None,
     }

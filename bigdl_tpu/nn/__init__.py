@@ -25,6 +25,7 @@ from bigdl_tpu.nn.control_ops import (
     SwitchOps,
     WhileLoop,
 )
+from bigdl_tpu.nn.tree_lstm import BinaryTreeLSTM
 from bigdl_tpu.nn.quantized import (
     QuantizedLinear,
     QuantizedSpatialConvolution,
@@ -124,7 +125,7 @@ __all__ = (
         "AbstractModule", "Container", "Sequential", "Identity", "Echo",
         "Graph", "DynamicGraph", "Input", "Node", "Model",
         "SwitchOps", "MergeOps", "IfElse", "WhileLoop", "LoopCondition",
-        "NextIteration",
+        "NextIteration", "BinaryTreeLSTM",
         "ConcatTable", "ParallelTable", "CAddTable", "CSubTable", "CMulTable",
         "CDivTable", "CMaxTable", "CMinTable", "JoinTable", "SelectTable",
         "FlattenTable", "MM", "MV", "CosineDistance", "DotProduct", "Concat",

@@ -1147,6 +1147,11 @@ class BatchNormalization(AbstractModule):
         # ~3 decimal digits and drift the running stats
         xf = input.astype(jnp.float32)
         if training:
+            # two-pass E[(x-mean)^2]: the single-pass E[x^2]-E[x]^2
+            # rewrite would fuse both stats into one read of x (BN is
+            # the bandwidth tax of conv nets on TPU, see BASELINE.md)
+            # but catastrophically cancels in f32 when |mean| >> std —
+            # correctness wins until a shifted single-pass lands
             mean = jnp.mean(xf, axis=axes)
             var = jnp.var(xf, axis=axes)  # biased, used for normalization
             n = 1

@@ -1,0 +1,162 @@
+"""BinaryTreeLSTM — tree-structured composition (Tai et al. 2015).
+
+Rebuild of ⟦«bigdl»/nn/BinaryTreeLSTM.scala⟧ (the tree-LSTM sentiment
+example's model — SURVEY.md §2.1 "Examples": tree-LSTM sentiment).
+
+TPU-first encoding: the reference walks a pointer-based tree object per
+sample on the JVM; under XLA the tree becomes **arrays** and the walk a
+``lax.scan`` with static shapes:
+
+* nodes are topologically numbered with **node 0 = root** and every
+  child index strictly greater than its parent's, so one reverse scan
+  (i = N-1 … 0) visits children before parents;
+* ``children``: (B, N, 2) int32 — left/right child indices, ``-1`` on
+  both marks a leaf, ``-1`` rows pad unused node slots;
+* ``embeddings``: (B, N, D) — leaf word vectors (zeros on internal
+  nodes).
+
+Each scan step computes BOTH the leaf transform and the binary
+composer for node *i* across the whole batch and selects per sample
+with ``jnp.where`` — branch-free, fixed shapes, MXU-batched gates.
+Output: (B, N, H) hidden states for every node (root at index 0, the
+convention ``TreeNNAccuracy`` reads).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from bigdl_tpu.common import RandomGenerator
+from bigdl_tpu.nn.module import AbstractModule
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+class BinaryTreeLSTM(AbstractModule):
+    """Input ``(embeddings (B,N,D), children (B,N,2))`` ->
+    hidden states (B, N, H)."""
+
+    param_names = ("leaf_w", "leaf_b", "comp_w", "comp_b")
+
+    def __init__(self, input_size: int, hidden_size: int):
+        super().__init__()
+        self._config = dict(input_size=input_size, hidden_size=hidden_size)
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.reset()
+
+    def reset(self):
+        h, d = self.hidden_size, self.input_size
+        jnp = _jnp()
+        s_leaf = 1.0 / np.sqrt(max(1, d))
+        s_comp = 1.0 / np.sqrt(max(1, 2 * h))
+        # leaf: x -> (i, o, u) gates; composer: [h_l, h_r] -> (i, f_l,
+        # f_r, o, u) gates
+        self.leaf_w = jnp.asarray(
+            RandomGenerator.RNG.uniform(-s_leaf, s_leaf, (d, 3 * h)),
+            jnp.float32)
+        self.leaf_b = jnp.zeros((3 * h,), jnp.float32)
+        self.comp_w = jnp.asarray(
+            RandomGenerator.RNG.uniform(-s_comp, s_comp, (2 * h, 5 * h)),
+            jnp.float32)
+        self.comp_b = jnp.zeros((5 * h,), jnp.float32)
+        return self
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        import jax
+        from jax import lax
+
+        jnp = _jnp()
+        emb, children = input
+        children = jnp.asarray(children, jnp.int32)
+        b, n, _ = emb.shape
+        hsz = self.hidden_size
+
+        leaf_w, leaf_b = params["leaf_w"], params["leaf_b"]
+        comp_w, comp_b = params["comp_w"], params["comp_b"]
+
+        def step(carry, i):
+            h_buf, c_buf = carry  # (B, N, H) each
+            kid = children[:, i, :]                      # (B, 2)
+            is_leaf = jnp.all(kid < 0, axis=-1)          # (B,)
+            safe = jnp.clip(kid, 0, n - 1)
+            h_l = jnp.take_along_axis(
+                h_buf, safe[:, 0][:, None, None].repeat(hsz, -1), axis=1
+            )[:, 0]
+            h_r = jnp.take_along_axis(
+                h_buf, safe[:, 1][:, None, None].repeat(hsz, -1), axis=1
+            )[:, 0]
+            c_l = jnp.take_along_axis(
+                c_buf, safe[:, 0][:, None, None].repeat(hsz, -1), axis=1
+            )[:, 0]
+            c_r = jnp.take_along_axis(
+                c_buf, safe[:, 1][:, None, None].repeat(hsz, -1), axis=1
+            )[:, 0]
+
+            # leaf transform
+            g = emb[:, i, :] @ leaf_w + leaf_b           # (B, 3H)
+            li, lo, lu = jnp.split(g, 3, axis=-1)
+            lc = jax.nn.sigmoid(li) * jnp.tanh(lu)
+            lh = jax.nn.sigmoid(lo) * jnp.tanh(lc)
+
+            # binary composer
+            hc = jnp.concatenate([h_l, h_r], axis=-1)    # (B, 2H)
+            gg = hc @ comp_w + comp_b                    # (B, 5H)
+            ci, cfl, cfr, co, cu = jnp.split(gg, 5, axis=-1)
+            cc = (jax.nn.sigmoid(ci) * jnp.tanh(cu)
+                  + jax.nn.sigmoid(cfl) * c_l
+                  + jax.nn.sigmoid(cfr) * c_r)
+            ch = jax.nn.sigmoid(co) * jnp.tanh(cc)
+
+            sel = is_leaf[:, None]
+            new_h = jnp.where(sel, lh, ch)
+            new_c = jnp.where(sel, lc, cc)
+            h_buf = lax.dynamic_update_slice(
+                h_buf, new_h[:, None, :], (0, i, 0))
+            c_buf = lax.dynamic_update_slice(
+                c_buf, new_c[:, None, :], (0, i, 0))
+            return (h_buf, c_buf), None
+
+        init = (jnp.zeros((b, n, hsz), emb.dtype),
+                jnp.zeros((b, n, hsz), emb.dtype))
+        # reverse order: children (higher indices) before parents
+        (h_buf, _), _ = lax.scan(step, init, jnp.arange(n - 1, -1, -1))
+        return h_buf, state
+
+    def __repr__(self):
+        return (f"BinaryTreeLSTM({self.input_size} -> {self.hidden_size})")
+
+
+def random_binary_trees(batch: int, n_leaves: int, seed: int = 0):
+    """Batch of random full binary tree skeletons in the module's array
+    encoding: returns (children (B,N,2) int32, leaf_slots list-of-lists)
+    with N = 2*n_leaves - 1, node 0 = root, child indices > parent's.
+
+    Allocation: each subtree with k leaves owns a contiguous block of
+    2k-1 node slots starting at its root — so children always land at
+    higher indices than their parent, the reverse-scan invariant."""
+    rs = np.random.RandomState(seed)
+    n = 2 * n_leaves - 1
+    children = np.full((batch, n, 2), -1, np.int32)
+    leaf_slots = []
+    for bi in range(batch):
+        leaves = []
+
+        def build(node: int, k: int):
+            if k == 1:
+                leaves.append(node)
+                return
+            kl = int(rs.randint(1, k))  # leaves in the left subtree
+            left = node + 1
+            right = left + (2 * kl - 1)
+            children[bi, node] = (left, right)
+            build(left, kl)
+            build(right, k - kl)
+
+        build(0, n_leaves)
+        leaf_slots.append(sorted(leaves))
+    return children, leaf_slots

@@ -409,6 +409,12 @@ class LocalOptimizer(BaseOptimizer):
                     self.train_summary.add_scalar(
                         "Throughput", bs / max(1e-9, time.perf_counter() - t0), n
                     )
+                    # reference: setSummaryTrigger("Parameters", ...)
+                    # enables per-layer weight histograms
+                    ptrig = self.train_summary.get_summary_trigger(
+                        "Parameters")
+                    if ptrig is not None and ptrig(self.state):
+                        self._write_param_histograms(pvar, n)
                 if n % 20 == 0:
                     log.info(
                         "Epoch %d iter %d loss %.5f (%.1f records/s)",
@@ -480,6 +486,19 @@ class LocalOptimizer(BaseOptimizer):
         copy = lambda t: jax.tree.map(lambda a: jnp.array(a, copy=True), t)
         self.model.set_params(copy(pvar))
         self.model.set_state(copy(mod_state))
+
+    def _write_param_histograms(self, pvar, step):
+        """Per-layer weight histograms into the TrainSummary (reference:
+        TrainSummary with the "Parameters" trigger set)."""
+        import jax
+
+        tree = self._params_tree(pvar)
+        flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+        for path, leaf in flat:
+            tag = "/".join(
+                str(getattr(k, "key", getattr(k, "idx", k))) for k in path
+            )
+            self.train_summary.add_histogram(tag, np.asarray(leaf), step)
 
 
 def Optimizer(

@@ -57,8 +57,9 @@ class StepProfiler:
 
     def __init__(self, log_dir: Optional[str] = None, skip: int = 1,
                  steps: int = PROFILE_STEPS):
-        from bigdl_tpu.config import config
+        from bigdl_tpu.config import config, refresh_from_env
 
+        refresh_from_env()
         self.log_dir = log_dir or config.profile_dir
         self.skip = skip
         self.steps = steps

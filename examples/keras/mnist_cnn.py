@@ -1,0 +1,58 @@
+"""Keras-API MNIST CNN — the reference's keras example.
+
+Reference analogue: «bigdl»/example/keras (the Keras-1.2.2-compatible
+API driving BigDL training).  Same shape here: the bigdl_tpu.keras
+Sequential builds the model, ``compile``/``fit``/``evaluate`` drive it.
+With no MNIST on disk the deterministic synthetic digits stand in.
+
+    python examples/keras/mnist_cnn.py --nb-epoch 2
+"""
+
+import argparse
+import logging
+
+import numpy as np
+
+
+def main():
+    from bigdl_tpu.dataset.mnist import load_mnist, normalize
+    from bigdl_tpu.keras.layers import (
+        Activation, Convolution2D, Dense, Dropout, Flatten, MaxPooling2D,
+    )
+    from bigdl_tpu.keras.models import Sequential
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-f", "--data-dir", default=None)
+    ap.add_argument("-b", "--batch-size", type=int, default=128)
+    ap.add_argument("--nb-epoch", type=int, default=2)
+    ap.add_argument("-n", "--num-samples", type=int, default=2048)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    log = logging.getLogger("keras_mnist")
+
+    x, y = load_mnist(args.data_dir, "train", synthetic_n=args.num_samples)
+    x = normalize(x).reshape(-1, 1, 28, 28)
+
+    model = Sequential()
+    model.add(Convolution2D(16, 3, 3, activation="relu",
+                            input_shape=(1, 28, 28)))
+    model.add(MaxPooling2D((2, 2)))
+    model.add(Convolution2D(32, 3, 3, activation="relu"))
+    model.add(MaxPooling2D((2, 2)))
+    model.add(Flatten())
+    model.add(Dense(64, activation="relu"))
+    model.add(Dropout(0.25))
+    model.add(Dense(10, activation="softmax"))
+    log.info("\n%s", model.summary())
+
+    model.compile(optimizer="adam", loss="categorical_crossentropy",
+                  metrics=["accuracy"])
+    model.fit(x[256:], y[256:], batch_size=args.batch_size,
+              nb_epoch=args.nb_epoch)
+    loss, acc = model.evaluate(x[:256], y[:256],
+                               batch_size=args.batch_size)
+    log.info("held-out loss %.4f accuracy %.4f", loss, acc)
+
+
+if __name__ == "__main__":
+    main()

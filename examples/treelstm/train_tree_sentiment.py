@@ -1,0 +1,83 @@
+"""Tree-LSTM sentiment — the reference's treeLSTMSentiment example.
+
+Reference analogue: «bigdl»/example/treeLSTMSentiment (BinaryTreeLSTM
+over constituency trees, GloVe leaf embeddings, sentiment at the root).
+With no SST corpus on disk, a deterministic synthetic task stands in:
+random binary trees whose label is the majority sign of a planted leaf
+feature — same model, same array encoding, same TreeNNAccuracy metric.
+
+    python examples/treelstm/train_tree_sentiment.py --max-steps 200
+"""
+
+import argparse
+import logging
+
+import numpy as np
+
+
+def synthetic_trees(batch, n_leaves, dim, seed=7):
+    from bigdl_tpu.nn.tree_lstm import random_binary_trees
+
+    children, leaf_slots = random_binary_trees(batch, n_leaves, seed)
+    n = 2 * n_leaves - 1
+    rs = np.random.RandomState(seed + 1)
+    emb = np.zeros((batch, n, dim), np.float32)
+    labels = np.zeros((batch,), np.float32)
+    for bi, leaves in enumerate(leaf_slots):
+        signs = rs.choice([-1.0, 1.0], len(leaves))
+        for slot, s in zip(leaves, signs):
+            v = rs.randn(dim) * 0.1
+            v[0] = s
+            emb[bi, slot] = v
+        labels[bi] = 1.0 if signs.sum() > 0 else 2.0
+    return emb, children, labels
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.nn import BinaryTreeLSTM
+    from bigdl_tpu.optim import TreeNNAccuracy
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-b", "--batch-size", type=int, default=128)
+    ap.add_argument("--n-leaves", type=int, default=8)
+    ap.add_argument("--embed-dim", type=int, default=16)
+    ap.add_argument("--hidden", type=int, default=32)
+    ap.add_argument("--max-steps", type=int, default=200)
+    ap.add_argument("--learning-rate", type=float, default=0.3)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    log = logging.getLogger("treelstm")
+
+    emb, children, labels = synthetic_trees(
+        args.batch_size, args.n_leaves, args.embed_dim)
+    m = BinaryTreeLSTM(args.embed_dim, args.hidden)
+    rs = np.random.RandomState(0)
+    params = {"tree": m.params(),
+              "w": jnp.asarray(rs.randn(args.hidden, 2) * 0.1)}
+    emb_j, ch_j = jnp.asarray(emb), jnp.asarray(children)
+    y = jnp.asarray(labels, jnp.int32) - 1
+
+    def loss_fn(p):
+        h, _ = m.apply(p["tree"], {}, (emb_j, ch_j))
+        logp = jax.nn.log_softmax(h[:, 0] @ p["w"])
+        return -jnp.mean(jnp.take_along_axis(logp, y[:, None], 1))
+
+    lr = args.learning_rate
+    step = jax.jit(lambda p: jax.tree.map(
+        lambda w, g: w - lr * g, p, jax.grad(loss_fn)(p)))
+    for i in range(args.max_steps):
+        params = step(params)
+        if (i + 1) % 50 == 0:
+            log.info("step %d loss %.4f", i + 1, float(loss_fn(params)))
+
+    h, _ = m.apply(params["tree"], {}, (emb_j, ch_j))
+    logits = np.asarray(h[:, 0] @ params["w"])
+    acc = TreeNNAccuracy().batch_result(logits[:, None, :], labels)
+    log.info("root sentiment accuracy: %.4f", acc.result()[0])
+
+
+if __name__ == "__main__":
+    main()

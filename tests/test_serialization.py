@@ -269,6 +269,8 @@ def test_every_exported_layer_is_covered_or_known():
         # control flow: own specs in test_control_ops.py
         "DynamicGraph", "SwitchOps", "MergeOps", "IfElse", "WhileLoop",
         "LoopCondition", "NextIteration",
+        # tree composition: own specs in test_tree_lstm.py
+        "BinaryTreeLSTM",
         # sparse layers operate on SparseTensor inputs (own spec)
         "SparseLinear", "LookupTableSparse", "SparseJoinTable",
         # quantized layers are constructed from float twins (own spec)

@@ -1,0 +1,129 @@
+"""BinaryTreeLSTM specs (reference: BinaryTreeLSTM + the tree-LSTM
+sentiment example; TreeNNAccuracy reads the root = node 0)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.tree_lstm import BinaryTreeLSTM, random_binary_trees
+
+
+def _tree_batch(batch=8, n_leaves=6, dim=8, seed=0):
+    children, leaf_slots = random_binary_trees(batch, n_leaves, seed)
+    n = 2 * n_leaves - 1
+    rs = np.random.RandomState(seed + 1)
+    emb = np.zeros((batch, n, dim), np.float32)
+    for bi, leaves in enumerate(leaf_slots):
+        for slot in leaves:
+            emb[bi, slot] = rs.randn(dim)
+    return jnp.asarray(emb), jnp.asarray(children), leaf_slots
+
+
+class TestTreeStructure:
+    def test_random_trees_well_formed(self):
+        children, leaf_slots = random_binary_trees(4, 5, seed=3)
+        n = 2 * 5 - 1
+        for bi in range(4):
+            internal = [i for i in range(n) if children[bi, i, 0] >= 0]
+            leaves = leaf_slots[bi]
+            assert len(leaves) == 5
+            assert len(internal) == 4
+            for i in internal:
+                l, r = children[bi, i]
+                assert l > i and r > i  # reverse-scan invariant
+            # every non-root node is someone's child exactly once
+            kids = children[bi][children[bi, :, 0] >= 0].reshape(-1)
+            assert sorted(kids.tolist()) == list(range(1, n))
+
+
+class TestForwardBackward:
+    def test_forward_shapes(self):
+        emb, children, _ = _tree_batch()
+        m = BinaryTreeLSTM(8, 12)
+        out = m.forward((emb, children))
+        assert out.shape == (8, 11, 12)
+
+    def test_root_depends_on_all_leaves(self):
+        """Gradient of the root hidden state reaches every leaf slot."""
+        emb, children, leaf_slots = _tree_batch(batch=1)
+        m = BinaryTreeLSTM(8, 12)
+        params = m.params()
+
+        def root_sum(e):
+            out, _ = m.apply(params, {}, (e, children))
+            return jnp.sum(out[:, 0])
+
+        g = np.asarray(jax.grad(root_sum)(emb))
+        for slot in leaf_slots[0]:
+            assert np.abs(g[0, slot]).sum() > 0, f"leaf {slot} unreached"
+
+    def test_jit_compiles_once(self):
+        emb, children, _ = _tree_batch()
+        m = BinaryTreeLSTM(8, 12)
+        fwd = jax.jit(lambda p, e, c: m.apply(p, {}, (e, c))[0])
+        out = fwd(m.params(), emb, children)
+        assert out.shape == (8, 11, 12)
+
+    def test_serialization_roundtrip(self, tmp_path):
+        from bigdl_tpu.utils.serializer import load_module, save_module
+
+        emb, children, _ = _tree_batch()
+        m = BinaryTreeLSTM(8, 12)
+        out1 = np.asarray(m.forward((emb, children)))
+        path = save_module(m, str(tmp_path / "tree"))
+        m2 = load_module(path)
+        np.testing.assert_allclose(
+            out1, np.asarray(m2.forward((emb, children))), rtol=1e-5,
+            atol=1e-6)
+
+
+class TestSentimentTraining:
+    def test_learns_leaf_majority(self):
+        """Tree-sentiment stand-in: label = majority sign of a leaf
+        feature; the composed root state must become separable.
+        Validated through TreeNNAccuracy (root = node 0)."""
+        from bigdl_tpu.optim import TreeNNAccuracy
+
+        batch, n_leaves, dim, hid = 64, 5, 6, 16
+        children, leaf_slots = random_binary_trees(batch, n_leaves, seed=2)
+        n = 2 * n_leaves - 1
+        rs = np.random.RandomState(7)
+        emb = np.zeros((batch, n, dim), np.float32)
+        labels = np.zeros((batch,), np.float32)
+        for bi, leaves in enumerate(leaf_slots):
+            signs = rs.choice([-1.0, 1.0], len(leaves))
+            for slot, s in zip(leaves, signs):
+                v = rs.randn(dim) * 0.1
+                v[0] = s  # signed signature feature
+                emb[bi, slot] = v
+            labels[bi] = 1.0 if signs.sum() > 0 else 2.0
+
+        m = BinaryTreeLSTM(dim, hid)
+        w_out = jnp.asarray(rs.randn(hid, 2) * 0.1)
+        params = {"tree": m.params(), "w": w_out}
+        emb_j, ch_j = jnp.asarray(emb), jnp.asarray(children)
+        y = jnp.asarray(labels, jnp.int32) - 1
+
+        def loss_fn(p):
+            h, _ = m.apply(p["tree"], {}, (emb_j, ch_j))
+            logits = h[:, 0] @ p["w"]
+            logp = jax.nn.log_softmax(logits)
+            return -jnp.mean(jnp.take_along_axis(logp, y[:, None], 1))
+
+        step = jax.jit(lambda p: jax.tree.map(
+            lambda w, g: w - 0.5 * g, p, jax.grad(loss_fn)(p)))
+        l0 = float(loss_fn(params))
+        for _ in range(150):
+            params = step(params)
+        l1 = float(loss_fn(params))
+        assert l1 < l0 * 0.3, (l0, l1)
+
+        h, _ = m.apply(params["tree"], {}, (emb_j, ch_j))
+        logits = np.asarray(h[:, 0] @ params["w"])
+        acc = TreeNNAccuracy().batch_result(
+            logits[:, None, :], labels)
+        value, count = acc.result()
+        assert count == batch
+        assert value > 0.9, value

@@ -83,3 +83,33 @@ def test_step_profiler_writes_trace(tmp_path, monkeypatch):
     for root, _dirs, files in os.walk(tmp_path):
         found.extend(files)
     assert found, "no profiler trace files written"
+
+
+def test_parameters_trigger_writes_histograms(tmp_path):
+    """Reference: TrainSummary.setSummaryTrigger("Parameters", trigger)
+    makes the optimizer dump per-layer weight histograms."""
+    import numpy as np
+
+    from bigdl_tpu.nn import ClassNLLCriterion, Linear, LogSoftMax, Sequential
+    from bigdl_tpu.optim import LocalOptimizer, SGD, Trigger
+    from bigdl_tpu.visualization import TrainSummary
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(64, 6).astype(np.float32)
+    y = (rs.randint(0, 3, 64) + 1).astype(np.float32)
+    model = Sequential().add(Linear(6, 3)).add(LogSoftMax())
+    opt = LocalOptimizer(model, (x, y), ClassNLLCriterion(), batch_size=32)
+    opt.set_optim_method(SGD(learningrate=0.1))
+    opt.set_end_when(Trigger.max_epoch(2))
+    summary = TrainSummary(str(tmp_path), "histapp")
+    summary.set_summary_trigger("Parameters", Trigger.several_iteration(2))
+    opt.set_train_summary(summary)
+    opt.optimize()
+    summary.close()
+
+    import os
+    events = [f for f in os.listdir(summary.log_dir) if "tfevents" in f]
+    assert events
+    blob = open(os.path.join(summary.log_dir, events[0]), "rb").read()
+    # histogram tags for the Linear layer's weight+bias appear
+    assert b"weight" in blob and b"bias" in blob
