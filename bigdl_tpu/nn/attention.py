@@ -197,11 +197,10 @@ class _Composite(AbstractModule):
             m.training()
         return self
 
-    def evaluate(self):
-        super().evaluate()
+    def evaluate(self, dataset=None, methods=None, batch_size: int = 32):
         for m in self._children.values():
             m.evaluate()
-        return self
+        return super().evaluate(dataset, methods, batch_size)
 
 
 class TransformerBlock(_Composite):

@@ -230,9 +230,46 @@ class AbstractModule:
         self.is_training = True
         return self
 
-    def evaluate(self):
+    def evaluate(self, dataset=None, methods=None, batch_size: int = 32):
+        """No args: switch to eval mode (reference ``evaluate()``).
+        With a dataset + validation methods: run distributed evaluation
+        and return the ValidationResults (reference
+        ``model.evaluate(rdd, Array(new Top1Accuracy))`` — SURVEY §3.6),
+        sharded over the Engine mesh when one is initialized."""
         self.is_training = False
-        return self
+        if dataset is None:
+            return self
+        if not methods:
+            raise ValueError(
+                "evaluate(dataset, methods): pass validation methods, "
+                "e.g. [Top1Accuracy()]"
+            )
+        from bigdl_tpu.dataset import to_dataset
+        from bigdl_tpu.engine import Engine
+        from bigdl_tpu.optim.evaluator import evaluate_dataset
+
+        mesh = Engine.mesh() if Engine.is_initialized() else None
+        return evaluate_dataset(
+            self, to_dataset(dataset, batch_size), methods, mesh=mesh
+        )
+
+    def predict(self, features, batch_size: int = 32):
+        """Reference: model.predict — batched forward, host outputs."""
+        from bigdl_tpu.engine import Engine
+        from bigdl_tpu.optim.evaluator import predict as _predict
+
+        mesh = Engine.mesh() if Engine.is_initialized() else None
+        return _predict(self, features, batch_size, mesh=mesh)
+
+    def predict_class(self, features, batch_size: int = 32):
+        """Reference: model.predictClass — argmax + 1 (1-based)."""
+        from bigdl_tpu.engine import Engine
+        from bigdl_tpu.optim.evaluator import predict_class as _pc
+
+        mesh = Engine.mesh() if Engine.is_initialized() else None
+        return _pc(self, features, batch_size, mesh=mesh)
+
+    predictClass = predict_class
 
     def quantize(self):
         """Reference: AbstractModule.quantize() — swap Linear/Conv layers
@@ -322,11 +359,10 @@ class Container(AbstractModule):
             m.training()
         return self
 
-    def evaluate(self):
-        super().evaluate()
+    def evaluate(self, dataset=None, methods=None, batch_size: int = 32):
         for m in self.modules:
             m.evaluate()
-        return self
+        return super().evaluate(dataset, methods, batch_size)
 
     def reset(self):
         for m in self.modules:

@@ -116,3 +116,30 @@ def test_imagenet_recipe_schedule():
     lr_after_60 = float(opt.current_rate(state))
     assert abs(lr_after_30 - 0.01) < 1e-6
     assert abs(lr_after_60 - 0.001) < 1e-6
+
+
+def test_module_level_evaluate_and_predict():
+    """Reference parity: model.evaluate(data, methods) and
+    model.predict/predictClass as MODULE methods (SURVEY §3.6)."""
+    import numpy as np
+    from bigdl_tpu.nn import Linear, LogSoftMax, Sequential
+    from bigdl_tpu.optim import Top1Accuracy
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(40, 6).astype(np.float32)
+    y = (rs.randint(0, 3, 40) + 1).astype(np.float32)
+    m = Sequential().add(Linear(6, 3)).add(LogSoftMax())
+
+    # no-arg evaluate keeps the mode-switch contract
+    assert m.evaluate() is m
+    assert not m.is_training
+
+    (acc,) = m.evaluate((x, y), [Top1Accuracy()])
+    value, count = acc.result()
+    assert count == 40
+    preds = m.predict(x, batch_size=16)
+    assert preds.shape == (40, 3)
+    classes = m.predict_class(x)
+    assert classes.min() >= 1 and classes.max() <= 3
+    # predictions and the accuracy agree
+    assert value == np.mean(classes == y)

@@ -83,23 +83,22 @@ class TestSentimentTraining:
     def test_learns_leaf_majority(self):
         """Tree-sentiment stand-in: label = majority sign of a leaf
         feature; the composed root state must become separable.
-        Validated through TreeNNAccuracy (root = node 0)."""
+        Validated through TreeNNAccuracy (root = node 0).  Shares the
+        example's task generator so test and example can't drift."""
+        import importlib.util as iu
+
         from bigdl_tpu.optim import TreeNNAccuracy
 
-        batch, n_leaves, dim, hid = 64, 5, 6, 16
-        children, leaf_slots = random_binary_trees(batch, n_leaves, seed=2)
-        n = 2 * n_leaves - 1
-        rs = np.random.RandomState(7)
-        emb = np.zeros((batch, n, dim), np.float32)
-        labels = np.zeros((batch,), np.float32)
-        for bi, leaves in enumerate(leaf_slots):
-            signs = rs.choice([-1.0, 1.0], len(leaves))
-            for slot, s in zip(leaves, signs):
-                v = rs.randn(dim) * 0.1
-                v[0] = s  # signed signature feature
-                emb[bi, slot] = v
-            labels[bi] = 1.0 if signs.sum() > 0 else 2.0
+        spec = iu.spec_from_file_location(
+            "tree_example", "examples/treelstm/train_tree_sentiment.py")
+        example = iu.module_from_spec(spec)
+        spec.loader.exec_module(example)
 
+        batch, n_leaves, dim, hid = 64, 5, 6, 16
+        emb, children, labels = example.synthetic_trees(
+            batch, n_leaves, dim, seed=2)
+
+        rs = np.random.RandomState(7)
         m = BinaryTreeLSTM(dim, hid)
         w_out = jnp.asarray(rs.randn(hid, 2) * 0.1)
         params = {"tree": m.params(), "w": w_out}
