@@ -1,0 +1,1 @@
+from bigdl.dlframes import dl_classifier  # noqa: F401

@@ -1,0 +1,7 @@
+"""⟦«py»/dlframes/dl_classifier.py⟧ — DLEstimator/DLClassifier/DLModel."""
+from bigdl_tpu.dlframes.dl_estimator import (  # noqa: F401
+    DLClassifier,
+    DLClassifierModel,
+    DLEstimator,
+    DLModel,
+)

@@ -1,0 +1,1 @@
+from bigdl.keras import converter  # noqa: F401

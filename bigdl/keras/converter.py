@@ -1,0 +1,2 @@
+"""⟦«py»/keras/converter.py⟧ — Keras-1.2.2 JSON/HDF5 model importer."""
+from bigdl_tpu.keras.converter import *  # noqa: F401,F403

@@ -1,0 +1,7 @@
+"""⟦«py»/models/lenet/lenet5.py⟧ — build_model + the training main."""
+from bigdl_tpu.models.lenet import build_lenet5, main, train_lenet  # noqa: F401
+
+
+def build_model(class_num: int = 10):
+    """Reference spelling (lenet5.build_model)."""
+    return build_lenet5(class_num=class_num)

@@ -1,0 +1,2 @@
+"""pyspark-bigdl import path: bigdl.nn.keras (⟦«py»/nn/keras/⟧)."""
+from bigdl.nn.keras import topology, layer  # noqa: F401

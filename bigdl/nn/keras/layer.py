@@ -1,0 +1,2 @@
+"""⟦«py»/nn/keras/layer.py⟧ — Keras-style layer spellings."""
+from bigdl_tpu.keras.layers import *  # noqa: F401,F403

@@ -1,0 +1,7 @@
+"""⟦«py»/nn/keras/topology.py⟧ — Sequential Keras-style builder.
+
+The reference also ships a graph-style ``Model(input, output)`` with
+Keras shape inference; the rebuild's functional graph API lives at
+``bigdl.nn.layer.Model`` (node-based) — use that for graph topologies.
+"""
+from bigdl_tpu.keras.models import Sequential  # noqa: F401

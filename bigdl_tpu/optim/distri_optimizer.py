@@ -224,15 +224,12 @@ class DistriOptimizer(LocalOptimizer):
             in_specs=(P(), opt_state_specs, mstate_spec, P(), P(axis), P(axis)),
             out_specs=(P(), opt_state_specs, mstate_spec, P()),
         )
-        step = jax.jit(mapped)
-
-        # divide grads by global batch, not by loss-local mean twice: the
-        # criterion already averages over the *local* sub-batch, so rescale
-        # to make sum-then-divide match the reference exactly
-        def train_step(flat_p, opt_st, mstate, rng, inp, tgt):
-            return step(flat_p, opt_st, mstate, rng, inp, tgt)
-
-        return train_step
+        # donate params/opt-state/model-state like LocalOptimizer: the
+        # step updates in place on-device instead of holding two copies
+        # of the flat vector + sharded velocity in HBM (the driver loop
+        # rebinds from the outputs; _write_back copies before any host
+        # read)
+        return jax.jit(mapped, donate_argnums=(0, 1, 2))
 
     def _loss_fn(self):
         """Reference semantics: sub-model gradients are *summed* then

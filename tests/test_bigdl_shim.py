@@ -63,3 +63,22 @@ def test_trigger_spellings():
     assert MaxIteration(10)({"epoch": 1, "neval": 11, "epoch_finished": 0})
     assert SeveralIteration(5)({"epoch": 1, "neval": 6, "epoch_finished": 0})
     EveryEpoch()  # constructible
+
+
+def test_extended_shim_import_paths():
+    """§2.2 pyspark package surface: keras, models, dlframes paths."""
+    from bigdl.nn.keras.topology import Sequential as KSequential
+    from bigdl.nn.keras.layer import Dense
+    from bigdl.keras.converter import model_from_json
+    from bigdl.models.lenet.lenet5 import build_model
+    from bigdl.dlframes.dl_classifier import (
+        DLClassifier, DLClassifierModel, DLEstimator, DLModel,
+    )
+
+    m = build_model(class_num=10)
+    out = m.forward(np.ones((2, 28, 28), np.float32))
+    assert np.asarray(out).shape == (2, 10)
+
+    km = KSequential()
+    km.add(Dense(4, input_shape=(6,)))
+    assert km.output_shape == (None, 4)
