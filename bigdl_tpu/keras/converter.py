@@ -26,6 +26,11 @@ from bigdl_tpu.keras import layers as KL
 from bigdl_tpu.keras import models as KM
 
 
+__all__ = [
+    "KerasConversionException", "model_from_json",
+    "model_from_json_path", "load_weights_hdf5",
+]
+
 class KerasConversionException(Exception):
     pass
 

@@ -19,6 +19,15 @@ from bigdl_tpu.nn import module as M
 from bigdl_tpu.nn import recurrent as R
 
 
+__all__ = [
+    "KerasLayer", "InputLayer", "Dense", "Activation", "Dropout",
+    "Flatten", "Reshape", "Permute", "RepeatVector", "Convolution2D",
+    "MaxPooling2D", "AveragePooling2D", "GlobalAveragePooling2D",
+    "GlobalMaxPooling2D", "ZeroPadding2D", "BatchNormalization",
+    "Embedding", "LSTM", "GRU", "SimpleRNN", "Bidirectional",
+    "TimeDistributedDense",
+]
+
 _ACTIVATIONS = {
     "relu": L.ReLU,
     "tanh": L.Tanh,

@@ -1139,41 +1139,119 @@ class BatchNormalization(AbstractModule):
             f"got {input.ndim}-d"
         )
 
+    def _fold(self, params, mean, var, center):
+        """Fold (mean, var, weight, bias) into per-channel f32
+        (scale, offset) for the CENTERED normalize
+        ``y = (x - center) * scale + offset``.
+
+        Centering keeps full precision at any activation magnitude: the
+        uncentered ``x*scale + offset`` form loses ~mean/std * 2^-24 of
+        the output to f32 rounding of the large ``x*scale`` product,
+        while here the big terms cancel before scaling.  ``center`` is
+        whatever per-channel vector is cheaply available — the stats
+        mean itself (exact), or the running mean (off by the tiny
+        shifted-mean d, equally good)."""
+        jnp = _jnp()
+        lax = _lax()
+        inv = lax.rsqrt(var + self.eps)
+        if self.affine:
+            scale = inv * params["weight"].astype(jnp.float32)
+            offset = params["bias"].astype(jnp.float32) \
+                - (mean - center) * scale
+        else:
+            scale = inv
+            offset = -(mean - center) * scale
+        return scale, offset
+
     def apply(self, params, state, input, *, training=False, rng=None):
         jnp = _jnp()
+        lax = _lax()
         axes, bshape = self._axes_and_shape(input)
+
+        def _normalize(scale, offset, center):
+            # elementwise pass in the INPUT dtype: under a bf16 compute
+            # policy it runs at half the HBM bytes (measured ~4% of a
+            # ResNet-50 step, scripts/perf_probe.py), and no full-tensor
+            # f32 copy of the input is ever materialized.  The centered
+            # subtract is exact-ish at any magnitude (nearby values),
+            # so low-precision here costs only the input's own ulp.
+            dt = input.dtype
+            return (input - center.astype(dt).reshape(bshape)) \
+                * scale.astype(dt).reshape(bshape) \
+                + offset.astype(dt).reshape(bshape)
+
+        if not training:
+            rm = state["running_mean"]
+            scale, offset = self._fold(
+                params, rm, state["running_var"], rm
+            )
+            return _normalize(scale, offset, rm), state
+
         # statistics always accumulate in f32: under a bf16 compute
-        # policy the batch-mean/variance reductions would otherwise lose
-        # ~3 decimal digits and drift the running stats
+        # policy the batch reductions would otherwise lose ~3 decimal
+        # digits and drift the running stats
         xf = input.astype(jnp.float32)
-        if training:
-            # two-pass E[(x-mean)^2]: the single-pass E[x^2]-E[x]^2
-            # rewrite would fuse both stats into one read of x (BN is
-            # the bandwidth tax of conv nets on TPU, see BASELINE.md)
-            # but catastrophically cancels in f32 when |mean| >> std —
-            # correctness wins until a shifted single-pass lands
-            mean = jnp.mean(xf, axis=axes)
-            var = jnp.var(xf, axis=axes)  # biased, used for normalization
-            n = 1
-            for a in axes:
-                n *= input.shape[a]
-            unbiased = var * (n / max(1, n - 1))
-            new_state = {
-                "running_mean": (1 - self.momentum) * state["running_mean"]
-                + self.momentum * mean,
-                "running_var": (1 - self.momentum) * state["running_var"]
-                + self.momentum * unbiased,
-            }
-        else:
-            mean, var = state["running_mean"], state["running_var"]
-            new_state = state
-        inv = 1.0 / jnp.sqrt(var + self.eps)
-        y = (xf - mean.reshape(bshape)) * inv.reshape(bshape)
-        if self.affine:
-            w = params["weight"].astype(jnp.float32)
-            b = params["bias"].astype(jnp.float32)
-            y = y * w.reshape(bshape) + b.reshape(bshape)
-        return y.astype(input.dtype), new_state
+        # BN is the bandwidth tax of conv nets on TPU (BASELINE.md): a
+        # naive mean-then-var reads the activation twice.  Shifted
+        # single-pass stats read it once — E[x-s] and E[(x-s)^2] are two
+        # reductions over the same fused operand (XLA multi-output
+        # fusion), and shifting by the running mean keeps the
+        # E[y^2]-E[y]^2 form from catastrophically cancelling: the shift
+        # tracks the batch mean, so |E[x-s]| ~ 0 in steady state and the
+        # subtraction loses no digits
+        shift = state["running_mean"].reshape(bshape)
+        xc = xf - shift
+        d = jnp.mean(xc, axis=axes)
+        m2 = jnp.mean(lax.square(xc), axis=axes)
+        mean = state["running_mean"] + d
+        var_sp = jnp.maximum(m2 - lax.square(d), 0.0)  # biased
+
+        # cancellation rescue: when the shift is stale (zero-init
+        # running_mean on un-normalized inputs, distribution shift), d^2
+        # dominates m2 and the single-pass variance has lost real digits
+        # — at d^2/var ~ 4096 the f32 relative error is still only
+        # ~2^-24 * 4096 ~ 2e-4; past that, recompute the variance
+        # two-pass and normalize in f32, both centered on the true mean.
+        # The branch is one XLA conditional: steady-state training never
+        # pays the second activation read.
+        def _pathological():
+            var = jnp.maximum(
+                jnp.mean(lax.square(xf - mean.reshape(bshape)), axis=axes),
+                0.0,
+            )
+            scale, offset = self._fold(params, mean, var, mean)
+            y = (xf - mean.reshape(bshape)) * scale.reshape(bshape) \
+                + offset.reshape(bshape)
+            return y.astype(input.dtype), var
+
+        def _fast():
+            # centered on the shift: the residual offset carries only
+            # the tiny d, so precision matches the centered form
+            scale, offset = self._fold(
+                params, mean, var_sp, state["running_mean"]
+            )
+            return _normalize(scale, offset, state["running_mean"]), var_sp
+
+        # (no absolute floor in the predicate: it must stay correct at
+        # every activation scale, and d == 0 with var_sp == 0 — the
+        # all-zero channel — already evaluates false; a near-constant
+        # channel with a stale shift correctly takes the rescue branch)
+        y, var = lax.cond(
+            jnp.any(lax.square(d) > 4096.0 * var_sp),
+            _pathological,
+            _fast,
+        )
+        n = 1
+        for a in axes:
+            n *= input.shape[a]
+        unbiased = var * (n / max(1, n - 1))
+        new_state = {
+            "running_mean": (1 - self.momentum) * state["running_mean"]
+            + self.momentum * mean,
+            "running_var": (1 - self.momentum) * state["running_var"]
+            + self.momentum * unbiased,
+        }
+        return y, new_state
 
     def __repr__(self):
         return f"{type(self).__name__}({self.n_output})"

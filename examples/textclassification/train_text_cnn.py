@@ -32,6 +32,18 @@ def build_text_cnn(vocab, embed=32, n_classes=4, doc_len=32):
     )
 
 
+def encode_texts(texts, dic, doc_len):
+    """Raw texts -> padded 1-based id matrix.  The ONE encoding both
+    training and serving (examples/udfpredict) must share — any unk/
+    offset/tokenization change here reaches both sides."""
+    x = np.zeros((len(texts), doc_len), np.float32)
+    for i, text in enumerate(texts):
+        for j, tok in enumerate(text.lower().split()[:doc_len]):
+            # ids are 1-based for LookupTable; 0 stays padding
+            x[i, j] = dic.get_index(tok, 0) + 1
+    return x
+
+
 def tokenize_corpus(docs, doc_len=128, vocab_limit=20000):
     """[(text, label)] -> padded id matrix via the Dictionary pipeline
     (reference: news20 GloVe+CNN example preprocessing)."""
@@ -39,11 +51,7 @@ def tokenize_corpus(docs, doc_len=128, vocab_limit=20000):
 
     tokenized = [d.lower().split() for d, _ in docs]
     dic = Dictionary(tokenized, vocab_size=vocab_limit)
-    x = np.zeros((len(docs), doc_len), np.float32)
-    for i, toks in enumerate(tokenized):
-        for j, tok in enumerate(toks[:doc_len]):
-            # ids are 1-based for LookupTable; 0 stays padding
-            x[i, j] = dic.get_index(tok, 0) + 1
+    x = encode_texts([d for d, _ in docs], dic, doc_len)
     y = np.asarray([label for _, label in docs], np.float32)
     return x, y, dic
 

@@ -1,0 +1,244 @@
+"""Perf probe — ResNet-50 train-step variants on the real chip.
+
+Explores the two bottlenecks BASELINE.md's analysis identified
+(1x1-conv MXU mapping, BatchNorm bandwidth tax) plus data layout:
+
+  layout    : NCHW (BigDL convention) vs NHWC (channels-minor = TPU lanes)
+  bn        : f32 elementwise normalize (current) vs bf16 normalize with
+              f32-accumulated statistics
+  dot11     : lower 1x1 convs to reshape+dot_general instead of
+              lax.conv_general_dilated
+
+Usage:  python scripts/perf_probe.py [batch] [iters]
+Prints one JSON line per variant: {"variant": ..., "step_ms": ..., "mfu": ...}
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+from bench import (  # noqa: E402
+    _resnet50_cfg,
+    train_step_flops_per_image,
+    _peak_flops,
+)
+
+BATCH = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+ITERS = int(sys.argv[2]) if len(sys.argv) > 2 else 10
+IMG = 224
+N_CLASSES = 1000
+
+
+def init_params(rng, layout):
+    import jax
+    import jax.numpy as jnp
+
+    params = {}
+
+    def conv_p(key, cin, cout, k):
+        fan = cin * k * k
+        shape = (cout, cin, k, k) if layout == "NCHW" else (k, k, cin, cout)
+        params[key] = {
+            "w": jax.random.normal(
+                jax.random.fold_in(rng, hash(key) % (2**31)), shape,
+                dtype=np.float32) * np.sqrt(2.0 / fan)
+        }
+
+    def bn_p(key, c):
+        params[key] = {
+            "scale": jnp.ones(c), "bias": jnp.zeros(c),
+        }
+
+    conv_p("stem", 3, 64, 7)
+    bn_p("stem_bn", 64)
+    cin = 64
+    for s, (w, n, stride) in enumerate(_resnet50_cfg()):
+        for i in range(n):
+            pfx = f"s{s}b{i}"
+            conv_p(pfx + "c1", cin, w, 1)
+            bn_p(pfx + "bn1", w)
+            conv_p(pfx + "c2", w, w, 3)
+            bn_p(pfx + "bn2", w)
+            conv_p(pfx + "c3", w, w * 4, 1)
+            bn_p(pfx + "bn3", w * 4)
+            if i == 0:
+                conv_p(pfx + "sc", cin, w * 4, 1)
+                bn_p(pfx + "scbn", w * 4)
+            cin = w * 4
+    params["fc"] = {
+        "w": jax.random.normal(jax.random.fold_in(rng, 77), (cin, N_CLASSES))
+        * 0.01,
+        "b": jnp.zeros(N_CLASSES),
+    }
+    return params
+
+
+def make_forward(layout, bn_mode, dot11):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    dn = (layout, "OIHW" if layout == "NCHW" else "HWIO", layout)
+    caxis = 1 if layout == "NCHW" else 3
+
+    def conv(p, x, stride=1):
+        w = p["w"]
+        k = w.shape[2] if layout == "NCHW" else w.shape[0]
+        if dot11 and k == 1:
+            if stride != 1:
+                if layout == "NCHW":
+                    x = x[:, :, ::stride, ::stride]
+                else:
+                    x = x[:, ::stride, ::stride, :]
+            if layout == "NCHW":
+                n, c, h, wd = x.shape
+                cout = w.shape[0]
+                y = jnp.einsum("nchw,oc->nohw", x, w[:, :, 0, 0])
+                return y
+            else:
+                n, h, wd, c = x.shape
+                cout = w.shape[3]
+                y = x.reshape(n * h * wd, c) @ w[0, 0]
+                return y.reshape(n, h, wd, cout)
+        return lax.conv_general_dilated(
+            x, w, (stride, stride), "SAME", dimension_numbers=dn)
+
+    def bn(p, x):
+        axes = (0, 2, 3) if layout == "NCHW" else (0, 1, 2)
+        bshape = (1, -1, 1, 1) if layout == "NCHW" else (1, 1, 1, -1)
+        if bn_mode == "f32":
+            xf = x.astype(jnp.float32)
+            mean = jnp.mean(xf, axis=axes)
+            var = jnp.var(xf, axis=axes)
+            inv = lax.rsqrt(var + 1e-5) * p["scale"]
+            y = xf * inv.reshape(bshape) + (
+                p["bias"] - mean * inv).reshape(bshape)
+            return y.astype(x.dtype)
+        elif bn_mode == "bf16_2pass":
+            # two-pass f32 stats (mean then E[(x-mean)^2]) like the
+            # framework today, but normalize in the compute dtype
+            xf = x.astype(jnp.float32)
+            mean = jnp.mean(xf, axis=axes)
+            var = jnp.var(xf, axis=axes)
+            inv = lax.rsqrt(var + 1e-5) * p["scale"]
+            shift = p["bias"] - mean * inv
+            return x * inv.astype(x.dtype).reshape(bshape) + \
+                shift.astype(x.dtype).reshape(bshape)
+        else:  # bf16 normalize, f32-accumulated single-pass stats
+            mean = jnp.mean(x, axis=axes, dtype=jnp.float32)
+            mean2 = jnp.mean(
+                lax.square(x.astype(jnp.float32)), axis=axes)
+            var = jnp.maximum(mean2 - mean * mean, 0.0)
+            inv = lax.rsqrt(var + 1e-5) * p["scale"]
+            shift = p["bias"] - mean * inv
+            return x * inv.astype(x.dtype).reshape(bshape) + \
+                shift.astype(x.dtype).reshape(bshape)
+
+    def forward(params, x):
+        x = conv(params["stem"], x, 2)
+        x = jax.nn.relu(bn(params["stem_bn"], x))
+        window = (1, 1, 3, 3) if layout == "NCHW" else (1, 3, 3, 1)
+        strides = (1, 1, 2, 2) if layout == "NCHW" else (1, 2, 2, 1)
+        pads = [(0, 0), (0, 0), (1, 1), (1, 1)] if layout == "NCHW" else \
+            [(0, 0), (1, 1), (1, 1), (0, 0)]
+        x = lax.reduce_window(x, -jnp.inf, lax.max, window, strides, pads)
+        for s, (w, n, stride) in enumerate(_resnet50_cfg()):
+            for i in range(n):
+                pfx = f"s{s}b{i}"
+                st = stride if i == 0 else 1
+                y = jax.nn.relu(
+                    bn(params[pfx + "bn1"], conv(params[pfx + "c1"], x)))
+                y = jax.nn.relu(
+                    bn(params[pfx + "bn2"], conv(params[pfx + "c2"], y, st)))
+                y = bn(params[pfx + "bn3"], conv(params[pfx + "c3"], y))
+                if i == 0:
+                    sc = bn(params[pfx + "scbn"],
+                            conv(params[pfx + "sc"], x, st))
+                else:
+                    sc = x
+                x = jax.nn.relu(y + sc)
+        x = jnp.mean(x, axis=(2, 3) if layout == "NCHW" else (1, 2))
+        return x @ params["fc"]["w"] + params["fc"]["b"]
+
+    return forward
+
+
+def bench_variant(layout, bn_mode, dot11, x, y):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    fwd = make_forward(layout, bn_mode, dot11)
+    params = init_params(jax.random.key(0), layout)
+
+    def loss_fn(p, x, y):
+        ct = jnp.bfloat16
+        p = jax.tree.map(
+            lambda a: a.astype(ct)
+            if jnp.issubdtype(a.dtype, jnp.floating) else a, p)
+        logits = fwd(p, x.astype(ct)).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits)
+        idx = y.astype(jnp.int32) - 1
+        return -jnp.mean(jnp.take_along_axis(logp, idx[:, None], 1))
+
+    def step(p, x, y):
+        loss, g = jax.value_and_grad(loss_fn)(p, x, y)
+        p = jax.tree.map(lambda w, gw: w - 0.1 * gw, p, g)
+        return p, loss
+
+    @jax.jit
+    def run(carry, x, y):
+        def body(c, _):
+            c, loss = step(c, x, y)
+            return c, loss
+        _, losses = lax.scan(body, carry, None, length=ITERS)
+        return losses[-1]
+
+    xd = jnp.asarray(x if layout == "NCHW" else x.transpose(0, 2, 3, 1))
+    yd = jnp.asarray(y)
+    float(run(params, xd, yd))
+    t0 = time.perf_counter()
+    float(run(params, xd, yd))
+    dt = time.perf_counter() - t0
+    return dt / ITERS
+
+
+def main():
+    import jax
+
+    dev = jax.devices()[0]
+    peak = _peak_flops(dev.device_kind)
+    print(json.dumps({"device": dev.device_kind, "batch": BATCH}), flush=True)
+    x = np.random.RandomState(0).randn(BATCH, 3, IMG, IMG).astype(np.float32)
+    y = (np.random.RandomState(1).randint(0, N_CLASSES, BATCH) + 1).astype(
+        np.float32)
+    flops = train_step_flops_per_image(IMG) * BATCH
+    variants = itertools.product(
+        ("NCHW", "NHWC"), ("f32", "bf16"), (False, True))
+    if len(sys.argv) > 3:  # explicit variant list: LAYOUT/bn/dot11 triples
+        variants = [tuple(v.split("/")) for v in sys.argv[3].split(",")]
+        variants = [(l, b, d == "1") for l, b, d in variants]
+    for layout, bn_mode, dot11 in variants:
+        try:
+            s = bench_variant(layout, bn_mode, dot11, x, y)
+            mfu = flops / s / peak if peak else None
+            print(json.dumps({
+                "variant": f"{layout}/bn-{bn_mode}/dot11-{int(dot11)}",
+                "step_ms": round(s * 1e3, 2),
+                "images_per_sec": round(BATCH / s, 1),
+                "mfu": round(mfu, 4) if mfu else None,
+            }), flush=True)
+        except Exception as e:
+            print(json.dumps({
+                "variant": f"{layout}/bn-{bn_mode}/dot11-{int(dot11)}",
+                "error": f"{type(e).__name__}: {str(e)[:200]}",
+            }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -150,6 +150,43 @@ def test_batchnorm_train_and_eval():
     assert out_eval.shape == x.shape
 
 
+def test_batchnorm_stale_shift_cancellation_rescue():
+    # step 0, zero-init running_mean, activations with |mean| >> std:
+    # the single-pass E[(x-s)^2]-E[x-s]^2 statistics would
+    # catastrophically cancel here; the lax.cond rescue must recompute
+    # the variance two-pass and still normalize correctly
+    m = BatchNormalization(3)
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(
+        (rs.randn(64, 3) * 0.01 + 3000.0).astype(np.float32)
+    )
+    m.training()
+    out = np.asarray(m.forward(x))
+    # one f32 ulp of x (~2.4e-4 at 3000) is ~2.4% of the 0.01 std, and
+    # eps=1e-5 vs var~1e-4 shrinks the output std to sqrt(1/1.1)~0.95:
+    # input representation + eps bound achievable accuracy here
+    np.testing.assert_allclose(out.mean(axis=0), 0.0, atol=8e-2)
+    np.testing.assert_allclose(out.std(axis=0), 1.0, atol=1e-1)
+    # running_var picked up the true batch variance scale, not m2
+    rv = np.asarray(m.running_var)
+    assert np.all(rv < 1.0), rv  # (1-momentum)*1 + momentum*~1e-4
+
+
+def test_batchnorm_constant_channel():
+    # a constant channel (e.g. padding) has zero variance; both stats
+    # paths must keep it finite (normalize by rsqrt(eps))
+    m = BatchNormalization(2)
+    x = jnp.asarray(
+        np.stack(
+            [np.full(32, 5.0), np.random.RandomState(1).randn(32)], axis=1
+        ).astype(np.float32)
+    )
+    m.training()
+    out = np.asarray(m.forward(x))
+    assert np.all(np.isfinite(out))
+    np.testing.assert_allclose(out[:, 0], 0.0, atol=1e-3)
+
+
 def test_spatial_batchnorm():
     m = SpatialBatchNormalization(4)
     x = jnp.asarray(np.random.RandomState(0).randn(2, 4, 5, 5).astype(np.float32))
