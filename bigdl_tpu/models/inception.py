@@ -1,9 +1,13 @@
-"""Inception-v1 (GoogLeNet).
+"""Inception-v1 (GoogLeNet) and Inception-v2 (BN-Inception).
 
-Rebuild of «bigdl»/models/inception/Inception_v1.scala: the
+Rebuild of «bigdl»/models/inception/Inception_v1.scala — the
 Inception_Layer_v1 module (4-branch Concat: 1x1 / 3x3-reduce+3x3 /
 5x5-reduce+5x5 / pool+proj) and the NoAuxClassifier main tower (the
-reference's primary training config).
+reference's primary training config) — and of Inception_v2.scala: the
+BatchNorm variant where every conv is followed by
+SpatialBatchNormalization, the 5x5 branch is factored into a double
+3x3, and the grid-reduction modules (3c/4e) drop the 1x1 branch and
+run their conv towers at stride 2 alongside a pass-through max-pool.
 """
 
 from __future__ import annotations
@@ -17,6 +21,7 @@ from bigdl_tpu.nn import (
     Reshape,
     Sequential,
     SpatialAveragePooling,
+    SpatialBatchNormalization,
     SpatialConvolution,
     SpatialCrossMapLRN,
     SpatialMaxPooling,
@@ -66,6 +71,185 @@ def inception_layer_v1(n_in, config, name_prefix=""):
     return concat
 
 
+def _conv_bn_relu(seq, n_in, n_out, kw=1, kh=1, sw=1, sh=1, pw=0, ph=0,
+                  name=""):
+    """conv + SpatialBatchNormalization + ReLU — the v2 building block
+    («bigdl» Inception_v2.scala pairs every conv with an SpatialBN)."""
+    seq.add(
+        SpatialConvolution(n_in, n_out, kw, kh, sw, sh, pw, ph,
+                           init_method=Xavier()).set_name(name)
+    ).add(
+        SpatialBatchNormalization(n_out).set_name(name + "/bn")
+    ).add(ReLU())
+    return seq
+
+
+def inception_layer_v2(n_in, config, name_prefix=""):
+    """«bigdl» Inception_Layer_v2.
+
+    ``config = ([p1], [r3, c3], [rd3, cd3], (pool_kind, proj))``:
+    1x1 branch (dropped when p1 == 0 — the stride-2 grid-reduction
+    form), 3x3 branch, double-3x3 branch, and an avg/max pool branch
+    with optional 1x1 projection.  When p1 == 0 the conv towers run
+    their last conv at stride 2 and the pool branch is a bare stride-2
+    max-pool pass-through.
+    """
+    reduce_grid = config[0][0] == 0
+    stride = 2 if reduce_grid else 1
+    concat = Concat(2)
+    if not reduce_grid:
+        c1 = Sequential()
+        _conv_bn_relu(c1, n_in, config[0][0], name=name_prefix + "1x1")
+        concat.add(c1)
+    c3 = Sequential()
+    _conv_bn_relu(c3, n_in, config[1][0], name=name_prefix + "3x3_reduce")
+    _conv_bn_relu(c3, config[1][0], config[1][1], 3, 3, stride, stride, 1, 1,
+                  name=name_prefix + "3x3")
+    concat.add(c3)
+    cd = Sequential()
+    _conv_bn_relu(cd, n_in, config[2][0],
+                  name=name_prefix + "double3x3_reduce")
+    _conv_bn_relu(cd, config[2][0], config[2][1], 3, 3, 1, 1, 1, 1,
+                  name=name_prefix + "double3x3a")
+    _conv_bn_relu(cd, config[2][1], config[2][1], 3, 3, stride, stride, 1, 1,
+                  name=name_prefix + "double3x3b")
+    concat.add(cd)
+    pool = Sequential()
+    pool_kind, proj = config[3]
+    if reduce_grid:
+        pool.add(SpatialMaxPooling(3, 3, 2, 2).ceil()
+                 .set_name(name_prefix + "pool"))
+    else:
+        if pool_kind == "max":
+            pool.add(SpatialMaxPooling(3, 3, 1, 1, 1, 1).ceil()
+                     .set_name(name_prefix + "pool"))
+        else:
+            pool.add(SpatialAveragePooling(3, 3, 1, 1, 1, 1).ceil()
+                     .set_name(name_prefix + "pool"))
+        _conv_bn_relu(pool, n_in, proj, name=name_prefix + "pool_proj")
+    concat.add(pool)
+    return concat
+
+
+def build_inception_v2(class_num: int = 1000):
+    """«bigdl» Inception_v2 (BN-Inception, 224x224 input)."""
+    model = Sequential()
+    _conv_bn_relu(model, 3, 64, 7, 7, 2, 2, 3, 3, name="conv1/7x7_s2")
+    model.add(SpatialMaxPooling(3, 3, 2, 2).ceil().set_name("pool1/3x3_s2"))
+    _conv_bn_relu(model, 64, 64, name="conv2/3x3_reduce")
+    _conv_bn_relu(model, 64, 192, 3, 3, 1, 1, 1, 1, name="conv2/3x3")
+    model.add(SpatialMaxPooling(3, 3, 2, 2).ceil().set_name("pool2/3x3_s2"))
+    model \
+        .add(inception_layer_v2(
+            192, ([64], [64, 64], [64, 96], ("avg", 32)), "inception_3a/")) \
+        .add(inception_layer_v2(
+            256, ([64], [64, 96], [64, 96], ("avg", 64)), "inception_3b/")) \
+        .add(inception_layer_v2(
+            320, ([0], [128, 160], [64, 96], ("max", 0)), "inception_3c/")) \
+        .add(inception_layer_v2(
+            576, ([224], [64, 96], [96, 128], ("avg", 128)),
+            "inception_4a/")) \
+        .add(inception_layer_v2(
+            576, ([192], [96, 128], [96, 128], ("avg", 128)),
+            "inception_4b/")) \
+        .add(inception_layer_v2(
+            576, ([160], [128, 160], [128, 160], ("avg", 128)),
+            "inception_4c/")) \
+        .add(inception_layer_v2(
+            608, ([96], [128, 192], [160, 192], ("avg", 128)),
+            "inception_4d/")) \
+        .add(inception_layer_v2(
+            608, ([0], [128, 192], [192, 256], ("max", 0)),
+            "inception_4e/")) \
+        .add(inception_layer_v2(
+            1056, ([352], [192, 320], [160, 224], ("avg", 128)),
+            "inception_5a/")) \
+        .add(inception_layer_v2(
+            1024, ([352], [192, 320], [192, 224], ("max", 128)),
+            "inception_5b/")) \
+        .add(SpatialAveragePooling(7, 7, 1, 1).set_name("pool5/7x7_s1")) \
+        .add(Reshape([1024])) \
+        .add(Linear(1024, class_num,
+                    init_method=Xavier()).set_name("loss3/classifier")) \
+        .add(LogSoftMax())
+    return model
+
+
+def inception_recipe_optim(batch_size: int, n_epochs: int,
+                           iterations_per_epoch: int,
+                           base_lr: float = None):
+    """The reference Inception recipe («bigdl» models/inception
+    Train.scala): SGD + momentum + weight decay with a Poly(0.5)
+    learning-rate decay over the full training run."""
+    from bigdl_tpu.optim import SGD, Poly
+
+    if base_lr is None:
+        base_lr = 0.0898330 * batch_size / 1024.0
+    max_iter = max(1, n_epochs * iterations_per_epoch)
+    return SGD(learningrate=base_lr, momentum=0.9, dampening=0.0,
+               weightdecay=1e-4,
+               learningrate_schedule=Poly(0.5, max_iter))
+
+
+def main(argv=None):
+    """Console entry (reference: models/inception Train.scala CLI).
+
+    With ``-f/--data-dir`` pointing at an ImageNet-style tree this is
+    the TrainImageNet path: Inception v1 or v2 (``--version``) + the
+    reference Poly recipe, file-backed distributed ingestion under
+    DistriOptimizer.  Without a data dir it trains a few steps on a
+    synthetic 224px task as a smoke path."""
+    import argparse
+    import logging
+
+    import numpy as np
+
+    from bigdl_tpu.nn import ClassNLLCriterion
+    from bigdl_tpu.optim import Optimizer, Trigger
+
+    logging.basicConfig(level=logging.INFO)
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-f", "--data-dir", default=None,
+                    help="ImageNet-style dir (train/<cls>/*.jpg); "
+                         "absent = tiny synthetic smoke task")
+    ap.add_argument("--version", choices=["v1", "v2"], default="v1")
+    ap.add_argument("-b", "--batch-size", type=int, default=128)
+    ap.add_argument("-e", "--max-epoch", type=int, default=1)
+    ap.add_argument("--learning-rate", type=float, default=None)
+    ap.add_argument("-n", "--num-samples", type=int, default=64)
+    ap.add_argument("--checkpoint", default=None)
+    args = ap.parse_args(argv)
+
+    build = build_inception_v1 if args.version == "v1" \
+        else build_inception_v2
+
+    if args.data_dir:
+        from bigdl_tpu.models.train_util import train_imagenet_folder
+
+        train_imagenet_folder(
+            build,
+            lambda bs, ep, it: inception_recipe_optim(
+                bs, ep, it, base_lr=args.learning_rate),
+            args.data_dir, args.batch_size, args.max_epoch,
+            checkpoint=args.checkpoint)
+        return
+
+    rs = np.random.RandomState(0)
+    n = args.num_samples
+    x = rs.rand(n, 3, 224, 224).astype(np.float32)
+    y = (rs.randint(0, 10, n) + 1).astype(np.float32)
+    model = build(class_num=10)
+    bs = min(args.batch_size, n)
+    opt = Optimizer(model, (x, y), ClassNLLCriterion(), batch_size=bs)
+    opt.set_optim_method(inception_recipe_optim(
+        bs, args.max_epoch, max(1, n // bs),
+        base_lr=args.learning_rate))
+    opt.set_end_when(Trigger.max_epoch(args.max_epoch))
+    if args.checkpoint:
+        opt.set_checkpoint(args.checkpoint, Trigger.every_epoch())
+    opt.optimize()
+
+
 def build_inception_v1(class_num: int = 1000, has_dropout: bool = True):
     """«bigdl» Inception_v1_NoAuxClassifier (224x224 input)."""
     model = Sequential()
@@ -111,3 +295,7 @@ def build_inception_v1(class_num: int = 1000, has_dropout: bool = True):
                     init_method=Xavier()).set_name("loss3/classifier")) \
         .add(LogSoftMax())
     return model
+
+
+if __name__ == "__main__":
+    main()

@@ -176,9 +176,7 @@ def main(argv=None):
     import numpy as np
 
     from bigdl_tpu.nn import ClassNLLCriterion
-    from bigdl_tpu.optim import (
-        DistriOptimizer, Optimizer, SGD, Top1Accuracy, Top5Accuracy, Trigger,
-    )
+    from bigdl_tpu.optim import Optimizer, SGD, Top1Accuracy, Trigger
 
     logging.basicConfig(level=logging.INFO)
     ap = argparse.ArgumentParser()
@@ -199,32 +197,17 @@ def main(argv=None):
 
     if args.data_dir:
         # ----- TrainImageNet path: real files, distributed ingestion ----
-        from bigdl_tpu.dataset.imagenet import ImageFolderDataSet
+        from bigdl_tpu.models.train_util import train_imagenet_folder
 
-        train_ds = ImageFolderDataSet(
-            args.data_dir, batch_size=args.batch_size, train=True,
-            image_size=args.image_size)
         depth = args.depth if args.depth in _IMAGENET_CFG else 50
-        model = build_resnet_imagenet(depth=depth,
-                                      class_num=train_ds.class_num())
-        iters = max(1, train_ds.size() // args.batch_size)
-        opt = DistriOptimizer(model, train_ds, ClassNLLCriterion(),
-                              batch_size=args.batch_size)
-        opt.set_optim_method(imagenet_recipe_optim(
-            args.batch_size, n_epochs=args.max_epoch,
-            iterations_per_epoch=iters, base_lr=args.learning_rate))
-        opt.set_end_when(Trigger.max_epoch(args.max_epoch))
-        try:
-            val_ds = ImageFolderDataSet(
-                args.data_dir, batch_size=args.batch_size, train=False,
-                image_size=args.image_size)
-            opt.set_validation(Trigger.every_epoch(), val_ds,
-                               [Top1Accuracy(), Top5Accuracy()])
-        except FileNotFoundError:
-            pass  # no val split
-        if args.checkpoint:
-            opt.set_checkpoint(args.checkpoint, Trigger.every_epoch())
-        opt.optimize()
+        train_imagenet_folder(
+            lambda class_num: build_resnet_imagenet(
+                depth=depth, class_num=class_num),
+            lambda bs, ep, it: imagenet_recipe_optim(
+                bs, n_epochs=ep, iterations_per_epoch=it,
+                base_lr=args.learning_rate),
+            args.data_dir, args.batch_size, args.max_epoch,
+            image_size=args.image_size, checkpoint=args.checkpoint)
         return
 
     model = build_resnet_cifar(depth=args.depth)

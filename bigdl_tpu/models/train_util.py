@@ -1,0 +1,56 @@
+"""Shared pieces of the model Train.scala-style CLIs.
+
+The reference gives every model family its own Train.scala +
+Utils.scala (SURVEY.md §2.1 "Reference models"); the rebuild keeps one
+``main`` per model module but routes the common ImageNet-folder
+training flow through here so checkpoint/validation/ingestion wiring
+can't diverge between families.
+"""
+
+from __future__ import annotations
+
+
+def train_imagenet_folder(
+    build_model,
+    make_optim,
+    data_dir: str,
+    batch_size: int,
+    max_epoch: int,
+    image_size: int = 224,
+    checkpoint: str = None,
+):
+    """Train ``build_model(class_num)`` on an ImageNet-style directory
+    tree (``<dir>/train/<wnid>/*.JPEG``) under DistriOptimizer.
+
+    ``make_optim(batch_size, n_epochs, iterations_per_epoch)`` supplies
+    the family's recipe (warmup/multistep for resnet, Poly for
+    inception).  A ``val`` split is attached when present; its absence
+    is not an error (matching the reference mains' optional
+    ``--valFolder``), but a bad ``data_dir`` raises from the train-split
+    loader."""
+    from bigdl_tpu.dataset.imagenet import ImageFolderDataSet
+    from bigdl_tpu.nn import ClassNLLCriterion
+    from bigdl_tpu.optim import (
+        DistriOptimizer, Top1Accuracy, Top5Accuracy, Trigger,
+    )
+
+    train_ds = ImageFolderDataSet(
+        data_dir, batch_size=batch_size, train=True, image_size=image_size)
+    model = build_model(class_num=train_ds.class_num())
+    iters = max(1, train_ds.size() // batch_size)
+    opt = DistriOptimizer(model, train_ds, ClassNLLCriterion(),
+                          batch_size=batch_size)
+    opt.set_optim_method(make_optim(batch_size, max_epoch, iters))
+    opt.set_end_when(Trigger.max_epoch(max_epoch))
+    try:
+        val_ds = ImageFolderDataSet(
+            data_dir, batch_size=batch_size, train=False,
+            image_size=image_size)
+        opt.set_validation(Trigger.every_epoch(), val_ds,
+                           [Top1Accuracy(), Top5Accuracy()])
+    except FileNotFoundError:
+        pass  # no val split
+    if checkpoint:
+        opt.set_checkpoint(checkpoint, Trigger.every_epoch())
+    opt.optimize()
+    return model

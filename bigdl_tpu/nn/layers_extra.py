@@ -945,6 +945,89 @@ class PairwiseDistance(_Stateless):
         return jnp.sum(d, axis=-1) ** (1.0 / self.norm)
 
 
+class Maxout(AbstractModule):
+    """⟦«bigdl»/nn/Maxout.scala⟧ — Linear to maxout_number*output_size
+    then max over the maxout groups: y_j = max_k (W_k x + b_k)_j.
+
+    TPU note: the whole layer is one (in, maxout*out) matmul plus a
+    reshape-max — a single MXU contraction with a fused reduction."""
+
+    param_names = ("weight", "bias")
+
+    def __init__(self, input_size: int, output_size: int,
+                 maxout_number: int, with_bias: bool = True):
+        super().__init__()
+        self._config = dict(input_size=input_size, output_size=output_size,
+                            maxout_number=maxout_number, with_bias=with_bias)
+        self.input_size = input_size
+        self.output_size = output_size
+        self.maxout_number = maxout_number
+        self.with_bias = with_bias
+        self.reset()
+
+    def reset(self):
+        n_out = self.maxout_number * self.output_size
+        bound = 1.0 / math.sqrt(self.input_size)
+        self.weight = _to_device(
+            RandomGenerator.RNG.uniform(-bound, bound,
+                        (self.input_size, n_out)).astype(np.float32)
+        )
+        self.bias = (
+            _to_device(
+                RandomGenerator.RNG.uniform(
+                    -bound, bound, n_out).astype(np.float32))
+            if self.with_bias else None
+        )
+        return self
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        jnp = _jnp()
+        x, squeezed = _auto_batch(input, 2)
+        y = x @ params["weight"]
+        if self.with_bias:
+            y = y + params["bias"]
+        y = y.reshape(y.shape[0], self.maxout_number, self.output_size)
+        y = jnp.max(y, axis=1)
+        return y[0] if squeezed else y
+
+    def __repr__(self):
+        return (f"Maxout({self.input_size} -> {self.output_size} "
+                f"x{self.maxout_number})")
+
+
+class SReLU(AbstractModule):
+    """⟦«bigdl»/nn/SReLU.scala⟧ — S-shaped ReLU with four learnable
+    per-channel parameters:
+    y = t_r + a_r (x - t_r) for x >= t_r; x between the thresholds;
+    y = t_l + a_l (x - t_l) for x <= t_l."""
+
+    param_names = ("t_left", "a_left", "t_right", "a_right")
+
+    def __init__(self, shape: Sequence[int]):
+        super().__init__()
+        self._config = dict(shape=list(shape))
+        self.shape = tuple(int(s) for s in shape)
+        self.reset()
+
+    def reset(self):
+        self.t_left = _to_device(np.zeros(self.shape, np.float32))
+        self.a_left = _to_device(np.full(self.shape, 0.2, np.float32))
+        self.t_right = _to_device(
+            RandomGenerator.RNG.uniform(0.0, 1.0, self.shape).astype(np.float32))
+        self.a_right = _to_device(np.ones(self.shape, np.float32))
+        return self
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        jnp = _jnp()
+        tl, al = params["t_left"], params["a_left"]
+        tr, ar = params["t_right"], params["a_right"]
+        y = jnp.where(input >= tr, tr + ar * (input - tr), input)
+        return jnp.where(input <= tl, tl + al * (input - tl), y)
+
+    def __repr__(self):
+        return f"SReLU({self.shape})"
+
+
 class NegativeEntropyPenalty(_Stateless):
     """⟦«bigdl»/nn/NegativeEntropyPenalty.scala⟧ — identity forward that
     adds β·Σ p·log p to the training loss (pass-through analogue of
@@ -1001,6 +1084,8 @@ __all__ = [
     "Tile",
     "Reverse",
     "MaskedSelect",
+    "Maxout",
+    "SReLU",
     "PairwiseDistance",
     "NegativeEntropyPenalty",
 ]

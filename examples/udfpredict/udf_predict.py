@@ -13,11 +13,15 @@ over a dict-DataFrame (the DLframes form).
 
 import argparse
 import logging
+import os
 import sys
 
 import numpy as np
 
-sys.path.insert(0, ".")
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+)
 from examples.textclassification.train_text_cnn import (  # noqa: E402
     build_text_cnn, encode_texts, tokenize_corpus,
 )
@@ -26,12 +30,15 @@ log = logging.getLogger("udfpredict")
 
 
 def load_docs(data_dir=None):
-    """news20 from disk when present, else the synthetic stand-in."""
+    """news20 from disk when present, else the synthetic stand-in.  An
+    explicitly requested corpus that can't be loaded is an error — the
+    silent fallback applies only to the no-argument default."""
     from bigdl_tpu.dataset.news20 import get_news20, synthetic_news20
 
+    if data_dir:
+        return get_news20(data_dir), 20
     try:
-        docs = get_news20(data_dir) if data_dir else get_news20()
-        return docs, 20
+        return get_news20(), 20
     except FileNotFoundError:
         log.info("no news20 corpus on disk; using the synthetic stand-in")
         return synthetic_news20(1536, class_num=4), 4

@@ -16,12 +16,15 @@ from __future__ import annotations
 
 import itertools
 import json
+import os
 import sys
 import time
 
 import numpy as np
 
-sys.path.insert(0, ".")
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
 from bench import (  # noqa: E402
     _resnet50_cfg,
     train_step_flops_per_image,

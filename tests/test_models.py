@@ -86,6 +86,48 @@ def test_inception_v1_shape_and_params():
     assert 5e6 < n < 8e6, n
 
 
+def test_inception_v2_shape_and_params():
+    from bigdl_tpu.models import build_inception_v2
+
+    m = build_inception_v2(class_num=1000)
+    m.evaluate()
+    out = m.forward(jnp.ones((1, 3, 224, 224)))
+    assert out.shape == (1, 1000)
+    n = _count_params(m)
+    # BN-Inception ~ 11M params
+    assert 10e6 < n < 13e6, n
+
+
+def test_inception_v2_train_step_decreases_loss():
+    from bigdl_tpu.models.inception import inception_layer_v2
+    from bigdl_tpu.nn import (
+        ClassNLLCriterion, Linear, LogSoftMax, Reshape, Sequential,
+        SpatialAveragePooling,
+    )
+    from bigdl_tpu.optim import SGD, Trigger
+    from bigdl_tpu.optim.optimizer import LocalOptimizer
+
+    # a tiny v2 tower (one regular + one grid-reduction module) so the
+    # double-3x3/stride-2/pool-pass-through paths all run fwd+bwd
+    model = (
+        Sequential()
+        .add(inception_layer_v2(3, ([8], [8, 8], [8, 8], ("avg", 8)), "a/"))
+        .add(inception_layer_v2(32, ([0], [8, 8], [8, 8], ("max", 0)), "b/"))
+        .add(SpatialAveragePooling(8, 8, 1, 1))
+        .add(Reshape([48]))
+        .add(Linear(48, 4))
+        .add(LogSoftMax())
+    )
+    rs = np.random.RandomState(0)
+    x = rs.rand(32, 3, 16, 16).astype(np.float32)
+    y = (rs.randint(0, 4, 32) + 1).astype(np.float32)
+    opt = LocalOptimizer(model, (x, y), ClassNLLCriterion(), batch_size=16)
+    opt.set_optim_method(SGD(learningrate=0.1))
+    opt.set_end_when(Trigger.max_epoch(4))
+    opt.optimize()
+    assert opt.state["loss"] < np.log(4)  # below chance-level NLL
+
+
 def test_autoencoder_trains():
     from bigdl_tpu.models.autoencoder import train_autoencoder
 
