@@ -14,6 +14,7 @@ from bigdl_tpu.models.resnet import (
 from bigdl_tpu.models.vgg import build_vgg16, build_vgg19, build_vgg_cifar
 from bigdl_tpu.models.alexnet import build_alexnet, build_alexnet_original
 from bigdl_tpu.models.inception import build_inception_v1, build_inception_v2
+from bigdl_tpu.models.ncf import build_ncf
 from bigdl_tpu.models.autoencoder import build_autoencoder
 from bigdl_tpu.models.rnn import build_ptb_lm
 from bigdl_tpu.models.transformer import TransformerLM, build_transformer_lm
@@ -22,7 +23,7 @@ __all__ = [
     "build_lenet5", "build_resnet_cifar", "build_resnet_imagenet",
     "imagenet_recipe_optim", "build_vgg16", "build_vgg19", "build_vgg_cifar",
     "build_alexnet", "build_alexnet_original", "build_inception_v1",
-    "build_inception_v2",
+    "build_inception_v2", "build_ncf",
     "build_autoencoder", "build_ptb_lm", "TransformerLM",
     "build_transformer_lm",
 ]

@@ -1028,6 +1028,76 @@ class SReLU(AbstractModule):
         return f"SReLU({self.shape})"
 
 
+class RoiPooling(_Stateless):
+    """⟦«bigdl»/nn/RoiPooling.scala⟧ — Fast-RCNN region-of-interest max
+    pooling.  Table input [data (B,C,H,W), rois (R,5)] with roi rows
+    (batch_index 1-based, x1, y1, x2, y2) in image coordinates; output
+    (R, C, pooled_h, pooled_w).
+
+    TPU note: the reference's per-roi scalar loops become two masked
+    rectangular max-reductions (independent h/w interval masks), fully
+    vectorized and jittable at static shapes; autograd routes the
+    gradient to each bin's argmax like the hand-written backward."""
+
+    def __init__(self, pooled_w: int, pooled_h: int,
+                 spatial_scale: float = 1.0):
+        super().__init__(pooled_w=pooled_w, pooled_h=pooled_h,
+                         spatial_scale=spatial_scale)
+        self.pooled_w = pooled_w
+        self.pooled_h = pooled_h
+        self.spatial_scale = spatial_scale
+
+    def _interval_mask(self, starts, ends, size):
+        jnp = _jnp()
+        idx = jnp.arange(size, dtype=jnp.float32)
+        return (idx[None, None, :] >= starts[:, :, None]) & (
+            idx[None, None, :] < ends[:, :, None]
+        )
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        jnp = _jnp()
+        data, rois = input[0], input[1]
+        _, _, H, W = data.shape
+        ph, pw = self.pooled_h, self.pooled_w
+        img = rois[:, 0].astype(jnp.int32) - 1  # 1-based image index
+        x1 = jnp.round(rois[:, 1] * self.spatial_scale)
+        y1 = jnp.round(rois[:, 2] * self.spatial_scale)
+        x2 = jnp.round(rois[:, 3] * self.spatial_scale)
+        y2 = jnp.round(rois[:, 4] * self.spatial_scale)
+        roi_w = jnp.maximum(x2 - x1 + 1.0, 1.0)
+        roi_h = jnp.maximum(y2 - y1 + 1.0, 1.0)
+        bin_w = roi_w / pw
+        bin_h = roi_h / ph
+        j = jnp.arange(pw, dtype=jnp.float32)
+        i = jnp.arange(ph, dtype=jnp.float32)
+        wstart = jnp.clip(jnp.floor(j[None] * bin_w[:, None])
+                          + x1[:, None], 0, W)
+        wend = jnp.clip(jnp.ceil((j[None] + 1) * bin_w[:, None])
+                        + x1[:, None], 0, W)
+        hstart = jnp.clip(jnp.floor(i[None] * bin_h[:, None])
+                          + y1[:, None], 0, H)
+        hend = jnp.clip(jnp.ceil((i[None] + 1) * bin_h[:, None])
+                        + y1[:, None], 0, H)
+        mask_w = self._interval_mask(wstart, wend, W)   # (R, pw, W)
+        mask_h = self._interval_mask(hstart, hend, H)   # (R, ph, H)
+        x = data[img]                                   # (R, C, H, W)
+        neg = jnp.asarray(-jnp.inf, x.dtype)
+        # max over w per (h, output-col), then over h per output-row
+        t = jnp.max(
+            jnp.where(mask_w[:, None, None, :, :], x[:, :, :, None, :], neg),
+            axis=-1,
+        )                                               # (R, C, H, pw)
+        y = jnp.max(
+            jnp.where(mask_h[:, None, :, :, None], t[:, :, None, :, :], neg),
+            axis=3,
+        )                                               # (R, C, ph, pw)
+        return jnp.where(jnp.isneginf(y), 0.0, y)  # empty bin -> 0 (Caffe)
+
+    def __repr__(self):
+        return (f"RoiPooling({self.pooled_w}x{self.pooled_h}, "
+                f"scale={self.spatial_scale})")
+
+
 class NegativeEntropyPenalty(_Stateless):
     """⟦«bigdl»/nn/NegativeEntropyPenalty.scala⟧ — identity forward that
     adds β·Σ p·log p to the training loss (pass-through analogue of
@@ -1086,6 +1156,7 @@ __all__ = [
     "MaskedSelect",
     "Maxout",
     "SReLU",
+    "RoiPooling",
     "PairwiseDistance",
     "NegativeEntropyPenalty",
 ]

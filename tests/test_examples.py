@@ -46,3 +46,11 @@ def test_text_cnn_example():
 @pytest.mark.slow
 def test_dlframes_example():
     _run_main("examples.dlframes.dl_classifier_example", [])
+
+
+@pytest.mark.slow
+def test_ncf_recommendation_example():
+    from examples.recommendation.ncf_train import main
+
+    hr, ndcg = main(["-e", "4"])
+    assert hr > 0.15  # well above the 0.10 random HitRatio@10

@@ -675,3 +675,49 @@ def test_logger_filter_keeps_shared_handler_open():
         if isinstance(h, logging.FileHandler):
             assert not h.stream.closed
     logging.getLogger("_lf_b").info("must not raise on a closed stream")
+
+
+def test_maxout_reduces_groups():
+    m = N.Maxout(6, 4, 3)
+    x = jnp.asarray(np.random.RandomState(0).randn(5, 6).astype(np.float32))
+    y = np.asarray(m.forward(x))
+    assert y.shape == (5, 4)
+    # equals max over the 3 affine maps computed by hand
+    w = np.asarray(m.weight).reshape(6, 3, 4)
+    b = np.asarray(m.bias).reshape(3, 4)
+    ref = (np.asarray(x) @ w.reshape(6, 12) + b.reshape(12)).reshape(5, 3, 4)
+    np.testing.assert_allclose(y, ref.max(axis=1), rtol=1e-5)
+
+
+def test_srelu_piecewise():
+    m = N.SReLU((4,))
+    # fix the thresholds for a deterministic check
+    m.t_left = jnp.asarray([-1.0, -1.0, -1.0, -1.0])
+    m.a_left = jnp.asarray([0.5, 0.5, 0.5, 0.5])
+    m.t_right = jnp.asarray([1.0, 1.0, 1.0, 1.0])
+    m.a_right = jnp.asarray([2.0, 2.0, 2.0, 2.0])
+    x = jnp.asarray([[-3.0, 0.0, 0.5, 3.0]])
+    y = np.asarray(m.forward(x))[0]
+    np.testing.assert_allclose(y, [-2.0, 0.0, 0.5, 5.0], rtol=1e-6)
+
+
+def test_roi_pooling_forward_backward_and_roundtrip(tmp_path):
+    from bigdl_tpu.utils.serializer import load_module, save_module
+
+    data = jnp.arange(2 * 16, dtype=jnp.float32).reshape(2, 1, 4, 4)
+    rois = jnp.asarray(
+        [[1, 0, 0, 3, 3], [2, 1, 0, 3, 1], [2, 2, 2, 3, 3]], jnp.float32
+    )
+    m = N.RoiPooling(2, 2, 1.0)
+    y = np.asarray(m.forward([data, rois]))
+    assert y.shape == (3, 1, 2, 2)
+    np.testing.assert_allclose(y[0, 0], [[5, 7], [13, 15]])
+    # roi 2: image 2 (offset 16), x in [1,3], y in [0,1] -> rows 0..1
+    np.testing.assert_allclose(y[1, 0], [[18, 19], [22, 23]])
+    import jax
+
+    g = jax.grad(lambda d: m.forward([d, rois]).sum())(data)
+    assert float(np.asarray(g).sum()) == 12.0  # one unit per pooled cell
+    loaded = load_module(save_module(m, str(tmp_path / "roi")))
+    np.testing.assert_allclose(
+        np.asarray(loaded.forward([data, rois])), y)

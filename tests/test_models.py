@@ -185,3 +185,24 @@ def test_module_level_evaluate_and_predict():
     assert classes.min() >= 1 and classes.max() <= 3
     # predictions and the accuracy agree
     assert value == np.mean(classes == y)
+
+
+def test_ncf_forward_and_learns():
+    from bigdl_tpu.models import build_ncf
+    from bigdl_tpu.nn import ClassNLLCriterion
+    from bigdl_tpu.optim import Adam, Trigger
+    from bigdl_tpu.optim.optimizer import LocalOptimizer
+    from examples.recommendation.ncf_train import (
+        synthetic_interactions, training_pairs,
+    )
+
+    pos = synthetic_interactions(50, 80, per_user=10)
+    x, y = training_pairs(pos, 80, neg_per_pos=2)
+    m = build_ncf(50, 80, class_num=2)
+    out = m.forward(jnp.asarray(x[:8]))
+    assert out.shape == (8, 2)
+    opt = LocalOptimizer(m, (x, y), ClassNLLCriterion(), batch_size=128)
+    opt.set_optim_method(Adam(learningrate=1e-2))
+    opt.set_end_when(Trigger.max_epoch(3))
+    opt.optimize()
+    assert opt.state["loss"] < 0.63  # below the all-negative prior NLL
