@@ -1,0 +1,73 @@
+"""MovieLens fetcher.
+
+Rebuild of ⟦«py»/dataset/movielens.py⟧: the reference downloads
+``ml-1m.zip`` and exposes ``get_id_ratings`` (a (N, 3) int array of
+1-based ``user_id, item_id, rating`` rows from ``ratings.dat``).  This
+environment has no egress, so the fetcher reads an already-downloaded
+layout from ``source_dir`` (the same on-disk shapes the reference's
+download produces: ``ml-1m/ratings.dat`` with ``::``-separated fields,
+or the zip) and raises with the canonical URL when absent.
+``synthetic_movielens`` is the offline stand-in (same pattern as
+dataset/mnist.py / dataset/news20.py): a latent-factor rating model
+with the ml-1m id ranges scaled down.
+"""
+
+from __future__ import annotations
+
+import os
+import zipfile
+
+import numpy as np
+
+MOVIELENS_1M_URL = "http://files.grouplens.org/datasets/movielens/ml-1m.zip"
+
+
+def get_id_ratings(source_dir: str = "/tmp/movielens/") -> np.ndarray:
+    """(N, 3) int32 array of 1-based (user, item, rating) rows."""
+    ratings = os.path.join(source_dir, "ml-1m", "ratings.dat")
+    if not os.path.exists(ratings):
+        zpath = os.path.join(source_dir, "ml-1m.zip")
+        if os.path.exists(zpath):
+            with zipfile.ZipFile(zpath) as z:
+                z.extractall(source_dir)
+        if not os.path.exists(ratings):
+            raise FileNotFoundError(
+                f"no MovieLens data under {source_dir}; download "
+                f"{MOVIELENS_1M_URL} there first (no egress here)"
+            )
+    rows = []
+    with open(ratings, encoding="latin-1") as f:
+        for line in f:
+            parts = line.strip().split("::")
+            if len(parts) >= 3:
+                rows.append((int(parts[0]), int(parts[1]), int(parts[2])))
+    return np.asarray(rows, dtype=np.int32)
+
+
+def latent_scores(n_users: int, n_items: int, dim: int = 4,
+                  seed: int = 0) -> np.ndarray:
+    """The hidden user x item affinity model behind every synthetic
+    recommendation corpus here (also used by the NCF example's direct
+    interaction generator)."""
+    rs = np.random.RandomState(seed)
+    return rs.randn(n_users, dim) @ rs.randn(n_items, dim).T
+
+
+def synthetic_movielens(n_users: int = 200, n_items: int = 400,
+                        per_user: int = 25, dim: int = 4,
+                        seed: int = 0) -> np.ndarray:
+    """Deterministic stand-in with the same (N, 3) shape: ratings 1-5
+    quantized from a hidden latent-factor score model."""
+    rs = np.random.RandomState(seed + 1)  # item sampling; scores use seed
+    all_scores = latent_scores(n_users, n_items, dim, seed)
+    # GLOBAL quantile buckets -> 1..5 ratings, so "rating >= 4" aligns
+    # with the latent structure across users (implicit-feedback
+    # protocols threshold absolutely, and real MovieLens stars do too)
+    cuts = np.quantile(all_scores, [0.2, 0.4, 0.6, 0.8])
+    rows = []
+    for uid in range(n_users):
+        items = rs.choice(n_items, size=per_user, replace=False)
+        rating = 1 + np.searchsorted(cuts, all_scores[uid, items])
+        for it, r in zip(items, rating):
+            rows.append((uid + 1, it + 1, int(np.clip(r, 1, 5))))
+    return np.asarray(rows, dtype=np.int32)

@@ -117,6 +117,10 @@ from bigdl_tpu.nn.criterion import (
 )
 from bigdl_tpu.nn.volumetric import *  # noqa: F401,F403
 from bigdl_tpu.nn.volumetric import __all__ as _volumetric_all
+from bigdl_tpu.nn.fused import (
+    SpatialConvolutionBatchNorm,
+    fuse_conv_bn,
+)
 from bigdl_tpu.nn.layers_extra import *  # noqa: F401,F403
 from bigdl_tpu.nn.layers_extra import __all__ as _extra_all
 
@@ -146,6 +150,7 @@ __all__ = (
         "TimeDistributed", "Select", "MultiRNNCell", "ConvLSTMPeephole",
         "LayerNorm", "MultiHeadAttention", "TransformerBlock",
         "PositionalEmbedding",
+        "SpatialConvolutionBatchNorm", "fuse_conv_bn",
     ]
     + list(_layers_all)
     + list(_volumetric_all)

@@ -1,0 +1,170 @@
+"""Fused 1x1-conv + BatchNorm-statistics Pallas kernel.
+
+BASELINE.md's measured analysis: after the BN normalize pass was folded
+into the compute dtype, the remaining BN bandwidth tax on ResNet-50 is
+the separate statistics pass — every training-mode BN re-reads its
+input activation once to reduce per-channel mean/variance.  Half of
+ResNet-50's FLOPs flow through 1x1 convolutions whose outputs feed
+straight into BN, so this kernel computes the 1x1 conv as an MXU
+matmul (W (O,C) @ X (C,HW) per sample) and accumulates the BN
+statistics **in the conv epilogue** while the output tile is still in
+VMEM: per-channel sums of (y - shift) and (y - shift)^2, shift being
+the running mean (the same shifted single-pass formulation
+``nn.BatchNormalization`` uses, see layers.py).  The activation is
+then never re-read for statistics.
+
+Backward is analytic (jax.custom_vjp): with cotangents (gy, gs1, gs2),
+  dy_eff = gy + gs1[c] + 2 (y - shift) gs2[c]
+  dx     = W^T dy_eff          (one matmul)
+  dW     = dy_eff X^T          (one matmul)
+— standard XLA dots; only the forward needs the hand kernel (the
+backward reads the activation anyway, there is no second pass to
+save).
+
+Grid: (O-tiles outer, N inner) so each stats tile is revisited by
+consecutive programs and accumulates in VMEM, written back once.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _reference(x2, w, shift):
+    """Plain-XLA reference: x2 (N, C, HW), w (O, C), shift (O,) f32."""
+    y = jnp.einsum(
+        "oc,nch->noh", w, x2, preferred_element_type=jnp.float32
+    )
+    yc = y - shift[None, :, None]
+    s1 = jnp.sum(yc, axis=(0, 2))
+    s2 = jnp.sum(yc * yc, axis=(0, 2))
+    return y.astype(x2.dtype), s1, s2
+
+
+def _fwd_kernel(x_ref, w_ref, shift_ref, y_ref, s1_ref, s2_ref):
+    from jax.experimental import pallas as pl
+
+    n = pl.program_id(1)
+    x = x_ref[0]                      # (C, HW)
+    w = w_ref[...]                    # (block_o, C)
+    y = jax.lax.dot_general(
+        w, x, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                 # (block_o, HW) f32
+    yc = y - shift_ref[...][:, None]
+    p1 = jnp.sum(yc, axis=1)
+    p2 = jnp.sum(yc * yc, axis=1)
+
+    @pl.when(n == 0)
+    def _init():
+        s1_ref[...] = p1
+        s2_ref[...] = p2
+
+    @pl.when(n > 0)
+    def _acc():
+        s1_ref[...] += p1
+        s2_ref[...] += p2
+
+    y_ref[0] = y.astype(y_ref.dtype)
+
+
+def _pick_block_o(o: int) -> int:
+    for b in (256, 128, 64, 32, 16, 8):
+        if o % b == 0:
+            return b
+    return 0
+
+
+def _fwd(x, w, shift, interpret):
+    """x (N, C, H, W), w (O, C), shift (O,) f32 ->
+    (y (N, O, H, W), s1 (O,) f32, s2 (O,) f32)."""
+    from jax.experimental import pallas as pl
+
+    n, c, h, wd = x.shape
+    o = w.shape[0]
+    hw = h * wd
+    block_o = _pick_block_o(o)
+    x2 = x.reshape(n, c, hw)
+    if block_o == 0 or hw * max(c, block_o) * 4 > 6 * 1024 * 1024:
+        y, s1, s2 = _reference(x2, w, shift)
+        return y.reshape(n, o, h, wd), s1, s2
+
+    y2, s1, s2 = pl.pallas_call(
+        _fwd_kernel,
+        grid=(o // block_o, n),
+        in_specs=[
+            pl.BlockSpec((1, c, hw), lambda oi, ni: (ni, 0, 0)),
+            pl.BlockSpec((block_o, c), lambda oi, ni: (oi, 0)),
+            pl.BlockSpec((block_o,), lambda oi, ni: (oi,)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_o, hw), lambda oi, ni: (ni, oi, 0)),
+            pl.BlockSpec((block_o,), lambda oi, ni: (oi,)),
+            pl.BlockSpec((block_o,), lambda oi, ni: (oi,)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, o, hw), x.dtype),
+            jax.ShapeDtypeStruct((o,), jnp.float32),
+            jax.ShapeDtypeStruct((o,), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x2, w, shift)
+    return y2.reshape(n, o, h, wd), s1, s2
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _conv1x1_bn_stats_vjp(x, w, shift, interpret):
+    return _fwd(x, w, shift, interpret)
+
+
+def _fwd_rule(x, w, shift, interpret):
+    out = _fwd(x, w, shift, interpret)
+    y, s1, _ = out
+    return out, (x, w, y, shift, s1)
+
+
+def _bwd_rule(interpret, res, cts):
+    x, w, y, shift, s1 = res
+    gy, gs1, gs2 = cts
+    yc = y.astype(jnp.float32) - shift[None, :, None, None]
+    gy_eff = (
+        gy.astype(jnp.float32)
+        + gs1[None, :, None, None]
+        + 2.0 * yc * gs2[None, :, None, None]
+    ).astype(x.dtype)
+    dx = jnp.einsum(
+        "nohw,oc->nchw", gy_eff, w, preferred_element_type=jnp.float32
+    ).astype(x.dtype)
+    dw = jnp.einsum(
+        "nohw,nchw->oc", gy_eff, x, preferred_element_type=jnp.float32
+    ).astype(w.dtype)
+    # shift is normally running-state (no grad requested), but the
+    # cotangent is cheap and exact: ds1/dshift = -n, ds2/dshift = -2 s1
+    n = y.shape[0] * y.shape[2] * y.shape[3]
+    gshift = -float(n) * gs1 - 2.0 * s1 * gs2
+    return dx, dw, gshift
+
+
+_conv1x1_bn_stats_vjp.defvjp(_fwd_rule, _bwd_rule)
+
+
+def conv1x1_bn_stats(x, w, shift, *, stride: int = 1,
+                     interpret: bool = False):
+    """Fused 1x1 conv + centered BN statistics.
+
+    x (N, C, H, W); w (O, C); shift (O,) f32 — typically the BN running
+    mean.  ``stride`` subsamples the input first (a strided 1x1 conv
+    reads only the kept positions; the slice is differentiable and
+    outside the custom_vjp).  Returns (y, s1, s2) with
+    s1 = sum(y - shift) and s2 = sum((y - shift)^2) per channel in f32.
+    """
+    if stride != 1:
+        x = x[:, :, ::stride, ::stride]
+    shift = shift.astype(jnp.float32)
+    # compiled Mosaic kernels exist only on TPU; CPU (tests, the
+    # 8-virtual-device mesh) runs the interpreter
+    interpret = interpret or jax.default_backend() == "cpu"
+    return _conv1x1_bn_stats_vjp(x, w, shift, interpret)

@@ -26,16 +26,40 @@ sys.path.insert(
 log = logging.getLogger("ncf")
 
 
+def _group_positives(rows, min_per_user=5):
+    """(N, 3) rating rows -> implicit positives (rating >= 4, the
+    standard NCF protocol): a list of 0-based item arrays, one per kept
+    user.  Users with fewer than ``min_per_user`` positives are dropped
+    (need one held-out + training items).  Vectorized — the real ml-1m
+    file is a million rows."""
+    keep = rows[rows[:, 2] >= 4]
+    order = np.argsort(keep[:, 0], kind="stable")
+    users, starts = np.unique(keep[order, 0], return_index=True)
+    items = keep[order, 1] - 1
+    chunks = np.split(items, starts[1:])
+    return [c for c in chunks if len(c) >= min_per_user]
+
+
 def synthetic_interactions(n_users=200, n_items=400, dim=4, per_user=20,
                            seed=0):
     """Latent-factor implicit feedback: each user interacts with their
-    top-scoring items under a hidden embedding model."""
-    rs = np.random.RandomState(seed)
-    u = rs.randn(n_users, dim)
-    v = rs.randn(n_items, dim)
-    scores = u @ v.T
+    top-scoring items under the shared hidden embedding model
+    (dataset/movielens.latent_scores)."""
+    from bigdl_tpu.dataset.movielens import latent_scores
+
+    scores = latent_scores(n_users, n_items, dim, seed)
     pos = np.argsort(-scores, axis=1)[:, :per_user]  # (U, per_user)
     return pos
+
+
+def movielens_interactions(data_dir, min_per_user=5):
+    """MovieLens ratings -> (positives, n_users, n_items) via the
+    shared implicit-feedback grouping."""
+    from bigdl_tpu.dataset.movielens import get_id_ratings
+
+    rows = get_id_ratings(data_dir)
+    pos = _group_positives(rows, min_per_user)
+    return pos, len(pos), int(rows[:, 1].max())
 
 
 def training_pairs(pos, n_items, neg_per_pos=4, seed=1):
@@ -84,6 +108,9 @@ def eval_ranking(model, pos, n_items, neg_num=99, k=10, seed=2):
 
 def main(argv=None):
     ap = argparse.ArgumentParser()
+    ap.add_argument("-f", "--data-dir", default=None,
+                    help="dir containing ml-1m/ratings.dat (else a "
+                         "synthetic latent-factor corpus)")
     ap.add_argument("-b", "--batch-size", type=int, default=256)
     ap.add_argument("-e", "--max-epoch", type=int, default=4)
     ap.add_argument("--learning-rate", type=float, default=1e-3)
@@ -96,9 +123,14 @@ def main(argv=None):
     from bigdl_tpu.nn import ClassNLLCriterion
     from bigdl_tpu.optim import Adam, Optimizer, Trigger
 
-    pos = synthetic_interactions(args.n_users, args.n_items)
-    x, y = training_pairs(pos, args.n_items)
-    model = build_ncf(args.n_users, args.n_items, class_num=2)
+    if args.data_dir:
+        pos, n_users, n_items = movielens_interactions(args.data_dir)
+        log.info("MovieLens: %d users, %d items", n_users, n_items)
+    else:
+        pos = synthetic_interactions(args.n_users, args.n_items)
+        n_users, n_items = args.n_users, args.n_items
+    x, y = training_pairs(pos, n_items)
+    model = build_ncf(n_users, n_items, class_num=2)
 
     opt = Optimizer(model=model, training_set=(x, y),
                     criterion=ClassNLLCriterion(),
@@ -107,7 +139,7 @@ def main(argv=None):
     opt.set_end_when(Trigger.max_epoch(args.max_epoch))
     model = opt.optimize()
 
-    hr, ndcg = eval_ranking(model, pos, args.n_items)
+    hr, ndcg = eval_ranking(model, pos, n_items)
     log.info("HitRatio@10: %.3f   NDCG@10: %.3f  (random ~ 0.10 / 0.045)",
              hr, ndcg)
     return hr, ndcg

@@ -1,0 +1,178 @@
+"""Fused 1x1-conv+BN specs (ops/conv_bn.py + nn/fused.py).
+
+The contract under test: the fused module is bit-compatible (within
+float tolerance) with the ``SpatialConvolution(1x1) ->
+SpatialBatchNormalization (-> ReLU)`` chain it replaces — forward,
+running-stat updates, gradients, eval mode, and the model-level
+``fuse_conv_bn`` rewrite of ResNet-50.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn import (
+    ReLU,
+    Sequential,
+    SpatialBatchNormalization,
+    SpatialConvolution,
+    SpatialConvolutionBatchNorm,
+    fuse_conv_bn,
+)
+from bigdl_tpu.nn.layers import MsraFiller
+from bigdl_tpu.ops.conv_bn import _reference, conv1x1_bn_stats
+
+
+def test_kernel_matches_reference():
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(3, 16, 8, 8).astype(np.float32))
+    w = jnp.asarray(rs.randn(32, 16).astype(np.float32) * 0.1)
+    shift = jnp.asarray(rs.randn(32).astype(np.float32) * 0.01)
+    y, s1, s2 = conv1x1_bn_stats(x, w, shift, interpret=True)
+    yr, s1r, s2r = _reference(x.reshape(3, 16, 64), w, shift)
+    np.testing.assert_allclose(np.asarray(y),
+                               np.asarray(yr).reshape(3, 32, 8, 8),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(s1), np.asarray(s1r),
+                               rtol=1e-4, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(s2), np.asarray(s2r),
+                               rtol=1e-4, atol=1e-3)
+
+
+def test_custom_vjp_matches_autodiff():
+    rs = np.random.RandomState(1)
+    x = jnp.asarray(rs.randn(2, 8, 4, 4).astype(np.float32))
+    w = jnp.asarray(rs.randn(16, 8).astype(np.float32) * 0.2)
+    shift = jnp.asarray(rs.randn(16).astype(np.float32) * 0.1)
+    coef = jnp.arange(16, dtype=jnp.float32)
+
+    def loss_k(x, w, shift):
+        y, s1, s2 = conv1x1_bn_stats(x, w, shift, interpret=True)
+        return 0.5 * jnp.sum(y ** 2) + jnp.sum(s1 * coef) + 0.1 * jnp.sum(s2)
+
+    def loss_r(x, w, shift):
+        y, s1, s2 = _reference(x.reshape(2, 8, 16), w, shift)
+        return 0.5 * jnp.sum(y ** 2) + jnp.sum(s1 * coef) + 0.1 * jnp.sum(s2)
+
+    gk = jax.grad(loss_k, argnums=(0, 1, 2))(x, w, shift)
+    gr = jax.grad(loss_r, argnums=(0, 1, 2))(x, w, shift)
+    for a, b in zip(gk, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-3)
+
+
+def _pair_and_fused(cin=16, cout=32, with_relu=True, stride=1):
+    conv = SpatialConvolution(cin, cout, 1, 1, stride, stride,
+                              with_bias=False,
+                              init_method=MsraFiller(False))
+    bn = SpatialBatchNormalization(cout)
+    pair = Sequential().add(conv).add(bn)
+    if with_relu:
+        pair.add(ReLU())
+    fused = SpatialConvolutionBatchNorm.from_pair(conv, bn, with_relu)
+    return pair, fused
+
+
+@pytest.mark.parametrize("stride", [1, 2])
+def test_module_parity_train_eval_state(stride):
+    pair, fused = _pair_and_fused(stride=stride)
+    x = jnp.asarray(
+        np.random.RandomState(0).randn(4, 16, 8, 8).astype(np.float32))
+    p1, s1 = pair.params(), pair.state()
+    o1, ns1 = pair.apply(p1, s1, x, training=True, rng=jax.random.key(0))
+    p2, s2 = fused.params(), fused.state()
+    o2, ns2 = fused.apply(p2, s2, x, training=True, rng=jax.random.key(0))
+    np.testing.assert_allclose(np.asarray(o1), np.asarray(o2),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(ns1["1"]["running_mean"]),
+                               np.asarray(ns2["running_mean"]),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(ns1["1"]["running_var"]),
+                               np.asarray(ns2["running_var"]),
+                               rtol=1e-4, atol=1e-5)
+    pair.evaluate()
+    fused.evaluate()
+    np.testing.assert_allclose(np.asarray(pair.forward(x)),
+                               np.asarray(fused.forward(x)),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_module_gradient_parity():
+    pair, fused = _pair_and_fused()
+    x = jnp.asarray(
+        np.random.RandomState(2).randn(4, 16, 8, 8).astype(np.float32))
+    p1, s1 = pair.params(), pair.state()
+    p2, s2 = fused.params(), fused.state()
+
+    def loss_pair(p):
+        out, _ = pair.apply(p, s1, x, training=True, rng=jax.random.key(0))
+        return jnp.sum(out ** 2)
+
+    def loss_fused(p):
+        out, _ = fused.apply(p, s2, x, training=True, rng=jax.random.key(0))
+        return jnp.sum(out ** 2)
+
+    g1 = jax.grad(loss_pair)(p1)
+    g2 = jax.grad(loss_fused)(p2)
+    np.testing.assert_allclose(np.asarray(g1["0"]["weight"])[:, :, 0, 0],
+                               np.asarray(g2["weight"]),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(g1["1"]["weight"]),
+                               np.asarray(g2["bn_weight"]),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(g1["1"]["bias"]),
+                               np.asarray(g2["bn_bias"]),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_fuse_resnet50_eval_parity_and_train():
+    from bigdl_tpu.models import build_resnet_imagenet
+    from bigdl_tpu.nn import CrossEntropyCriterion
+    from bigdl_tpu.optim import SGD, Trigger
+    from bigdl_tpu.optim.optimizer import LocalOptimizer
+
+    m = build_resnet_imagenet(depth=50, class_num=10)
+    x = jnp.asarray(
+        np.random.RandomState(0).rand(2, 3, 64, 64).astype(np.float32))
+    m.evaluate()
+    ref = np.asarray(m.forward(x))
+    fuse_conv_bn(m)
+
+    fused_count = [0]
+
+    def count(mod):
+        for c in getattr(mod, "modules", []):
+            count(c)
+            if isinstance(c, SpatialConvolutionBatchNorm):
+                fused_count[0] += 1
+
+    count(m)
+    # 16 bottleneck c1 + 16 c3 + 4 strided shortcuts
+    assert fused_count[0] == 36, fused_count[0]
+    m.evaluate()
+    np.testing.assert_allclose(ref, np.asarray(m.forward(x)),
+                               rtol=5e-4, atol=5e-4)
+
+    m.modules = m.modules[:-1]  # drop LogSoftMax for CE
+    y = (np.random.RandomState(1).randint(0, 10, 2) + 1).astype(np.float32)
+    opt = LocalOptimizer(m, (np.asarray(x), y), CrossEntropyCriterion(),
+                         batch_size=2)
+    opt.set_optim_method(SGD(learningrate=0.01))
+    opt.set_end_when(Trigger.max_iteration(2))
+    opt.optimize()
+    assert np.isfinite(float(opt.state["loss"]))
+
+
+def test_fused_serialization_roundtrip(tmp_path):
+    from bigdl_tpu.utils.serializer import load_module, save_module
+
+    m = SpatialConvolutionBatchNorm(8, 16, stride=2, with_relu=True)
+    m.evaluate()
+    x = jnp.asarray(
+        np.random.RandomState(0).randn(2, 8, 6, 6).astype(np.float32))
+    o1 = np.asarray(m.forward(x))
+    loaded = load_module(save_module(m, str(tmp_path / "fused")))
+    loaded.evaluate()
+    np.testing.assert_allclose(o1, np.asarray(loaded.forward(x)), rtol=1e-6)

@@ -280,6 +280,8 @@ def test_every_exported_layer_is_covered_or_known():
         "Index",
         # table-input [data, rois] layer (own spec in test_layers_extra)
         "RoiPooling",
+        # fused conv+BN (own parity + round-trip specs in test_fused)
+        "SpatialConvolutionBatchNorm",
     }
     missing = []
     for name in dir(N):

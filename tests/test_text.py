@@ -123,3 +123,33 @@ class TestNews20:
         assert n_classes == 4
         assert vocab > 10
         assert x.max() <= vocab
+
+
+def test_movielens_parse_and_synthetic():
+    """⟦«py»/dataset/movielens.py⟧ parity: ratings.dat '::' rows ->
+    (N, 3) 1-based int array; synthetic stand-in has the same shape."""
+    import os
+    import tempfile
+
+    from bigdl_tpu.dataset.movielens import (
+        get_id_ratings, synthetic_movielens,
+    )
+
+    with tempfile.TemporaryDirectory() as d:
+        os.makedirs(os.path.join(d, "ml-1m"))
+        with open(os.path.join(d, "ml-1m", "ratings.dat"), "w") as f:
+            f.write("1::31::4::978300019\n7::1193::5::978300760\n")
+        rows = get_id_ratings(d)
+    assert rows.shape == (2, 3)
+    assert rows[1].tolist() == [7, 1193, 5]
+
+    syn = synthetic_movielens(20, 40, per_user=10)
+    assert syn.shape == (200, 3)
+    assert syn[:, 0].min() >= 1 and syn[:, 2].max() <= 5
+    # global-quantile buckets: each rating level is populated
+    assert len(set(syn[:, 2].tolist())) == 5
+
+    import pytest
+
+    with pytest.raises(FileNotFoundError, match="grouplens"):
+        get_id_ratings("/nonexistent-dir/")
