@@ -379,6 +379,46 @@ def _bench_ptb(batch=64, num_steps=20, iters=20):
     return ips * num_steps  # tokens/sec
 
 
+def _bench_transformer(batch=16, seq=512, iters=10):
+    """Beyond-parity flagship: decoder-only TransformerLM (Pallas flash
+    attention) — tokens/sec/chip at a long-context operating point."""
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.models.transformer import build_transformer_lm
+
+    vocab, dim = 8192, 512
+    model = build_transformer_lm(vocab, dim=dim, n_head=8, n_layer=8,
+                                 max_len=seq)
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randint(1, vocab + 1, (batch, seq)).astype(np.float32))
+    y = rs.randint(0, vocab, (batch, seq))
+
+    params = model.params()
+    state = model.state()
+    rng = jax.random.key(0)
+    yhot = jnp.asarray(y)
+
+    def loss_fn(p, x):
+        ct = jnp.bfloat16
+        p = jax.tree.map(
+            lambda a: a.astype(ct)
+            if jnp.issubdtype(a.dtype, jnp.floating) else a, p)
+        logits, _ = model.apply(p, state, x, training=True, rng=rng)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        return -jnp.mean(
+            jnp.take_along_axis(logp, yhot[:, :, None], 2))
+
+    def step(p, x, _y):
+        loss, g = jax.value_and_grad(loss_fn)(p, x)
+        p = jax.tree.map(lambda w, gw: w - 1e-3 * gw, p, g)
+        return p, loss
+
+    ips, _ = _timed_scan_throughput(step, params, x, jnp.asarray(y), batch,
+                                    iters)
+    return ips * seq  # tokens/sec
+
+
 def _bench_lenet(platform_batch=256, iters=20):
     """Secondary config (BASELINE.md table): LeNet-5 / LocalOptimizer."""
     from bigdl_tpu.models.lenet import build_lenet5
@@ -499,6 +539,10 @@ def _run_child(platform: str):
         ptb_tps = _bench_ptb()
     except Exception:
         ptb_tps = None
+    try:
+        lm_tps = _bench_transformer() if platform != "cpu" else None
+    except Exception:
+        lm_tps = None
 
     result = {
         "metric": "resnet50_train_images_per_sec_per_chip",
@@ -522,6 +566,8 @@ def _run_child(platform: str):
                 round(lenet_ips, 1) if lenet_ips else None,
             "ptb_lstm_tokens_per_sec":
                 round(ptb_tps, 1) if ptb_tps else None,
+            "transformer_lm_tokens_per_sec":
+                round(lm_tps, 1) if lm_tps else None,
         },
         "error": None,
     }

@@ -914,6 +914,16 @@ class GELU(_Elementwise):
         return jax.nn.gelu(input)
 
 
+class SELU(_Elementwise):
+    """«bigdl»/nn/SELU.scala — scaled exponential linear unit (fixed
+    lambda/alpha from Klambauer et al.)."""
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        import jax
+
+        return jax.nn.selu(input)
+
+
 # --------------------------------------------------------------------------
 # Elementwise math layers
 # --------------------------------------------------------------------------
@@ -1831,6 +1841,7 @@ __all__ = [
     "ReLU", "ReLU6", "Tanh", "Sigmoid", "LogSoftMax", "SoftMax", "SoftMin",
     "SoftPlus", "SoftSign", "ELU", "LeakyReLU", "HardTanh", "HardSigmoid",
     "Clamp", "Threshold", "PReLU", "GELU",
+    "SELU",
     "Abs", "Square", "Sqrt", "Power", "Log", "Exp", "Negative",
     "AddConstant", "MulConstant",
     "CMul", "CAdd", "Add", "Mul", "Scale",

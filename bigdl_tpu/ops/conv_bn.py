@@ -164,7 +164,9 @@ def conv1x1_bn_stats(x, w, shift, *, stride: int = 1,
     if stride != 1:
         x = x[:, :, ::stride, ::stride]
     shift = shift.astype(jnp.float32)
-    # compiled Mosaic kernels exist only on TPU; CPU (tests, the
-    # 8-virtual-device mesh) runs the interpreter
-    interpret = interpret or jax.default_backend() == "cpu"
+    # compiled Mosaic kernels exist only on TPU; everything else
+    # (CPU tests, the 8-virtual-device mesh, a hypothetical GPU box —
+    # whose parallel grid would race the s1/s2 accumulation) runs the
+    # interpreter
+    interpret = interpret or jax.default_backend() != "tpu"
     return _conv1x1_bn_stats_vjp(x, w, shift, interpret)

@@ -9,12 +9,12 @@ NodeDef / AttrValue / TensorProto are read and written through the
 generic wire reader/writer in :mod:`bigdl_tpu.utils.caffe`.
 
 Supported ops cover the classic frozen-inference vocabulary: Const,
-Placeholder, Identity, MatMul, BiasAdd, Add/AddV2/Sub/Mul/Maximum,
-Conv2D, DepthwiseConv2dNative, Relu, Relu6, Elu, Tanh, Sigmoid,
-Softplus, MaxPool, AvgPool, Mean (global pool), Pad, Reshape, Squeeze,
-Softmax, ConcatV2, FusedBatchNorm(V2/V3).  Data-dependent control flow
-is out of scope — under XLA the static graph is the only graph
-(SURVEY.md nn/graph rationale).
+Placeholder, Identity, MatMul, BiasAdd, Add/AddV2/Sub/Mul/Maximum/
+Minimum/RealDiv/Pow, Conv2D, DepthwiseConv2dNative, Relu, Relu6, Elu,
+LeakyRelu, Selu, Tanh, Sigmoid, Softplus, Softsign, MaxPool, AvgPool,
+Mean (global pool) / Sum / Max / Min reductions, Pad, Reshape, Squeeze,
+Tile, Cast, Slice, Softmax, ConcatV2, FusedBatchNorm(V2/V3), plus the
+Switch/Merge/LoopCond control-flow family via DynamicGraph.
 """
 
 from __future__ import annotations
@@ -474,7 +474,8 @@ class TensorflowLoader:
                 mod.bias = jnp_set(b)
             return self._named(mod, nd)(self._build(ins[0]))
 
-        if op in ("Add", "AddV2", "Sub", "Mul", "Maximum", "RealDiv"):
+        if op in ("Add", "AddV2", "Sub", "Mul", "Maximum", "Minimum",
+                  "RealDiv"):
             # constant operand -> elementwise const op; else table op
             const_idx = None
             for i, inp in enumerate(ins):
@@ -507,8 +508,11 @@ class TensorflowLoader:
                         else:  # c / x = c * x^-1
                             mod = Sequential().add(L.Power(-1.0)) \
                                 .add(L.MulConstant(v))
-                    else:
+                    elif op == "Maximum":
                         mod = L.Threshold(v, v)
+                    else:  # Minimum: min(x, c) = -max(-x, -c)
+                        mod = Sequential().add(L.Negative()) \
+                            .add(L.Threshold(-v, -v)).add(L.Negative())
                     return self._named(mod, nd)(self._build(other))
                 # broadcast add/mul with a vector -> CAdd/CMul.  TF
                 # broadcasts trailing axes: on an NHWC tensor a (C,) const
@@ -534,7 +538,8 @@ class TensorflowLoader:
             table = {
                 "Add": T.CAddTable, "AddV2": T.CAddTable,
                 "Sub": T.CSubTable, "Mul": T.CMulTable,
-                "Maximum": T.CMaxTable, "RealDiv": T.CDivTable,
+                "Maximum": T.CMaxTable, "Minimum": T.CMinTable,
+                "RealDiv": T.CDivTable,
             }[op]()
             return self._named(table, nd)(*[self._build(i) for i in ins])
 
@@ -681,6 +686,91 @@ class TensorflowLoader:
             axis = self._map_axis(axis, image)
             mod = T.JoinTable(dimension=axis + 1, n_input_dims=-1)
             return self._named(mod, nd)(*[self._build(i) for i in data])
+
+        if op == "LeakyRelu":
+            alpha = nd.attr("alpha")
+            mod = L.LeakyReLU(alpha.fl if alpha else 0.2)
+            return self._named(mod, nd)(self._build(ins[0]))
+
+        if op in ("Selu", "Softsign"):
+            mod = L.SELU() if op == "Selu" else L.SoftSign()
+            return self._named(mod, nd)(self._build(ins[0]))
+
+        if op == "Pow":
+            e = self._const(ins[1])
+            if e.size != 1:
+                raise TFConversionException(
+                    "Pow with a non-scalar exponent unsupported")
+            mod = L.Power(float(e.reshape(-1)[0]))
+            return self._named(mod, nd)(self._build(ins[0]))
+
+        if op in ("Sum", "Max", "Min"):
+            image = self._is_image(ins[0])
+            axes = [self._map_axis(int(a), image)
+                    for a in self._const(ins[1]).reshape(-1).tolist()]
+            keep = nd.attr("keep_dims")
+            keep = bool(keep.b) if keep else False
+            if len(axes) != 1 or keep:
+                raise TFConversionException(
+                    f"{op} over axes {axes} (keep_dims={keep}) unsupported"
+                )
+            cls = {"Sum": L.Sum, "Max": L.Max, "Min": L.Min}[op]
+            mod = cls(axes[0] + 1)
+            return self._named(mod, nd)(self._build(ins[0]))
+
+        if op == "Tile":
+            image = self._is_image(ins[0])
+            mults = self._const(ins[1]).reshape(-1).astype(int).tolist()
+            if mults[0] != 1:
+                raise TFConversionException(
+                    "Tile on the batch axis unsupported")
+            from bigdl_tpu.nn.layers_extra import Tile
+            from bigdl_tpu.nn.module import Sequential
+
+            seq = Sequential()
+            for axis, m in enumerate(mults):
+                if axis == 0 or m == 1:
+                    continue
+                dim = self._map_axis(axis, image)
+                seq.add(Tile(dim + 1, m))
+            mod = seq if len(seq.modules) != 1 else seq.modules[0]
+            return self._named(mod, nd)(self._build(ins[0]))
+
+        if op == "Cast":
+            # float->float casts are identity in this f32 runtime (the
+            # compute-dtype policy governs precision); an integer target
+            # would truncate, which Identity silently would not
+            dst = nd.attr("DstT")
+            if dst is not None and dst.type not in (
+                    _DT_FLOAT, _DT_DOUBLE, _DT_HALF, _DT_BFLOAT16):
+                raise TFConversionException(
+                    f"Cast to dtype {dst.type} unsupported")
+            from bigdl_tpu.nn.module import Identity
+
+            return self._named(Identity(), nd)(self._build(ins[0]))
+
+        if op == "Slice":
+            begin = self._const(ins[1]).reshape(-1).astype(int).tolist()
+            size = self._const(ins[2]).reshape(-1).astype(int).tolist()
+            image = self._is_image(ins[0])
+            if begin[0] != 0 or size[0] != -1:
+                raise TFConversionException(
+                    "Slice on the batch axis unsupported")
+            from bigdl_tpu.nn.module import Sequential
+
+            seq = Sequential()
+            for axis in range(1, len(begin)):
+                if begin[axis] == 0 and size[axis] == -1:
+                    continue
+                dim = self._map_axis(axis, image)
+                seq.add(L.Narrow(dim + 1, begin[axis] + 1, size[axis]))
+            from bigdl_tpu.nn.module import Identity
+
+            mod = (
+                Identity() if not seq.modules
+                else seq if len(seq.modules) != 1 else seq.modules[0]
+            )
+            return self._named(mod, nd)(self._build(ins[0]))
 
         if op in ("FusedBatchNorm", "FusedBatchNormV2", "FusedBatchNormV3"):
             scale = self._const(ins[1])

@@ -150,7 +150,8 @@ def _layer_cases():
         (L.SoftPlus(), v), (L.SoftSign(), v), (L.ELU(), v),
         (L.LeakyReLU(0.2), v), (L.HardTanh(), v), (L.HardSigmoid(), v),
         (L.Clamp(-1, 1), v), (L.Threshold(0.1, 0.0), v), (L.PReLU(), v),
-        (L.GELU(), v), (L.Abs(), v), (L.Square(), pos), (L.Sqrt(), pos),
+        (L.GELU(), v), (L.SELU(), v), (L.Abs(), v), (L.Square(), pos),
+        (L.Sqrt(), pos),
         (N.Maxout(6, 4, 3), v), (N.SReLU((6,)), v),
         (L.Power(2.0, 1.5, 0.1), pos), (L.Log(), pos), (L.Exp(), v),
         (L.Negative(), v), (L.AddConstant(1.5), v), (L.MulConstant(2.0), v),

@@ -264,3 +264,87 @@ def test_const_add_vector_on_image():
     kernel = w[0, 0]
     expect = np.einsum("nihw,io->nohw", x, kernel) + c[None, :, None, None]
     np.testing.assert_allclose(out, expect, rtol=2e-3, atol=1e-5)
+
+
+def test_loader_extended_elementwise_ops():
+    """Round-3 op additions: LeakyRelu, Selu, Softsign, Pow, Minimum."""
+    rs = np.random.RandomState(5)
+    b = GraphDefBuilder()
+    b.placeholder("x")
+    b.const("e", np.asarray(2.0, np.float32))
+    b.op("lrelu", "LeakyRelu", ["x"])
+    b.op("selu", "Selu", ["lrelu"])
+    b.op("ssign", "Softsign", ["selu"])
+    b.op("pow", "Pow", ["ssign", "e"])
+    model = TensorflowLoader(data=b.tobytes()).load(
+        inputs=["x"], outputs=["pow"])
+    model.evaluate()
+    x = rs.randn(4, 6).astype(np.float32)
+    out = np.asarray(model.forward(x))
+
+    h = np.where(x >= 0, x, 0.2 * x)
+    lam, alpha = 1.0507009873554805, 1.6732632423543772
+    h = np.where(h > 0, lam * h, lam * alpha * (np.exp(h) - 1.0))
+    h = h / (1.0 + np.abs(h))
+    np.testing.assert_allclose(out, h ** 2, rtol=1e-4, atol=1e-5)
+
+
+def test_loader_minimum_sum_tile_cast_slice():
+    rs = np.random.RandomState(6)
+    b = GraphDefBuilder()
+    b.placeholder("x")
+    b.placeholder("y")
+    b.const("axis", np.asarray([1], np.int32))
+    b.const("mults", np.asarray([1, 3], np.int32))
+    b.const("begin", np.asarray([0, 2], np.int32))
+    b.const("size", np.asarray([-1, 4], np.int32))
+    b.op("mn", "Minimum", ["x", "y"])
+    b.op("s", "Sum", ["mn", "axis"])
+    model = TensorflowLoader(data=b.tobytes()).load(
+        inputs=["x", "y"], outputs=["s"])
+    model.evaluate()
+    xv = rs.randn(3, 5).astype(np.float32)
+    yv = rs.randn(3, 5).astype(np.float32)
+    out = np.asarray(model.forward([xv, yv]))
+    np.testing.assert_allclose(out, np.minimum(xv, yv).sum(axis=1),
+                               rtol=1e-5, atol=1e-6)
+
+    b2 = GraphDefBuilder()
+    b2.placeholder("x")
+    b2.const("mults", np.asarray([1, 3], np.int32))
+    b2.op("t", "Tile", ["x", "mults"])
+    b2.op("c", "Cast", ["t"])
+    b2.const("begin", np.asarray([0, 2], np.int32))
+    b2.const("size", np.asarray([-1, 4], np.int32))
+    b2.op("sl", "Slice", ["c", "begin", "size"])
+    model2 = TensorflowLoader(data=b2.tobytes()).load(
+        inputs=["x"], outputs=["sl"])
+    model2.evaluate()
+    xv2 = rs.randn(2, 5).astype(np.float32)
+    out2 = np.asarray(model2.forward(xv2))
+    expect = np.tile(xv2, (1, 3))[:, 2:6]
+    np.testing.assert_allclose(out2, expect, rtol=1e-6)
+
+
+def test_minimum_with_const_and_cast_to_int_rejected():
+    # min(x, 6) — the clip lowering — must convert via the const path
+    b = GraphDefBuilder()
+    b.placeholder("x")
+    b.const("six", np.asarray(6.0, np.float32))
+    b.op("clip", "Minimum", ["x", "six"])
+    model = TensorflowLoader(data=b.tobytes()).load(
+        inputs=["x"], outputs=["clip"])
+    model.evaluate()
+    xv = np.asarray([[-2.0, 5.0, 9.0]], np.float32)
+    np.testing.assert_allclose(
+        np.asarray(model.forward(xv)), [[-2.0, 5.0, 6.0]], rtol=1e-6)
+
+    # Cast to an integer dtype would silently drop truncation -> raise
+    from bigdl_tpu.utils.tf_interop import TFConversionException
+
+    b2 = GraphDefBuilder()
+    b2.placeholder("x")
+    b2.op("c", "Cast", ["x"], DstT=GraphDefBuilder.attr_type(3))  # int32
+    with pytest.raises(TFConversionException, match="Cast"):
+        TensorflowLoader(data=b2.tobytes()).load(
+            inputs=["x"], outputs=["c"])
