@@ -70,7 +70,8 @@ class QuantizedSpatialConvolution(_QuantizedBase):
     the conv runs via int8 ``lax.conv_general_dilated`` with an int32
     accumulator and a fused per-channel rescale."""
 
-    def __init__(self, weight, bias, stride, padding, n_group=1):
+    def __init__(self, weight, bias, stride, padding, n_group=1,
+                 dilation=(1, 1)):
         super().__init__()
         jnp = _jnp()
         w = jnp.asarray(weight)  # (out, in/group, kh, kw)
@@ -79,6 +80,7 @@ class QuantizedSpatialConvolution(_QuantizedBase):
         self.stride = tuple(stride)
         self.padding = padding
         self.n_group = n_group
+        self.dilation = tuple(dilation)
         self._config = dict()
 
     def update_output_pure(self, params, input, *, training=False, rng=None):
@@ -99,6 +101,7 @@ class QuantizedSpatialConvolution(_QuantizedBase):
             self.padding,
             dimension_numbers=("NCHW", "OIHW", "NCHW"),
             feature_group_count=self.n_group,
+            rhs_dilation=self.dilation,
             preferred_element_type=jnp.int32,
         )
         w_scale = params["weight_scale"].reshape(1, -1, 1, 1)
@@ -125,16 +128,19 @@ def _quantize_inplace(module: AbstractModule) -> AbstractModule:
         q = QuantizedLinear(module.weight, module.bias)
         q.set_name(module._name) if module._name else None
         return q
-    if type(module) is L.SpatialConvolution:
+    if type(module) in (L.SpatialConvolution, L.SpatialDilatedConvolution):
         from bigdl_tpu.nn.layers import _conv_pads
 
         pads = _conv_pads(
             module.pad_h, module.pad_w, module.kernel_h, module.kernel_w,
             1, 1,
         )
+        dilation = (getattr(module, "dilation_h", 1),
+                    getattr(module, "dilation_w", 1))
         q = QuantizedSpatialConvolution(
             module.weight, module.bias,
             (module.stride_h, module.stride_w), pads, module.n_group,
+            dilation,
         )
         q.set_name(module._name) if module._name else None
         return q

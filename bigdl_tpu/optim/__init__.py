@@ -41,6 +41,7 @@ from bigdl_tpu.optim.validation import (
 from bigdl_tpu.optim.optimizer import Optimizer, LocalOptimizer
 from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
 from bigdl_tpu.optim.metrics import Metrics
+from bigdl_tpu.optim.evaluator import Evaluator, Predictor
 
 __all__ = [
     "OptimMethod", "SGD", "Adam", "Adagrad", "Adadelta", "Adamax", "RMSprop",
@@ -52,4 +53,5 @@ __all__ = [
     "ValidationMethod", "ValidationResult", "Top1Accuracy", "Top5Accuracy",
     "Loss", "MAE", "TreeNNAccuracy", "HitRatio", "NDCG",
     "Optimizer", "LocalOptimizer", "DistriOptimizer", "Metrics",
+    "Evaluator", "Predictor",
 ]

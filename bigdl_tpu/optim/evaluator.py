@@ -16,9 +16,13 @@ output, so results are bit-identical to single-device evaluation.
 
 from __future__ import annotations
 
+import weakref
 from typing import Sequence
 
 import numpy as np
+
+# jitted eval-forward per module (see _forward_fn)
+_EVAL_FWD_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 
 
 def _mesh_usable(mesh):
@@ -36,24 +40,39 @@ def _mesh_usable(mesh):
 def _forward_fn(model, params=None, state=None, mesh=None):
     import jax
 
-    # cache the jitted forward on the module so repeated validation
-    # triggers reuse the compiled program (params/state are arguments, so
-    # weight updates don't invalidate it; only new input shapes retrace)
-    fwd = getattr(model, "_jit_eval_fwd", None)
+    # cache the jitted forward per module so repeated validation
+    # triggers reuse the compiled program (params/state are arguments,
+    # so weight updates don't invalidate it; only new input shapes
+    # retrace).  A WeakKeyDictionary rather than an on-module attribute:
+    # a deepcopy of the tree (e.g. module.quantize()) would carry an
+    # attribute over with its closure still pointing at the ORIGINAL
+    # module — stale results at best, and the copy would pin the float
+    # weights + compiled program alive.  The weak cache simply has no
+    # entry for the copy, and entries die with their module.
+    fwd = _EVAL_FWD_CACHE.get(model)
     if fwd is None:
+        # the closure must hold the model WEAKLY — a strong reference
+        # from the cache value back to its key would keep every entry
+        # (and its compiled program) immortal.  Callers reach fwd only
+        # through this cache or through the returned lambda below, and
+        # both hold the model strongly, so the deref cannot fail while
+        # fwd is reachable.
+        model_ref = weakref.ref(model)
+
         @jax.jit
         def fwd(p, s, inp):
-            out, _ = model.apply(p, s, inp, training=False, rng=None)
+            out, _ = model_ref().apply(p, s, inp, training=False, rng=None)
             return out
 
-        model._jit_eval_fwd = fwd
+        _EVAL_FWD_CACHE[model] = fwd
     if params is None:
         params = model.params()
     if state is None:
         state = model.state()
 
     if not _mesh_usable(mesh):
-        return lambda inp: fwd(params, state, inp), 1
+        # _m pins the model while the forward fn is in use
+        return lambda inp, _m=model: fwd(params, state, inp), 1
 
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -73,6 +92,7 @@ def _forward_fn(model, params=None, state=None, mesh=None):
             inp = jax.device_put(jnp.asarray(inp), data_sh)
         return fwd(params, state, inp)
 
+    sharded._pin = model  # keep the weakly-held model alive while in use
     return sharded, n
 
 
@@ -160,3 +180,38 @@ def predict_class(model, features, batch_size: int = 32, mesh=None):
     """Reference: predictClass — argmax + 1 (1-based labels)."""
     out = predict(model, features, batch_size, mesh=mesh)
     return np.argmax(out.reshape(out.shape[0], -1), axis=-1) + 1
+
+
+class Evaluator:
+    """Reference API parity: ``Evaluator(model).test(dataset, methods)``
+    (⟦«bigdl»/optim/Evaluator.scala⟧) over the same mesh-sharded path
+    as :func:`evaluate_dataset`."""
+
+    def __init__(self, model):
+        self.model = model
+
+    def test(self, dataset, methods: Sequence, batch_size: int = 32,
+             mesh=None):
+        from bigdl_tpu.dataset import to_dataset
+
+        return evaluate_dataset(
+            self.model, to_dataset(dataset, batch_size), methods, mesh=mesh
+        )
+
+
+class Predictor:
+    """Reference API parity: ``Predictor(model).predict(features)``
+    (⟦«bigdl»/optim/Predictor.scala⟧); ``predict_class`` returns 1-based
+    labels like the reference's predictClass."""
+
+    def __init__(self, model, batch_size: int = 32, mesh=None):
+        self.model = model
+        self.batch_size = batch_size
+        self.mesh = mesh
+
+    def predict(self, features):
+        return predict(self.model, features, self.batch_size, self.mesh)
+
+    def predict_class(self, features):
+        return predict_class(self.model, features, self.batch_size,
+                             self.mesh)

@@ -263,9 +263,10 @@ class TensorflowLoader:
     _IMG_PROPAGATORS = ("Identity", "StopGradient", "CheckNumerics",
                         "Relu", "Relu6", "Elu", "Tanh", "Sigmoid",
                         "Softplus", "BiasAdd", "Add", "AddV2", "Sub",
-                        "Mul", "Maximum", "RealDiv", "Pad", "ConcatV2",
-                        "Concat", "Abs", "Neg", "Sqrt", "Square", "Exp",
-                        "Log")
+                        "Mul", "Maximum", "Minimum", "RealDiv", "Pad",
+                        "ConcatV2", "Concat", "Abs", "Neg", "Sqrt",
+                        "Square", "Exp", "Log", "LeakyRelu", "Selu",
+                        "Softsign", "Pow", "Cast", "Tile", "Slice")
 
     def _is_image(self, name: str) -> bool:
         """True when ``name`` carries an NHWC conv-path tensor whose axes

@@ -172,3 +172,24 @@ def test_lbfgs_tree_params():
         params, state = opt.step(grad, params, state)
     np.testing.assert_allclose(np.asarray(params["a"]), [1.0, 1.0], atol=1e-2)
     np.testing.assert_allclose(float(params["b"]), -2.0, atol=1e-2)
+
+
+def test_evaluator_predictor_classes():
+    """Reference API parity: Evaluator(model).test / Predictor(model)
+    (⟦«bigdl»/optim/Evaluator.scala⟧, Predictor.scala)."""
+    import numpy as np
+
+    from bigdl_tpu.nn import Linear, LogSoftMax, Sequential
+    from bigdl_tpu.optim import Evaluator, Predictor, Top1Accuracy
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(40, 6).astype(np.float32)
+    y = (rs.randint(0, 3, 40) + 1).astype(np.float32)
+    m = Sequential().add(Linear(6, 3)).add(LogSoftMax())
+    (acc,) = Evaluator(m).test((x, y), [Top1Accuracy()])
+    value, count = acc.result()
+    assert count == 40
+    cls = np.asarray(Predictor(m).predict_class(x))
+    assert value == np.mean(cls == y)
+    probs = np.asarray(Predictor(m).predict(x))
+    assert probs.shape == (40, 3)

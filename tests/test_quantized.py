@@ -77,3 +77,47 @@ def test_quantize_graph_container():
     qg = g.quantize()
     out = np.asarray(qg.forward(x))
     assert _rel_err(out, ref) < 0.03
+
+
+def test_quantize_dilated_convolution():
+    """⟦«bigdl»/nn/quantized⟧ also covers SpatialDilatedConvolution."""
+    import numpy as np
+
+    from bigdl_tpu.nn import Sequential, SpatialDilatedConvolution
+    from bigdl_tpu.nn.quantized import (
+        QuantizedSpatialConvolution, quantize,
+    )
+
+    import jax.numpy as jnp
+
+    m = Sequential().add(
+        SpatialDilatedConvolution(3, 6, 3, 3, 1, 1, 2, 2, 2, 2))
+    x = jnp.asarray(
+        np.random.RandomState(0).randn(2, 3, 10, 10).astype(np.float32))
+    m.evaluate()
+    ref = np.asarray(m.forward(x))
+    q = quantize(m)
+    assert isinstance(q.modules[0], QuantizedSpatialConvolution)
+    out = np.asarray(q.forward(x))
+    assert out.shape == ref.shape
+    # int8 tolerance: couple percent of the dynamic range
+    err = np.abs(out - ref).max() / max(1e-6, np.abs(ref).max())
+    assert err < 0.05, err
+
+
+def test_quantize_after_jitted_predict_rebuilds_forward():
+    """Regression: module.quantize() deep-copies the tree including the
+    cached jitted eval forward; the copy must not reuse the float
+    model's closure (it would KeyError on the quantized params)."""
+    import numpy as np
+
+    from bigdl_tpu.nn import Linear, LogSoftMax, Sequential
+    from bigdl_tpu.optim import Predictor
+
+    rs = np.random.RandomState(0)
+    m = Sequential().add(Linear(6, 3)).add(LogSoftMax())
+    x = rs.randn(8, 6).astype(np.float32)
+    ref = np.asarray(Predictor(m).predict_class(x))  # caches jitted fwd
+    q = m.quantize()
+    out = np.asarray(Predictor(q).predict_class(x))  # must rebuild
+    assert (ref == out).mean() >= 0.8

@@ -348,3 +348,33 @@ def test_minimum_with_const_and_cast_to_int_rejected():
     with pytest.raises(TFConversionException, match="Cast"):
         TensorflowLoader(data=b2.tobytes()).load(
             inputs=["x"], outputs=["c"])
+
+
+def test_image_layout_propagates_through_new_ops():
+    """Conv2D -> LeakyRelu -> Minimum(const) -> Mean([1,2]) must keep
+    NHWC tracking through the new elementwise ops: the Mean becomes a
+    global average pool over the remapped NCHW spatial axes."""
+    rs = np.random.RandomState(9)
+    b = GraphDefBuilder()
+    b.placeholder("img")
+    w = rs.randn(1, 1, 3, 5).astype(np.float32)  # HWIO 1x1
+    b.const("w", w)
+    b.const("six", np.asarray(6.0, np.float32))
+    b.const("axes", np.asarray([1, 2], np.int32))
+    b.op("conv", "Conv2D", ["img", "w"],
+         strides=GraphDefBuilder.attr_ints([1, 1, 1, 1]),
+         padding=GraphDefBuilder.attr_s("SAME"))
+    b.op("act", "LeakyRelu", ["conv"])
+    b.op("clip", "Minimum", ["act", "six"])
+    b.op("gap", "Mean", ["clip", "axes"])
+    model = TensorflowLoader(data=b.tobytes()).load(
+        inputs=["img"], outputs=["gap"])
+    model.evaluate()
+    x = rs.rand(2, 3, 4, 4).astype(np.float32)  # NCHW framework input
+    out = np.asarray(model.forward(x))
+    # numpy reference in NCHW: 1x1 conv = channel matmul
+    y = np.einsum("nchw,co->nohw", x, w[0, 0])
+    y = np.where(y >= 0, y, 0.2 * y)
+    y = np.minimum(y, 6.0)
+    expect = y.mean(axis=(2, 3))
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
