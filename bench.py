@@ -419,6 +419,29 @@ def _bench_transformer(batch=16, seq=512, iters=10):
     return ips * seq  # tokens/sec
 
 
+def _bench_dlframes(n_rows=4096, n_feat=64, epochs=2):
+    """Parity config 5 (BASELINE.md): DLEstimator fit + DLModel
+    transform over a dict DataFrame — rows/sec end-to-end wall time."""
+    from bigdl_tpu.dlframes import DLClassifier
+    from bigdl_tpu.nn import ClassNLLCriterion, Linear, LogSoftMax, ReLU, Sequential
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(n_rows, n_feat).astype(np.float32)
+    w = rs.randn(n_feat, 4)
+    y = (np.argmax(x @ w, axis=1) + 1).astype(np.float32)
+    df = {"features": [row for row in x], "label": list(y)}
+    model = Sequential().add(Linear(n_feat, 32)).add(ReLU()) \
+        .add(Linear(32, 4)).add(LogSoftMax())
+    est = DLClassifier(model, ClassNLLCriterion(), [n_feat]) \
+        .set_batch_size(256).set_max_epoch(epochs)
+    t0 = time.perf_counter()
+    fitted = est.fit(df)
+    out = fitted.transform(df)
+    dt = time.perf_counter() - t0
+    assert len(out["prediction"]) == n_rows
+    return n_rows * (epochs + 1) / dt  # rows/sec through fit+transform
+
+
 def _bench_lenet(platform_batch=256, iters=20):
     """Secondary config (BASELINE.md table): LeNet-5 / LocalOptimizer."""
     from bigdl_tpu.models.lenet import build_lenet5
@@ -543,6 +566,10 @@ def _run_child(platform: str):
         lm_tps = _bench_transformer() if platform != "cpu" else None
     except Exception:
         lm_tps = None
+    try:
+        dlf_rps = _bench_dlframes()
+    except Exception:
+        dlf_rps = None
 
     result = {
         "metric": "resnet50_train_images_per_sec_per_chip",
@@ -568,6 +595,8 @@ def _run_child(platform: str):
                 round(ptb_tps, 1) if ptb_tps else None,
             "transformer_lm_tokens_per_sec":
                 round(lm_tps, 1) if lm_tps else None,
+            "dlframes_fit_transform_rows_per_sec":
+                round(dlf_rps, 1) if dlf_rps else None,
         },
         "error": None,
     }

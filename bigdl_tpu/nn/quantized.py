@@ -131,10 +131,17 @@ def _quantize_inplace(module: AbstractModule) -> AbstractModule:
     if type(module) in (L.SpatialConvolution, L.SpatialDilatedConvolution):
         from bigdl_tpu.nn.layers import _conv_pads
 
-        pads = _conv_pads(
-            module.pad_h, module.pad_w, module.kernel_h, module.kernel_w,
-            1, 1,
-        )
+        if type(module) is L.SpatialDilatedConvolution:
+            # mirror the float layer exactly: SpatialDilatedConvolution
+            # passes its pads literally (no -1/SAME mapping), so the
+            # quantized twin must too or the output geometry changes
+            pads = [(module.pad_h, module.pad_h),
+                    (module.pad_w, module.pad_w)]
+        else:
+            pads = _conv_pads(
+                module.pad_h, module.pad_w, module.kernel_h,
+                module.kernel_w, 1, 1,
+            )
         dilation = (getattr(module, "dilation_h", 1),
                     getattr(module, "dilation_w", 1))
         q = QuantizedSpatialConvolution(

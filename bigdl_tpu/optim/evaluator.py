@@ -182,10 +182,21 @@ def predict_class(model, features, batch_size: int = 32, mesh=None):
     return np.argmax(out.reshape(out.shape[0], -1), axis=-1) + 1
 
 
+def _default_mesh(mesh):
+    """mesh=None -> the Engine mesh when initialized (exactly what the
+    module-level evaluate/predict do, nn/module.py)."""
+    if mesh is not None:
+        return mesh
+    from bigdl_tpu.engine import Engine
+
+    return Engine.mesh() if Engine.is_initialized() else None
+
+
 class Evaluator:
     """Reference API parity: ``Evaluator(model).test(dataset, methods)``
     (⟦«bigdl»/optim/Evaluator.scala⟧) over the same mesh-sharded path
-    as :func:`evaluate_dataset`."""
+    as :func:`evaluate_dataset` — the Engine mesh is picked up
+    automatically when initialized."""
 
     def __init__(self, model):
         self.model = model
@@ -195,14 +206,16 @@ class Evaluator:
         from bigdl_tpu.dataset import to_dataset
 
         return evaluate_dataset(
-            self.model, to_dataset(dataset, batch_size), methods, mesh=mesh
+            self.model, to_dataset(dataset, batch_size), methods,
+            mesh=_default_mesh(mesh),
         )
 
 
 class Predictor:
     """Reference API parity: ``Predictor(model).predict(features)``
     (⟦«bigdl»/optim/Predictor.scala⟧); ``predict_class`` returns 1-based
-    labels like the reference's predictClass."""
+    labels like the reference's predictClass.  The Engine mesh is picked
+    up automatically when initialized."""
 
     def __init__(self, model, batch_size: int = 32, mesh=None):
         self.model = model
@@ -210,8 +223,9 @@ class Predictor:
         self.mesh = mesh
 
     def predict(self, features):
-        return predict(self.model, features, self.batch_size, self.mesh)
+        return predict(self.model, features, self.batch_size,
+                       _default_mesh(self.mesh))
 
     def predict_class(self, features):
         return predict_class(self.model, features, self.batch_size,
-                             self.mesh)
+                             _default_mesh(self.mesh))

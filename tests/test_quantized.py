@@ -121,3 +121,20 @@ def test_quantize_after_jitted_predict_rebuilds_forward():
     q = m.quantize()
     out = np.asarray(Predictor(q).predict_class(x))  # must rebuild
     assert (ref == out).mean() >= 0.8
+
+
+def test_quantize_dilated_pad_geometry_matches_float():
+    """quantize() must mirror the float SpatialDilatedConvolution's
+    literal-pads behavior (incl. the pad=-1 spelling) so the quantized
+    twin keeps the same output geometry."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bigdl_tpu.nn import SpatialDilatedConvolution
+    from bigdl_tpu.nn.quantized import quantize
+
+    m = SpatialDilatedConvolution(3, 4, 3, 3, 1, 1, -1, -1, 2, 2)
+    x = jnp.asarray(
+        np.random.RandomState(0).randn(1, 3, 12, 12).astype(np.float32))
+    m.evaluate()
+    assert quantize(m).forward(x).shape == m.forward(x).shape
