@@ -22,12 +22,20 @@ _LOSSES = {
     "mean_squared_error": "_mse",
     "mae": "_mae",
     "binary_crossentropy": "_bce",
+    "poisson": "_poisson",
+    "cosine_proximity": "_cosine",
+    "mape": "_mape",
+    "mean_absolute_percentage_error": "_mape",
+    "msle": "_msle",
+    "mean_squared_logarithmic_error": "_msle",
 }
 
 
 def _resolve_loss(loss):
     from bigdl_tpu.nn import (
-        AbsCriterion, BCECriterion, CrossEntropyCriterion, MSECriterion,
+        AbsCriterion, BCECriterion, CosineProximityCriterion,
+        CrossEntropyCriterion, MeanAbsolutePercentageCriterion,
+        MeanSquaredLogarithmicCriterion, MSECriterion, PoissonCriterion,
     )
 
     if not isinstance(loss, str):
@@ -39,6 +47,14 @@ def _resolve_loss(loss):
         return MSECriterion()
     if kind == "_mae":
         return AbsCriterion()
+    if kind == "_poisson":
+        return PoissonCriterion()
+    if kind == "_cosine":
+        return CosineProximityCriterion()
+    if kind == "_mape":
+        return MeanAbsolutePercentageCriterion()
+    if kind == "_msle":
+        return MeanSquaredLogarithmicCriterion()
     return BCECriterion()
 
 

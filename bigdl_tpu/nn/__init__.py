@@ -114,6 +114,10 @@ from bigdl_tpu.nn.criterion import (
     GaussianCriterion,
     KLDCriterion,
     L1HingeEmbeddingCriterion,
+    PoissonCriterion,
+    CosineProximityCriterion,
+    MeanAbsolutePercentageCriterion,
+    MeanSquaredLogarithmicCriterion,
 )
 from bigdl_tpu.nn.volumetric import *  # noqa: F401,F403
 from bigdl_tpu.nn.volumetric import __all__ as _volumetric_all
@@ -146,6 +150,9 @@ __all__ = (
         "CosineDistanceCriterion", "DiceCoefficientCriterion",
         "SoftMarginCriterion", "MultiLabelMarginCriterion",
         "GaussianCriterion", "KLDCriterion", "L1HingeEmbeddingCriterion",
+        "PoissonCriterion", "CosineProximityCriterion",
+        "MeanAbsolutePercentageCriterion",
+        "MeanSquaredLogarithmicCriterion",
         "Recurrent", "RnnCell", "LSTM", "LSTMPeephole", "GRU", "BiRecurrent",
         "TimeDistributed", "Select", "MultiRNNCell", "ConvLSTMPeephole",
         "LayerNorm", "MultiHeadAttention", "TransformerBlock",

@@ -580,3 +580,54 @@ class L1HingeEmbeddingCriterion(AbstractCriterion):
         t = target.reshape(d.shape)
         v = jnp.where(t > 0, d, jnp.maximum(0.0, self.margin - d))
         return jnp.mean(v)
+
+
+class PoissonCriterion(AbstractCriterion):
+    """⟦«bigdl»/nn/PoissonCriterion.scala⟧ (keras-support era) — mean of
+    pred - target * log(pred)."""
+
+    def loss(self, input, target):
+        jnp = _jnp()
+        t = target.reshape(input.shape)
+        return jnp.mean(input - t * jnp.log(jnp.maximum(input, 1e-7)))
+
+
+class CosineProximityCriterion(AbstractCriterion):
+    """⟦«bigdl»/nn/CosineProximityCriterion.scala⟧ — negative mean
+    cosine similarity between L2-normalized prediction and target."""
+
+    def loss(self, input, target):
+        jnp = _jnp()
+        t = target.reshape(input.shape)
+        # rsqrt(sum + eps) rather than maximum(norm, eps): the gradient
+        # of linalg.norm at an all-zero row is NaN, and max() does not
+        # mask the NaN cotangent (0 * NaN = NaN)
+        import jax.lax as lax
+
+        xn = input * lax.rsqrt(
+            jnp.sum(input * input, axis=-1, keepdims=True) + 1e-12)
+        tn = t * lax.rsqrt(jnp.sum(t * t, axis=-1, keepdims=True) + 1e-12)
+        return -jnp.mean(jnp.sum(xn * tn, axis=-1))
+
+
+class MeanAbsolutePercentageCriterion(AbstractCriterion):
+    """⟦«bigdl»/nn/MeanAbsolutePercentageCriterion.scala⟧ — 100 * mean
+    |t - p| / clip(|t|)."""
+
+    def loss(self, input, target):
+        jnp = _jnp()
+        t = target.reshape(input.shape)
+        diff = jnp.abs(t - input) / jnp.clip(jnp.abs(t), 1e-7, None)
+        return 100.0 * jnp.mean(diff)
+
+
+class MeanSquaredLogarithmicCriterion(AbstractCriterion):
+    """⟦«bigdl»/nn/MeanSquaredLogarithmicCriterion.scala⟧ — mean of
+    (log(t+1) - log(p+1))^2 with inputs clipped to >= 0."""
+
+    def loss(self, input, target):
+        jnp = _jnp()
+        t = target.reshape(input.shape)
+        lp = jnp.log(jnp.clip(input, 1e-7, None) + 1.0)
+        lt = jnp.log(jnp.clip(t, 1e-7, None) + 1.0)
+        return jnp.mean((lt - lp) ** 2)

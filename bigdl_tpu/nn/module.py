@@ -245,29 +245,26 @@ class AbstractModule:
                 "e.g. [Top1Accuracy()]"
             )
         from bigdl_tpu.dataset import to_dataset
-        from bigdl_tpu.engine import Engine
-        from bigdl_tpu.optim.evaluator import evaluate_dataset
+        from bigdl_tpu.optim.evaluator import _default_mesh, evaluate_dataset
 
-        mesh = Engine.mesh() if Engine.is_initialized() else None
         return evaluate_dataset(
-            self, to_dataset(dataset, batch_size), methods, mesh=mesh
+            self, to_dataset(dataset, batch_size), methods,
+            mesh=_default_mesh(None),
         )
 
     def predict(self, features, batch_size: int = 32):
         """Reference: model.predict — batched forward, host outputs."""
-        from bigdl_tpu.engine import Engine
+        from bigdl_tpu.optim.evaluator import _default_mesh
         from bigdl_tpu.optim.evaluator import predict as _predict
 
-        mesh = Engine.mesh() if Engine.is_initialized() else None
-        return _predict(self, features, batch_size, mesh=mesh)
+        return _predict(self, features, batch_size, mesh=_default_mesh(None))
 
     def predict_class(self, features, batch_size: int = 32):
         """Reference: model.predictClass — argmax + 1 (1-based)."""
-        from bigdl_tpu.engine import Engine
+        from bigdl_tpu.optim.evaluator import _default_mesh
         from bigdl_tpu.optim.evaluator import predict_class as _pc
 
-        mesh = Engine.mesh() if Engine.is_initialized() else None
-        return _pc(self, features, batch_size, mesh=mesh)
+        return _pc(self, features, batch_size, mesh=_default_mesh(None))
 
     predictClass = predict_class
 

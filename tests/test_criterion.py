@@ -130,3 +130,48 @@ def test_time_distributed_criterion():
     c2 = TimeDistributedCriterion(inner, size_average=False)
     np.testing.assert_allclose(float(c2.forward(logp, target)), 3 * np.log(2),
                                rtol=1e-6)
+
+
+def test_poisson_criterion():
+    from bigdl_tpu.nn import PoissonCriterion
+
+    p = jnp.asarray([[1.0, 2.0], [0.5, 3.0]])
+    t = jnp.asarray([[1.0, 1.0], [2.0, 2.0]])
+    got = float(PoissonCriterion().loss(p, t))
+    expect = float(np.mean(np.asarray(p) - np.asarray(t) * np.log(np.asarray(p))))
+    np.testing.assert_allclose(got, expect, rtol=1e-6)
+
+
+def test_cosine_proximity_criterion():
+    from bigdl_tpu.nn import CosineProximityCriterion
+
+    p = jnp.asarray([[1.0, 0.0], [0.0, 2.0]])
+    t = jnp.asarray([[1.0, 0.0], [0.0, -1.0]])
+    got = float(CosineProximityCriterion().loss(p, t))
+    # rows: cos=1 and cos=-1 -> -mean = 0
+    np.testing.assert_allclose(got, 0.0, atol=1e-6)
+    # gradient exists and is finite — including for an all-zero row
+    # (ReLU tails emit those; linalg.norm's grad at 0 is NaN and a
+    # maximum() clamp would not mask it)
+    import jax
+
+    g = jax.grad(lambda x: CosineProximityCriterion().loss(x, t))(p)
+    assert np.isfinite(np.asarray(g)).all()
+    pz = jnp.asarray([[0.0, 0.0], [1.0, 2.0]])
+    gz = jax.grad(lambda x: CosineProximityCriterion().loss(x, t))(pz)
+    assert np.isfinite(np.asarray(gz)).all()
+
+
+def test_mape_and_msle_criterions():
+    from bigdl_tpu.nn import (
+        MeanAbsolutePercentageCriterion, MeanSquaredLogarithmicCriterion,
+    )
+
+    p = jnp.asarray([[90.0], [110.0]])
+    t = jnp.asarray([[100.0], [100.0]])
+    mape = float(MeanAbsolutePercentageCriterion().loss(p, t))
+    np.testing.assert_allclose(mape, 10.0, rtol=1e-5)
+    msle = float(MeanSquaredLogarithmicCriterion().loss(p, t))
+    expect = np.mean(
+        (np.log(101.0) - np.log(np.asarray([91.0, 111.0]))) ** 2)
+    np.testing.assert_allclose(msle, expect, rtol=1e-5)
