@@ -245,26 +245,23 @@ class AbstractModule:
                 "e.g. [Top1Accuracy()]"
             )
         from bigdl_tpu.dataset import to_dataset
-        from bigdl_tpu.optim.evaluator import _default_mesh, evaluate_dataset
+        from bigdl_tpu.optim.evaluator import evaluate_dataset
 
         return evaluate_dataset(
-            self, to_dataset(dataset, batch_size), methods,
-            mesh=_default_mesh(None),
+            self, to_dataset(dataset, batch_size), methods
         )
 
     def predict(self, features, batch_size: int = 32):
         """Reference: model.predict — batched forward, host outputs."""
-        from bigdl_tpu.optim.evaluator import _default_mesh
         from bigdl_tpu.optim.evaluator import predict as _predict
 
-        return _predict(self, features, batch_size, mesh=_default_mesh(None))
+        return _predict(self, features, batch_size)
 
     def predict_class(self, features, batch_size: int = 32):
         """Reference: model.predictClass — argmax + 1 (1-based)."""
-        from bigdl_tpu.optim.evaluator import _default_mesh
         from bigdl_tpu.optim.evaluator import predict_class as _pc
 
-        return _pc(self, features, batch_size, mesh=_default_mesh(None))
+        return _pc(self, features, batch_size)
 
     predictClass = predict_class
 

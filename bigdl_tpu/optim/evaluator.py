@@ -107,7 +107,7 @@ def _pad_batch(arr, divisor):
     return arr, b
 
 
-def evaluate_dataset(model, dataset, methods: Sequence, mesh=None,
+def evaluate_dataset(model, dataset, methods: Sequence, mesh="auto",
                      params=None, state=None):
     """Fold validation methods over a dataset (reference:
     model.evaluate(rdd, Array(new Top1Accuracy))).
@@ -117,6 +117,7 @@ def evaluate_dataset(model, dataset, methods: Sequence, mesh=None,
     trainer can validate without a host weight copy."""
     import jax.numpy as jnp
 
+    mesh = _resolve_mesh(mesh)
     model.evaluate()
     fwd, divisor = _forward_fn(model, params=params, state=state, mesh=mesh)
     results = [None] * len(methods)
@@ -159,14 +160,14 @@ def _allreduce_results(results, dataset):
     return out
 
 
-def predict(model, features, batch_size: int = 32, mesh=None):
+def predict(model, features, batch_size: int = 32, mesh="auto"):
     """Batched forward over an array of inputs; returns stacked host
     outputs (reference: model.predict).  With ``mesh``, each batch
     shards ``P(data)`` over the devices."""
     import jax.numpy as jnp
 
     model.evaluate()
-    fwd, divisor = _forward_fn(model, mesh=mesh)
+    fwd, divisor = _forward_fn(model, mesh=_resolve_mesh(mesh))
     feats = np.asarray(features)
     outs = []
     n = feats.shape[0]
@@ -176,16 +177,17 @@ def predict(model, features, batch_size: int = 32, mesh=None):
     return np.concatenate(outs, axis=0)
 
 
-def predict_class(model, features, batch_size: int = 32, mesh=None):
+def predict_class(model, features, batch_size: int = 32, mesh="auto"):
     """Reference: predictClass — argmax + 1 (1-based labels)."""
     out = predict(model, features, batch_size, mesh=mesh)
     return np.argmax(out.reshape(out.shape[0], -1), axis=-1) + 1
 
 
-def _default_mesh(mesh):
-    """mesh=None -> the Engine mesh when initialized (exactly what the
-    module-level evaluate/predict do, nn/module.py)."""
-    if mesh is not None:
+def _resolve_mesh(mesh):
+    """``"auto"`` -> the Engine mesh when initialized, else no mesh.
+    Explicit ``None`` always means single-device (internal callers that
+    manage their own mesh pass it outright)."""
+    if mesh != "auto":
         return mesh
     from bigdl_tpu.engine import Engine
 
@@ -202,12 +204,11 @@ class Evaluator:
         self.model = model
 
     def test(self, dataset, methods: Sequence, batch_size: int = 32,
-             mesh=None):
+             mesh="auto"):
         from bigdl_tpu.dataset import to_dataset
 
         return evaluate_dataset(
-            self.model, to_dataset(dataset, batch_size), methods,
-            mesh=_default_mesh(mesh),
+            self.model, to_dataset(dataset, batch_size), methods, mesh=mesh
         )
 
 
@@ -217,15 +218,14 @@ class Predictor:
     labels like the reference's predictClass.  The Engine mesh is picked
     up automatically when initialized."""
 
-    def __init__(self, model, batch_size: int = 32, mesh=None):
+    def __init__(self, model, batch_size: int = 32, mesh="auto"):
         self.model = model
         self.batch_size = batch_size
         self.mesh = mesh
 
     def predict(self, features):
-        return predict(self.model, features, self.batch_size,
-                       _default_mesh(self.mesh))
+        return predict(self.model, features, self.batch_size, self.mesh)
 
     def predict_class(self, features):
         return predict_class(self.model, features, self.batch_size,
-                             _default_mesh(self.mesh))
+                             self.mesh)
