@@ -181,11 +181,13 @@ def _baseline_forward(params, x):
         )
 
     def bn(p, x):
-        # training-mode BN with batch statistics in f32, matching the
-        # framework's SpatialBatchNormalization normalization math under
-        # both precisions (the framework additionally updates running-
-        # stat EMAs — that small extra cost stays attributed to the
-        # framework side of the ratio)
+        # training-mode BN as a user would naturally write it: two-pass
+        # f32 batch statistics + f32 normalize.  The framework's
+        # SpatialBatchNormalization deliberately diverges (shifted
+        # single-pass stats, compute-dtype normalize — BASELINE.md r03b),
+        # which is exactly the advantage vs_baseline measures; the
+        # framework also pays for running-stat EMA updates the baseline
+        # skips.
         xf = x.astype(jnp.float32)
         mean = jnp.mean(xf, axis=(0, 2, 3))
         var = jnp.var(xf, axis=(0, 2, 3))
