@@ -593,8 +593,11 @@ class PoissonCriterion(AbstractCriterion):
 
 
 class CosineProximityCriterion(AbstractCriterion):
-    """⟦«bigdl»/nn/CosineProximityCriterion.scala⟧ — negative mean
-    cosine similarity between L2-normalized prediction and target."""
+    """⟦«bigdl»/nn/CosineProximityCriterion.scala⟧ — negative mean of
+    the L2-normalized elementwise product, averaged over ALL elements
+    (Keras cosine_proximity semantics: ``-mean(l2norm(y) * l2norm(t))``,
+    a factor of last-dim D smaller than a per-row cosine mean — ADVICE
+    r3 #1)."""
 
     def loss(self, input, target):
         jnp = _jnp()
@@ -607,7 +610,7 @@ class CosineProximityCriterion(AbstractCriterion):
         xn = input * lax.rsqrt(
             jnp.sum(input * input, axis=-1, keepdims=True) + 1e-12)
         tn = t * lax.rsqrt(jnp.sum(t * t, axis=-1, keepdims=True) + 1e-12)
-        return -jnp.mean(jnp.sum(xn * tn, axis=-1))
+        return -jnp.mean(xn * tn)
 
 
 class MeanAbsolutePercentageCriterion(AbstractCriterion):

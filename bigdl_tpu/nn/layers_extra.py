@@ -1010,10 +1010,22 @@ class SReLU(AbstractModule):
         self.reset()
 
     def reset(self):
+        # Keras-1.2.2 SReLU defaults (ADVICE r3 #4): t_left zero,
+        # a_left/t_right glorot_uniform over the param shape, a_right
+        # one.  Fans follow Keras get_fans: 2-D -> (s0, s1), anything
+        # else -> fan_in = fan_out = sqrt(prod(shape))
+        if len(self.shape) == 2:
+            fan_in, fan_out = float(self.shape[0]), float(self.shape[1])
+        else:
+            fan_in = fan_out = float(np.sqrt(np.prod(self.shape)))
+        limit = float(np.sqrt(6.0 / (fan_in + fan_out)))
         self.t_left = _to_device(np.zeros(self.shape, np.float32))
-        self.a_left = _to_device(np.full(self.shape, 0.2, np.float32))
+        self.a_left = _to_device(
+            RandomGenerator.RNG.uniform(-limit, limit, self.shape)
+            .astype(np.float32))
         self.t_right = _to_device(
-            RandomGenerator.RNG.uniform(0.0, 1.0, self.shape).astype(np.float32))
+            RandomGenerator.RNG.uniform(-limit, limit, self.shape)
+            .astype(np.float32))
         self.a_right = _to_device(np.ones(self.shape, np.float32))
         return self
 

@@ -150,6 +150,12 @@ def test_cosine_proximity_criterion():
     got = float(CosineProximityCriterion().loss(p, t))
     # rows: cos=1 and cos=-1 -> -mean = 0
     np.testing.assert_allclose(got, 0.0, atol=1e-6)
+    # reduction semantics pin (ADVICE r3 #1): Keras cosine_proximity
+    # averages the normalized elementwise PRODUCT over all elements —
+    # two perfectly-aligned 2-D rows give -0.5, not the per-row-mean -1
+    pa = jnp.asarray([[3.0, 0.0], [0.0, 5.0]])
+    got_aligned = float(CosineProximityCriterion().loss(pa, pa))
+    np.testing.assert_allclose(got_aligned, -0.5, atol=1e-6)
     # gradient exists and is finite — including for an all-zero row
     # (ReLU tails emit those; linalg.norm's grad at 0 is NaN and a
     # maximum() clamp would not mask it)
