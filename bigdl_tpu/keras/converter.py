@@ -39,6 +39,23 @@ def _tuple(v):
     return tuple(v) if isinstance(v, (list, tuple)) else v
 
 
+def _regularizer(spec):
+    """Keras-1.2.2 regularizer config -> L1L2Regularizer.
+    ``{"name": "WeightRegularizer"/"ActivityRegularizer", "l1": x,
+    "l2": y}`` (activity regularizers have no analogue and are
+    rejected)."""
+    if not spec:
+        return None
+    from bigdl_tpu.optim.regularizer import L1L2Regularizer
+
+    name = spec.get("name", "WeightRegularizer")
+    if "Activity" in name:
+        raise KerasConversionException(
+            "ActivityRegularizer has no bigdl analogue")
+    return L1L2Regularizer(float(spec.get("l1", 0.0)),
+                           float(spec.get("l2", 0.0)))
+
+
 def _strip_batch(shape):
     if shape is None:
         return None
@@ -59,6 +76,8 @@ def _build_layer(class_name: str, cfg: dict) -> Optional[KL.KerasLayer]:
             activation=cfg.get("activation"),
             input_shape=input_shape,
             bias=cfg.get("bias", True),
+            W_regularizer=_regularizer(cfg.get("W_regularizer")),
+            b_regularizer=_regularizer(cfg.get("b_regularizer")),
             name=name,
         )
     if class_name == "Activation":
@@ -89,8 +108,75 @@ def _build_layer(class_name: str, cfg: dict) -> Optional[KL.KerasLayer]:
             border_mode=cfg.get("border_mode", "valid"),
             subsample=sub,
             input_shape=input_shape,
+            bias=cfg.get("bias", True),
+            W_regularizer=_regularizer(cfg.get("W_regularizer")),
+            b_regularizer=_regularizer(cfg.get("b_regularizer")),
             name=name,
         )
+    if class_name == "AtrousConvolution2D":
+        if cfg.get("dim_ordering", "th") == "tf":
+            raise KerasConversionException(
+                "tf dim_ordering AtrousConvolution2D unsupported")
+        return KL.AtrousConvolution2D(
+            cfg["nb_filter"], cfg["nb_row"], cfg["nb_col"],
+            atrous_rate=_tuple(cfg.get("atrous_rate", (1, 1))),
+            activation=cfg.get("activation"),
+            border_mode=cfg.get("border_mode", "valid"),
+            subsample=_tuple(cfg.get("subsample", (1, 1))),
+            input_shape=input_shape,
+            bias=cfg.get("bias", True),
+            name=name,
+        )
+    if class_name == "Convolution1D":
+        return KL.Convolution1D(
+            cfg["nb_filter"], cfg["filter_length"],
+            activation=cfg.get("activation"),
+            border_mode=cfg.get("border_mode", "valid"),
+            subsample_length=cfg.get("subsample_length", 1),
+            input_shape=input_shape,
+            bias=cfg.get("bias", True),
+            name=name,
+        )
+    if class_name == "MaxPooling1D":
+        return KL.MaxPooling1D(
+            pool_length=cfg.get("pool_length", 2),
+            stride=cfg.get("stride"),
+            input_shape=input_shape, name=name,
+        )
+    if class_name == "AveragePooling1D":
+        return KL.AveragePooling1D(
+            pool_length=cfg.get("pool_length", 2),
+            stride=cfg.get("stride"),
+            input_shape=input_shape, name=name,
+        )
+    if class_name == "GlobalMaxPooling1D":
+        return KL.GlobalMaxPooling1D(input_shape=input_shape, name=name)
+    if class_name == "GlobalAveragePooling1D":
+        return KL.GlobalAveragePooling1D(input_shape=input_shape, name=name)
+    if class_name == "ZeroPadding1D":
+        return KL.ZeroPadding1D(cfg.get("padding", 1),
+                                input_shape=input_shape, name=name)
+    if class_name == "ZeroPadding3D":
+        return KL.ZeroPadding3D(_tuple(cfg.get("padding", (1, 1, 1))),
+                                input_shape=input_shape, name=name)
+    if class_name == "Cropping2D":
+        return KL.Cropping2D(_tuple(cfg.get("cropping", ((0, 0), (0, 0)))),
+                             input_shape=input_shape, name=name)
+    if class_name == "UpSampling2D":
+        return KL.UpSampling2D(_tuple(cfg.get("size", (2, 2))),
+                               input_shape=input_shape, name=name)
+    if class_name == "LeakyReLU":
+        return KL.LeakyReLU(cfg.get("alpha", 0.3),
+                            input_shape=input_shape, name=name)
+    if class_name == "ELU":
+        return KL.ELU(cfg.get("alpha", 1.0), input_shape=input_shape,
+                      name=name)
+    if class_name == "ThresholdedReLU":
+        return KL.ThresholdedReLU(cfg.get("theta", 1.0),
+                                  input_shape=input_shape, name=name)
+    if class_name == "Masking":
+        return KL.Masking(cfg.get("mask_value", 0.0),
+                          input_shape=input_shape, name=name)
     if class_name == "MaxPooling2D":
         return KL.MaxPooling2D(
             pool_size=_tuple(cfg.get("pool_size", (2, 2))),
@@ -134,12 +220,29 @@ def _build_layer(class_name: str, cfg: dict) -> Optional[KL.KerasLayer]:
         )
     if class_name in ("LSTM", "GRU", "SimpleRNN"):
         cls = getattr(KL, class_name)
+        kw = {}
+        if class_name != "SimpleRNN":
+            kw["inner_activation"] = cfg.get("inner_activation",
+                                             "hard_sigmoid")
+        if cfg.get("stateful"):
+            raise KerasConversionException(
+                f"stateful {class_name} {name}: cross-batch state is not "
+                "supported by the jit-pure recurrence")
+        if cfg.get("go_backwards"):
+            raise KerasConversionException(
+                f"go_backwards {class_name} unsupported")
         return cls(
             cfg["output_dim"],
             activation=cfg.get("activation", "tanh"),
             return_sequences=cfg.get("return_sequences", False),
             input_shape=input_shape,
+            dropout_W=cfg.get("dropout_W", 0.0) or 0.0,
+            dropout_U=cfg.get("dropout_U", 0.0) or 0.0,
+            W_regularizer=_regularizer(cfg.get("W_regularizer")),
+            U_regularizer=_regularizer(cfg.get("U_regularizer")),
+            b_regularizer=_regularizer(cfg.get("b_regularizer")),
             name=name,
+            **kw,
         )
     if class_name == "TimeDistributedDense":
         return KL.TimeDistributedDense(
@@ -225,6 +328,13 @@ def _graph_from_config(cfg: dict):
                     mod = {"sum": T.CAddTable, "max": T.CMaxTable,
                            "mul": T.CMulTable}[mode]()
                 out_shape = shapes[in_names[0]]
+            elif mode in ("dot", "cos"):
+                if len(in_names) != 2:
+                    raise KerasConversionException(
+                        f"Merge mode {mode} needs exactly 2 inputs")
+                mod = T.DotProduct() if mode == "dot" \
+                    else T.CosineDistance()
+                out_shape = (1,)
             else:
                 raise KerasConversionException(f"Merge mode {mode}")
             if lname:
@@ -297,20 +407,24 @@ def _assign_weights(mod, lname, weight_names, arrays):
     import jax.numpy as jnp
 
     from bigdl_tpu.nn import layers as L
+    from bigdl_tpu.nn import recurrent as R
     from bigdl_tpu.nn.module import Sequential
 
     # keras Dense+activation / Conv+activation become a Sequential in the
-    # keras layer build; the parameterised core is the first child
+    # keras layer build; the parameterised core is the first child —
+    # for recurrents that child is the Recurrent container whose cell
+    # holds the parameters
     if isinstance(mod, Sequential):
         for child in mod.modules:
             if child.params():
                 mod = child
                 break
-    if any("lstm" in w.lower() or "gru" in w.lower() for w in weight_names) \
-            or len(arrays) > 4:
-        raise KerasConversionException(
-            f"recurrent weight import not supported (layer {lname})"
-        )
+    if isinstance(mod, (R.Recurrent, R.BiRecurrent)):
+        cell = mod.modules[0]
+        return _assign_recurrent(cell, lname, weight_names, arrays)
+    if isinstance(mod, R.TimeDistributed):
+        inner = mod.modules[0]
+        return _assign_weights(inner, lname, weight_names, arrays)
     if isinstance(mod, L.Linear):
         w = arrays[0]
         mod.weight = jnp.asarray(w.T)  # keras (in,out) -> (out,in)
@@ -337,6 +451,104 @@ def _assign_weights(mod, lname, weight_names, arrays):
             f"weight import for {type(mod).__name__} (layer {lname}) "
             "not supported"
         )
+
+
+def _assign_recurrent(cell, lname, weight_names, arrays):
+    """Keras-1.2.2 recurrent weights -> cell params.
+
+    consume_less='cpu' saves one array per gate tensor named
+    ``<layer>_W_i`` / ``_U_i`` / ``_b_i`` (LSTM gates i/c/f/o, GRU
+    z/r/h, SimpleRNN plain W/U/b); consume_less='gpu' saves packed
+    W/U/b with keras gate order i,f,c,o (LSTM) / z,r,h (GRU).  Mapping
+    is name-based with a positional fallback in the 1.2.2
+    trainable_weights order (i,c,f,o / z,r,h)."""
+    import re
+
+    import jax.numpy as jnp
+
+    from bigdl_tpu.nn import recurrent as R
+
+    named = {}
+    for wn, arr in zip(weight_names, arrays):
+        tail = wn.split("/")[-1].split(":")[0]
+        m = re.search(r"_(W|U|b)(?:_(i|f|c|o|z|r|h))?$", tail)
+        if m:
+            named[(m.group(1), m.group(2))] = arr
+
+    def pick(kind, gate):
+        if (kind, gate) in named:
+            return named[(kind, gate)]
+        raise KerasConversionException(
+            f"layer {lname}: missing recurrent weight {kind}_{gate}")
+
+    H = cell.hidden_size
+    if isinstance(cell, R.LSTM):
+        if len(arrays) == 12:
+            if not named:  # positional: 1.2.2 order i, c, f, o
+                gates = ["i", "c", "f", "o"]
+                named.update({("W", g): arrays[3 * k] for k, g in
+                              enumerate(gates)})
+                named.update({("U", g): arrays[3 * k + 1] for k, g in
+                              enumerate(gates)})
+                named.update({("b", g): arrays[3 * k + 2] for k, g in
+                              enumerate(gates)})
+            # our packing: (i, f, g=c, o)
+            cell.w = jnp.asarray(np.concatenate(
+                [pick("W", g) for g in ("i", "f", "c", "o")], axis=1))
+            cell.u = jnp.asarray(np.concatenate(
+                [pick("U", g) for g in ("i", "f", "c", "o")], axis=1))
+            cell.b = jnp.asarray(np.concatenate(
+                [pick("b", g) for g in ("i", "f", "c", "o")]))
+        elif len(arrays) == 3:  # gpu mode: packed i, f, c, o — ours too
+            cell.w = jnp.asarray(arrays[0])
+            cell.u = jnp.asarray(arrays[1])
+            cell.b = jnp.asarray(arrays[2])
+        else:
+            raise KerasConversionException(
+                f"layer {lname}: unexpected LSTM weight count "
+                f"{len(arrays)}")
+    elif isinstance(cell, R.GRU):
+        if len(arrays) == 9:
+            if not named:  # positional: 1.2.2 order z, r, h
+                gates = ["z", "r", "h"]
+                named.update({("W", g): arrays[3 * k] for k, g in
+                              enumerate(gates)})
+                named.update({("U", g): arrays[3 * k + 1] for k, g in
+                              enumerate(gates)})
+                named.update({("b", g): arrays[3 * k + 2] for k, g in
+                              enumerate(gates)})
+            # our packing: (r, z) + candidate h
+            cell.w_rz = jnp.asarray(np.concatenate(
+                [pick("W", "r"), pick("W", "z")], axis=1))
+            cell.u_rz = jnp.asarray(np.concatenate(
+                [pick("U", "r"), pick("U", "z")], axis=1))
+            cell.b_rz = jnp.asarray(np.concatenate(
+                [pick("b", "r"), pick("b", "z")]))
+            cell.w_h = jnp.asarray(pick("W", "h"))
+            cell.u_h = jnp.asarray(pick("U", "h"))
+            cell.b_h = jnp.asarray(pick("b", "h"))
+        elif len(arrays) == 3:  # gpu mode: packed z, r, h
+            W, U, b = (np.asarray(a) for a in arrays)
+            cell.w_rz = jnp.asarray(
+                np.concatenate([W[:, H:2 * H], W[:, :H]], axis=1))
+            cell.u_rz = jnp.asarray(
+                np.concatenate([U[:, H:2 * H], U[:, :H]], axis=1))
+            cell.b_rz = jnp.asarray(np.concatenate([b[H:2 * H], b[:H]]))
+            cell.w_h = jnp.asarray(W[:, 2 * H:])
+            cell.u_h = jnp.asarray(U[:, 2 * H:])
+            cell.b_h = jnp.asarray(b[2 * H:])
+        else:
+            raise KerasConversionException(
+                f"layer {lname}: unexpected GRU weight count {len(arrays)}")
+    elif isinstance(cell, R.RnnCell):
+        cell.w = jnp.asarray(arrays[0])
+        cell.u = jnp.asarray(arrays[1])
+        if len(arrays) > 2:
+            cell.b = jnp.asarray(arrays[2])
+    else:
+        raise KerasConversionException(
+            f"recurrent weight import for {type(cell).__name__} "
+            f"(layer {lname}) not supported")
 
 
 def _iter_modules(m):

@@ -301,17 +301,26 @@ class ZeroPadding2D(KerasLayer):
 
 
 class BatchNormalization(KerasLayer):
-    def __init__(self, epsilon=1e-3, momentum=0.99, input_shape=None,
-                 name=None):
+    def __init__(self, epsilon=1e-3, momentum=0.99, axis=1,
+                 input_shape=None, name=None):
         super().__init__(input_shape, name)
         self.epsilon = epsilon
         self.momentum = momentum
+        # keras-1.2.2 "th" models normalize the channel axis (1); any
+        # other axis would need a transpose sandwich — reject loudly
+        if axis not in (1, -1):
+            raise ValueError(f"BatchNormalization axis {axis} unsupported")
+        self.axis = axis
 
     def build(self, input_shape):
         # keras momentum is the running-average keep rate; the core layer
         # uses the update rate
         update = 1.0 - self.momentum
         if len(input_shape) == 3:
+            if self.axis == -1:
+                raise ValueError(
+                    "BatchNormalization axis=-1 on an image tensor "
+                    "implies tf dim_ordering — unsupported")
             return L.SpatialBatchNormalization(int(input_shape[0]),
                                                eps=self.epsilon,
                                                momentum=update)
@@ -343,14 +352,32 @@ class _KerasRecurrent(KerasLayer):
     def __init__(self, output_dim: int, activation="tanh",
                  inner_activation="hard_sigmoid", return_sequences=False,
                  input_shape=None, input_dim=None, input_length=None,
+                 stateful=False, dropout_W=0.0, dropout_U=0.0,
+                 W_regularizer=None, U_regularizer=None, b_regularizer=None,
                  name=None):
         if input_shape is None and input_dim is not None:
             input_shape = (input_length, input_dim)
         super().__init__(input_shape, name)
+        if stateful:
+            # cross-batch carried state needs a stateful recurrence the
+            # jit-pure Recurrent deliberately does not keep; fail loudly
+            # rather than silently resetting state every batch
+            raise ValueError(
+                "stateful=True recurrent layers are not supported: the "
+                "recurrence is jit-pure and resets state per batch "
+                "(Keras-1.2.2 stateful semantics carry it across batches)")
+        if dropout_U:
+            raise ValueError(
+                "dropout_U (recurrent-state dropout) is not supported; "
+                "dropout_W maps to the cell's per-gate input dropout")
         self.output_dim = output_dim
         self.activation = activation
         self.inner_activation = inner_activation
         self.return_sequences = return_sequences
+        self.dropout_W = dropout_W
+        self.W_regularizer = W_regularizer
+        self.U_regularizer = U_regularizer
+        self.b_regularizer = b_regularizer
 
     def _cell(self, n_in):
         raise NotImplementedError
@@ -371,14 +398,22 @@ class _KerasRecurrent(KerasLayer):
 
 class LSTM(_KerasRecurrent):
     def _cell(self, n_in):
-        return R.LSTM(n_in, self.output_dim,
+        return R.LSTM(n_in, self.output_dim, p=self.dropout_W,
                       activation=_activation_module(self.activation),
-                      inner_activation=_activation_module(self.inner_activation))
+                      inner_activation=_activation_module(self.inner_activation),
+                      w_regularizer=self.W_regularizer,
+                      u_regularizer=self.U_regularizer,
+                      b_regularizer=self.b_regularizer)
 
 
 class GRU(_KerasRecurrent):
     def _cell(self, n_in):
-        return R.GRU(n_in, self.output_dim)
+        return R.GRU(n_in, self.output_dim, p=self.dropout_W,
+                     activation=_activation_module(self.activation),
+                     inner_activation=_activation_module(self.inner_activation),
+                     w_regularizer=self.W_regularizer,
+                     u_regularizer=self.U_regularizer,
+                     b_regularizer=self.b_regularizer)
 
 
 class SimpleRNN(_KerasRecurrent):
@@ -429,3 +464,259 @@ class TimeDistributedDense(KerasLayer):
 
     def compute_output_shape(self, input_shape):
         return tuple(input_shape[:-1]) + (self.output_dim,)
+
+
+# ---------------------------------------------------------------------------
+# VERDICT r3 item 4: Keras-1.2.2 core-vocabulary breadth
+# ---------------------------------------------------------------------------
+
+
+class Convolution1D(KerasLayer):
+    """keras.layers.Convolution1D over (steps, dim) inputs."""
+
+    def __init__(self, nb_filter: int, filter_length: int, activation=None,
+                 border_mode: str = "valid", subsample_length: int = 1,
+                 input_shape=None, bias=True, W_regularizer=None,
+                 b_regularizer=None, name=None):
+        super().__init__(input_shape, name)
+        self.nb_filter, self.filter_length = nb_filter, filter_length
+        self.activation = activation
+        self.border_mode = border_mode
+        self.subsample_length = subsample_length
+        self.bias = bias
+        self.W_regularizer, self.b_regularizer = W_regularizer, b_regularizer
+
+    def build(self, input_shape):
+        dim = int(input_shape[-1])
+        core = M.Sequential()
+        if self.border_mode == "same":
+            k = self.filter_length
+            left, right = (k - 1) // 2, k - 1 - (k - 1) // 2
+            if left:
+                core.add(L.Padding(1, -left, 2))
+            if right:
+                core.add(L.Padding(1, right, 2))
+        core.add(L.TemporalConvolution(
+            dim, self.nb_filter, self.filter_length, self.subsample_length,
+            with_bias=self.bias))
+        act = _activation_module(self.activation)
+        if act is not None:
+            core.add(act)
+        return core
+
+    def compute_output_shape(self, input_shape):
+        steps = input_shape[0]
+        if self.border_mode == "same":
+            out = -(-steps // self.subsample_length)
+        else:
+            out = (steps - self.filter_length) // self.subsample_length + 1
+        return (out, self.nb_filter)
+
+
+class MaxPooling1D(KerasLayer):
+    def __init__(self, pool_length: int = 2, stride=None,
+                 border_mode="valid", input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.pool_length = pool_length
+        self.stride = stride if stride is not None else pool_length
+
+    def _core(self):
+        from bigdl_tpu.nn.layers_extra import TemporalMaxPooling
+
+        return TemporalMaxPooling(self.pool_length, self.stride)
+
+    def build(self, input_shape):
+        return self._core()
+
+    def compute_output_shape(self, input_shape):
+        steps, dim = input_shape
+        return ((steps - self.pool_length) // self.stride + 1, dim)
+
+
+class AveragePooling1D(MaxPooling1D):
+    def _core(self):
+        from bigdl_tpu.nn.layers_extra import TemporalAveragePooling
+
+        return TemporalAveragePooling(self.pool_length, self.stride)
+
+
+class GlobalMaxPooling1D(KerasLayer):
+    def build(self, input_shape):
+        # L.Max reduces its 1-based dim over the FULL batched tensor:
+        # dim 2 is the time axis of (B, T, F)
+        return L.Max(2)
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[-1],)
+
+
+class GlobalAveragePooling1D(KerasLayer):
+    def build(self, input_shape):
+        return L.Mean(2)
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[-1],)
+
+
+class AtrousConvolution2D(KerasLayer):
+    """keras.layers.AtrousConvolution2D — dilated conv, NCHW layout."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 atrous_rate=(1, 1), activation=None,
+                 border_mode: str = "valid", subsample=(1, 1),
+                 input_shape=None, bias=True, W_regularizer=None,
+                 b_regularizer=None, name=None):
+        super().__init__(input_shape, name)
+        self.nb_filter, self.nb_row, self.nb_col = nb_filter, nb_row, nb_col
+        self.atrous_rate = _pair(atrous_rate)
+        self.activation = activation
+        self.border_mode = border_mode
+        self.subsample = _pair(subsample)
+        self.bias = bias
+        self.W_regularizer, self.b_regularizer = W_regularizer, b_regularizer
+
+    def _effective_kernel(self):
+        dh, dw = self.atrous_rate
+        return (dh * (self.nb_row - 1) + 1, dw * (self.nb_col - 1) + 1)
+
+    def build(self, input_shape):
+        n_in = int(input_shape[0])
+        eh, ew = self._effective_kernel()
+        if self.border_mode == "same":
+            ph, pw = (eh - 1) // 2, (ew - 1) // 2
+        else:
+            ph = pw = 0
+        core = M.Sequential()
+        core.add(L.SpatialDilatedConvolution(
+            n_in, self.nb_filter, self.nb_col, self.nb_row,
+            self.subsample[1], self.subsample[0], pw, ph,
+            self.atrous_rate[1], self.atrous_rate[0],
+            with_bias=self.bias))
+        act = _activation_module(self.activation)
+        if act is not None:
+            core.add(act)
+        return core
+
+    def compute_output_shape(self, input_shape):
+        _, h, w = input_shape
+        sh, sw = self.subsample
+        eh, ew = self._effective_kernel()
+        if self.border_mode == "same":
+            ph, pw = (eh - 1) // 2, (ew - 1) // 2
+        else:
+            ph = pw = 0
+        return (self.nb_filter,
+                (h + 2 * ph - eh) // sh + 1,
+                (w + 2 * pw - ew) // sw + 1)
+
+
+class ZeroPadding1D(KerasLayer):
+    def __init__(self, padding: int = 1, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.padding = padding
+
+    def build(self, input_shape):
+        p = self.padding
+        return M.Sequential().add(L.Padding(1, -p, 2)).add(L.Padding(1, p, 2))
+
+    def compute_output_shape(self, input_shape):
+        steps, dim = input_shape
+        return (steps + 2 * self.padding, dim)
+
+
+class ZeroPadding3D(KerasLayer):
+    def __init__(self, padding=(1, 1, 1), input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.padding = tuple(padding) if not isinstance(padding, int) \
+            else (padding,) * 3
+
+    def build(self, input_shape):
+        seq = M.Sequential()
+        for axis, p in enumerate(self.padding):  # (C, D, H, W): pad D/H/W
+            if p:
+                seq.add(L.Padding(axis + 2, -p, 4))
+                seq.add(L.Padding(axis + 2, p, 4))
+        return seq if seq.modules else M.Identity()
+
+    def compute_output_shape(self, input_shape):
+        c, d, h, w = input_shape
+        pd, ph, pw = self.padding
+        return (c, d + 2 * pd, h + 2 * ph, w + 2 * pw)
+
+
+class Cropping2D(KerasLayer):
+    def __init__(self, cropping=((0, 0), (0, 0)), input_shape=None,
+                 name=None):
+        super().__init__(input_shape, name)
+        self.cropping = tuple(tuple(c) for c in cropping)
+
+    def build(self, input_shape):
+        from bigdl_tpu.nn.layers_extra import Cropping2D as _C2D
+
+        return _C2D(self.cropping[0], self.cropping[1])
+
+    def compute_output_shape(self, input_shape):
+        c, h, w = input_shape
+        (t, b), (l, r) = self.cropping
+        return (c, h - t - b, w - l - r)
+
+
+class UpSampling2D(KerasLayer):
+    def __init__(self, size=(2, 2), input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.size = _pair(size)
+
+    def build(self, input_shape):
+        from bigdl_tpu.nn.layers_extra import UpSampling2D as _U2D
+
+        return _U2D(self.size)
+
+    def compute_output_shape(self, input_shape):
+        c, h, w = input_shape
+        return (c, h * self.size[0], w * self.size[1])
+
+
+class LeakyReLU(KerasLayer):
+    """keras.layers.advanced_activations.LeakyReLU (1.2.2 alpha=0.3)."""
+
+    def __init__(self, alpha: float = 0.3, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.alpha = alpha
+
+    def build(self, input_shape):
+        return L.LeakyReLU(self.alpha)
+
+
+class ELU(KerasLayer):
+    def __init__(self, alpha: float = 1.0, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.alpha = alpha
+
+    def build(self, input_shape):
+        return L.ELU(self.alpha)
+
+
+class ThresholdedReLU(KerasLayer):
+    def __init__(self, theta: float = 1.0, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.theta = theta
+
+    def build(self, input_shape):
+        return L.Threshold(self.theta, 0.0)
+
+
+class Masking(KerasLayer):
+    def __init__(self, mask_value: float = 0.0, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.mask_value = mask_value
+
+    def build(self, input_shape):
+        return L.Masking(self.mask_value)
+
+
+__all__ += [
+    "Convolution1D", "MaxPooling1D", "AveragePooling1D",
+    "GlobalMaxPooling1D", "GlobalAveragePooling1D", "AtrousConvolution2D",
+    "ZeroPadding1D", "ZeroPadding3D", "Cropping2D", "UpSampling2D",
+    "LeakyReLU", "ELU", "ThresholdedReLU", "Masking",
+]

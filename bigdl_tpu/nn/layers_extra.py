@@ -434,6 +434,35 @@ class TemporalMaxPooling(AbstractModule):
         return f"TemporalMaxPooling({self.k_w}, {self.d_w})"
 
 
+class TemporalAveragePooling(AbstractModule):
+    """Average pool over the frame axis of a (B, T, F) tensor — the
+    Keras ``AveragePooling1D`` core (the reference expressed it via its
+    keras layer set; no classic-module analogue)."""
+
+    def __init__(self, k_w: int, d_w: Optional[int] = None):
+        super().__init__()
+        self.k_w = k_w
+        self.d_w = d_w if d_w is not None else k_w
+        self._config = dict(k_w=k_w, d_w=self.d_w)
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        lax = _lax()
+        jnp = _jnp()
+        x, squeezed = _auto_batch(input, 3)
+        y = lax.reduce_window(
+            x,
+            jnp.zeros((), x.dtype),
+            lax.add,
+            window_dimensions=(1, self.k_w, 1),
+            window_strides=(1, self.d_w, 1),
+            padding=[(0, 0), (0, 0), (0, 0)],
+        ) / self.k_w
+        return y[0] if squeezed else y
+
+    def __repr__(self):
+        return f"TemporalAveragePooling({self.k_w}, {self.d_w})"
+
+
 # --------------------------------------------------------------------------
 # Shrink-family activations
 # --------------------------------------------------------------------------
@@ -895,6 +924,50 @@ class Tile(_Stateless):
         return _jnp().tile(input, reps)
 
 
+class SplitChunks(_Stateless):
+    """TF ``Split`` semantics: cut the tensor into ``n`` equal chunks
+    along 1-based ``dim`` (the chunk length comes from the runtime
+    shape — static under jit), returning a table.  Companion to
+    ``SplitTable`` (which unstacks every index); used by the TF
+    GraphDef importer (utils/tf_interop.py)."""
+
+    def __init__(self, dim: int = 1, n: int = 2):
+        super().__init__(dim=dim, n=n)
+        self.dim, self.n = dim, n
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        d = self.dim - 1 if self.dim > 0 else input.ndim + self.dim
+        size = input.shape[d]
+        if size % self.n:
+            raise ValueError(
+                f"SplitChunks: dim {self.dim} size {size} not divisible "
+                f"into {self.n} chunks")
+        chunk = size // self.n
+        idx = [slice(None)] * input.ndim
+        outs = []
+        for i in range(self.n):
+            idx[d] = slice(i * chunk, (i + 1) * chunk)
+            outs.append(input[tuple(idx)])
+        return tuple(outs)
+
+
+class GatherIndices(_Stateless):
+    """TF ``GatherV2`` semantics with a CONSTANT index vector: one
+    ``jnp.take`` along 1-based ``dim`` (negative counts from the end).
+    Used by the GraphDef importer — a fan-out of Select modules would
+    scale the module graph with the index count."""
+
+    def __init__(self, dim: int = 1, indices=()):
+        super().__init__(dim=dim, indices=[int(i) for i in indices])
+        self.dim = dim
+        self.indices = [int(i) for i in indices]
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        jnp = _jnp()
+        d = self.dim - 1 if self.dim > 0 else input.ndim + self.dim
+        return jnp.take(input, jnp.asarray(self.indices), axis=d)
+
+
 class Reverse(_Stateless):
     """⟦«bigdl»/nn/Reverse.scala⟧ — flip along 1-based ``dimension``."""
 
@@ -1164,6 +1237,9 @@ __all__ = [
     "ExpandSize",
     "InferReshape",
     "Tile",
+    "SplitChunks",
+    "TemporalAveragePooling",
+    "GatherIndices",
     "Reverse",
     "MaskedSelect",
     "Maxout",

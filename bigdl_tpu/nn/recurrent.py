@@ -277,15 +277,29 @@ class LSTMPeephole(Cell):
 
 class GRU(Cell):
     """«bigdl»/nn/GRU.scala — gates packed (r, z) + candidate; honors
-    ``p`` per-gate input dropout like the reference."""
+    ``p`` per-gate input dropout like the reference.  ``activation`` /
+    ``inner_activation`` default to the reference's Tanh/Sigmoid; the
+    Keras importer passes hard_sigmoid gates for Keras-1.2.2 parity."""
 
     param_names = ("w_rz", "u_rz", "b_rz", "w_h", "u_h", "b_h")
 
-    def __init__(self, input_size: int, hidden_size: int, p: float = 0.0):
+    def __init__(self, input_size: int, hidden_size: int, p: float = 0.0,
+                 activation=None, inner_activation=None,
+                 w_regularizer=None, u_regularizer=None,
+                 b_regularizer=None):
         super().__init__()
         self._config = dict(input_size=input_size, hidden_size=hidden_size, p=p)
         self.input_size, self.hidden_size = input_size, hidden_size
         self.p = p
+        self.activation = activation or Tanh()
+        self.inner_activation = inner_activation or Sigmoid()
+        self._regularizers = []
+        for names, reg in ((("w_rz", "w_h"), w_regularizer),
+                           (("u_rz", "u_h"), u_regularizer),
+                           (("b_rz", "b_h"), b_regularizer)):
+            if reg is not None:
+                for n in names:
+                    self._regularizers.append((n, reg))
         self.reset()
 
     def reset(self):
@@ -316,14 +330,14 @@ class GRU(Cell):
         return jnp.zeros((batch, self.hidden_size), dtype=dtype)
 
     def step(self, params, carry, proj_t):
-        import jax
-
         jnp = _jnp()
         h = carry
         H = self.hidden_size
         rz = proj_t[..., : 2 * H] + h @ params["u_rz"]
-        r, z = jnp.split(jax.nn.sigmoid(rz), 2, axis=-1)
-        cand = jnp.tanh(proj_t[..., 2 * H :] + (r * h) @ params["u_h"])
+        r, z = jnp.split(
+            self.inner_activation.update_output_pure({}, rz), 2, axis=-1)
+        cand = self.activation.update_output_pure(
+            {}, proj_t[..., 2 * H:] + (r * h) @ params["u_h"])
         h_new = (1 - z) * cand + z * h
         return h_new, h_new
 

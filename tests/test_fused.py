@@ -31,9 +31,8 @@ def test_kernel_matches_reference():
     w = jnp.asarray(rs.randn(32, 16).astype(np.float32) * 0.1)
     shift = jnp.asarray(rs.randn(32).astype(np.float32) * 0.01)
     y, s1, s2 = conv1x1_bn_stats(x, w, shift, interpret=True)
-    yr, s1r, s2r = _reference(x.reshape(3, 16, 64), w, shift)
-    np.testing.assert_allclose(np.asarray(y),
-                               np.asarray(yr).reshape(3, 32, 8, 8),
+    yr, s1r, s2r = _reference(x, w[:, :, None, None], shift, 1, 0)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(yr),
                                rtol=1e-5, atol=1e-5)
     np.testing.assert_allclose(np.asarray(s1), np.asarray(s1r),
                                rtol=1e-4, atol=1e-3)
@@ -53,7 +52,7 @@ def test_custom_vjp_matches_autodiff():
         return 0.5 * jnp.sum(y ** 2) + jnp.sum(s1 * coef) + 0.1 * jnp.sum(s2)
 
     def loss_r(x, w, shift):
-        y, s1, s2 = _reference(x.reshape(2, 8, 16), w, shift)
+        y, s1, s2 = _reference(x, w[:, :, None, None], shift, 1, 0)
         return 0.5 * jnp.sum(y ** 2) + jnp.sum(s1 * coef) + 0.1 * jnp.sum(s2)
 
     gk = jax.grad(loss_k, argnums=(0, 1, 2))(x, w, shift)
@@ -63,9 +62,73 @@ def test_custom_vjp_matches_autodiff():
                                    rtol=1e-4, atol=1e-3)
 
 
-def _pair_and_fused(cin=16, cout=32, with_relu=True, stride=1):
-    conv = SpatialConvolution(cin, cout, 1, 1, stride, stride,
-                              with_bias=False,
+def test_kxk_kernel_matches_reference():
+    """3x3 kernel (the other half of ResNet-50's BN inputs) at both
+    strides, plus O-padding (O=20 is not a tile multiple)."""
+    from bigdl_tpu.ops.conv_bn import conv_bn_stats
+
+    rs = np.random.RandomState(3)
+    x = jnp.asarray(rs.randn(2, 16, 8, 8).astype(np.float32))
+    for o, stride in [(32, 1), (32, 2), (20, 1)]:
+        w = jnp.asarray(rs.randn(o, 16, 3, 3).astype(np.float32) * 0.1)
+        shift = jnp.asarray(rs.randn(o).astype(np.float32) * 0.01)
+        y, s1, s2 = conv_bn_stats(x, w, shift, stride=stride, pad=1,
+                                  interpret=True)
+        yr, s1r, s2r = _reference(x, w, shift, stride, 1)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(yr),
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(s1), np.asarray(s1r),
+                                   rtol=1e-4, atol=1e-2)
+        np.testing.assert_allclose(np.asarray(s2), np.asarray(s2r),
+                                   rtol=1e-4, atol=1e-2)
+
+
+def test_kxk_vjp_matches_autodiff():
+    from bigdl_tpu.ops.conv_bn import conv_bn_stats
+
+    rs = np.random.RandomState(4)
+    x = jnp.asarray(rs.randn(2, 8, 6, 6).astype(np.float32))
+    w = jnp.asarray(rs.randn(16, 8, 3, 3).astype(np.float32) * 0.2)
+    shift = jnp.asarray(rs.randn(16).astype(np.float32) * 0.1)
+    coef = jnp.arange(16, dtype=jnp.float32)
+
+    def loss_k(x, w, shift):
+        y, s1, s2 = conv_bn_stats(x, w, shift, stride=2, pad=1,
+                                  interpret=True)
+        return 0.5 * jnp.sum(y ** 2) + jnp.sum(s1 * coef) + 0.1 * jnp.sum(s2)
+
+    def loss_r(x, w, shift):
+        y, s1, s2 = _reference(x, w, shift, 2, 1)
+        return 0.5 * jnp.sum(y ** 2) + jnp.sum(s1 * coef) + 0.1 * jnp.sum(s2)
+
+    gk = jax.grad(loss_k, argnums=(0, 1, 2))(x, w, shift)
+    gr = jax.grad(loss_r, argnums=(0, 1, 2))(x, w, shift)
+    for a, b in zip(gk, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-3)
+
+
+def test_1x1_odd_shapes_no_fallback():
+    """r03 fell back to plain XLA when block_o didn't divide O or the
+    tile blew the VMEM heuristic; the rewrite pads + masks instead."""
+    rs = np.random.RandomState(5)
+    x = jnp.asarray(rs.randn(2, 12, 5, 7).astype(np.float32))  # hw=35
+    w = jnp.asarray(rs.randn(20, 12).astype(np.float32) * 0.1)  # o=20
+    shift = jnp.asarray(rs.randn(20).astype(np.float32) * 0.01)
+    y, s1, s2 = conv1x1_bn_stats(x, w, shift, interpret=True)
+    yr, s1r, s2r = _reference(x, w[:, :, None, None], shift, 1, 0)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(yr),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(s1), np.asarray(s1r),
+                               rtol=1e-4, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(s2), np.asarray(s2r),
+                               rtol=1e-4, atol=1e-3)
+
+
+def _pair_and_fused(cin=16, cout=32, with_relu=True, stride=1, kernel=1):
+    pad = (kernel - 1) // 2
+    conv = SpatialConvolution(cin, cout, kernel, kernel, stride, stride,
+                              pad, pad, with_bias=False,
                               init_method=MsraFiller(False))
     bn = SpatialBatchNormalization(cout)
     pair = Sequential().add(conv).add(bn)
@@ -75,9 +138,9 @@ def _pair_and_fused(cin=16, cout=32, with_relu=True, stride=1):
     return pair, fused
 
 
-@pytest.mark.parametrize("stride", [1, 2])
-def test_module_parity_train_eval_state(stride):
-    pair, fused = _pair_and_fused(stride=stride)
+@pytest.mark.parametrize("stride,kernel", [(1, 1), (2, 1), (1, 3), (2, 3)])
+def test_module_parity_train_eval_state(stride, kernel):
+    pair, fused = _pair_and_fused(stride=stride, kernel=kernel)
     x = jnp.asarray(
         np.random.RandomState(0).randn(4, 16, 8, 8).astype(np.float32))
     p1, s1 = pair.params(), pair.state()
@@ -149,8 +212,9 @@ def test_fuse_resnet50_eval_parity_and_train():
                 fused_count[0] += 1
 
     count(m)
-    # 16 bottleneck c1 + 16 c3 + 4 strided shortcuts
-    assert fused_count[0] == 36, fused_count[0]
+    # 16 bottleneck c1 + 16 c2 (3x3) + 16 c3 + 4 strided shortcuts
+    # (the 7x7 stem stays on XLA)
+    assert fused_count[0] == 52, fused_count[0]
     m.evaluate()
     np.testing.assert_allclose(ref, np.asarray(m.forward(x)),
                                rtol=5e-4, atol=5e-4)

@@ -217,6 +217,9 @@ def _layer_cases():
         (N.ExpandSize([-1, 6]), v[:, :1]),
         (N.InferReshape([0, 3, 2]), v),
         (N.Tile(2, 2), v), (N.Reverse(2), v),
+        (N.TemporalAveragePooling(2), seq),
+        (N.SplitChunks(2, 2), v),
+        (N.GatherIndices(2, [0, 2]), v),
         (N.PairwiseDistance(2), (v, v + 1)),
         (N.NegativeEntropyPenalty(0.1), np.abs(v)),
         (N.GaussianSampler(), (v, v * 0)),  # eval: returns the mean

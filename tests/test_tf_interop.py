@@ -378,3 +378,252 @@ def test_image_layout_propagates_through_new_ops():
     y = np.minimum(y, 6.0)
     expect = y.mean(axis=(2, 3))
     np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# VERDICT r3 item 3: training-grade op vocabulary + BigDLSession analogue
+# ---------------------------------------------------------------------------
+
+
+def test_split_and_selecttable_outputs():
+    """TF Split emits name:k refs; chunks must match np.split."""
+    rs = np.random.RandomState(1)
+    b = GraphDefBuilder()
+    b.placeholder("x")
+    b.const("axis", np.asarray(1, np.int32))
+    b.op("sp", "Split", ["axis", "x"], num_split=GraphDefBuilder.attr_i(2))
+    b.op("o0", "Relu", ["sp"])        # output 0 via bare name
+    b.op("o1", "Relu", ["sp:1"])
+    model = TensorflowLoader(data=b.tobytes()).load(
+        inputs=["x"], outputs=["o0", "o1"])
+    model.evaluate()
+    x = rs.randn(3, 8).astype(np.float32)
+    o0, o1 = model.forward(x)
+    h0, h1 = np.split(x, 2, axis=1)
+    np.testing.assert_allclose(np.asarray(o0), np.maximum(h0, 0), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(o1), np.maximum(h1, 0), rtol=1e-6)
+
+
+def test_splitv_unequal_sizes():
+    rs = np.random.RandomState(2)
+    b = GraphDefBuilder()
+    b.placeholder("x")
+    b.const("sizes", np.asarray([3, 5], np.int32))
+    b.const("dim", np.asarray(1, np.int32))
+    b.op("sp", "SplitV", ["x", "sizes", "dim"])
+    b.op("o0", "Identity", ["sp"])
+    b.op("o1", "Identity", ["sp:1"])
+    model = TensorflowLoader(data=b.tobytes()).load(
+        inputs=["x"], outputs=["o0", "o1"])
+    model.evaluate()
+    x = rs.randn(2, 8).astype(np.float32)
+    o0, o1 = model.forward(x)
+    np.testing.assert_allclose(np.asarray(o0), x[:, :3], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(o1), x[:, 3:], rtol=1e-6)
+
+
+def test_unpack_pack_roundtrip():
+    rs = np.random.RandomState(3)
+    b = GraphDefBuilder()
+    b.placeholder("x")
+    b.op("un", "Unpack", ["x"], num=GraphDefBuilder.attr_i(3),
+         axis=GraphDefBuilder.attr_i(1))
+    b.op("pk", "Pack", ["un", "un:2", "un:1"],
+         axis=GraphDefBuilder.attr_i(1))
+    model = TensorflowLoader(data=b.tobytes()).load(
+        inputs=["x"], outputs=["pk"])
+    model.evaluate()
+    x = rs.randn(2, 3, 4).astype(np.float32)
+    out = np.asarray(model.forward(x))
+    np.testing.assert_allclose(out, x[:, [0, 2, 1], :], rtol=1e-6)
+
+
+def test_strided_slice_narrow_and_shrink():
+    rs = np.random.RandomState(4)
+    b = GraphDefBuilder()
+    b.placeholder("x")
+    b.const("begin", np.asarray([0, 1, 2], np.int32))
+    b.const("end", np.asarray([0, 3, 3], np.int32))
+    b.const("strides", np.asarray([1, 1, 1], np.int32))
+    b.op("ss", "StridedSlice", ["x", "begin", "end", "strides"],
+         begin_mask=GraphDefBuilder.attr_i(1),
+         end_mask=GraphDefBuilder.attr_i(1),
+         shrink_axis_mask=GraphDefBuilder.attr_i(4))
+    model = TensorflowLoader(data=b.tobytes()).load(
+        inputs=["x"], outputs=["ss"])
+    model.evaluate()
+    x = rs.randn(2, 5, 6).astype(np.float32)
+    out = np.asarray(model.forward(x))
+    np.testing.assert_allclose(out, x[:, 1:3, 2], rtol=1e-6)
+
+
+def test_gather_transpose_batchmatmul_expanddims():
+    rs = np.random.RandomState(5)
+    b = GraphDefBuilder()
+    b.placeholder("x")
+    b.const("idx", np.asarray([2, 0], np.int32))
+    b.const("gax", np.asarray(1, np.int32))
+    b.op("g", "GatherV2", ["x", "idx", "gax"])
+    b.const("perm", np.asarray([0, 2, 1], np.int32))
+    b.op("tr", "Transpose", ["x", "perm"])
+    b.op("bmm", "BatchMatMul", ["x", "tr"])
+    b.const("eax", np.asarray(1, np.int32))
+    b.op("ed", "ExpandDims", ["g", "eax"])
+    model = TensorflowLoader(data=b.tobytes()).load(
+        inputs=["x"], outputs=["bmm", "ed"])
+    model.evaluate()
+    x = rs.randn(2, 3, 4).astype(np.float32)
+    bmm, ed = model.forward(x)
+    np.testing.assert_allclose(
+        np.asarray(bmm), x @ np.transpose(x, (0, 2, 1)),
+        rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(ed), np.take(x, [2, 0], axis=1)[:, None], rtol=1e-6)
+
+
+def test_const_folding_shape_arithmetic():
+    """Reshape target computed via Fill/Range/Pack/StridedSlice chains
+    over Consts must constant-fold (real exporter graphs do this)."""
+    rs = np.random.RandomState(6)
+    b = GraphDefBuilder()
+    b.placeholder("x")
+    b.const("c", np.asarray([2, 3, 4], np.int32))
+    b.const("b2", np.asarray([1], np.int32))
+    b.const("e2", np.asarray([3], np.int32))
+    b.const("s2", np.asarray([1], np.int32))
+    # tail = c[1:3] = [3, 4]; shape = concat([[-1]], tail) -> [-1, 3, 4]
+    b.op("tail", "StridedSlice", ["c", "b2", "e2", "s2"])
+    b.const("minus1", np.asarray([-1], np.int32))
+    b.const("cax", np.asarray(0, np.int32))
+    b.op("shape", "ConcatV2", ["minus1", "tail", "cax"])
+    b.op("rs", "Reshape", ["x", "shape"])
+    model = TensorflowLoader(data=b.tobytes()).load(
+        inputs=["x"], outputs=["rs"])
+    model.evaluate()
+    x = rs.randn(5, 12).astype(np.float32)
+    out = np.asarray(model.forward(x))
+    assert out.shape == (5, 3, 4)
+    np.testing.assert_allclose(out, x.reshape(5, 3, 4), rtol=1e-6)
+
+
+def test_slice_concrete_batch_extent_accepted():
+    """ADVICE r3 #3: size[0] == concrete batch extent (not -1) with
+    begin[0]==0 is a no-op batch slice and must convert."""
+    rs = np.random.RandomState(7)
+    b = GraphDefBuilder()
+    b.placeholder("x")
+    b.const("begin", np.asarray([0, 2], np.int32))
+    b.const("size", np.asarray([4, 3], np.int32))  # 4 = frozen batch
+    b.op("sl", "Slice", ["x", "begin", "size"])
+    model = TensorflowLoader(data=b.tobytes()).load(
+        inputs=["x"], outputs=["sl"])
+    model.evaluate()
+    x = rs.randn(4, 8).astype(np.float32)
+    out = np.asarray(model.forward(x))
+    np.testing.assert_allclose(out, x[:, 2:5], rtol=1e-6)
+
+
+def test_tf_training_session_finetunes_under_distri_optimizer():
+    """VERDICT r3 item 3 'done' gate: import a frozen classifier AND
+    fine-tune it under DistriOptimizer — gradients must flow through
+    the imported ops and improve the model."""
+    from bigdl_tpu.engine import Engine
+    from bigdl_tpu.nn import ClassNLLCriterion
+    from bigdl_tpu.optim import SGD, Top1Accuracy, Trigger
+    from bigdl_tpu.optim.evaluator import evaluate_dataset
+    from bigdl_tpu.dataset import ArrayDataSet
+    from bigdl_tpu.utils.tf_interop import TFTrainingSession
+
+    Engine.reset()
+    Engine.init()
+    try:
+        rs = np.random.RandomState(8)
+        d, k, n = 16, 4, 256
+        wtrue = rs.randn(d, k)
+        x = rs.randn(n, d).astype(np.float32)
+        y = (np.argmax(x @ wtrue, axis=1) + 1).astype(np.float32)
+
+        # a frozen MLP classifier exported with DELIBERATELY bad last
+        # weights (random init): the session must train it back
+        b = GraphDefBuilder()
+        b.placeholder("x")
+        b.const("w1", rs.randn(d, 32).astype(np.float32) * 0.3)
+        b.const("b1", np.zeros(32, np.float32))
+        b.const("w2", rs.randn(32, k).astype(np.float32) * 0.3)
+        b.op("mm1", "MatMul", ["x", "w1"])
+        b.op("h", "BiasAdd", ["mm1", "b1"])
+        b.op("r", "Relu", ["h"])
+        b.op("mm2", "MatMul", ["r", "w2"])
+        b.op("logp", "LogSoftmax", ["mm2"])
+
+        sess = TFTrainingSession(data=b.tobytes(), inputs=["x"],
+                                 outputs=["logp"])
+        before = np.asarray(sess.run(x[:8]))
+        trained = sess.train(
+            (x, y), ClassNLLCriterion(), optim_method=SGD(learningrate=0.5),
+            batch_size=64, end_trigger=Trigger.max_epoch(8),
+            distributed=True)
+        (acc,) = evaluate_dataset(trained, ArrayDataSet(x, y, 64),
+                                  [Top1Accuracy()])
+        value, _ = acc.result()
+        assert value > 0.9, f"fine-tuned accuracy {value}"
+        after = np.asarray(sess.run(x[:8]))
+        assert not np.allclose(before, after)  # weights actually moved
+    finally:
+        Engine.reset()
+
+
+def test_strided_slice_negative_end_and_gather_negative_axis():
+    rs = np.random.RandomState(9)
+    b = GraphDefBuilder()
+    b.placeholder("x")
+    b.const("begin", np.asarray([0, 1], np.int32))
+    b.const("end", np.asarray([0, -1], np.int32))   # x[:, 1:-1]
+    b.const("strides", np.asarray([1, 1], np.int32))
+    b.op("ss", "StridedSlice", ["x", "begin", "end", "strides"],
+         begin_mask=GraphDefBuilder.attr_i(1),
+         end_mask=GraphDefBuilder.attr_i(1))
+    b.const("idx", np.asarray([0, 2], np.int32))
+    b.const("gax", np.asarray(-1, np.int32))        # gather on last axis
+    b.op("g", "GatherV2", ["ss", "idx", "gax"])
+    model = TensorflowLoader(data=b.tobytes()).load(
+        inputs=["x"], outputs=["g"])
+    model.evaluate()
+    x = rs.randn(3, 6).astype(np.float32)
+    out = np.asarray(model.forward(x))
+    np.testing.assert_allclose(out, x[:, 1:-1][:, [0, 2]], rtol=1e-6)
+
+
+def test_strided_slice_batch_cut_rejected():
+    """A StridedSlice that genuinely cuts the batch axis must raise,
+    not silently pass every sample through."""
+    b = GraphDefBuilder()
+    b.placeholder("x")
+    b.const("begin", np.asarray([0, 0], np.int32))
+    b.const("end", np.asarray([1, 4], np.int32))  # x[0:1, :4] cuts batch
+    b.const("strides", np.asarray([1, 1], np.int32))
+    b.op("ss", "StridedSlice", ["x", "begin", "end", "strides"])
+    import pytest as _pytest
+
+    from bigdl_tpu.utils.tf_interop import TFConversionException
+
+    with _pytest.raises(TFConversionException):
+        TensorflowLoader(data=b.tobytes()).load(inputs=["x"],
+                                                outputs=["ss"])
+
+
+def test_split_negative_axis():
+    rs = np.random.RandomState(15)
+    b = GraphDefBuilder()
+    b.placeholder("x")
+    b.const("axis", np.asarray(-1, np.int32))
+    b.op("sp", "Split", ["axis", "x"], num_split=GraphDefBuilder.attr_i(2))
+    b.op("o0", "Identity", ["sp"])
+    b.op("o1", "Identity", ["sp:1"])
+    model = TensorflowLoader(data=b.tobytes()).load(
+        inputs=["x"], outputs=["o0", "o1"])
+    model.evaluate()
+    x = rs.randn(2, 3, 8).astype(np.float32)
+    o0, o1 = model.forward(x)
+    np.testing.assert_allclose(np.asarray(o0), x[..., :4], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(o1), x[..., 4:], rtol=1e-6)
