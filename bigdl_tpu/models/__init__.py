@@ -18,12 +18,13 @@ from bigdl_tpu.models.ncf import build_ncf
 from bigdl_tpu.models.autoencoder import build_autoencoder
 from bigdl_tpu.models.rnn import build_ptb_lm
 from bigdl_tpu.models.transformer import TransformerLM, build_transformer_lm
+from bigdl_tpu.models.wide_and_deep import build_wide_and_deep, pack_batch
 
 __all__ = [
     "build_lenet5", "build_resnet_cifar", "build_resnet_imagenet",
     "imagenet_recipe_optim", "build_vgg16", "build_vgg19", "build_vgg_cifar",
     "build_alexnet", "build_alexnet_original", "build_inception_v1",
-    "build_inception_v2", "build_ncf",
+    "build_inception_v2", "build_ncf", "build_wide_and_deep", "pack_batch",
     "build_autoencoder", "build_ptb_lm", "TransformerLM",
     "build_transformer_lm",
 ]

@@ -1,0 +1,107 @@
+"""Wide & Deep recommender.
+
+Rebuild of the reference's wide-and-deep path (SURVEY.md §2.1 "Sparse
+tensor": SparseLinear / LookupTableSparse / SparseJoinTable exist to
+feed exactly this model family; the zoo's WideAndDeep assembled them
+the same way).
+
+Input encoding — the TPU-native fixed-slot layout
+(``SparseTensor.to_padded``): one packed float matrix per batch
+
+    x = [wide_ids (S_w) | wide_weights (S_w) | deep_ids (n_deep)]
+
+* ``wide_ids``: 1-based indices into the wide (cross-feature) vocab,
+  0 = padding; ``wide_weights`` the matching values.  The wide linear
+  term ``sum_i w[id_i] * weight_i`` is an embedding bag with
+  ``n_output = class_num`` — ``LookupTableSparse``'s padded dense path.
+* ``deep_ids``: one 1-based categorical id per deep column, each with
+  its own embedding table, concatenated into an MLP.
+
+Static shapes mean the batch shards ``P(data)`` over the mesh and the
+whole model jits into one XLA program — gathers + dense matmuls, no
+host-side sparse scatter.  The COO ``SparseTensor`` surface
+(nn/sparse.py) is the host-side data-prep companion.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from bigdl_tpu.nn import (
+    CAddTable,
+    Graph,
+    Input,
+    JoinTable,
+    Linear,
+    LogSoftMax,
+    LookupTable,
+    LookupTableSparse,
+    Narrow,
+    ReLU,
+)
+
+
+def build_wide_and_deep(
+    wide_vocab: int,
+    deep_vocabs: Sequence[int],
+    class_num: int = 2,
+    wide_slots: int = 8,
+    embed_dim: int = 8,
+    hidden_layers: Sequence[int] = (32, 16),
+):
+    """Wide & Deep graph over the packed fixed-slot input.
+
+    x (B, 2 * wide_slots + len(deep_vocabs)) float32 packed as
+    described in the module docstring.
+    """
+    n_deep = len(deep_vocabs)
+    inp = Input()
+
+    wide_ids = Narrow(2, 1, wide_slots)(inp)
+    wide_wts = Narrow(2, wide_slots + 1, wide_slots)(inp)
+    # wide linear term: embedding bag over the cross-feature vocab with
+    # per-id weights, n_output = class_num (LookupTableSparse padded path)
+    wide_out = LookupTableSparse(wide_vocab, class_num, combiner="sum")(
+        wide_ids, wide_wts)
+
+    # deep: per-column embeddings -> concat -> MLP
+    embeds = []
+    for c, vocab in enumerate(deep_vocabs):
+        ids_c = Narrow(2, 2 * wide_slots + c + 1, 1)(inp)
+        emb = LookupTable(vocab, embed_dim)(ids_c)   # (B, 1, D)
+        embeds.append(emb)
+    h = JoinTable(2, 3)(*embeds) if n_deep > 1 else embeds[0]
+    from bigdl_tpu.nn import Reshape
+
+    h = Reshape([n_deep * embed_dim], batch_mode=True)(h)
+    width = n_deep * embed_dim
+    for n in hidden_layers:
+        h = ReLU()(Linear(width, n)(h))
+        width = n
+    deep_out = Linear(width, class_num)(h)
+
+    out = LogSoftMax()(CAddTable()(wide_out, deep_out))
+    return Graph([inp], [out])
+
+
+def pack_batch(wide_sparse, deep_ids, wide_slots: int):
+    """Host-side batch packer: COO wide features + (B, n_deep) deep ids
+    -> the packed dense matrix ``build_wide_and_deep`` consumes.
+
+    The packed matrix is float32 (one homogeneous array rides the
+    P(data) pipeline), which represents integers exactly only below
+    2**24 — large hashed-cross vocabs must be bucketed under that bound
+    first; this packer refuses ids beyond it rather than silently
+    gathering a neighboring embedding row."""
+    import numpy as np
+
+    ids, wts = wide_sparse.to_padded(wide_slots)
+    deep = np.asarray(deep_ids)
+    limit = 1 << 24
+    if ids.max(initial=0) >= limit or deep.max(initial=0) >= limit:
+        raise ValueError(
+            "pack_batch: ids >= 2**24 do not survive the float32 packed "
+            "encoding; hash/bucket the vocab below 16.7M first")
+    return np.concatenate(
+        [ids.astype(np.float32), wts, deep.astype(np.float32)], axis=1
+    ).astype(np.float32)

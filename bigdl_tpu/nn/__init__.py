@@ -36,6 +36,7 @@ from bigdl_tpu.nn.sparse import (
     SparseJoinTable,
     SparseLinear,
     SparseTensor,
+    SparseTensorMath,
 )
 from bigdl_tpu.nn.attention import (
     LayerNorm,

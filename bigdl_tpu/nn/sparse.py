@@ -72,8 +72,134 @@ class SparseTensor:
 
         return jsparse.BCOO((self.values, self.indices), shape=self.shape)
 
+    # ---- reference SparseTensor op surface ---------------------------
+    # («bigdl»/tensor/SparseTensor.scala narrow/concat/resize and the
+    # arithmetic entry points SparseTensorMath routes through)
+
+    def narrow(self, dim: int, start: int, length: int) -> "SparseTensor":
+        """0-based slice [start, start+length) along ``dim`` (host-side:
+        nnz changes, so this is a data-prep op, not a jit op)."""
+        idx = np.asarray(self.indices)
+        vals = np.asarray(self.values)
+        keep = (idx[:, dim] >= start) & (idx[:, dim] < start + length)
+        out_idx = idx[keep].copy()
+        out_idx[:, dim] -= start
+        shape = list(self.shape)
+        shape[dim] = length
+        return SparseTensor(out_idx, vals[keep], tuple(shape))
+
+    @staticmethod
+    def concat(dim: int, tensors: Sequence["SparseTensor"]) -> "SparseTensor":
+        """Concatenate COO tensors along ``dim`` (0-based)."""
+        jnp = _jnp()
+        offset = 0
+        idx_parts, val_parts = [], []
+        out_shape = list(tensors[0].shape)
+        out_shape[dim] = 0
+        for t in tensors:
+            idx = t.indices
+            if offset:
+                idx = idx.at[:, dim].add(offset)
+            idx_parts.append(idx)
+            val_parts.append(t.values)
+            offset += t.shape[dim]
+            out_shape[dim] += t.shape[dim]
+        return SparseTensor(
+            jnp.concatenate(idx_parts, 0),
+            jnp.concatenate(val_parts, 0),
+            tuple(out_shape),
+        )
+
+    def t(self) -> "SparseTensor":
+        """2-D transpose (indices swap; no data movement)."""
+        if self.ndim != 2:
+            raise ValueError("t() needs a 2-D SparseTensor")
+        jnp = _jnp()
+        return SparseTensor(self.indices[:, ::-1], self.values,
+                            (self.shape[1], self.shape[0]))
+
+    def mul(self, scalar) -> "SparseTensor":
+        return SparseTensor(self.indices, self.values * scalar, self.shape)
+
+    def add(self, other: "SparseTensor") -> "SparseTensor":
+        """Sparse + sparse: union of entries (duplicates accumulate on
+        densify, matching COO semantics)."""
+        jnp = _jnp()
+        if self.shape != other.shape:
+            raise ValueError("shape mismatch")
+        return SparseTensor(
+            jnp.concatenate([self.indices, other.indices], 0),
+            jnp.concatenate([self.values, other.values], 0),
+            self.shape,
+        )
+
+    def to_padded(self, max_per_row: int):
+        """Host-side: (B, vocab)-ish COO rows -> fixed-slot dense
+        ``(ids, weights)`` arrays of shape (B, max_per_row) — the
+        TPU-native batch encoding (static shapes shard P(data) and jit
+        cleanly).  ids are 1-based int32 with 0 = padding; the column
+        index becomes the id and the value the weight."""
+        idx = np.asarray(self.indices)
+        vals = np.asarray(self.values)
+        B = self.shape[0]
+        ids = np.zeros((B, max_per_row), np.int32)
+        wts = np.zeros((B, max_per_row), np.float32)
+        fill = np.zeros(B, np.int64)
+        for (r, c), v in zip(idx, vals):
+            if fill[r] >= max_per_row:
+                raise ValueError(
+                    f"row {r} has more than {max_per_row} entries")
+            ids[r, fill[r]] = c + 1
+            wts[r, fill[r]] = v
+            fill[r] += 1
+        return ids, wts
+
     def __repr__(self):
         return f"SparseTensor(shape={self.shape}, nnz={self.nnz})"
+
+
+class SparseTensorMath:
+    """Reference: «bigdl»/tensor/SparseTensorMath.scala +
+    SparseTensorBLAS.scala — the BLAS-style entry points over COO
+    operands.  Compute lowers to gather + segment-sum (the TPU fast
+    path for these shapes; XLA has no sparse MXU path)."""
+
+    @staticmethod
+    def mm(sparse: SparseTensor, dense):
+        """sparse (m, k) @ dense (k, n) -> dense (m, n)."""
+        import jax
+
+        rows = sparse.indices[:, 0]
+        cols = sparse.indices[:, 1]
+        contrib = dense[cols] * sparse.values[:, None]
+        return jax.ops.segment_sum(contrib, rows,
+                                   num_segments=sparse.shape[0])
+
+    @staticmethod
+    def addmm(beta, mat, alpha, sparse: SparseTensor, dense):
+        """beta * mat + alpha * (sparse @ dense)."""
+        return beta * mat + alpha * SparseTensorMath.mm(sparse, dense)
+
+    @staticmethod
+    def mv(sparse: SparseTensor, vec):
+        """sparse (m, k) @ vec (k,) -> dense (m,)."""
+        import jax
+
+        rows = sparse.indices[:, 0]
+        cols = sparse.indices[:, 1]
+        return jax.ops.segment_sum(vec[cols] * sparse.values, rows,
+                                   num_segments=sparse.shape[0])
+
+    @staticmethod
+    def addmv(beta, vec_out, alpha, sparse: SparseTensor, vec):
+        """beta * vec_out + alpha * (sparse @ vec)."""
+        return beta * vec_out + alpha * SparseTensorMath.mv(sparse, vec)
+
+    @staticmethod
+    def vdot(a: SparseTensor, b):
+        """<a_sparse, b_dense> over matching shapes."""
+        jnp = _jnp()
+        return jnp.sum(b[tuple(a.indices.T)] * a.values)
 
 
 class SparseLinear(AbstractModule):
@@ -164,7 +290,32 @@ class LookupTableSparse(AbstractModule):
         else:
             ids, weights = input, None
         if not isinstance(ids, SparseTensor):
-            raise TypeError("LookupTableSparse expects a SparseTensor of ids")
+            # TPU-native padded encoding (SparseTensor.to_padded): dense
+            # (B, S) 1-based ids with 0 = pad, optional (B, S) weights.
+            # Static shapes -> shards P(data) and jits; this is how
+            # wide-and-deep batches ride DistriOptimizer.
+            ids_arr = jnp.asarray(ids)
+            if ids_arr.ndim != 2:
+                raise TypeError(
+                    "LookupTableSparse expects a SparseTensor or a "
+                    "padded (B, S) id matrix")
+            idx = jnp.maximum(ids_arr.astype(jnp.int32) - 1, 0)
+            emb = params["weight"][idx]                      # (B, S, D)
+            if self.max_norm > 0:
+                norms = jnp.linalg.norm(emb, axis=-1, keepdims=True)
+                emb = emb * jnp.minimum(1.0, self.max_norm / (norms + 1e-12))
+            mask = (ids_arr > 0).astype(emb.dtype)           # (B, S)
+            w = mask if weights is None \
+                else jnp.asarray(weights).astype(emb.dtype) * mask
+            summed = jnp.sum(emb * w[..., None], axis=1)
+            if self.combiner == "sum":
+                return summed
+            if self.combiner == "mean":
+                denom = jnp.maximum(jnp.sum(w, axis=1), 1e-12)[:, None]
+                return summed / denom
+            denom = jnp.sqrt(
+                jnp.maximum(jnp.sum(w * w, axis=1), 1e-12))[:, None]
+            return summed / denom
         rows = ids.indices[:, 0]
         # reference: ids are 1-based (LookupTable convention)
         emb_ids = ids.values.astype(jnp.int32) - 1

@@ -338,6 +338,214 @@ class Tensor:
         self._a = jnp.asarray(np.vectorize(fn)(a, b).astype(a.dtype))
         return self
 
+    # ----------------------------------------- gather / scatter / masked
+    # (reference Tensor.scala user-facing surface — VERDICT r3 item 9)
+
+    def gather(self, dim: int, index) -> "Tensor":
+        """Reference: gather(dim, index) — index holds 1-based positions
+        along ``dim``; output has index's shape."""
+        jnp = _jnp()
+        ix = jnp.asarray(np.asarray(index, np.int64) - 1)
+        return Tensor(jnp.take_along_axis(self._a, ix, axis=dim - 1))
+
+    def scatter(self, dim: int, index, src) -> "Tensor":
+        """Reference: scatter(dim, index, src) — writes src values at
+        the 1-based positions in index along ``dim`` (in place)."""
+        jnp = _jnp()
+        ix = np.asarray(index, np.int64) - 1
+        srcv = self._coerce(src)
+        d = dim - 1
+        grids = np.indices(ix.shape)
+        loc = [grids[k] for k in range(ix.ndim)]
+        loc[d] = ix
+        self._a = self._a.at[tuple(loc)].set(
+            jnp.asarray(srcv)[tuple(grids)] if np.ndim(srcv) else srcv)
+        return self
+
+    def masked_fill(self, mask, value) -> "Tensor":
+        """Reference: maskedFill(mask, value) — in place where mask != 0."""
+        jnp = _jnp()
+        m = jnp.asarray(self._coerce(mask)) != 0
+        self._a = jnp.where(m, jnp.asarray(value, self._a.dtype), self._a)
+        return self
+
+    def masked_select(self, mask) -> "Tensor":
+        """Reference: maskedSelect — 1-D tensor of elements where
+        mask != 0 (host-side: output size is data-dependent)."""
+        m = np.asarray(self._coerce(mask)) != 0
+        return Tensor(np.asarray(self._a)[m])
+
+    def masked_copy(self, mask, src) -> "Tensor":
+        """Reference: maskedCopy — write src's elements (in order) into
+        the mask-selected positions (host-side, in place)."""
+        jnp = _jnp()
+        a = np.array(self._a)
+        m = np.asarray(self._coerce(mask)) != 0
+        s = np.asarray(self._coerce(src)).reshape(-1)
+        a[m] = s[: int(m.sum())]
+        self._a = jnp.asarray(a)
+        return self
+
+    def index_fill(self, dim: int, indices, value) -> "Tensor":
+        jnp = _jnp()
+        ix = np.asarray(indices, np.int64) - 1
+        idx = [slice(None)] * self._a.ndim
+        idx[dim - 1] = jnp.asarray(ix)
+        self._a = self._a.at[tuple(idx)].set(value)
+        return self
+
+    def index_copy(self, dim: int, indices, src) -> "Tensor":
+        jnp = _jnp()
+        ix = np.asarray(indices, np.int64) - 1
+        idx = [slice(None)] * self._a.ndim
+        idx[dim - 1] = jnp.asarray(ix)
+        self._a = self._a.at[tuple(idx)].set(jnp.asarray(self._coerce(src)))
+        return self
+
+    def index_add(self, dim: int, indices, src) -> "Tensor":
+        jnp = _jnp()
+        ix = np.asarray(indices, np.int64) - 1
+        idx = [slice(None)] * self._a.ndim
+        idx[dim - 1] = jnp.asarray(ix)
+        self._a = self._a.at[tuple(idx)].add(jnp.asarray(self._coerce(src)))
+        return self
+
+    # --------------------------------------------- more reference math
+    def cmax(self, other) -> "Tensor":
+        jnp = _jnp()
+        self._a = jnp.maximum(self._a, self._coerce(other))
+        return self
+
+    def cmin(self, other) -> "Tensor":
+        jnp = _jnp()
+        self._a = jnp.minimum(self._a, self._coerce(other))
+        return self
+
+    def clamp(self, min_value, max_value) -> "Tensor":
+        jnp = _jnp()
+        self._a = jnp.clip(self._a, min_value, max_value)
+        return self
+
+    def sign(self) -> "Tensor":
+        self._a = _jnp().sign(self._a)
+        return self
+
+    def floor(self) -> "Tensor":
+        self._a = _jnp().floor(self._a)
+        return self
+
+    def ceil(self) -> "Tensor":
+        self._a = _jnp().ceil(self._a)
+        return self
+
+    def addcmul(self, scalar, t1, t2) -> "Tensor":
+        """self += scalar * t1 * t2 (reference addcmul)."""
+        self._a = self._a + scalar * self._coerce(t1) * self._coerce(t2)
+        return self
+
+    def addcdiv(self, scalar, t1, t2) -> "Tensor":
+        self._a = self._a + scalar * self._coerce(t1) / self._coerce(t2)
+        return self
+
+    def addr(self, v1, v2) -> "Tensor":
+        """Outer product v1 (m) x v2 (n) added into self (m, n)."""
+        jnp = _jnp()
+        self._a = self._a + jnp.outer(jnp.asarray(self._coerce(v1)),
+                                      jnp.asarray(self._coerce(v2)))
+        return self
+
+    def topk(self, k: int, dim: Optional[int] = None, increase: bool = False):
+        """Reference: topk(k, dim, increase) -> (values, 1-based
+        indices); smallest-k when ``increase`` (the reference default
+        sorts ascending=smallest first when increase=true)."""
+        jnp = _jnp()
+        d = (self._a.ndim if dim is None else dim) - 1
+        a = self._a if increase else -self._a
+        order = jnp.argsort(a, axis=d)
+        take = [slice(None)] * self._a.ndim
+        take[d] = slice(0, k)
+        idx = order[tuple(take)]
+        vals = jnp.take_along_axis(self._a, idx, axis=d)
+        return Tensor(vals), Tensor((idx + 1).astype(_jnp().float32))
+
+    def sort(self, dim: Optional[int] = None, descending: bool = False):
+        jnp = _jnp()
+        d = (self._a.ndim if dim is None else dim) - 1
+        order = jnp.argsort(-self._a if descending else self._a, axis=d)
+        vals = jnp.take_along_axis(self._a, order, axis=d)
+        return Tensor(vals), Tensor((order + 1).astype(jnp.float32))
+
+    def nonzero(self) -> "Tensor":
+        """1-based (nnz, ndim) coordinates (host-side: size is
+        data-dependent)."""
+        return Tensor(np.argwhere(np.asarray(self._a) != 0) + 1)
+
+    def expand(self, *sizes) -> "Tensor":
+        jnp = _jnp()
+        if len(sizes) == 1 and isinstance(sizes[0], (list, tuple)):
+            sizes = tuple(sizes[0])
+        return Tensor(jnp.broadcast_to(self._a, tuple(int(s) for s in sizes)))
+
+    def repeat_tensor(self, *sizes) -> "Tensor":
+        jnp = _jnp()
+        if len(sizes) == 1 and isinstance(sizes[0], (list, tuple)):
+            sizes = tuple(sizes[0])
+        return Tensor(jnp.tile(self._a, tuple(int(s) for s in sizes)))
+
+    def split(self, size: int, dim: int = 1):
+        """Chunks of ``size`` along 1-based dim (last may be smaller)."""
+        d = dim - 1
+        n = self._a.shape[d]
+        outs = []
+        idx = [slice(None)] * self._a.ndim
+        for s in range(0, n, size):
+            idx[d] = slice(s, min(s + size, n))
+            outs.append(Tensor(self._a[tuple(idx)]))
+        return outs
+
+    def chunk(self, n_chunks: int, dim: int = 1):
+        d = dim - 1
+        size = -(-self._a.shape[d] // n_chunks)
+        return self.split(size, dim)
+
+    # ------------------------------------------------- random fills
+    def uniform(self, a: float = 0.0, b: float = 1.0) -> "Tensor":
+        from bigdl_tpu.common import RandomGenerator
+
+        jnp = _jnp()
+        self._a = jnp.asarray(
+            RandomGenerator.RNG.uniform(a, b, self._a.shape)
+            .astype(self._a.dtype))
+        return self
+
+    def normal(self, mean: float = 0.0, stdv: float = 1.0) -> "Tensor":
+        from bigdl_tpu.common import RandomGenerator
+
+        jnp = _jnp()
+        self._a = jnp.asarray(
+            (RandomGenerator.RNG.normal(mean, stdv, self._a.shape))
+            .astype(self._a.dtype))
+        return self
+
+    def bernoulli(self, p: float = 0.5) -> "Tensor":
+        from bigdl_tpu.common import RandomGenerator
+
+        jnp = _jnp()
+        self._a = jnp.asarray(
+            (RandomGenerator.RNG.uniform(0, 1, self._a.shape) < p)
+            .astype(self._a.dtype))
+        return self
+
+    # reference camelCase spellings
+    maskedFill = masked_fill
+    maskedSelect = masked_select
+    maskedCopy = masked_copy
+    indexSelect = index_select
+    indexFill = index_fill
+    indexCopy = index_copy
+    indexAdd = index_add
+    repeatTensor = repeat_tensor
+
     # -------------------------------------------------------- operators
     def __add__(self, other):
         return Tensor(self._a + self._coerce(other))

@@ -289,6 +289,145 @@ class ColorJitter(FeatureTransformer):
         return feature
 
 
+class Hue(FeatureTransformer):
+    """«bigdl» Hue.scala — rotate the hue channel by a random delta in
+    [delta_low, delta_high] degrees (detection-era color aug)."""
+
+    def __init__(self, delta_low: float = -18.0, delta_high: float = 18.0):
+        self.lo, self.hi = delta_low, delta_high
+
+    @staticmethod
+    def _rgb_to_hsv(img):
+        img = img.astype(np.float32)
+        mx = img.max(-1)
+        mn = img.min(-1)
+        diff = mx - mn
+        r, g, b = img[..., 0], img[..., 1], img[..., 2]
+        h = np.zeros_like(mx)
+        mask = diff > 0
+        rmax = mask & (mx == r)
+        gmax = mask & (mx == g) & ~rmax
+        bmax = mask & ~rmax & ~gmax
+        h[rmax] = (60 * (g - b)[rmax] / diff[rmax]) % 360
+        h[gmax] = 60 * (b - r)[gmax] / diff[gmax] + 120
+        h[bmax] = 60 * (r - g)[bmax] / diff[bmax] + 240
+        s = np.where(mx > 0, diff / np.maximum(mx, 1e-12), 0.0)
+        return h, s, mx
+
+    @staticmethod
+    def _hsv_to_rgb(h, s, v):
+        h = (h % 360) / 60.0
+        i = np.floor(h).astype(np.int32)
+        f = h - i
+        p = v * (1 - s)
+        q = v * (1 - s * f)
+        t = v * (1 - s * (1 - f))
+        i = i % 6
+        r = np.choose(i, [v, q, p, p, t, v])
+        g = np.choose(i, [t, v, v, q, p, p])
+        b = np.choose(i, [p, p, t, v, v, q])
+        return np.stack([r, g, b], axis=-1)
+
+    def transform(self, feature):
+        delta = RandomGenerator.RNG.uniform(self.lo, self.hi)
+        h, s, v = self._rgb_to_hsv(feature.image)
+        feature[ImageFeature.MAT] = self._hsv_to_rgb(h + delta, s, v)
+        return feature
+
+
+class Expand(FeatureTransformer):
+    """«bigdl» Expand.scala — place the image at a random offset on a
+    larger mean-filled canvas (SSD-style zoom-out augmentation)."""
+
+    def __init__(self, means_r: float = 123.0, means_g: float = 117.0,
+                 means_b: float = 104.0, min_expand_ratio: float = 1.0,
+                 max_expand_ratio: float = 4.0):
+        self.means = np.array([means_r, means_g, means_b], np.float32)
+        self.lo, self.hi = min_expand_ratio, max_expand_ratio
+
+    def transform(self, feature):
+        img = feature.image.astype(np.float32)
+        h, w = img.shape[:2]
+        ratio = RandomGenerator.RNG.uniform(self.lo, self.hi)
+        oh, ow = int(h * ratio), int(w * ratio)
+        y = int(RandomGenerator.RNG.uniform(0, max(1, oh - h)))
+        x = int(RandomGenerator.RNG.uniform(0, max(1, ow - w)))
+        canvas = np.tile(self.means, (oh, ow, 1)).astype(np.float32)
+        canvas[y:y + h, x:x + w] = img
+        feature[ImageFeature.MAT] = canvas
+        return feature
+
+
+class FixedCrop(FeatureTransformer):
+    """«bigdl» FixedCrop.scala — crop a fixed bbox (x1, y1, x2, y2);
+    ``normalized`` coords are fractions of width/height."""
+
+    def __init__(self, x1: float, y1: float, x2: float, y2: float,
+                 normalized: bool = True):
+        self.box = (x1, y1, x2, y2)
+        self.normalized = normalized
+
+    def transform(self, feature):
+        img = feature.image
+        h, w = img.shape[:2]
+        x1, y1, x2, y2 = self.box
+        if self.normalized:
+            x1, x2 = x1 * w, x2 * w
+            y1, y2 = y1 * h, y2 * h
+        x1, y1 = max(0, int(round(x1))), max(0, int(round(y1)))
+        x2, y2 = min(w, int(round(x2))), min(h, int(round(y2)))
+        feature[ImageFeature.MAT] = img[y1:y2, x1:x2]
+        return feature
+
+
+class RandomAspectScale(FeatureTransformer):
+    """«bigdl» RandomAspectScale.scala — AspectScale with the short-edge
+    target drawn from ``scales``."""
+
+    def __init__(self, scales: Sequence[int], scale_multiple_of: int = 1,
+                 max_size: int = 1000):
+        self.scales = list(scales)
+        self.mult = scale_multiple_of
+        self.max_size = max_size
+
+    def transform(self, feature):
+        pick = self.scales[
+            int(RandomGenerator.RNG.randint(0, len(self.scales)))]
+        img = feature.image
+        h, w = img.shape[:2]
+        short, long = min(h, w), max(h, w)
+        ratio = pick / short
+        if long * ratio > self.max_size:
+            ratio = self.max_size / long
+        oh, ow = int(round(h * ratio)), int(round(w * ratio))
+        if self.mult > 1:
+            oh = -(-oh // self.mult) * self.mult
+            ow = -(-ow // self.mult) * self.mult
+        feature[ImageFeature.MAT] = _resize_bilinear(img, oh, ow)
+        return feature
+
+
+class ChannelOrder(FeatureTransformer):
+    """«bigdl» ChannelOrder.scala — swap RGB <-> BGR."""
+
+    def transform(self, feature):
+        feature[ImageFeature.MAT] = feature.image[..., ::-1]
+        return feature
+
+
+class RandomTransformer(FeatureTransformer):
+    """«bigdl» RandomTransformer.scala — apply ``inner`` with
+    probability ``p``."""
+
+    def __init__(self, inner: FeatureTransformer, p: float = 0.5):
+        self.inner, self.p = inner, p
+
+    def transform(self, feature):
+        if RandomGenerator.RNG.uniform(0, 1) < self.p:
+            return self.inner.transform(feature)
+        return feature
+
+
 class MatToTensor(FeatureTransformer):
     """«bigdl» MatToTensor.scala — HWC -> CHW float32 model input."""
 
@@ -322,8 +461,8 @@ class ImageFrameToSample(FeatureTransformer):
 
 class ImageFrame:
     """«bigdl» ImageFrame — a collection of ImageFeatures with
-    ``transform``.  LocalImageFrame only: the distributed variant's role
-    (RDD of features) is played by the data loader feeding the device."""
+    ``transform`` (reference LocalImageFrame).  See
+    :class:`DistributedImageFrame` for the RDD-of-features analogue."""
 
     def __init__(self, features: Sequence[ImageFeature]):
         self.features = list(features)
@@ -360,3 +499,116 @@ class ImageFrame:
 
         self.transform(ImageFrameToSample())
         return SampleDataSet(self.to_samples(), batch_size)
+
+
+class DistributedImageFrame(ImageFrame):
+    """«bigdl» DistributedImageFrame — the RDD-of-ImageFeatures variant.
+
+    TPU-native mapping: each PROCESS holds only its own shard of the
+    file list / array list (the reference's executors cache their RDD
+    partition); transforms run on the local shard, and ``to_dataset``
+    yields per-process batch slices that DistriOptimizer assembles into
+    global device arrays via ``jax.make_array_from_process_local_data``
+    — no host ever materialises the full epoch.
+
+    ``read`` shards a global list of paths/arrays round-robin by
+    ``process_id``; pass explicit ``process_id``/``num_processes`` for
+    tests, defaults read ``jax.process_index()/process_count()``.
+    """
+
+    def __init__(self, features: Sequence[ImageFeature],
+                 process_id: Optional[int] = None,
+                 num_processes: Optional[int] = None,
+                 global_size: Optional[int] = None):
+        """``features`` is THIS process's local shard.  ``global_size``
+        (total across processes) coordinates the per-epoch batch count
+        so unequal shards never desynchronise the collective; when
+        omitted it is estimated as balanced (shard * nproc)."""
+        super().__init__(features)
+        pid, nproc = self._world(process_id, num_processes)
+        self._pid = pid
+        self._nproc = nproc
+        self._global_n = global_size if global_size is not None \
+            else len(self.features) * nproc
+
+    @staticmethod
+    def _world(process_id, num_processes):
+        if process_id is not None and num_processes is not None:
+            return process_id, num_processes
+        import jax
+
+        return jax.process_index(), jax.process_count()
+
+    @staticmethod
+    def read(arrays, labels=None, process_id: Optional[int] = None,
+             num_processes: Optional[int] = None):
+        """Shard a GLOBAL list of paths/arrays: this process keeps
+        every ``num_processes``-th entry starting at ``process_id``
+        (deterministic, balanced like the reference's coalesce)."""
+        pid, nproc = DistributedImageFrame._world(process_id, num_processes)
+        feats = []
+        for i in range(pid, len(arrays), nproc):
+            a = arrays[i]
+            if isinstance(a, str):
+                from PIL import Image
+
+                a = np.asarray(Image.open(a).convert("RGB"))
+            feats.append(
+                ImageFeature(a, None if labels is None else labels[i])
+            )
+        return DistributedImageFrame(feats, process_id=pid,
+                                     num_processes=nproc,
+                                     global_size=len(arrays))
+
+    def to_dataset(self, batch_size: int = 32):
+        """Per-process dataset over the local shard: yields this
+        process's slice of every global batch (the iterator contract
+        DistriOptimizer's multi-host path expects).  Every process
+        yields the SAME number of batches (derived from global_size),
+        so unequal shards cannot desynchronise the collective."""
+        self.transform(ImageFrameToSample())
+        samples = self.to_samples()
+        feats = np.stack([np.asarray(s.features) for s in samples])
+        labels = np.stack(
+            [np.asarray(s.labels).reshape(-1)[0] for s in samples])
+        return _LocalShardDataSet(feats, labels, batch_size,
+                                  num_processes=self._nproc,
+                                  global_size=self._global_n)
+
+
+class _LocalShardDataSet:
+    """Dataset over an ALREADY-SHARDED local slice: yields local
+    sub-batches directly (the shard was taken at read time), flagged
+    ``per_process`` so DistriOptimizer uses
+    ``make_array_from_process_local_data``.  The per-epoch batch count
+    comes from the GLOBAL minimum shard size (global_size // nproc) —
+    identical on every process, so no process is left waiting inside a
+    collective while another's iterator is exhausted."""
+
+    per_process = True
+
+    def __init__(self, features, labels, batch_size: int = 32,
+                 shuffle: bool = True, num_processes: int = 1,
+                 global_size: Optional[int] = None):
+        self.features = np.asarray(features)
+        self.labels = np.asarray(labels)
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self._n = len(self.features)
+        self._nproc = max(1, num_processes)
+        self._global_n = global_size if global_size is not None \
+            else self._n * self._nproc
+
+    def size(self):
+        return self._global_n
+
+    def data(self, train: bool = True):
+        local_bs = max(1, self.batch_size // self._nproc)
+        min_shard = self._global_n // self._nproc
+        n_batches = min_shard // local_bs
+        order = np.arange(self._n)
+        if train and self.shuffle:
+            order = RandomGenerator.RNG.randperm(self._n)
+        for b in range(n_batches):
+            sel = order[b * local_bs:(b + 1) * local_bs]
+            yield self.features[sel], self.labels[sel]

@@ -169,3 +169,47 @@ def test_dl_classifier_spark_partition_streamed():
     preds = np.asarray(out["prediction"], np.float32)
     acc = float(np.mean(preds == labels))
     assert acc > 0.9, f"accuracy {acc}"
+
+
+# ---------------------------------------------------------------------------
+# VERDICT r3 item 10: activation path for a REAL SparkSession the day
+# pyspark lands in the image — importorskip-gated end-to-end fit/transform
+# ---------------------------------------------------------------------------
+
+
+def test_dl_estimator_on_real_spark_dataframe():
+    pyspark = pytest.importorskip("pyspark")
+    from pyspark.sql import SparkSession
+
+    from bigdl_tpu.dlframes import DLClassifier
+    from bigdl_tpu.nn import (
+        ClassNLLCriterion, Linear, LogSoftMax, ReLU, Sequential,
+    )
+
+    spark = SparkSession.builder.master("local[2]") \
+        .appName("bigdl_tpu-dlframes-it").getOrCreate()
+    try:
+        rs = np.random.RandomState(30)
+        n, d, k = 256, 8, 3
+        w = rs.randn(d, k)
+        x = rs.randn(n, d).astype(np.float32)
+        y = (np.argmax(x @ w, axis=1) + 1).astype(float)
+        df = spark.createDataFrame(
+            [(list(map(float, row)), float(lab)) for row, lab in zip(x, y)],
+            ["features", "label"],
+        ).repartition(4)
+
+        model = Sequential().add(Linear(d, 16)).add(ReLU()) \
+            .add(Linear(16, k)).add(LogSoftMax())
+        est = DLClassifier(model, ClassNLLCriterion(), [d]) \
+            .set_batch_size(64).set_max_epoch(12)
+        fitted = est.fit(df)
+        # transform over a spark DF yields a pandas frame (predictions
+        # are a host-side product — dl_estimator._with_column)
+        out = fitted.transform(df)
+        acc = float(np.mean(
+            np.asarray(out["label"], float)
+            == np.asarray(out["prediction"], float)))
+        assert acc > 0.85, f"spark fit/transform accuracy {acc}"
+    finally:
+        spark.stop()

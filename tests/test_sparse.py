@@ -92,3 +92,135 @@ def test_wide_and_deep_shape():
     out = np.asarray(wide.forward(SparseTensor.from_dense(wide_in))) + \
         np.asarray(deep.forward(ids))
     assert out.shape == (4, 8)
+
+
+# ---------------------------------------------------------------------------
+# VERDICT r3 item 6: SparseTensorMath/BLAS surface + wide-and-deep
+# ---------------------------------------------------------------------------
+
+
+def _rand_sparse(rs, m, k, density=0.3):
+    d = rs.randn(m, k).astype(np.float32)
+    d[rs.rand(m, k) > density] = 0.0
+    return SparseTensor.from_dense(d), d
+
+
+def test_sparse_tensor_math_blas_surface():
+    from bigdl_tpu.nn.sparse import SparseTensorMath as STM
+
+    rs = np.random.RandomState(5)
+    sp, d = _rand_sparse(rs, 6, 10)
+    B = rs.randn(10, 4).astype(np.float32)
+    v = rs.randn(10).astype(np.float32)
+    M = rs.randn(6, 4).astype(np.float32)
+    y = rs.randn(6).astype(np.float32)
+
+    np.testing.assert_allclose(np.asarray(STM.mm(sp, B)), d @ B,
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(STM.addmm(0.5, M, 2.0, sp, B)), 0.5 * M + 2.0 * (d @ B),
+        rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(STM.mv(sp, v)), d @ v,
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(STM.addmv(0.3, y, 1.5, sp, v)), 0.3 * y + 1.5 * (d @ v),
+        rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(STM.vdot(sp, d)), (d * d).sum(),
+                               rtol=1e-5)
+
+
+def test_sparse_tensor_narrow_concat_t_add_mul():
+    rs = np.random.RandomState(6)
+    sp, d = _rand_sparse(rs, 5, 8)
+    # narrow along cols
+    nar = sp.narrow(1, 2, 4)
+    np.testing.assert_allclose(np.asarray(nar.to_dense()), d[:, 2:6])
+    # narrow along rows
+    nar0 = sp.narrow(0, 1, 3)
+    np.testing.assert_allclose(np.asarray(nar0.to_dense()), d[1:4])
+    # concat
+    sp2, d2 = _rand_sparse(rs, 5, 3)
+    cat = SparseTensor.concat(1, [sp, sp2])
+    np.testing.assert_allclose(np.asarray(cat.to_dense()),
+                               np.concatenate([d, d2], 1))
+    # transpose / scalar mul / sparse add
+    np.testing.assert_allclose(np.asarray(sp.t().to_dense()), d.T)
+    np.testing.assert_allclose(np.asarray(sp.mul(2.5).to_dense()), d * 2.5)
+    np.testing.assert_allclose(np.asarray(sp.add(sp).to_dense()), 2 * d)
+
+
+def test_lookup_table_sparse_padded_path_matches_coo():
+    """The padded dense encoding (to_padded) must compute exactly what
+    the COO path computes — all three combiners, with weights."""
+    rs = np.random.RandomState(7)
+    B, V, D, S = 4, 30, 6, 5
+    rows = np.repeat(np.arange(B), 3)
+    ids = rs.randint(1, V + 1, B * 3).astype(np.float32)
+    wts = rs.rand(B * 3).astype(np.float32) + 0.1
+    id_sp = SparseTensor(np.stack([rows, np.arange(B * 3) % S], 1), ids,
+                         (B, S))
+    wt_sp = SparseTensor(np.stack([rows, np.arange(B * 3) % S], 1), wts,
+                         (B, S))
+    for combiner in ("sum", "mean", "sqrtn"):
+        mod = LookupTableSparse(V, D, combiner=combiner)
+        coo = np.asarray(mod.forward((id_sp, wt_sp)))
+        # padded encoding: S slots, ids already 1-based
+        ids_pad = np.zeros((B, S), np.float32)
+        wts_pad = np.zeros((B, S), np.float32)
+        fill = np.zeros(B, int)
+        for r, i, w in zip(rows, ids, wts):
+            ids_pad[r, fill[r]] = i
+            wts_pad[r, fill[r]] = w
+            fill[r] += 1
+        padded = np.asarray(mod.forward((ids_pad, wts_pad)))
+        np.testing.assert_allclose(padded, coo, rtol=1e-5, atol=1e-5,
+                                   err_msg=combiner)
+
+
+@pytest.mark.slow
+def test_wide_and_deep_trains_under_distri_optimizer():
+    """VERDICT r3 item 6 'done' gate: a wide-and-deep model (sparse
+    wide embedding-bag + deep embeddings) training under the REAL
+    sharded DistriOptimizer step on the 8-device mesh."""
+    import jax
+
+    from bigdl_tpu.engine import Engine
+    from bigdl_tpu.models import build_wide_and_deep, pack_batch
+    from bigdl_tpu.nn import ClassNLLCriterion
+    from bigdl_tpu.optim import DistriOptimizer, SGD, Top1Accuracy, Trigger
+    from bigdl_tpu.optim.evaluator import evaluate_dataset
+    from bigdl_tpu.dataset import ArrayDataSet
+
+    Engine.reset()
+    Engine.init()
+    try:
+        rs = np.random.RandomState(8)
+        B, WV, slots, n = 64, 50, 6, 512
+        deep_vocabs = [8, 12]
+        # synthetic task: label decided by one wide cross-feature and
+        # one deep categorical
+        wide_cols = rs.randint(0, WV, (n, 3))
+        rows = np.repeat(np.arange(n), 3)
+        sp = SparseTensor(
+            np.stack([rows, wide_cols.reshape(-1)], 1),
+            np.ones(n * 3, np.float32), (n, WV))
+        deep = np.stack([rs.randint(1, 9, n), rs.randint(1, 13, n)], 1)
+        # OR of one wide and one deep signal: expressible by the
+        # additive wide+deep sum (XOR would not be)
+        y = (((wide_cols[:, 0] > WV // 2).astype(int)
+              | (deep[:, 0] > 4).astype(int)) + 1).astype(np.float32)
+        x = pack_batch(sp, deep, slots)
+
+        model = build_wide_and_deep(WV, deep_vocabs, class_num=2,
+                                    wide_slots=slots)
+        opt = DistriOptimizer(model, (x, y), ClassNLLCriterion(),
+                              batch_size=B)
+        opt.set_optim_method(SGD(learningrate=1.0))
+        opt.set_end_when(Trigger.max_epoch(40))
+        trained = opt.optimize()
+        (acc,) = evaluate_dataset(trained, ArrayDataSet(x, y, B),
+                                  [Top1Accuracy()])
+        value, _ = acc.result()
+        assert value > 0.9, f"wide-and-deep accuracy {value}"
+    finally:
+        Engine.reset()

@@ -65,3 +65,135 @@ def test_color_jitter_runs():
     f = ImageFeature(_img(6, 6).astype(np.float32))
     ColorJitter().transform(f)
     assert f.image.shape == (6, 6, 3)
+
+
+# ---------------------------------------------------------------------------
+# VERDICT r3 item 7: detection-era transforms + distributed ImageFrame
+# ---------------------------------------------------------------------------
+
+
+def test_hue_identity_and_rotation():
+    from bigdl_tpu.transform.vision import Hue, ImageFeature
+
+    rs = np.random.RandomState(20)
+    img = rs.rand(6, 5, 3).astype(np.float32)
+    # delta 0 must reproduce the image exactly (HSV round-trip)
+    f = Hue(0.0, 0.0).transform(ImageFeature(img.copy()))
+    np.testing.assert_allclose(f.image, img, rtol=1e-4, atol=1e-5)
+    # a 360-degree rotation is also identity
+    f = Hue(360.0, 360.0).transform(ImageFeature(img.copy()))
+    np.testing.assert_allclose(f.image, img, rtol=1e-4, atol=1e-4)
+    # a nonzero rotation changes hue but preserves value (max channel)
+    f = Hue(90.0, 90.0).transform(ImageFeature(img.copy()))
+    np.testing.assert_allclose(f.image.max(-1), img.max(-1),
+                               rtol=1e-4, atol=1e-5)
+    assert not np.allclose(f.image, img)
+
+
+def test_expand_places_image_on_mean_canvas():
+    from bigdl_tpu.common import RandomGenerator
+    from bigdl_tpu.transform.vision import Expand, ImageFeature
+
+    RandomGenerator.RNG.set_seed(4)
+    img = np.full((4, 4, 3), 200.0, np.float32)
+    f = Expand(10.0, 20.0, 30.0, 2.0, 2.0).transform(ImageFeature(img))
+    out = f.image
+    assert out.shape == (8, 8, 3)
+    # exactly 16 pixels carry the image; the rest are the channel means
+    hits = (out == 200.0).all(-1).sum()
+    assert hits == 16
+    means_px = (out == np.array([10.0, 20.0, 30.0], np.float32)).all(-1)
+    assert means_px.sum() == 64 - 16
+
+
+def test_fixed_crop_normalized_and_absolute():
+    from bigdl_tpu.transform.vision import FixedCrop, ImageFeature
+
+    img = np.arange(8 * 10 * 3, dtype=np.float32).reshape(8, 10, 3)
+    f = FixedCrop(0.2, 0.25, 0.7, 0.75).transform(ImageFeature(img.copy()))
+    np.testing.assert_allclose(f.image, img[2:6, 2:7])
+    f = FixedCrop(1, 2, 5, 6, normalized=False).transform(
+        ImageFeature(img.copy()))
+    np.testing.assert_allclose(f.image, img[2:6, 1:5])
+
+
+def test_random_aspect_scale_and_channel_order():
+    from bigdl_tpu.common import RandomGenerator
+    from bigdl_tpu.transform.vision import (
+        ChannelOrder, ImageFeature, RandomAspectScale,
+    )
+
+    RandomGenerator.RNG.set_seed(5)
+    img = np.random.RandomState(21).rand(20, 30, 3).astype(np.float32)
+    f = RandomAspectScale([10], max_size=100).transform(
+        ImageFeature(img.copy()))
+    assert min(f.image.shape[:2]) == 10
+    assert f.image.shape[1] == 15  # aspect preserved: 30 * (10/20)
+
+    f2 = ChannelOrder().transform(ImageFeature(img.copy()))
+    np.testing.assert_allclose(f2.image, img[..., ::-1])
+
+
+def test_random_transformer_gates_inner():
+    from bigdl_tpu.common import RandomGenerator
+    from bigdl_tpu.transform.vision import (
+        HFlip, ImageFeature, RandomTransformer,
+    )
+
+    img = np.arange(12, dtype=np.float32).reshape(2, 2, 3)
+    RandomGenerator.RNG.set_seed(6)
+    applied = 0
+    for _ in range(50):
+        f = RandomTransformer(HFlip(), 0.5).transform(
+            ImageFeature(img.copy()))
+        if not np.allclose(f.image, img):
+            applied += 1
+    assert 10 < applied < 40  # ~Bernoulli(0.5)
+
+
+def test_distributed_image_frame_shards_and_feeds_distri():
+    """Two virtual processes each read their shard; the per-process
+    dataset yields local slices DistriOptimizer can assemble."""
+    from bigdl_tpu.transform.vision import (
+        ChannelNormalize, DistributedImageFrame, MatToTensor,
+    )
+
+    rs = np.random.RandomState(22)
+    arrays = [rs.rand(6, 6, 3).astype(np.float32) for _ in range(10)]
+    labels = list((np.arange(10) % 2 + 1).astype(np.float32))
+
+    shard0 = DistributedImageFrame.read(arrays, labels, process_id=0,
+                                        num_processes=2)
+    shard1 = DistributedImageFrame.read(arrays, labels, process_id=1,
+                                        num_processes=2)
+    assert len(shard0) == 5 and len(shard1) == 5
+    # shards are disjoint and together cover the global list
+    tf = ChannelNormalize(0.5, 0.5, 0.5) >> MatToTensor()
+    shard0.transform(tf)
+    shard1.transform(tf)
+    ds = shard0.to_dataset(batch_size=4)
+    assert getattr(ds, "per_process", False)
+    batches = list(ds.data(train=False))
+    assert batches, "no batches yielded"
+    xb, yb = batches[0]
+    # 2-process world: each yields its batch_size // nproc = 2 rows
+    assert xb.shape == (2, 3, 6, 6)
+    assert set(np.asarray(yb)) <= {1.0, 2.0}
+
+
+def test_distributed_image_frame_unequal_shards_stay_synchronised():
+    """11 images over 2 processes (shards 6 and 5): both processes must
+    yield the SAME number of batches or the multi-host collective
+    deadlocks waiting on the shorter iterator."""
+    from bigdl_tpu.transform.vision import DistributedImageFrame
+
+    rs = np.random.RandomState(23)
+    arrays = [rs.rand(4, 4, 3).astype(np.float32) for _ in range(11)]
+    labels = list(np.ones(11, np.float32))
+    counts = []
+    for pid in (0, 1):
+        shard = DistributedImageFrame.read(arrays, labels, process_id=pid,
+                                           num_processes=2)
+        ds = shard.to_dataset(batch_size=4)
+        counts.append(len(list(ds.data(train=False))))
+    assert counts[0] == counts[1] > 0, counts
