@@ -705,8 +705,13 @@ class TensorflowLoader:
             ks = nd.attr("ksize").ints
             strides = nd.attr("strides").ints
             padding = nd.attr("padding").s
-            kh, kw = ks[1], ks[2]
-            sh, sw = strides[1], strides[2]
+            fmt = nd.attr("data_format")
+            if fmt and fmt.s == "NCHW":
+                kh, kw = ks[2], ks[3]
+                sh, sw = strides[2], strides[3]
+            else:
+                kh, kw = ks[1], ks[2]
+                sh, sw = strides[1], strides[2]
             pad = -1 if padding == "SAME" else 0
             if op == "MaxPool":
                 mod = L.SpatialMaxPooling(kw, kh, sw, sh, pad, pad)
@@ -1295,6 +1300,87 @@ class TensorflowSaver:
                 b.const(nm + "/axis", np.asarray(m.dimension - 1, np.int32))
                 names[node.id] = b.op(nm, "ConcatV2", prev + [nm + "/axis"],
                                       N=b.attr_ints([len(prev)]))
+                continue
+            if isinstance(m, L.SpatialConvolution) \
+                    and type(m) is L.SpatialConvolution:
+                # NCHW Conv2D; loader reads HWIO weights.  VALID for
+                # pad 0, SAME when the pad is the stride-1 half-kernel
+                if m.n_group != 1:
+                    raise TFConversionException(
+                        "TensorflowSaver: grouped conv unsupported")
+                if m.pad_w == m.pad_h == 0:
+                    padding = "VALID"
+                elif (m.stride_w == m.stride_h == 1
+                      and m.pad_w == (m.kernel_w - 1) // 2
+                      and m.pad_h == (m.kernel_h - 1) // 2):
+                    padding = "SAME"
+                else:
+                    raise TFConversionException(
+                        "TensorflowSaver: conv padding has no TF "
+                        "SAME/VALID equivalent")
+                w = np.asarray(m.weight)  # (O, I, kh, kw) -> HWIO
+                b.const(nm + "/w",
+                        np.ascontiguousarray(w.transpose(2, 3, 1, 0)))
+                out = b.op(nm, "Conv2D", [prev[0], nm + "/w"],
+                           strides=b.attr_ints(
+                               [1, 1, m.stride_h, m.stride_w]),
+                           padding=b.attr_s(padding),
+                           data_format=b.attr_s("NCHW"))
+                if m.with_bias and m.bias is not None:
+                    b.const(nm + "/b", np.asarray(m.bias))
+                    out = b.op(nm + "/bias", "BiasAdd", [out, nm + "/b"],
+                               data_format=b.attr_s("NCHW"))
+                names[node.id] = out
+                continue
+            if isinstance(m, (L.SpatialMaxPooling, L.SpatialAveragePooling)):
+                if getattr(m, "global_pooling", False):
+                    raise TFConversionException(
+                        "TensorflowSaver: global pooling unsupported")
+                if m.pad_w or m.pad_h:
+                    raise TFConversionException(
+                        "TensorflowSaver: padded pooling unsupported")
+                opn = "MaxPool" if isinstance(m, L.SpatialMaxPooling) \
+                    else "AvgPool"
+                names[node.id] = b.op(
+                    nm, opn, prev,
+                    ksize=b.attr_ints([1, 1, m.kh, m.kw]),
+                    strides=b.attr_ints([1, 1, m.dh, m.dw]),
+                    padding=b.attr_s("VALID"),
+                    data_format=b.attr_s("NCHW"))
+                continue
+            if isinstance(m, L.SpatialBatchNormalization) \
+                    and type(m) is L.SpatialBatchNormalization:
+                c = m.n_output
+                ones = np.ones(c, np.float32)
+                zeros = np.zeros(c, np.float32)
+                b.const(nm + "/scale",
+                        np.asarray(m.weight) if m.affine else ones)
+                b.const(nm + "/offset",
+                        np.asarray(m.bias) if m.affine else zeros)
+                b.const(nm + "/mean", np.asarray(m.running_mean))
+                b.const(nm + "/var", np.asarray(m.running_var))
+                names[node.id] = b.op(
+                    nm, "FusedBatchNorm",
+                    [prev[0], nm + "/scale", nm + "/offset",
+                     nm + "/mean", nm + "/var"],
+                    epsilon=b.attr_f(m.eps),
+                    data_format=b.attr_s("NCHW"))
+                continue
+            if isinstance(m, L.Reshape):
+                b.const(nm + "/shape",
+                        np.asarray([-1] + list(m.size), np.int32))
+                names[node.id] = b.op(nm, "Reshape",
+                                      [prev[0], nm + "/shape"])
+                continue
+            if isinstance(m, L.Squeeze):
+                attrs = {}
+                if m.dim is not None:
+                    attrs["squeeze_dims"] = b.attr_ints([m.dim - 1])
+                names[node.id] = b.op(nm, "Squeeze", prev, **attrs)
+                continue
+            if isinstance(m, L.Dropout) or type(m).__name__ == "Identity":
+                # frozen-inference semantics: dropout exports as identity
+                names[node.id] = b.op(nm, "Identity", prev)
                 continue
             raise TFConversionException(
                 f"TensorflowSaver: unsupported layer {type(m).__name__}"

@@ -1,0 +1,99 @@
+"""Wide & Deep recommendation — sparse cross features + deep embeddings.
+
+Reference analogue: the wide-and-deep recommendation path the sparse
+stack exists to serve (SURVEY.md §2.1 "Sparse tensor": SparseLinear /
+LookupTableSparse feed this family).  With no corpus on disk this
+builds a synthetic tabular dataset: each sample carries a handful of
+active wide cross-features (COO, packed to the fixed-slot encoding via
+``SparseTensor.to_padded``) plus categorical deep columns; the label
+mixes a memorization signal (one wide cross) with a generalization
+signal (a deep-column interaction) — the textbook reason the two
+towers are summed.
+
+    python examples/recommendation/wide_and_deep_train.py --max-epoch 20
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+)
+
+log = logging.getLogger("wide_and_deep")
+
+
+def synthetic_tabular(n=4096, wide_vocab=200, deep_vocabs=(12, 20, 8),
+                      wide_active=4, seed=0):
+    from bigdl_tpu.nn import SparseTensor
+
+    rs = np.random.RandomState(seed)
+    cols = rs.randint(0, wide_vocab, (n, wide_active))
+    rows = np.repeat(np.arange(n), wide_active)
+    sp = SparseTensor(
+        np.stack([rows, cols.reshape(-1)], 1),
+        np.ones(n * wide_active, np.float32), (n, wide_vocab))
+    deep = np.stack(
+        [rs.randint(1, v + 1, n) for v in deep_vocabs], axis=1)
+    # label: wide memorization OR deep generalization
+    y = (((cols[:, 0] > wide_vocab // 2).astype(int)
+          | (deep[:, 0] > deep_vocabs[0] // 2).astype(int)) + 1
+         ).astype(np.float32)
+    return sp, deep, y
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("-b", "--batch-size", type=int, default=128)
+    p.add_argument("-e", "--max-epoch", type=int, default=20)
+    p.add_argument("--learning-rate", type=float, default=1.0)
+    p.add_argument("--wide-vocab", type=int, default=200)
+    p.add_argument("--wide-slots", type=int, default=8)
+    p.add_argument("--distributed", action="store_true",
+                   help="DistriOptimizer over the Engine mesh")
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    from bigdl_tpu.models import build_wide_and_deep, pack_batch
+    from bigdl_tpu.nn import ClassNLLCriterion
+    from bigdl_tpu.optim import SGD, Top1Accuracy, Trigger
+    from bigdl_tpu.optim.evaluator import evaluate_dataset
+    from bigdl_tpu.dataset import ArrayDataSet
+
+    deep_vocabs = (12, 20, 8)
+    sp, deep, y = synthetic_tabular(wide_vocab=args.wide_vocab,
+                                    deep_vocabs=deep_vocabs)
+    x = pack_batch(sp, deep, args.wide_slots)
+    model = build_wide_and_deep(args.wide_vocab, deep_vocabs, class_num=2,
+                                wide_slots=args.wide_slots)
+
+    if args.distributed:
+        from bigdl_tpu.engine import Engine
+        from bigdl_tpu.optim import DistriOptimizer
+
+        Engine.init()
+        opt = DistriOptimizer(model, (x, y), ClassNLLCriterion(),
+                              batch_size=args.batch_size)
+    else:
+        from bigdl_tpu.optim.optimizer import LocalOptimizer
+
+        opt = LocalOptimizer(model, (x, y), ClassNLLCriterion(),
+                             batch_size=args.batch_size)
+    opt.set_optim_method(SGD(learningrate=args.learning_rate))
+    opt.set_end_when(Trigger.max_epoch(args.max_epoch))
+    trained = opt.optimize()
+
+    (acc,) = evaluate_dataset(trained, ArrayDataSet(x, y, args.batch_size),
+                              [Top1Accuracy()])
+    value, _ = acc.result()
+    log.info("train-set Top1Accuracy: %.4f", value)
+    return value
+
+
+if __name__ == "__main__":
+    main()

@@ -54,3 +54,11 @@ def test_ncf_recommendation_example():
 
     hr, ndcg = main(["-e", "4"])
     assert hr > 0.15  # well above the 0.10 random HitRatio@10
+
+
+@pytest.mark.slow
+def test_wide_and_deep_recommendation_example():
+    from examples.recommendation.wide_and_deep_train import main
+
+    acc = main(["-e", "12", "--learning-rate", "1.0"])
+    assert acc > 0.85, f"wide-and-deep example accuracy {acc}"

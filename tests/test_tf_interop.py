@@ -627,3 +627,37 @@ def test_split_negative_axis():
     o0, o1 = model.forward(x)
     np.testing.assert_allclose(np.asarray(o0), x[..., :4], rtol=1e-6)
     np.testing.assert_allclose(np.asarray(o1), x[..., 4:], rtol=1e-6)
+
+
+def test_saver_cnn_roundtrip(tmp_path):
+    """Saver breadth (reference TensorflowSaver covered the conv
+    vocabulary): conv+BN+relu+pool+reshape+linear exports to a frozen
+    GraphDef and reloads with output parity."""
+    from bigdl_tpu.nn import layers as L
+    from bigdl_tpu.nn.graph import Graph, Input
+
+    rs = np.random.RandomState(16)
+    inp = Input("img")
+    conv = L.SpatialConvolution(2, 4, 3, 3, 1, 1, 1, 1)
+    conv.set_name("c1")
+    h = conv(inp)
+    bn = L.SpatialBatchNormalization(4)
+    bn.running_mean = bn.running_mean + 0.2
+    bn.running_var = bn.running_var * 1.5
+    bn.set_name("bn1")
+    h = bn(h)
+    h = L.ReLU().set_name("r1")(h)
+    h = L.SpatialMaxPooling(2, 2).set_name("p1")(h)
+    h = L.Reshape([4 * 3 * 3], batch_mode=True).set_name("flat")(h)
+    h = L.Linear(36, 5).set_name("fc")(h)
+    g = Graph(inp, h)
+    g.evaluate()
+
+    x = rs.randn(2, 2, 6, 6).astype(np.float32)
+    ref = np.asarray(g.forward(x))
+    path = tmp_path / "cnn.pb"
+    TensorflowSaver.save(g, str(path))
+    loaded = TensorflowLoader(path=str(path)).load()
+    loaded.evaluate()
+    np.testing.assert_allclose(np.asarray(loaded.forward(x)), ref,
+                               rtol=2e-3, atol=1e-4)
