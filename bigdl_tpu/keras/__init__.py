@@ -31,9 +31,10 @@ from bigdl_tpu.keras.layers import (
     TimeDistributedDense,
     ZeroPadding2D,
 )
-from bigdl_tpu.keras.models import Sequential
+from bigdl_tpu.keras.models import Model, Sequential
 
 __all__ = [
+    "Model",
     "Sequential", "KerasLayer", "InputLayer", "Dense", "Activation",
     "Dropout", "Flatten", "Reshape", "Permute", "RepeatVector",
     "Convolution2D", "MaxPooling2D", "AveragePooling2D", "ZeroPadding2D",

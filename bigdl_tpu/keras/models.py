@@ -192,3 +192,22 @@ class Sequential:
     def set_weights(self, weights):
         self.core.set_weights(weights)
         return self
+
+
+class Model(Sequential):
+    """keras.models.Model — the functional-API training surface over a
+    built :class:`bigdl_tpu.nn.Graph` (e.g. the converter's output for
+    functional JSON configs).  Inherits Sequential's compile/fit/
+    evaluate/predict verbs, which only touch ``self.core``; ``add`` is
+    disabled (the graph is already wired)."""
+
+    def __init__(self, core_graph):
+        super().__init__()
+        self.core = core_graph
+
+    def add(self, layer):
+        raise TypeError("Model wraps a finished Graph; use Sequential "
+                        "to build layer-by-layer")
+
+    def forward(self, x):
+        return self.core.forward(x)

@@ -1,0 +1,98 @@
+"""Per-lever microbench for the conv+BN epilogue-stats kernels.
+
+Times, for every distinct ResNet-50 (batch 128) conv+BN shape, the
+Pallas `conv_bn_stats` path against the unfused XLA pair (conv, then a
+separate stats reduction) — the per-lever evidence BASELINE.md's r04
+table predicts.  One JSON line per shape.
+
+    python scripts/fused_probe.py [batch]
+
+Runs on whatever the default backend is; on CPU the kernel drops to
+interpret mode, so real numbers need the chip.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def resnet50_conv_bn_shapes(img=224):
+    """(cin, cout, k, stride, h_in) for every conv feeding a BN."""
+    shapes = []
+    h = img // 4  # post stem+pool: 56
+    cin = 64
+    for w, n, stride in [(64, 3, 1), (128, 4, 2), (256, 6, 2), (512, 3, 2)]:
+        for i in range(n):
+            st = stride if i == 0 else 1
+            shapes.append((cin, w, 1, 1, h))
+            h2 = (h + 2 * 0 - 1) // st + 1 if st > 1 else h
+            shapes.append((w, w, 3, st, h))
+            shapes.append((w, w * 4, 1, 1, h2))
+            if i == 0:
+                shapes.append((cin, w * 4, 1, st, h))
+            h = h2
+            cin = w * 4
+    # dedupe preserving order
+    seen, out = set(), []
+    for s in shapes:
+        if s not in seen:
+            seen.add(s)
+            out.append(s)
+    return out
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.ops.conv_bn import _reference, conv_bn_stats
+
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+    dev = jax.devices()[0]
+    print(json.dumps({"device": dev.device_kind, "batch": batch}),
+          flush=True)
+
+    def timeit(fn, *args):
+        out = fn(*args)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(5):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / 5
+
+    for cin, cout, k, stride, h in resnet50_conv_bn_shapes():
+        pad = (k - 1) // 2
+        rs = np.random.RandomState(0)
+        x = jnp.asarray(rs.randn(batch, cin, h, h).astype(np.float32)
+                        ).astype(jnp.bfloat16)
+        w = jnp.asarray((rs.randn(cout, cin, k, k) * 0.1).astype(np.float32)
+                        ).astype(jnp.bfloat16)
+        shift = jnp.asarray(rs.randn(cout).astype(np.float32) * 0.01)
+        try:
+            fused = jax.jit(lambda a, b, s: conv_bn_stats(
+                a, b, s, stride=stride, pad=pad))
+            unfused = jax.jit(lambda a, b, s: _reference(
+                a, b, s, stride, pad))
+            tf_ = timeit(fused, x, w, shift)
+            tu = timeit(unfused, x, w, shift)
+            print(json.dumps({
+                "shape": f"{cin}->{cout} k{k}/s{stride} @{h}",
+                "fused_ms": round(tf_ * 1e3, 3),
+                "unfused_ms": round(tu * 1e3, 3),
+                "speedup": round(tu / tf_, 3),
+            }), flush=True)
+        except Exception as e:
+            print(json.dumps({
+                "shape": f"{cin}->{cout} k{k}/s{stride} @{h}",
+                "error": f"{type(e).__name__}: {str(e)[:200]}",
+            }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

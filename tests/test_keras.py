@@ -123,3 +123,46 @@ def test_summary_runs():
     m.add(Dense(4, input_shape=(2,)))
     s = m.summary()
     assert "Total params" in s
+
+
+def test_functional_model_wrapper_trains():
+    """keras.models.Model: the functional training surface over a
+    converted Graph (same compile/fit/predict verbs as Sequential)."""
+    import json as _json
+
+    from bigdl_tpu.keras import Model
+    from bigdl_tpu.keras.converter import model_from_json
+
+    spec = _json.dumps({
+        "class_name": "Model",
+        "config": {
+            "layers": [
+                {"class_name": "InputLayer", "name": "in", "config": {
+                    "name": "in", "batch_input_shape": [None, 8]}},
+                {"class_name": "Dense", "name": "h", "config": {
+                    "name": "h", "output_dim": 16, "activation": "relu"},
+                 "inbound_nodes": [[["in", 0, 0]]]},
+                {"class_name": "Dense", "name": "out", "config": {
+                    "name": "out", "output_dim": 3,
+                    "activation": "log_softmax"},
+                 "inbound_nodes": [[["h", 0, 0]]]},
+            ],
+            "output_layers": [["out", 0, 0]],
+        },
+    })
+    graph = model_from_json(spec)
+    model = Model(graph)
+    rs = np.random.RandomState(40)
+    x = rs.randn(256, 8).astype(np.float32)
+    w = rs.randn(8, 3)
+    y = (np.argmax(x @ w, axis=1) + 1).astype(np.float32)
+    model.compile("sgd", "sparse_categorical_crossentropy")
+    model._optim_method.learningrate = 0.5
+    model.fit(x, y, batch_size=64, nb_epoch=10)
+    preds = model.predict_classes(x) + 1
+    acc = float(np.mean(preds == y))
+    assert acc > 0.9, acc
+    import pytest as _pytest
+
+    with _pytest.raises(TypeError):
+        model.add(None)
