@@ -143,13 +143,21 @@ class SampleDataSet(LocalDataSet):
 
 
 def iter_process_batches(n: int, batch_size: int, pid: int, nproc: int,
-                         shuffle: bool):
+                         shuffle: bool, pad_tail: bool = False):
     """The per-process batch-slicing contract shared by every
     distributed dataset: derive the SAME global epoch permutation on
     every process (seeded global RNG), then yield this process's
     contiguous ``batch_size // nproc`` index slice of each full global
     batch.  DistriOptimizer assembles the global device array from
-    these shards via ``make_array_from_process_local_data``."""
+    these shards via ``make_array_from_process_local_data``.
+
+    ``pad_tail``: also yield the final partial global batch, its index
+    list repeat-padded to the process multiple (the reference's
+    SampleToMiniBatch padding — the repeated sample is counted, exactly
+    as the reference counts its pad copies).  Every process yields the
+    same tail length, so the trainer's local divisor padding stays
+    consistent across hosts.  Off (historical drop-the-tail) for eval
+    iteration, where repeated rows would distort metric counts."""
     if batch_size % nproc:
         raise ValueError(
             f"global batch {batch_size} not divisible by {nproc} processes"
@@ -159,6 +167,15 @@ def iter_process_batches(n: int, batch_size: int, pid: int, nproc: int,
     for b in range(n // batch_size):
         globl = idx[b * batch_size: (b + 1) * batch_size]
         yield globl[pid * local: (pid + 1) * local]
+    rem = n % batch_size
+    if pad_tail and rem:
+        tail = idx[n - rem:]
+        pad_to = -(-rem // nproc) * nproc
+        if pad_to != rem:
+            tail = np.concatenate(
+                [tail, np.repeat(tail[-1:], pad_to - rem)])
+        local_t = pad_to // nproc
+        yield tail[pid * local_t: (pid + 1) * local_t]
 
 
 class DistributedDataSet(ArrayDataSet):
@@ -198,7 +215,7 @@ class DistributedDataSet(ArrayDataSet):
         pid, nproc = self._world()
         for mine in iter_process_batches(
             self._n, self.batch_size, pid, nproc,
-            shuffle=train and self.shuffle,
+            shuffle=train and self.shuffle, pad_tail=train,
         ):
             if self._multi:
                 feats = tuple(f[mine] for f in self.features)

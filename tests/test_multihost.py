@@ -66,6 +66,22 @@ _WORKER = textwrap.dedent(
     value, count = acc.result()
     assert count == 128, count  # global sample count, not the local 64
     print("VAL_ACC %.9f" % value, flush=True)
+
+    # ragged dataset (134 = 4*32 + 6): the per-process iterator must
+    # repeat-pad the tail to the process multiple and the trainer's
+    # masked step must pad the local slice to the device multiple —
+    # both processes end bit-identical (VERDICT r3 items 5/7 seam)
+    RandomGenerator.RNG.set_seed(43)
+    x2 = rng.randn(134, 16).astype(np.float32)
+    y2 = (np.argmax(x2 @ w, axis=1) + 1).astype(np.float32)
+    m2 = Sequential().add(Linear(16, 32)).add(ReLU()) \\
+        .add(Linear(32, 4)).add(LogSoftMax())
+    ds2 = DistributedDataSet(x2, y2, 32, shuffle=False)
+    opt2 = DistriOptimizer(m2, ds2, ClassNLLCriterion(), batch_size=32)
+    opt2.set_optim_method(SGD(learningrate=0.5))
+    opt2.set_end_when(Trigger.max_epoch(2))
+    opt2.optimize()
+    print("RAGGED_LOSS %.9f" % opt2.state["loss"], flush=True)
     """
 )
 
@@ -114,6 +130,7 @@ def test_two_process_distri_fit_agrees(tmp_path):
         outs.append(out)
     losses = []
     accs = []
+    ragged = []
     for i, out in enumerate(outs):
         assert procs[i].returncode == 0, f"worker {i} failed:\n{out[-2000:]}"
         line = [l for l in out.splitlines() if l.startswith("FINAL_LOSS")]
@@ -122,7 +139,12 @@ def test_two_process_distri_fit_agrees(tmp_path):
         aline = [l for l in out.splitlines() if l.startswith("VAL_ACC")]
         assert aline, f"worker {i} printed no VAL_ACC:\n{out[-2000:]}"
         accs.append(aline[-1].split()[1])
+        rline = [l for l in out.splitlines() if l.startswith("RAGGED_LOSS")]
+        assert rline, f"worker {i} printed no RAGGED_LOSS:\n{out[-2000:]}"
+        ragged.append(rline[-1].split()[1])
     # both processes drive the same global computation: exact agreement
     assert losses[0] == losses[1], losses
     # every host reports the same GLOBAL validation accuracy
     assert accs[0] == accs[1], accs
+    # ragged tail (repeat-padded + masked) also agrees bit-for-bit
+    assert ragged[0] == ragged[1], ragged
