@@ -151,6 +151,39 @@ def _quantize_inplace(module: AbstractModule) -> AbstractModule:
         )
         q.set_name(module._name) if module._name else None
         return q
+    from bigdl_tpu.nn.fused import SpatialConvolutionBatchNorm
+
+    if isinstance(module, SpatialConvolutionBatchNorm):
+        # eval-mode BN folds into the conv: w' = w * scale_c,
+        # b' = offset_c with scale/offset from the running stats — then
+        # the folded conv quantizes like any other (the reference's
+        # quantized path likewise consumed inference-folded graphs)
+        jnp = _jnp()
+        import jax.lax as lax
+
+        inv = lax.rsqrt(module.running_var + module.eps)
+        scale = inv * module.bn_weight
+        offset = module.bn_bias - module.running_mean * scale
+        w = module.weight
+        if w.ndim == 2:
+            w = w[:, :, None, None]
+        w_folded = w * scale[:, None, None, None].astype(w.dtype)
+        pads = [(module.pad, module.pad), (module.pad, module.pad)]
+        q = QuantizedSpatialConvolution(
+            w_folded, jnp.asarray(offset),
+            (module.stride, module.stride), pads, 1, (1, 1),
+        )
+        if module.with_relu:
+            from bigdl_tpu.nn.layers import ReLU
+            from bigdl_tpu.nn.module import Sequential as _Seq
+
+            seq = _Seq().add(q).add(ReLU())
+            if module._name:
+                seq.set_name(module._name)
+            return seq
+        if module._name:
+            q.set_name(module._name)
+        return q
     if isinstance(module, Container):
         # rebuild children in place on the copied tree (graph containers
         # keep their wiring: node.module is swapped directly)

@@ -138,3 +138,36 @@ def test_quantize_dilated_pad_geometry_matches_float():
         np.random.RandomState(0).randn(1, 3, 12, 12).astype(np.float32))
     m.evaluate()
     assert quantize(m).forward(x).shape == m.forward(x).shape
+
+
+def test_quantize_fused_conv_bn_folds_stats():
+    """module.quantize() over a fuse_conv_bn'd model: the fused
+    conv+BN folds its running stats into int8 conv weights (+ ReLU
+    tail), staying close to the float eval output."""
+    from bigdl_tpu.nn import (
+        ReLU, Sequential, SpatialBatchNormalization, SpatialConvolution,
+        fuse_conv_bn,
+    )
+    from bigdl_tpu.nn.quantized import quantize
+    from bigdl_tpu.nn.layers import MsraFiller
+
+    rs = np.random.RandomState(31)
+    for kernel, pad, with_relu in [(1, 0, True), (3, 1, False)]:
+        conv = SpatialConvolution(8, 16, kernel, kernel, 1, 1, pad, pad,
+                                  with_bias=False,
+                                  init_method=MsraFiller(False))
+        bn = SpatialBatchNormalization(16)
+        bn.running_mean = bn.running_mean + 0.3
+        bn.running_var = bn.running_var * 2.0
+        m = Sequential().add(conv).add(bn)
+        if with_relu:
+            m.add(ReLU())
+        fuse_conv_bn(m)
+        m.evaluate()
+        x = rs.randn(2, 8, 10, 10).astype(np.float32)
+        ref = np.asarray(m.forward(x))
+        qm = quantize(m)
+        qm.evaluate()
+        got = np.asarray(qm.forward(x))
+        err = np.abs(got - ref).max() / (np.abs(ref).max() + 1e-9)
+        assert err < 0.1, f"kernel {kernel}: int8 rel err {err}"
