@@ -275,10 +275,71 @@ def model_from_json(json_str: str):
 
 def _sequential_from_config(layer_specs: List[dict]) -> KM.Sequential:
     model = KM.Sequential()
-    for ls in layer_specs:
+    start = 0
+    if layer_specs and layer_specs[0]["class_name"] == "Merge":
+        # keras-1.2.2 Sequential([Merge([left, right], mode=...), ...]):
+        # the branches are full sub-model configs; the merged table op
+        # heads the core and the model takes a TABLE of inputs
+        model = _merge_headed_sequential(layer_specs[0].get("config", {}))
+        start = 1
+    for ls in layer_specs[start:]:
         layer = _build_layer(ls["class_name"], ls.get("config", {}))
         if layer is not None:
             model.add(layer)
+    return model
+
+
+def _merge_headed_sequential(mcfg: dict) -> KM.Sequential:
+    from bigdl_tpu.nn import layers as KLY
+    from bigdl_tpu.nn import table_ops as T
+    from bigdl_tpu.nn.module import Sequential as CoreSeq
+
+    branches = []
+    for sub in mcfg.get("layers", []):
+        if sub.get("class_name") != "Sequential":
+            raise KerasConversionException(
+                "Merge branches must be Sequential sub-models")
+        branches.append(_sequential_from_config(sub["config"]))
+    if not branches:
+        raise KerasConversionException("Merge with no branch models")
+    mode = mcfg.get("mode", "concat")
+    shapes = [tuple(b._shape) for b in branches]
+
+    if mode == "concat":
+        axis = mcfg.get("concat_axis", -1)
+        if axis == -1:
+            axis = len(shapes[0])  # last non-batch dim, 1-based below
+        mod = T.JoinTable(dimension=axis + 1, n_input_dims=-1)
+        out_shape = list(shapes[0])
+        out_shape[axis - 1] = sum(s[axis - 1] for s in shapes)
+        out_shape = tuple(out_shape)
+    elif mode in ("sum", "ave", "max", "mul"):
+        if mode == "ave":
+            mod = CoreSeq().add(T.CAddTable()) \
+                .add(KLY.MulConstant(1.0 / len(branches)))
+        else:
+            mod = {"sum": T.CAddTable, "max": T.CMaxTable,
+                   "mul": T.CMulTable}[mode]()
+        out_shape = shapes[0]
+    elif mode in ("dot", "cos"):
+        if len(branches) != 2:
+            raise KerasConversionException(
+                f"Merge mode {mode} needs exactly 2 branches")
+        mod = T.DotProduct() if mode == "dot" else T.CosineDistance()
+        out_shape = (1,)
+    else:
+        raise KerasConversionException(f"Merge mode {mode}")
+
+    from bigdl_tpu.nn.table_ops import ParallelTable
+
+    pt = ParallelTable()
+    for b in branches:
+        pt.add(b.core)
+    model = KM.Sequential()
+    model.core.add(pt).add(mod)
+    model._shape = tuple(out_shape)
+    if mcfg.get("name"):
+        model.core.set_name(mcfg["name"])
     return model
 
 

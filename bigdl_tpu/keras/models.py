@@ -173,7 +173,9 @@ class Sequential:
     def predict(self, x, batch_size: int = 32):
         from bigdl_tpu.optim.evaluator import predict
 
-        return predict(self.core, np.asarray(x), batch_size)
+        if not isinstance(x, tuple):
+            x = np.asarray(x)  # tuples are table inputs, pass through
+        return predict(self.core, x, batch_size)
 
     def predict_classes(self, x, batch_size: int = 32):
         from bigdl_tpu.optim.evaluator import predict_class

@@ -163,13 +163,26 @@ def _allreduce_results(results, dataset):
 def predict(model, features, batch_size: int = 32, mesh="auto"):
     """Batched forward over an array of inputs; returns stacked host
     outputs (reference: model.predict).  With ``mesh``, each batch
-    shards ``P(data)`` over the devices."""
+    shards ``P(data)`` over the devices.  ``features`` may be a tuple/
+    list of arrays for table-input models (e.g. merged two-tower
+    graphs)."""
     import jax.numpy as jnp
 
     model.evaluate()
     fwd, divisor = _forward_fn(model, mesh=_resolve_mesh(mesh))
-    feats = np.asarray(features)
     outs = []
+    # a TUPLE is a table input (one array per graph input); a list stays
+    # the historical list-of-rows batch
+    if isinstance(features, tuple):
+        parts = [np.asarray(f) for f in features]
+        n = parts[0].shape[0]
+        for b in range(0, n, batch_size):
+            padded, true_bs = zip(*[
+                _pad_batch(p[b: b + batch_size], divisor) for p in parts])
+            out = fwd(tuple(jnp.asarray(p) for p in padded))
+            outs.append(np.asarray(out)[: true_bs[0]])
+        return np.concatenate(outs, axis=0)
+    feats = np.asarray(features)
     n = feats.shape[0]
     for b in range(0, n, batch_size):
         chunk, true_b = _pad_batch(feats[b : b + batch_size], divisor)

@@ -480,3 +480,60 @@ def test_stateful_recurrent_rejected():
     })
     with pytest.raises(KerasConversionException):
         model_from_json(spec)
+
+
+def test_sequential_embedded_merge():
+    """keras-1.2.2 Sequential([Merge([left, right], mode='concat'),
+    Dense]) — the classic two-tower pattern; takes a table of inputs."""
+    spec = json.dumps({
+        "class_name": "Sequential",
+        "config": [
+            {"class_name": "Merge", "config": {
+                "name": "mrg", "mode": "concat", "concat_axis": -1,
+                "layers": [
+                    {"class_name": "Sequential", "config": [
+                        {"class_name": "Dense", "config": {
+                            "name": "l1", "output_dim": 5,
+                            "batch_input_shape": [None, 4],
+                            "activation": "relu"}},
+                    ]},
+                    {"class_name": "Sequential", "config": [
+                        {"class_name": "Dense", "config": {
+                            "name": "r1", "output_dim": 7,
+                            "batch_input_shape": [None, 6]}},
+                    ]},
+                ]}},
+            {"class_name": "Dense", "config": {
+                "name": "head", "output_dim": 3,
+                "activation": "softmax"}},
+        ],
+    })
+    model = model_from_json(spec)
+    rs = np.random.RandomState(33)
+    xa = rs.randn(3, 4).astype(np.float32)
+    xb = rs.randn(3, 6).astype(np.float32)
+    out = np.asarray(model.predict((xa, xb)))
+    assert out.shape == (3, 3)
+    np.testing.assert_allclose(out.sum(1), 1.0, rtol=1e-4)
+
+    # sum mode requires equal branch widths
+    spec_sum = json.dumps({
+        "class_name": "Sequential",
+        "config": [
+            {"class_name": "Merge", "config": {
+                "mode": "sum",
+                "layers": [
+                    {"class_name": "Sequential", "config": [
+                        {"class_name": "Dense", "config": {
+                            "name": "a", "output_dim": 5,
+                            "batch_input_shape": [None, 4]}}]},
+                    {"class_name": "Sequential", "config": [
+                        {"class_name": "Dense", "config": {
+                            "name": "b", "output_dim": 5,
+                            "batch_input_shape": [None, 4]}}]},
+                ]}},
+        ],
+    })
+    m2 = model_from_json(spec_sum)
+    out2 = np.asarray(m2.predict((xa, xa)))
+    assert out2.shape == (3, 5)
