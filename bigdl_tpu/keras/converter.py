@@ -244,6 +244,23 @@ def _build_layer(class_name: str, cfg: dict) -> Optional[KL.KerasLayer]:
             name=name,
             **kw,
         )
+    if class_name == "Bidirectional":
+        inner_spec = cfg.get("layer", {})
+        inner = _build_layer(inner_spec.get("class_name"),
+                             inner_spec.get("config", {}))
+        return KL.Bidirectional(inner,
+                                merge_mode=cfg.get("merge_mode", "concat"),
+                                input_shape=input_shape, name=name)
+    if class_name == "GaussianNoise":
+        return KL.GaussianNoise(cfg.get("sigma", 0.1),
+                                input_shape=input_shape, name=name)
+    if class_name == "GaussianDropout":
+        return KL.GaussianDropout(cfg.get("p", 0.5),
+                                  input_shape=input_shape, name=name)
+    if class_name == "MaxoutDense":
+        return KL.MaxoutDense(cfg["output_dim"],
+                              nb_feature=cfg.get("nb_feature", 4),
+                              input_shape=input_shape, name=name)
     if class_name == "TimeDistributedDense":
         return KL.TimeDistributedDense(
             cfg["output_dim"], activation=cfg.get("activation"),
@@ -480,7 +497,21 @@ def _assign_weights(mod, lname, weight_names, arrays):
             if child.params():
                 mod = child
                 break
-    if isinstance(mod, (R.Recurrent, R.BiRecurrent)):
+    if isinstance(mod, R.BiRecurrent):
+        # keras Bidirectional saves forward_* then backward_* weights;
+        # positional fallback: first half forward, second half backward
+        pairs = list(zip(weight_names, arrays))
+        fw = [(n, a) for n, a in pairs if "backward" not in n.lower()]
+        bw = [(n, a) for n, a in pairs if "backward" in n.lower()]
+        if not bw:
+            half = len(pairs) // 2
+            fw, bw = pairs[:half], pairs[half:]
+        _assign_recurrent(mod.modules[0].modules[0], lname,
+                          [n for n, _ in fw], [a for _, a in fw])
+        _assign_recurrent(mod.modules[1].modules[0], lname,
+                          [n for n, _ in bw], [a for _, a in bw])
+        return
+    if isinstance(mod, R.Recurrent):
         cell = mod.modules[0]
         return _assign_recurrent(cell, lname, weight_names, arrays)
     if isinstance(mod, R.TimeDistributed):

@@ -714,9 +714,50 @@ class Masking(KerasLayer):
         return L.Masking(self.mask_value)
 
 
+class GaussianNoise(KerasLayer):
+    def __init__(self, sigma: float = 0.1, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.sigma = sigma
+
+    def build(self, input_shape):
+        from bigdl_tpu.nn.layers_extra import GaussianNoise as _GN
+
+        return _GN(self.sigma)
+
+
+class GaussianDropout(KerasLayer):
+    def __init__(self, p: float = 0.5, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.p = p
+
+    def build(self, input_shape):
+        from bigdl_tpu.nn.layers_extra import GaussianDropout as _GD
+
+        return _GD(self.p)
+
+
+class MaxoutDense(KerasLayer):
+    """keras.layers.MaxoutDense — max over nb_feature affine pieces."""
+
+    def __init__(self, output_dim: int, nb_feature: int = 4,
+                 input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.output_dim = output_dim
+        self.nb_feature = nb_feature
+
+    def build(self, input_shape):
+        from bigdl_tpu.nn.layers_extra import Maxout as _MX
+
+        return _MX(int(input_shape[-1]), self.output_dim, self.nb_feature)
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape[:-1]) + (self.output_dim,)
+
+
 __all__ += [
     "Convolution1D", "MaxPooling1D", "AveragePooling1D",
     "GlobalMaxPooling1D", "GlobalAveragePooling1D", "AtrousConvolution2D",
     "ZeroPadding1D", "ZeroPadding3D", "Cropping2D", "UpSampling2D",
     "LeakyReLU", "ELU", "ThresholdedReLU", "Masking",
+    "GaussianNoise", "GaussianDropout", "MaxoutDense",
 ]

@@ -537,3 +537,73 @@ def test_sequential_embedded_merge():
     m2 = model_from_json(spec_sum)
     out2 = np.asarray(m2.predict((xa, xa)))
     assert out2.shape == (3, 5)
+
+
+def test_bidirectional_noise_maxout_convert():
+    spec = json.dumps({
+        "class_name": "Sequential",
+        "config": [
+            {"class_name": "GaussianNoise", "config": {
+                "name": "gn", "sigma": 0.1,
+                "batch_input_shape": [None, 6, 5]}},
+            {"class_name": "Bidirectional", "config": {
+                "name": "bi", "merge_mode": "concat",
+                "layer": {"class_name": "LSTM", "config": {
+                    "name": "bl", "output_dim": 4,
+                    "return_sequences": False}}}},
+            {"class_name": "MaxoutDense", "config": {
+                "name": "mx", "output_dim": 3, "nb_feature": 2}},
+            {"class_name": "GaussianDropout", "config": {
+                "name": "gd", "p": 0.3}},
+        ],
+    })
+    model = model_from_json(spec)
+    x = np.random.RandomState(34).randn(2, 6, 5).astype(np.float32)
+    out = np.asarray(model.predict(x))
+    assert out.shape == (2, 3)
+
+
+def test_bidirectional_weight_import(tmp_path):
+    """Bidirectional LSTM HDF5 weights: forward_* / backward_* gate
+    tensors land in the right direction's cell, output matches a numpy
+    oracle running both directions."""
+    rs = np.random.RandomState(35)
+    D, H, T = 4, 3, 5
+    spec = json.dumps({
+        "class_name": "Sequential",
+        "config": [
+            {"class_name": "Bidirectional", "config": {
+                "name": "bi", "merge_mode": "concat",
+                "batch_input_shape": [None, T, D],
+                "layer": {"class_name": "LSTM", "config": {
+                    "name": "bl", "output_dim": H,
+                    "return_sequences": True}}}},
+        ],
+    })
+    gates = ("i", "c", "f", "o")
+    mk = lambda: ({g: (rs.randn(D, H) * 0.4).astype(np.float32)
+                   for g in gates},
+                  {g: (rs.randn(H, H) * 0.4).astype(np.float32)
+                   for g in gates},
+                  {g: (rs.randn(H) * 0.1).astype(np.float32)
+                   for g in gates})
+    fW, fU, fb = mk()
+    bW, bU, bb = mk()
+    weights = []
+    for pfx, (Ws, Us, bs) in (("forward", (fW, fU, fb)),
+                              ("backward", (bW, bU, bb))):
+        for g in gates:
+            weights += [(f"bi_{pfx}_W_{g}", Ws[g]),
+                        (f"bi_{pfx}_U_{g}", Us[g]),
+                        (f"bi_{pfx}_b_{g}", bs[g])]
+    path = tmp_path / "bi.h5"
+    _h5_write(path, [("bi", weights)])
+    model = model_from_json(spec)
+    load_weights_hdf5(model, str(path))
+
+    x = rs.randn(2, T, D).astype(np.float32)
+    got = np.asarray(model.predict(x))
+    fwd = _np_lstm_keras(x, fW, fU, fb)
+    bwd = _np_lstm_keras(x[:, ::-1], bW, bU, bb)[:, ::-1]
+    expect = np.concatenate([fwd, bwd], axis=-1)
+    np.testing.assert_allclose(got, expect, rtol=2e-3, atol=1e-4)
