@@ -1,0 +1,96 @@
+"""Fine-tune a frozen TensorFlow GraphDef (BigDLSession path).
+
+Reference analogue: the TF-interop examples (Module.loadTF + the
+BigDLSessionImpl training session, SURVEY.md §2.1 "TensorFlow
+interop").  With no model zoo on disk this script first EXPORTS a small
+frozen classifier GraphDef (TensorflowSaver), then imports it with
+``TFTrainingSession`` and fine-tunes it on a synthetic task under the
+chosen optimizer — gradients flow through every imported op.
+
+    python examples/tensorflow/finetune_frozen_graph.py --max-epoch 8
+"""
+
+import argparse
+import logging
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+)
+
+log = logging.getLogger("tf_finetune")
+
+
+def export_frozen_classifier(path, d, k, seed=0):
+    """Build + freeze a small MLP classifier as a GraphDef file."""
+    from bigdl_tpu.nn import layers as L
+    from bigdl_tpu.nn.graph import Graph, Input
+    from bigdl_tpu.utils.tf_interop import TensorflowSaver
+
+    rs = np.random.RandomState(seed)
+    inp = Input("x")
+    h = L.Linear(d, 32).set_name("fc1")(inp)
+    h = L.ReLU().set_name("relu1")(h)
+    h = L.Linear(32, k).set_name("fc2")(h)
+    h = L.LogSoftMax().set_name("logp")(h)
+    g = Graph(inp, h)
+    TensorflowSaver.save(g, path)
+    return path
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("-b", "--batch-size", type=int, default=64)
+    p.add_argument("-e", "--max-epoch", type=int, default=8)
+    p.add_argument("--learning-rate", type=float, default=0.5)
+    p.add_argument("--graph", default=None,
+                   help="existing frozen GraphDef; default: export one")
+    p.add_argument("--distributed", action="store_true")
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    from bigdl_tpu.nn import ClassNLLCriterion
+    from bigdl_tpu.optim import SGD, Top1Accuracy, Trigger
+    from bigdl_tpu.optim.evaluator import evaluate_dataset
+    from bigdl_tpu.dataset import ArrayDataSet
+    from bigdl_tpu.utils.tf_interop import TFTrainingSession
+
+    d, k, n = 16, 4, 1024
+    rs = np.random.RandomState(1)
+    w = rs.randn(d, k)
+    x = rs.randn(n, d).astype(np.float32)
+    y = (np.argmax(x @ w, axis=1) + 1).astype(np.float32)
+
+    graph_path = args.graph
+    if graph_path is None:
+        graph_path = os.path.join(tempfile.gettempdir(),
+                                  "bigdl_tpu_frozen_mlp.pb")
+        export_frozen_classifier(graph_path, d, k)
+        log.info("exported frozen classifier to %s", graph_path)
+
+    if args.distributed:
+        from bigdl_tpu.engine import Engine
+
+        Engine.init()
+    sess = TFTrainingSession(graph_path, inputs=["x"], outputs=["logp"])
+    trained = sess.train(
+        (x, y), ClassNLLCriterion(),
+        optim_method=SGD(learningrate=args.learning_rate),
+        batch_size=args.batch_size,
+        end_trigger=Trigger.max_epoch(args.max_epoch),
+        distributed=args.distributed)
+
+    (acc,) = evaluate_dataset(trained, ArrayDataSet(x, y, args.batch_size),
+                              [Top1Accuracy()])
+    value, _ = acc.result()
+    log.info("fine-tuned Top1Accuracy: %.4f", value)
+    return value
+
+
+if __name__ == "__main__":
+    main()

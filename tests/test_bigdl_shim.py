@@ -82,3 +82,12 @@ def test_extended_shim_import_paths():
     km = KSequential()
     km.add(Dense(4, input_shape=(6,)))
     assert km.output_shape == (None, 4)
+
+
+def test_tf_utils_shim():
+    from bigdl.util.tf_utils import (
+        BigDLSessionImpl, TensorflowLoader, TensorflowSaver,
+        TFTrainingSession, load_tf,
+    )
+
+    assert BigDLSessionImpl is TFTrainingSession

@@ -62,3 +62,11 @@ def test_wide_and_deep_recommendation_example():
 
     acc = main(["-e", "12", "--learning-rate", "1.0"])
     assert acc > 0.85, f"wide-and-deep example accuracy {acc}"
+
+
+@pytest.mark.slow
+def test_tensorflow_finetune_example():
+    from examples.tensorflow.finetune_frozen_graph import main
+
+    acc = main(["-e", "8"])
+    assert acc > 0.9, f"tf finetune accuracy {acc}"
