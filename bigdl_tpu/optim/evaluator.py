@@ -196,6 +196,30 @@ def predict_class(model, features, batch_size: int = 32, mesh="auto"):
     return np.argmax(out.reshape(out.shape[0], -1), axis=-1) + 1
 
 
+def predict_image(model, image_frame, batch_size: int = 32, mesh="auto",
+                  output_layer=None, predict_key="predict"):
+    """Reference: ``model.predictImage(imageFrame)`` — run the model
+    over an ImageFrame's (already-transformed) tensors and write each
+    prediction back into the feature under ``predict_key``.  Returns
+    the frame.  Features must have been through ``MatToTensor`` (or
+    hold CHW arrays in their SAMPLE slot)."""
+    from bigdl_tpu.transform.vision import ImageFeature
+
+    feats = []
+    for f in image_frame.features:
+        t = f.get(ImageFeature.SAMPLE)
+        if t is None:
+            t = np.transpose(
+                np.asarray(f.image, np.float32), (2, 0, 1))
+        elif hasattr(t, "features"):  # a Sample record
+            t = np.asarray(t.features)
+        feats.append(np.asarray(t))
+    out = predict(model, np.stack(feats), batch_size, mesh=mesh)
+    for f, o in zip(image_frame.features, out):
+        f[predict_key] = o
+    return image_frame
+
+
 def _resolve_mesh(mesh):
     """``"auto"`` -> the Engine mesh when initialized, else no mesh.
     Explicit ``None`` always means single-device (internal callers that

@@ -197,3 +197,58 @@ def test_distributed_image_frame_unequal_shards_stay_synchronised():
         ds = shard.to_dataset(batch_size=4)
         counts.append(len(list(ds.data(train=False))))
     assert counts[0] == counts[1] > 0, counts
+
+
+def test_predict_image_and_distri_training_end_to_end():
+    """ImageFrame glue: transform pipeline -> DistriOptimizer training
+    -> predict_image writes per-feature predictions (reference
+    model.predictImage)."""
+    from bigdl_tpu.engine import Engine
+    from bigdl_tpu.nn import (
+        ClassNLLCriterion, Linear, LogSoftMax, ReLU, Reshape, Sequential,
+        SpatialConvolution, SpatialMaxPooling,
+    )
+    from bigdl_tpu.optim import DistriOptimizer, SGD, Trigger
+    from bigdl_tpu.optim.evaluator import predict_image
+    from bigdl_tpu.transform.vision import (
+        ChannelNormalize, ImageFrame, MatToTensor,
+    )
+
+    Engine.reset()
+    Engine.init()
+    try:
+        rs = np.random.RandomState(24)
+        n = 128
+        # class 1: bright center, class 2: dark center
+        labels = (np.arange(n) % 2 + 1).astype(np.float32)
+        arrays = []
+        for i in range(n):
+            img = rs.rand(8, 8, 3).astype(np.float32) * 0.3
+            if labels[i] == 1:
+                img[2:6, 2:6] += 0.7
+            arrays.append(img)
+        frame = ImageFrame.read(arrays, list(labels))
+        frame.transform(ChannelNormalize(0.5, 0.5, 0.5) >> MatToTensor())
+        ds = frame.to_dataset(batch_size=32)
+
+        model = Sequential() \
+            .add(SpatialConvolution(3, 4, 3, 3, 1, 1, 1, 1)) \
+            .add(ReLU()) \
+            .add(SpatialMaxPooling(2, 2)) \
+            .add(Reshape([4 * 4 * 4], batch_mode=True)) \
+            .add(Linear(64, 2)).add(LogSoftMax())
+        opt = DistriOptimizer(model, ds, ClassNLLCriterion(),
+                              batch_size=32)
+        opt.set_optim_method(SGD(learningrate=0.5))
+        opt.set_end_when(Trigger.max_epoch(6))
+        trained = opt.optimize()
+
+        frame2 = ImageFrame.read(arrays[:16], list(labels[:16]))
+        frame2.transform(ChannelNormalize(0.5, 0.5, 0.5) >> MatToTensor())
+        predict_image(trained, frame2, batch_size=8)
+        preds = np.stack([f["predict"] for f in frame2.features])
+        assert preds.shape == (16, 2)
+        acc = np.mean(np.argmax(preds, 1) + 1 == labels[:16])
+        assert acc > 0.9, acc
+    finally:
+        Engine.reset()
