@@ -12,6 +12,9 @@ the TPU-first capabilities the mesh seams were left open for:
   axis is sharded over devices; K/V blocks rotate around the ICI ring
   via `ppermute` while an online-softmax accumulator keeps the
   attention exact.  Long-context training scales linearly in devices.
+* `ulysses` — all-to-all sequence parallelism (DeepSpeed-Ulysses
+  pattern): attention reshards seq->heads so the dense kernel runs
+  unchanged; the better deal when n_head >= n_devices.
 * `tensor_parallel` — GSPMD-style tensor parallelism: parameter
   PartitionSpec rules + `with_sharding_constraint` helpers.  No manual
   collectives; XLA inserts all-gathers/reduce-scatters from the
@@ -41,5 +44,10 @@ from bigdl_tpu.parallel.tensor_parallel import (  # noqa: F401
 from bigdl_tpu.parallel.pipeline import (  # noqa: F401
     pipeline_apply,
     pipelined,
+)
+from bigdl_tpu.parallel.ulysses import (  # noqa: F401
+    ulysses_attention,
+    ulysses_attention_sharded,
+    UlyssesMultiHeadAttention,
 )
 from bigdl_tpu.parallel.moe import MoE  # noqa: F401

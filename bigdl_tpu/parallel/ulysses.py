@@ -1,0 +1,106 @@
+"""Ulysses-style all-to-all sequence parallelism.
+
+New capability (the reference has no sequence parallelism — SURVEY.md
+§2.4); the second of the two standard long-context strategies, next to
+:mod:`bigdl_tpu.parallel.ring`:
+
+* activations flow through the network sequence-sharded — each device
+  holds (B, H, T/n, D);
+* at the attention boundary, one ``lax.all_to_all`` reshards to
+  head-sharded (B, H/n, T, D): every device now sees the FULL sequence
+  for its head subset, so the plain (flash) attention kernel runs
+  unchanged — no online-softmax ring bookkeeping;
+* a second all_to_all reshards back to sequence-sharded for the MLP.
+
+Trade-off vs the ring: two all_to_alls of the full activation per
+attention (ICI bandwidth) instead of n-1 K/V rotations, but the
+attention itself is a single dense kernel — typically the better deal
+when ``n_head >= n_devices`` and the per-hop latency would dominate.
+Requires ``n_head % axis_size == 0``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+from bigdl_tpu.nn.attention import MultiHeadAttention
+
+
+def ulysses_attention(q, k, v, axis_name: str, *, causal: bool = False,
+                      scale: Optional[float] = None):
+    """All-to-all attention.  MUST run inside shard_map with
+    ``axis_name`` bound; q/k/v are the LOCAL (B, H, T/n, D) blocks in
+    ring order.  Heads must divide by the axis size."""
+    from jax import lax
+
+    from bigdl_tpu.ops.attention import dot_product_attention
+
+    n = lax.psum(1, axis_name)
+    h = q.shape[1]
+    if h % n:
+        raise ValueError(
+            f"ulysses_attention: {h} heads not divisible by axis size {n}")
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+
+    # seq-sharded (B, H, T/n, D) -> head-sharded (B, H/n, T, D)
+    def to_heads(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    # full sequence present locally: the standard kernel applies,
+    # including plain causal masking ("auto" takes the Pallas flash
+    # path on TPU when the tiles fit, the lax reference elsewhere)
+    out = dot_product_attention(qh, kh, vh, causal=causal, scale=scale)
+    # head-sharded -> seq-sharded
+    return lax.all_to_all(out, axis_name, split_axis=2, concat_axis=1,
+                          tiled=True)
+
+
+def ulysses_attention_sharded(q, k, v, mesh, *, seq_axis: str = "seq",
+                              batch_axis: Optional[str] = None,
+                              causal: bool = False,
+                              scale: Optional[float] = None):
+    """shard_map wrapper: q/k/v are GLOBAL (B, H, T, D) arrays with the
+    seq dim sharded over ``seq_axis``.  Composable under jit."""
+    from functools import partial
+
+    from jax.sharding import PartitionSpec as P
+
+    from bigdl_tpu.optim.distri_optimizer import _shard_map
+
+    spec = P(batch_axis, None, seq_axis, None)
+    f = partial(ulysses_attention, axis_name=seq_axis, causal=causal,
+                scale=scale)
+    return _shard_map(f, mesh, in_specs=(spec, spec, spec),
+                      out_specs=spec)(q, k, v)
+
+
+class UlyssesMultiHeadAttention(MultiHeadAttention):
+    """MultiHeadAttention whose inner attention reshards
+    sequence->heads via all_to_all (DeepSpeed-Ulysses pattern) — the
+    drop-in alternative to RingMultiHeadAttention when
+    ``n_head >= mesh[seq_axis]``."""
+
+    def __init__(self, dim: int, n_head: int, mesh, *,
+                 seq_axis: str = "seq", batch_axis: Optional[str] = None,
+                 causal: bool = False, with_bias: bool = True,
+                 dropout: float = 0.0):
+        super().__init__(dim, n_head, causal=causal, with_bias=with_bias,
+                         dropout=dropout)
+        self.mesh = mesh
+        self.seq_axis = seq_axis
+        self.batch_axis = batch_axis
+
+    def _inner_attention(self, q, k, v):
+        return ulysses_attention_sharded(
+            q, k, v, self.mesh, seq_axis=self.seq_axis,
+            batch_axis=self.batch_axis, causal=self.causal,
+        )
+
+    def __repr__(self):
+        return (f"UlyssesMultiHeadAttention(dim={self.dim}, "
+                f"heads={self.n_head}, seq_axis={self.seq_axis!r}, "
+                f"causal={self.causal})")
