@@ -179,8 +179,8 @@ def _fwd_kernel_kxk(x_ref, w_ref, shift_ref, y_ref, s1_ref, s2_ref, *,
     n = pl.program_id(1)
     xp = x_ref[0]                     # (C, Hp, Wp) spatially pre-padded
     c = xp.shape[0]
-    block_o = w_ref.shape[1]
-    acc = jnp.zeros((block_o, ho * wo), jnp.float32)
+    block_o = w_ref.shape[0]          # w block: (block_o, k*k*C) tap-major
+    taps = []
     for t in range(k * k):
         dy, dx = t // k, t % k
         if stride == 1:
@@ -190,10 +190,15 @@ def _fwd_kernel_kxk(x_ref, w_ref, shift_ref, y_ref, s1_ref, s2_ref, *,
             # extent, split the parity axis by reshape, keep phase 0
             xs = xp[:, dy:dy + 2 * ho, dx:dx + 2 * wo]
             xs = xs.reshape(c, ho, 2, wo, 2)[:, :, 0, :, 0]
-        acc += jax.lax.dot_general(
-            w_ref[t], xs.reshape(c, ho * wo), (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
+        taps.append(xs.reshape(c, ho * wo))
+    # tap-major im2col in VMEM: ONE (block_o, k*k*C) @ (k*k*C, HW) MXU
+    # dot instead of k*k small K=C dots — k*k-fold deeper contraction
+    # fills the 128-lane systolic array at every ResNet channel width
+    xcat = jnp.concatenate(taps, axis=0)
+    acc = jax.lax.dot_general(
+        w_ref[...], xcat, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
     yc = acc - shift_ref[...][:, None]
     p1 = jnp.sum(yc, axis=1)
     p2 = jnp.sum(yc * yc, axis=1)
@@ -231,20 +236,24 @@ def _fwd_kxk(x, w, shift, stride, pad, interpret):
 
     block_o = min(256, _round_up(o, 8))
     while block_o > 8:
-        vmem = (2 * (c * hp * wp_ + k * k * block_o * c) * xb
+        # padded image (double-buffered) + tap-concat im2col + weight
+        # block + f32 accumulator/output
+        vmem = (2 * c * hp * wp_ * xb + k * k * c * ho * wo * xb
+                + k * k * block_o * c * xb
                 + block_o * ho * wo * (4 + xb))
         if vmem <= _VMEM_BUDGET:
             break
         block_o //= 2
-    if 2 * c * hp * wp_ * xb > _VMEM_BUDGET:  # image itself too big
-        return _reference(x, w, shift, stride, pad)
+    if (2 * c * hp * wp_ + k * k * c * ho * wo) * xb > _VMEM_BUDGET:
+        return _reference(x, w, shift, stride, pad)  # image too big
     o_pad = _round_up(o, block_o)
 
     xpad = jnp.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
-    # taps-major weight layout: (k*k, O, C)
-    wt = jnp.transpose(w, (2, 3, 0, 1)).reshape(k * k, o, c)
+    # tap-major flattened weights: (O, k*k*C) matching the kernel's
+    # im2col row order [tap0 c-rows, tap1 c-rows, ...]
+    wt = jnp.transpose(w, (0, 2, 3, 1)).reshape(o, k * k * c)
     if o_pad != o:
-        wt = jnp.pad(wt, ((0, 0), (0, o_pad - o), (0, 0)))
+        wt = jnp.pad(wt, ((0, o_pad - o), (0, 0)))
         shift = jnp.pad(shift, (0, o_pad - o))
 
     kern = functools.partial(_fwd_kernel_kxk, k=k, stride=stride,
@@ -254,7 +263,7 @@ def _fwd_kxk(x, w, shift, stride, pad, interpret):
         grid=(o_pad // block_o, n),
         in_specs=[
             pl.BlockSpec((1, c, hp, wp_), lambda oi, ni: (ni, 0, 0, 0)),
-            pl.BlockSpec((k * k, block_o, c), lambda oi, ni: (0, oi, 0)),
+            pl.BlockSpec((block_o, k * k * c), lambda oi, ni: (oi, 0)),
             pl.BlockSpec((block_o,), lambda oi, ni: (oi,)),
         ],
         out_specs=[
