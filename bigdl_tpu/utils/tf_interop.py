@@ -907,6 +907,35 @@ class TensorflowLoader:
             )
             return self._named(mod, nd)(self._build(ins[0]))
 
+        if op == "AddN":
+            # n-ary sum of runtime tensors
+            mod = T.CAddTable()
+            return self._named(mod, nd)(*[self._build(i) for i in ins])
+
+        if op == "SquaredDifference":
+            from bigdl_tpu.nn.module import Sequential
+
+            # (a - b)^2; const operands fold into an AddConstant
+            consts = []
+            for i in ins:
+                try:
+                    consts.append(self._const(i))
+                except TFConversionException:
+                    consts.append(None)
+            if consts[0] is None and consts[1] is None:
+                seq = Sequential().add(T.CSubTable()).add(L.Square())
+                return self._named(seq, nd)(
+                    self._build(ins[0]), self._build(ins[1]))
+            ci = 0 if consts[0] is not None else 1
+            cval = consts[ci]
+            if cval.size != 1:
+                raise TFConversionException(
+                    "SquaredDifference with a non-scalar const "
+                    "unsupported")
+            seq = Sequential().add(
+                L.AddConstant(-float(cval.reshape(-1)[0]))).add(L.Square())
+            return self._named(seq, nd)(self._build(ins[1 - ci]))
+
         if op in ("Split", "SplitV"):
             # TF Split(split_dim, value) / SplitV(value, sizes, dim):
             # equal chunks via SplitChunks (runtime-shape chunk length),

@@ -661,3 +661,22 @@ def test_saver_cnn_roundtrip(tmp_path):
     loaded.evaluate()
     np.testing.assert_allclose(np.asarray(loaded.forward(x)), ref,
                                rtol=2e-3, atol=1e-4)
+
+
+def test_addn_and_squared_difference():
+    rs = np.random.RandomState(17)
+    b = GraphDefBuilder()
+    b.placeholder("x")
+    b.placeholder("y")
+    b.op("s3", "AddN", ["x", "y", "x"])
+    b.op("sd", "SquaredDifference", ["s3", "y"])
+    b.const("half", np.asarray(0.5, np.float32))
+    b.op("sdc", "SquaredDifference", ["sd", "half"])
+    model = TensorflowLoader(data=b.tobytes()).load(
+        inputs=["x", "y"], outputs=["sdc"])
+    model.evaluate()
+    x = rs.randn(2, 5).astype(np.float32)
+    y = rs.randn(2, 5).astype(np.float32)
+    out = np.asarray(model.forward((x, y)))
+    expect = ((2 * x + y - y) ** 2 - 0.5) ** 2
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
