@@ -310,8 +310,17 @@ def _bwd_rule(stride, pad, interpret, res, cts):
         + gs1[None, :, None, None]
         + 2.0 * yc * gs2[None, :, None, None]
     ).astype(x.dtype)
-    _, vjp = jax.vjp(
-        lambda x_, w_: _conv_ref(x_, w_, stride, pad).astype(x.dtype), x, w)
+
+    # same-dtype conv (no preferred_element_type): its transpose would
+    # otherwise pair an f32 cotangent with bf16 operands and fail; the
+    # MXU accumulates the bf16 grads in f32 regardless
+    def _conv_same_dtype(x_, w_):
+        return jax.lax.conv_general_dilated(
+            x_, w_, (stride, stride), [(pad, pad), (pad, pad)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        )
+
+    _, vjp = jax.vjp(_conv_same_dtype, x, w)
     dx, dw = vjp(gy_eff)
     # shift is normally running-state (no grad requested), but the
     # cotangent is cheap and exact: ds1/dshift = -n, ds2/dshift = -2 s1

@@ -240,3 +240,35 @@ def test_fused_serialization_roundtrip(tmp_path):
     loaded = load_module(save_module(m, str(tmp_path / "fused")))
     loaded.evaluate()
     np.testing.assert_allclose(o1, np.asarray(loaded.forward(x)), rtol=1e-6)
+
+
+def test_fused_resnet50_traces_at_production_shapes():
+    """Abstract-eval the fused train step at the bench operating point
+    (batch 128, 224px): exercises every kernel's tile selection and
+    padding arithmetic at real dims without executing (the chip isn't
+    needed to catch a shape/VMEM bug in _tiles_1x1/_fwd_kxk)."""
+    import jax
+
+    from bigdl_tpu.models import build_resnet_imagenet
+    from bigdl_tpu.nn import CrossEntropyCriterion
+
+    m = build_resnet_imagenet(depth=50, class_num=1000)
+    fuse_conv_bn(m)
+    m.modules = m.modules[:-1]
+    crit = CrossEntropyCriterion()
+    params = m.params()
+    state = m.state()
+
+    def loss_fn(p, x, y):
+        pc = jax.tree.map(
+            lambda a: a.astype(jnp.bfloat16)
+            if jnp.issubdtype(a.dtype, jnp.floating) else a, p)
+        out, _ = m.apply(pc, state, x, training=True,
+                         rng=jax.random.key(0))
+        return crit.loss(out.astype(jnp.float32), y)
+
+    x = jax.ShapeDtypeStruct((128, 3, 224, 224), jnp.bfloat16)
+    y = jax.ShapeDtypeStruct((128,), jnp.float32)
+    shapes = jax.eval_shape(jax.grad(loss_fn), params, x, y)
+    flat = jax.tree_util.tree_leaves(shapes)
+    assert flat, "no gradients traced"
