@@ -327,3 +327,22 @@ class TestUlyssesAttention:
         m.evaluate()
         out = m.forward(x)
         assert np.asarray(out).shape == (2, 16, 32)
+
+
+def test_sequence_parallel_bf16_traces_at_scale():
+    """eval_shape both SP strategies at a long-context bf16 operating
+    point (B2 H16 T8192 D64 over 8 devices): locks tile selection and
+    vjp dtypes without executing."""
+    from bigdl_tpu.parallel.ring import ring_attention_sharded
+    from bigdl_tpu.parallel.ulysses import ulysses_attention_sharded
+
+    mesh = jax.sharding.Mesh(np.array(jax.devices()), ("seq",))
+    q = jax.ShapeDtypeStruct((2, 16, 8192, 64), jnp.bfloat16)
+
+    for fn in (ring_attention_sharded, ulysses_attention_sharded):
+        def loss(x, fn=fn):
+            out = fn(x, x, x, mesh, causal=True)
+            return jnp.sum(out.astype(jnp.float32) ** 2)
+
+        g = jax.eval_shape(jax.grad(loss), q)
+        assert g.shape == (2, 16, 8192, 64) and g.dtype == jnp.bfloat16
