@@ -424,20 +424,62 @@ class SimpleRNN(_KerasRecurrent):
 
 
 class Bidirectional(KerasLayer):
-    """keras.layers.wrappers.Bidirectional(merge_mode='concat')."""
+    """keras.layers.wrappers.Bidirectional.
+
+    ``BiRecurrent`` emits the last-dim CONCAT of the forward pass and
+    the (re-flipped to input order) backward pass.  Keras semantics:
+
+    * ``return_sequences=False`` takes each direction's FINAL state —
+      forward's sits at the last timestep, backward's at the FIRST
+      (it consumed the sequence reversed);
+    * non-concat ``merge_mode`` (sum/mul/ave) combines the two halves
+      elementwise.
+    """
 
     def __init__(self, layer: _KerasRecurrent, merge_mode="concat",
                  input_shape=None, name=None):
         super().__init__(input_shape or layer.input_shape, name)
+        if merge_mode not in ("concat", "sum", "mul", "ave"):
+            raise ValueError(f"Bidirectional merge_mode {merge_mode!r} "
+                             "unsupported")
         self.layer = layer
         self.merge_mode = merge_mode
 
     def build(self, input_shape):
+        from bigdl_tpu.nn.table_ops import (
+            CAddTable, CMulTable, ConcatTable, JoinTable,
+        )
+
         n_in = int(input_shape[-1])
+        H = self.layer.output_dim
         core = M.Sequential()
         core.add(R.BiRecurrent().add(self.layer._cell(n_in)))
         if not self.layer.return_sequences:
-            core.add(R.Select(2, -1))
+            # forward final = last step's first H dims; backward final =
+            # FIRST step's last H dims (backward saw the whole sequence
+            # there; the last step saw one element)
+            fwd = M.Sequential().add(R.Select(2, -1)).add(L.Narrow(2, 1, H))
+            bwd = M.Sequential().add(R.Select(2, 1)) \
+                .add(L.Narrow(2, H + 1, H))
+            core.add(ConcatTable().add(fwd).add(bwd))
+            combine_dim = 2
+        else:
+            if self.merge_mode == "concat":
+                return core
+            halves = ConcatTable() \
+                .add(L.Narrow(3, 1, H)).add(L.Narrow(3, H + 1, H))
+            core.add(halves)
+            combine_dim = 3
+        if self.merge_mode == "concat":
+            # n_input_dims == tensor ndim: `combine_dim` is the absolute
+            # 1-based axis (the ncf JoinTable(2, 2) convention)
+            core.add(JoinTable(combine_dim, combine_dim))
+        elif self.merge_mode == "sum":
+            core.add(CAddTable())
+        elif self.merge_mode == "mul":
+            core.add(CMulTable())
+        else:  # ave
+            core.add(CAddTable()).add(L.MulConstant(0.5))
         return core
 
     def compute_output_shape(self, input_shape):

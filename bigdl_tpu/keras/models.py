@@ -173,8 +173,13 @@ class Sequential:
     def predict(self, x, batch_size: int = 32):
         from bigdl_tpu.optim.evaluator import predict
 
-        if not isinstance(x, tuple):
-            x = np.asarray(x)  # tuples are table inputs, pass through
+        # keras multi-input convention: a tuple OR a list of >=2-D
+        # branch arrays is a table input (one array per graph input)
+        if isinstance(x, list) and x and all(
+                getattr(a, "ndim", 0) >= 2 for a in x):
+            x = tuple(np.asarray(a) for a in x)
+        elif not isinstance(x, tuple):
+            x = np.asarray(x)
         return predict(self.core, x, batch_size)
 
     def predict_classes(self, x, batch_size: int = 32):

@@ -236,10 +236,10 @@ def _fwd_kxk(x, w, shift, stride, pad, interpret):
 
     block_o = min(256, _round_up(o, 8))
     while block_o > 8:
-        # padded image (double-buffered) + tap-concat im2col + weight
-        # block + f32 accumulator/output
+        # padded image and weight block (both grid-varying, so Pallas
+        # double-buffers them) + tap-concat im2col + f32 acc/output
         vmem = (2 * c * hp * wp_ * xb + k * k * c * ho * wo * xb
-                + k * k * block_o * c * xb
+                + 2 * k * k * block_o * c * xb
                 + block_o * ho * wo * (4 + xb))
         if vmem <= _VMEM_BUDGET:
             break

@@ -607,3 +607,55 @@ def test_bidirectional_weight_import(tmp_path):
     bwd = _np_lstm_keras(x[:, ::-1], bW, bU, bb)[:, ::-1]
     expect = np.concatenate([fwd, bwd], axis=-1)
     np.testing.assert_allclose(got, expect, rtol=2e-3, atol=1e-4)
+
+
+def test_bidirectional_final_state_and_merge_modes(tmp_path):
+    """return_sequences=False must take the BACKWARD direction's final
+    state from the first (re-flipped) timestep, and non-concat merge
+    modes must combine halves elementwise — both against the numpy
+    oracle."""
+    rs = np.random.RandomState(36)
+    D, H, T = 4, 3, 5
+    gates = ("i", "c", "f", "o")
+    mk = lambda: ({g: (rs.randn(D, H) * 0.4).astype(np.float32)
+                   for g in gates},
+                  {g: (rs.randn(H, H) * 0.4).astype(np.float32)
+                   for g in gates},
+                  {g: (rs.randn(H) * 0.1).astype(np.float32)
+                   for g in gates})
+    fW, fU, fb = mk()
+    bW, bU, bb = mk()
+    weights = []
+    for pfx, (Ws, Us, bs) in (("forward", (fW, fU, fb)),
+                              ("backward", (bW, bU, bb))):
+        for g in gates:
+            weights += [(f"bi_{pfx}_W_{g}", Ws[g]),
+                        (f"bi_{pfx}_U_{g}", Us[g]),
+                        (f"bi_{pfx}_b_{g}", bs[g])]
+    x = rs.randn(2, T, D).astype(np.float32)
+    fwd_seq = _np_lstm_keras(x, fW, fU, fb)
+    bwd_seq = _np_lstm_keras(x[:, ::-1], bW, bU, bb)
+
+    for merge_mode, expect in [
+        ("concat", np.concatenate([fwd_seq[:, -1], bwd_seq[:, -1]], -1)),
+        ("sum", fwd_seq[:, -1] + bwd_seq[:, -1]),
+        ("ave", 0.5 * (fwd_seq[:, -1] + bwd_seq[:, -1])),
+    ]:
+        spec = json.dumps({
+            "class_name": "Sequential",
+            "config": [
+                {"class_name": "Bidirectional", "config": {
+                    "name": "bi", "merge_mode": merge_mode,
+                    "batch_input_shape": [None, T, D],
+                    "layer": {"class_name": "LSTM", "config": {
+                        "name": "bl", "output_dim": H,
+                        "return_sequences": False}}}},
+            ],
+        })
+        path = tmp_path / f"bi_{merge_mode}.h5"
+        _h5_write(path, [("bi", weights)])
+        model = model_from_json(spec)
+        load_weights_hdf5(model, str(path))
+        got = np.asarray(model.predict(x))
+        np.testing.assert_allclose(got, expect, rtol=2e-3, atol=1e-4,
+                                   err_msg=merge_mode)
