@@ -7,8 +7,6 @@ shape-inferring layers.
 
 from __future__ import annotations
 
-from typing import Optional
-
 import numpy as np
 
 from bigdl_tpu.keras.layers import KerasLayer

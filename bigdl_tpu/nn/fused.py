@@ -27,7 +27,7 @@ from bigdl_tpu.nn.layers import (
     SpatialConvolution,
     _to_device,
 )
-from bigdl_tpu.nn.module import AbstractModule, Container, Sequential
+from bigdl_tpu.nn.module import AbstractModule, Sequential
 
 
 def _jnp():
