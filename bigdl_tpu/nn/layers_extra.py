@@ -951,6 +951,30 @@ class SplitChunks(_Stateless):
         return tuple(outs)
 
 
+class CompareConstant(_Stateless):
+    """Elementwise comparison against a scalar constant, emitting a
+    bool tensor — the TF Less/Greater/... vocabulary with a const
+    operand (used by imported loop conditions)."""
+
+    _OPS = ("lt", "le", "gt", "ge", "eq", "ne")
+
+    def __init__(self, op: str = "lt", value: float = 0.0,
+                 const_first: bool = False):
+        if op not in self._OPS:
+            raise ValueError(f"op must be one of {self._OPS}")
+        super().__init__(op=op, value=value, const_first=const_first)
+        self.op, self.value, self.const_first = op, value, const_first
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        jnp = _jnp()
+        a, b = (self.value, input) if self.const_first else (input, self.value)
+        return {
+            "lt": lambda: a < b, "le": lambda: a <= b,
+            "gt": lambda: a > b, "ge": lambda: a >= b,
+            "eq": lambda: jnp.equal(a, b), "ne": lambda: jnp.not_equal(a, b),
+        }[self.op]()
+
+
 class GatherIndices(_Stateless):
     """TF ``GatherV2`` semantics with a CONSTANT index vector: one
     ``jnp.take`` along 1-based ``dim`` (negative counts from the end).
@@ -1240,6 +1264,7 @@ __all__ = [
     "SplitChunks",
     "TemporalAveragePooling",
     "GatherIndices",
+    "CompareConstant",
     "Reverse",
     "MaskedSelect",
     "Maxout",

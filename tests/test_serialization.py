@@ -220,6 +220,7 @@ def _layer_cases():
         (N.TemporalAveragePooling(2), seq),
         (N.SplitChunks(2, 2), v),
         (N.GatherIndices(2, [0, 2]), v),
+        (N.CompareConstant("lt", 0.5), v),
         (N.PairwiseDistance(2), (v, v + 1)),
         (N.NegativeEntropyPenalty(0.1), np.abs(v)),
         (N.GaussianSampler(), (v, v * 0)),  # eval: returns the mean

@@ -680,3 +680,43 @@ def test_addn_and_squared_difference():
     out = np.asarray(model.forward((x, y)))
     expect = ((2 * x + y - y) ** 2 - 0.5) ** 2
     np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_while_loop_import():
+    """TF while-frame family (Enter/Merge/Switch/LoopCond/
+    NextIteration/Exit): while (cnt < 4) { x *= 2; cnt += 1 } imports
+    as a DynamicGraph whose masked scan reproduces the trip count."""
+    from bigdl_tpu.nn.graph import DynamicGraph
+
+    b = GraphDefBuilder()
+    b.placeholder("x")
+    b.placeholder("cnt")
+    b.op("enter_x", "Enter", ["x"])
+    b.op("enter_c", "Enter", ["cnt"])
+    b.op("merge_x", "Merge", ["enter_x", "next_x"])
+    b.op("merge_c", "Merge", ["enter_c", "next_c"])
+    b.const("four", np.asarray(4.0, np.float32))
+    b.op("less", "Less", ["merge_c", "four"])
+    b.op("cond", "LoopCond", ["less"])
+    b.op("switch_x", "Switch", ["merge_x", "cond"])
+    b.op("switch_c", "Switch", ["merge_c", "cond"])
+    b.const("two", np.asarray(2.0, np.float32))
+    b.const("one", np.asarray(1.0, np.float32))
+    b.op("body_x", "Mul", ["switch_x:1", "two"])
+    b.op("body_c", "Add", ["switch_c:1", "one"])
+    b.op("next_x", "NextIteration", ["body_x"])
+    b.op("next_c", "NextIteration", ["body_c"])
+    b.op("exit_x", "Exit", ["switch_x"])
+
+    model = TensorflowLoader(data=b.tobytes()).load(
+        inputs=["x", "cnt"], outputs=["exit_x"])
+    assert isinstance(model, DynamicGraph)
+    model.evaluate()
+    out = model.forward((np.asarray(1.0, np.float32),
+                         np.asarray(0.0, np.float32)))
+    # cnt 0,1,2,3 pass the cond -> 4 doublings
+    assert float(np.asarray(out)) == 16.0
+    # different trip count from the same compiled graph
+    out2 = model.forward((np.asarray(3.0, np.float32),
+                          np.asarray(2.0, np.float32)))
+    assert float(np.asarray(out2)) == 12.0  # cnt 2,3 -> 2 doublings
