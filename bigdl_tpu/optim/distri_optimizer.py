@@ -66,7 +66,7 @@ class DistriOptimizer(LocalOptimizer):
     """Synchronous data-parallel trainer with ZeRO-1 sharded updates."""
 
     def __init__(self, model, dataset, criterion, batch_size=32, mesh=None,
-                 wire_dtype="bfloat16"):
+                 wire_dtype="bfloat16", data_axes=None):
         super().__init__(model, dataset, criterion, batch_size)
         from bigdl_tpu.engine import Engine
 
@@ -75,8 +75,20 @@ class DistriOptimizer(LocalOptimizer):
                 Engine.init()
             mesh = Engine.mesh()
         self.mesh = mesh
-        self.axis = mesh.axis_names[0]  # the data axis
-        self.n_shards = mesh.shape[self.axis]
+        # hierarchical data parallelism (multi-slice): pass
+        # data_axes=("dcn", "data") over a 2-level mesh and the batch /
+        # flat-parameter shards split over BOTH axes — XLA then builds
+        # the hierarchical collective (reduce-scatter inside each ICI
+        # slice, cross-slice exchange over DCN) from the axis order
+        self.axes = tuple(data_axes) if data_axes else (mesh.axis_names[0],)
+        for a in self.axes:
+            if a not in mesh.axis_names:
+                raise ValueError(f"data axis {a!r} not in mesh axes "
+                                 f"{mesh.axis_names}")
+        self.axis = self.axes if len(self.axes) > 1 else self.axes[0]
+        self.n_shards = 1
+        for a in self.axes:
+            self.n_shards *= mesh.shape[a]
         # reference: FP16CompressedTensor on-the-wire compression for
         # gradient blocks; bf16 is the TPU-native equivalent
         self.wire_dtype = wire_dtype
@@ -145,7 +157,7 @@ class DistriOptimizer(LocalOptimizer):
                 if v.ndim == 1 and v.shape[0] == shard_len:
                     full = jnp.tile(v, n)
                     sharded[k] = jax.device_put(
-                        full, NamedSharding(self.mesh, P(self.axis))
+                        full, NamedSharding(self.mesh, P(self.axis))  # noqa: E501  (tuple spec shards over all data axes)
                     )
                 else:
                     sharded[k] = jax.device_put(
@@ -224,7 +236,16 @@ class DistriOptimizer(LocalOptimizer):
                 gshard = clipper(gshard, global_sq_norm=sq)
             with jax.named_scope("optimizer_update"):
                 # ---- owner-slice weight update (ZeRO-1) -----------------
-                idx = jax.lax.axis_index(axis)
+                if isinstance(axis, tuple):
+                    # combined owner index over hierarchical data axes,
+                    # major-to-minor in axis order (matches the
+                    # P(axes)-tuple shard layout psum_scatter produces)
+                    idx = jax.lax.axis_index(axis[0])
+                    for a in axis[1:]:
+                        idx = idx * self.mesh.shape[a] \
+                            + jax.lax.axis_index(a)
+                else:
+                    idx = jax.lax.axis_index(axis)
                 shard_len = (flat_p.size + pad) // n
                 wshard = jax.lax.dynamic_slice(
                     jnp.pad(flat_p, (0, pad)), (idx * shard_len,),

@@ -436,3 +436,46 @@ def test_metrics_logged_per_epoch(caplog):
     assert lines, "no Metrics summary line logged"
     assert "computing time average" in lines[-1]
     assert "data wait time average" in lines[-1]
+
+
+def test_hierarchical_data_axes_multislice():
+    """Multi-slice seam: data parallelism over a 2-level ('dcn','ici')
+    mesh — batch and ZeRO shards split over BOTH axes, XLA free to build
+    the hierarchical collective.  Must converge like the flat 8-way run."""
+    x, y = _toy(n=256, seed=5)
+    flat_losses, hier_losses = [], []
+    from bigdl_tpu.common import RandomGenerator
+
+    for mode in ("flat", "hier"):
+        RandomGenerator.RNG.set_seed(11)
+        model = _model()
+        if mode == "flat":
+            mesh = Engine.build_mesh({"data": 8})
+            opt = DistriOptimizer(model, (x, y), ClassNLLCriterion(),
+                                  batch_size=64, mesh=mesh,
+                                  wire_dtype="none")
+        else:
+            mesh = Engine.build_mesh({"dcn": 2, "ici": 4})
+            opt = DistriOptimizer(model, (x, y), ClassNLLCriterion(),
+                                  batch_size=64, mesh=mesh,
+                                  wire_dtype="none",
+                                  data_axes=("dcn", "ici"))
+        opt.set_optim_method(SGD(learningrate=0.5, momentum=0.9))
+        opt.set_end_when(Trigger.max_epoch(4))
+        tape = _LossTape()
+        opt.set_train_summary(tape)
+        opt.optimize()
+        (flat_losses if mode == "flat" else hier_losses).extend(tape.losses)
+        if mode == "hier":
+            vel = opt.optim_method.state["velocity"]
+            spec = vel.sharding.spec
+            flat_axes = []
+            for entry in spec:
+                if isinstance(entry, (tuple, list)):
+                    flat_axes.extend(entry)
+                elif entry:
+                    flat_axes.append(entry)
+            assert set(flat_axes) == {"dcn", "ici"}, spec
+    # same data order (shared seeded RNG), same math to fp tolerance
+    np.testing.assert_allclose(flat_losses, hier_losses,
+                               rtol=2e-4, atol=2e-5)
