@@ -291,7 +291,11 @@ class AbstractModule:
     def regularization_loss(self, params) -> Any:
         """Sum of regularizer penalties (reference applies wRegularizer /
         bRegularizer gradients inside accGradParameters; the rebuild adds
-        the penalty to the jitted loss instead — same gradients)."""
+        the penalty to the jitted loss instead — same gradients).  A
+        frozen module contributes nothing (its parameters must not
+        move, including via weight decay)."""
+        if getattr(self, "_frozen", False):
+            return 0.0
         loss = 0.0
         regs = getattr(self, "_regularizers", None)
         if regs:
@@ -299,6 +303,77 @@ class AbstractModule:
                 if pname in params:
                     loss = loss + reg(params[pname])
         return loss
+
+    # ------------------------------------------------------------ freezing
+    def freeze(self, *names):
+        """Reference: ``module.freeze(names*)`` — with no names, freeze
+        this module and every descendant; with names, freeze the named
+        submodules (recursively).  Frozen parameters receive zero
+        updates (the optimizers mask their gradients) and contribute no
+        regularization."""
+        if not names:
+            self._frozen = True
+            for m in getattr(self, "modules", []):
+                m.freeze()
+            return self
+        for name in names:
+            target = self.find_module(name) if hasattr(self, "find_module") \
+                else None
+            if target is None:
+                raise ValueError(f"freeze: no module named {name!r}")
+            target.freeze()
+        return self
+
+    def unfreeze(self, *names):
+        """Reference: ``module.unFreeze(names*)``."""
+        if not names:
+            self._frozen = False
+            for m in getattr(self, "modules", []):
+                m.unfreeze()
+            return self
+        for name in names:
+            target = self.find_module(name) if hasattr(self, "find_module") \
+                else None
+            if target is None:
+                raise ValueError(f"unfreeze: no module named {name!r}")
+            target.unfreeze()
+        return self
+
+    unFreeze = unfreeze
+
+    def is_frozen(self) -> bool:
+        return getattr(self, "_frozen", False)
+
+    def has_frozen(self) -> bool:
+        """True when this module or any descendant is frozen."""
+        if self.is_frozen():
+            return True
+        return any(m.has_frozen() for m in getattr(self, "modules", []))
+
+    def grad_mask(self):
+        """Pytree shaped like :meth:`params` with 0.0 at frozen
+        parameters, 1.0 elsewhere — the optimizers multiply gradients
+        by this when any module is frozen."""
+        scale = 0.0 if self.is_frozen() else 1.0
+        return {n: scale for n in self.params()}
+
+    def get_parameters_table(self):
+        """Reference: ``getParametersTable()`` — name-keyed view of each
+        parameterised module's tensors."""
+        table = {}
+
+        def walk(m):
+            for child in getattr(m, "modules", []):
+                walk(child)
+            p = {n: getattr(m, n) for n in m.param_names
+                 if getattr(m, n, None) is not None}
+            if p:
+                table[m.get_name()] = p
+
+        walk(self)
+        return table
+
+    getParametersTable = get_parameters_table
 
     # ------------------------------------------------------------- graph fn
     def __call__(self, *nodes):
@@ -364,10 +439,19 @@ class Container(AbstractModule):
         return self
 
     def regularization_loss(self, params):
+        if getattr(self, "_frozen", False):
+            return 0.0
         loss = 0.0
         for i, m in enumerate(self.modules):
             loss = loss + m.regularization_loss(params.get(str(i), {}))
         return loss
+
+    def grad_mask(self):
+        if self.is_frozen():
+            import jax
+
+            return jax.tree.map(lambda _: 0.0, self.params())
+        return {str(i): m.grad_mask() for i, m in enumerate(self.modules)}
 
     def _ordered_params(self):
         out = []

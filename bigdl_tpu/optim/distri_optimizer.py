@@ -203,6 +203,18 @@ class DistriOptimizer(LocalOptimizer):
         wire = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
                 "none": None}.get(self.wire_dtype, None)
         global_batch = self.batch_size
+        # freeze support on the flat ZeRO vector: ravel a mask pytree
+        # shaped like the params once (host-side), embed as a constant
+        grad_mask_flat = None
+        if self.model.has_frozen():
+            import jax as _jax
+
+            mask_tree = _jax.tree.map(
+                lambda p, s: np.full(np.shape(p), s, np.float32),
+                self.model.params(), self.model.grad_mask())
+            from jax.flatten_util import ravel_pytree
+
+            grad_mask_flat, _ = ravel_pytree(mask_tree)
 
         def sharded_step(flat_p, opt_st, mstate, rng, inp, tgt, mask=None):
             # named_scopes carry the reference's Metrics phase names into
@@ -214,6 +226,8 @@ class DistriOptimizer(LocalOptimizer):
                 (_, (loss_aux, new_mstate)), grad = jax.value_and_grad(
                     loss_fn, has_aux=True
                 )(*args)
+                if grad_mask_flat is not None:
+                    grad = grad * grad_mask_flat
             with jax.named_scope("put_gradient"):
                 # ---- putGradients + aggregateGradientPartition ----------
                 g = jnp.pad(grad, (0, pad))

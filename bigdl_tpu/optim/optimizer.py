@@ -304,6 +304,9 @@ class LocalOptimizer(BaseOptimizer):
         opt = self.optim_method
         clipper = self._clipper
         loss_fn = self._loss_fn()
+        # freeze support (reference module.freeze): zero the gradients
+        # of frozen subtrees — static at trace time, no cost unfrozen
+        mask = self.model.grad_mask() if self.model.has_frozen() else None
 
         # params/opt state/model state buffers are donated: the step
         # updates in place on-device instead of allocating fresh HBM
@@ -313,6 +316,8 @@ class LocalOptimizer(BaseOptimizer):
                 loss_fn, has_aux=True
             )(p, mstate, rng, inp, tgt)
             grad = clipper(grad)
+            if mask is not None:
+                grad = jax.tree.map(lambda g, s: g * s, grad, mask)
             new_p, new_opt = opt.step(grad, p, opt_st)
             return new_p, new_opt, new_mstate, loss
 

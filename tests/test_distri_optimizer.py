@@ -479,3 +479,51 @@ def test_hierarchical_data_axes_multislice():
     # same data order (shared seeded RNG), same math to fp tolerance
     np.testing.assert_allclose(flat_losses, hier_losses,
                                rtol=2e-4, atol=2e-5)
+
+
+def test_freeze_and_parameters_table():
+    """Reference module.freeze / getParametersTable: frozen subtrees
+    take zero updates (incl. no weight-decay drift) under BOTH
+    optimizers; unfreeze resumes learning."""
+    from bigdl_tpu.optim.regularizer import L2Regularizer
+
+    x, y = _toy(n=128, seed=6)
+
+    def build():
+        from bigdl_tpu.common import RandomGenerator
+
+        RandomGenerator.RNG.set_seed(21)
+        m = Sequential() \
+            .add(Linear(16, 32, w_regularizer=L2Regularizer(1e-2))
+                 .set_name("stem")) \
+            .add(ReLU()) \
+            .add(Linear(32, 4).set_name("head")) \
+            .add(LogSoftMax())
+        return m
+
+    for cls in (LocalOptimizer, DistriOptimizer):
+        model = build()
+        model.freeze("stem")
+        w_before = np.asarray(model.modules[0].weight).copy()
+        h_before = np.asarray(model.modules[2].weight).copy()
+        opt = cls(model, (x, y), ClassNLLCriterion(), batch_size=32)
+        opt.set_optim_method(SGD(learningrate=0.5))
+        opt.set_end_when(Trigger.max_epoch(2))
+        opt.optimize()
+        np.testing.assert_array_equal(
+            np.asarray(model.modules[0].weight), w_before,
+            err_msg=f"{cls.__name__} moved frozen weights")
+        assert not np.allclose(np.asarray(model.modules[2].weight),
+                               h_before), f"{cls.__name__} head frozen too"
+
+        model.unfreeze("stem")
+        opt2 = cls(model, (x, y), ClassNLLCriterion(), batch_size=32)
+        opt2.set_optim_method(SGD(learningrate=0.5))
+        opt2.set_end_when(Trigger.max_epoch(1))
+        opt2.optimize()
+        assert not np.allclose(np.asarray(model.modules[0].weight),
+                               w_before), f"{cls.__name__} unfreeze inert"
+
+    table = build().get_parameters_table()
+    assert "stem" in table and "head" in table
+    assert set(table["stem"]) == {"weight", "bias"}
