@@ -304,6 +304,53 @@ class AbstractModule:
                     loss = loss + reg(params[pname])
         return loss
 
+    # ---------------------------------------------------------- persistence
+    def save(self, path: str, over_write: bool = False):
+        """Reference: ``model.save(path)`` — persist through the
+        ``.bigdl`` protobuf serializer (see utils/serializer)."""
+        import os
+
+        from bigdl_tpu.utils.serializer import save_module
+
+        if not over_write and os.path.exists(path):
+            raise FileExistsError(
+                f"{path} exists; pass over_write=True (reference "
+                "overWrite semantics)")
+        return save_module(self, path)
+
+    saveModule = save
+
+    def save_weights(self, path: str, over_write: bool = False):
+        """Reference: ``model.saveWeights(path)`` — weights-only npz."""
+        import os
+
+        import numpy as np
+
+        if not over_write and os.path.exists(path):
+            raise FileExistsError(
+                f"{path} exists; pass over_write=True")
+        arrays = {str(i): np.asarray(w)
+                  for i, w in enumerate(self.get_weights())}
+        np.savez(path, **arrays)
+        return path
+
+    def load_weights(self, path: str):
+        """Reference: ``model.loadWeights(path)`` — restore npz weights
+        in :meth:`get_weights` order."""
+        import numpy as np
+
+        with np.load(path) as data:
+            weights = [data[str(i)] for i in range(len(data.files))]
+        self.set_weights(weights)
+        return self
+
+    saveWeights = save_weights
+    loadWeights = load_weights
+
+    # reference: model.test(dataset, methods) — evaluation spelling
+    def test(self, dataset, methods, batch_size: int = 32):
+        return self.evaluate(dataset, methods, batch_size)
+
     # ------------------------------------------------------------ freezing
     def freeze(self, *names):
         """Reference: ``module.freeze(names*)`` — with no names, freeze

@@ -296,3 +296,37 @@ def test_every_exported_layer_is_covered_or_known():
             if name not in covered and name not in dedicated:
                 missing.append(name)
     assert not missing, f"layers with no round-trip coverage: {missing}"
+
+
+def test_module_save_load_weights_and_save(tmp_path):
+    """Classic persistence spellings: model.save / saveWeights /
+    loadWeights / test."""
+    from bigdl_tpu.nn import Linear, LogSoftMax, ReLU, Sequential
+    from bigdl_tpu.utils.serializer import load_module
+
+    m = Sequential().add(Linear(6, 8)).add(ReLU()).add(Linear(8, 3)) \
+        .add(LogSoftMax())
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 6), jnp.float32)
+    m.evaluate()
+    ref = np.asarray(m.forward(x))
+
+    p = m.save(str(tmp_path / "m.bigdl"))
+    loaded = load_module(p)
+    loaded.evaluate()
+    np.testing.assert_allclose(np.asarray(loaded.forward(x)), ref, rtol=1e-6)
+    with pytest.raises(FileExistsError):
+        m.save(p)
+
+    wp = m.save_weights(str(tmp_path / "w.npz"))
+    m2 = Sequential().add(Linear(6, 8)).add(ReLU()).add(Linear(8, 3)) \
+        .add(LogSoftMax())
+    m2.load_weights(wp)
+    m2.evaluate()
+    np.testing.assert_allclose(np.asarray(m2.forward(x)), ref, rtol=1e-6)
+
+    # test() == evaluate(dataset, methods)
+    from bigdl_tpu.optim import Top1Accuracy
+
+    y = np.ones(2, np.float32)
+    res = m.test((np.asarray(x), y), [Top1Accuracy()])
+    assert len(res) == 1
