@@ -326,6 +326,8 @@ class AbstractModule:
 
         import numpy as np
 
+        if not path.endswith(".npz"):
+            path += ".npz"  # np.savez appends it; keep check+return true
         if not over_write and os.path.exists(path):
             raise FileExistsError(
                 f"{path} exists; pass over_write=True")

@@ -266,6 +266,14 @@ class DistriOptimizer(LocalOptimizer):
                     (shard_len,)
                 )
                 new_wshard, new_opt = opt.step(gshard, wshard, opt_st)
+                if grad_mask_flat is not None:
+                    # mask the UPDATE as well as the gradient: optimizer
+                    # -internal weight decay adds wd*p past the zeroed
+                    # gradient — frozen parameters must not move at all
+                    mshard = jax.lax.dynamic_slice(
+                        jnp.pad(grad_mask_flat, (0, pad)),
+                        (idx * shard_len,), (shard_len,))
+                    new_wshard = wshard + mshard * (new_wshard - wshard)
             with jax.named_scope("send_weights"):
                 # ---- sendWeightPartition + getWeights -------------------
                 new_flat = jax.lax.all_gather(new_wshard, axis, tiled=True)

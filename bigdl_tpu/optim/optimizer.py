@@ -315,10 +315,19 @@ class LocalOptimizer(BaseOptimizer):
             (_, (loss, new_mstate)), grad = jax.value_and_grad(
                 loss_fn, has_aux=True
             )(p, mstate, rng, inp, tgt)
-            grad = clipper(grad)
             if mask is not None:
+                # mask BEFORE the clipper so frozen gradients cannot
+                # inflate the global norm and over-shrink live ones
                 grad = jax.tree.map(lambda g, s: g * s, grad, mask)
+            grad = clipper(grad)
             new_p, new_opt = opt.step(grad, p, opt_st)
+            if mask is not None:
+                # and mask the UPDATE too: optimizer-internal weight
+                # decay adds wd*p past the zeroed gradient — frozen
+                # parameters must not move at all
+                new_p = jax.tree.map(
+                    lambda old, new, s: old + s * (new - old),
+                    p, new_p, mask)
             return new_p, new_opt, new_mstate, loss
 
         return train_step

@@ -276,9 +276,16 @@ class TensorflowLoader:
             # the LoopCond chain gates, it doesn't feed the outputs —
             # build it explicitly, then attach body feedbacks to a
             # fixpoint (building a body may reach further loop Merges)
-            for tf_node in list(self.nodes.values()):
-                if tf_node.op == "LoopCond":
-                    self._build(tf_node.name)
+            loop_conds = [n for n in self.nodes.values()
+                          if n.op == "LoopCond"]
+            if len(loop_conds) > 1:
+                # a single masked scan can gate only one loop; silently
+                # merging two frames would stop both on one condition
+                raise TFConversionException(
+                    "multiple while loops in one graph unsupported "
+                    f"({[n.name for n in loop_conds]})")
+            for tf_node in loop_conds:
+                self._build(tf_node.name)
             attached = set()
             while True:
                 pending = [k for k in self._loop_feedbacks
@@ -290,7 +297,6 @@ class TensorflowLoader:
                     src = self._build(
                         self._data_inputs(self.nodes[ni_name])[0])
                     self._loop_feedbacks[ni_name].feedback_from(src)
-        if self._loop_feedbacks:
             # TF while is cond-before-body; the masked-scan DynamicGraph
             # is do-while, identical for any trip count >= 1 (zero-trip
             # loops are out of scope — graph.py docstring)

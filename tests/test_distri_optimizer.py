@@ -527,3 +527,25 @@ def test_freeze_and_parameters_table():
     table = build().get_parameters_table()
     assert "stem" in table and "head" in table
     assert set(table["stem"]) == {"weight", "bias"}
+
+
+def test_freeze_survives_optimizer_weight_decay():
+    """Freeze must hold against optimizer-INTERNAL weight decay (wd*p
+    added past the zeroed gradient) in both optimizers."""
+    x, y = _toy(n=64, seed=8)
+    from bigdl_tpu.common import RandomGenerator
+
+    for cls in (LocalOptimizer, DistriOptimizer):
+        RandomGenerator.RNG.set_seed(23)
+        model = Sequential() \
+            .add(Linear(16, 8).set_name("stem")) \
+            .add(ReLU()).add(Linear(8, 4)).add(LogSoftMax())
+        model.freeze("stem")
+        w_before = np.asarray(model.modules[0].weight).copy()
+        opt = cls(model, (x, y), ClassNLLCriterion(), batch_size=32)
+        opt.set_optim_method(SGD(learningrate=0.5, weightdecay=1e-2))
+        opt.set_end_when(Trigger.max_epoch(2))
+        opt.optimize()
+        np.testing.assert_array_equal(
+            np.asarray(model.modules[0].weight), w_before,
+            err_msg=f"{cls.__name__}: weight decay moved frozen weights")
