@@ -70,3 +70,11 @@ def test_tensorflow_finetune_example():
 
     acc = main(["-e", "8"])
     assert acc > 0.9, f"tf finetune accuracy {acc}"
+
+
+@pytest.mark.slow
+def test_finetune_frozen_backbone_example():
+    from examples.imageclassification.finetune_frozen_backbone import main
+
+    acc = main(["-e", "5"])
+    assert acc > 0.9, f"fine-tune accuracy {acc}"
