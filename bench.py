@@ -459,6 +459,28 @@ def _bench_dlframes(n_rows=4096, n_feat=64, epochs=2):
     return n_rows * (epochs + 1) / dt  # rows/sec through fit+transform
 
 
+def _bench_wide_and_deep(n=4096, batch=256, iters=20):
+    """Parity config (SURVEY "Sparse tensor"): wide-and-deep over the
+    padded fixed-slot sparse encoding — samples/sec/chip."""
+    from bigdl_tpu.models import build_wide_and_deep, pack_batch
+    from bigdl_tpu.nn import ClassNLLCriterion, SparseTensor
+
+    rs = np.random.RandomState(0)
+    WV, slots = 10000, 8
+    deep_vocabs = (100, 50, 20)
+    cols = rs.randint(0, WV, (n, 4))
+    rows = np.repeat(np.arange(n), 4)
+    sp = SparseTensor(np.stack([rows, cols.reshape(-1)], 1),
+                      np.ones(n * 4, np.float32), (n, WV))
+    deep = np.stack([rs.randint(1, v + 1, n) for v in deep_vocabs], 1)
+    y = (rs.randint(0, 2, n) + 1).astype(np.float32)
+    x = pack_batch(sp, deep, slots)
+    model = build_wide_and_deep(WV, deep_vocabs, class_num=2,
+                                wide_slots=slots)
+    return _bench_local_optimizer(
+        model, x[:batch], y[:batch], ClassNLLCriterion(), batch, iters)
+
+
 def _bench_lenet(platform_batch=256, iters=20):
     """Secondary config (BASELINE.md table): LeNet-5 / LocalOptimizer."""
     from bigdl_tpu.models.lenet import build_lenet5
