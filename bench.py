@@ -20,10 +20,14 @@ driver's kill window):
     final line before exiting 0 — a driver `timeout` kill yields JSON;
   * the child self-truncates: it stops starting new segments when its
     own deadline nears, labelling skipped segments in extras;
-  * worst-case envelope (all defaults): probe 120 + TPU child 900 +
-    CPU child 240 + slop < BENCH_TIMEOUT 1500s.  Every budget is
-    env-overridable; tests/test_bench_envelope.py proves the arithmetic
-    and exercises the hung-bring-up path with compressed budgets.
+  * a probe timeout no longer forfeits the round (VERDICT r4 item 1a):
+    after the CPU fallback the parent re-probes ONCE — a tunnel that
+    recovers mid-window still yields the real TPU measurement;
+  * worst-case envelope (all defaults): probe 120 + CPU child 240 +
+    re-probe 120 + TPU child 900 + slop < BENCH_TIMEOUT 1500s.  Every
+    budget is env-overridable; tests/test_bench_envelope.py proves the
+    arithmetic and exercises both the hung-bring-up and the
+    tunnel-recovers paths with compressed budgets.
 
 The headline metric is BASELINE.json's (ResNet-50 ImageNet images/sec/
 chip).  ``vs_baseline`` compares against a hand-written plain-JAX
@@ -45,10 +49,20 @@ import time
 
 import numpy as np
 
+def _env_flag(name):
+    return os.environ.get(name, "").lower() in ("1", "true", "yes")
+
+
+# BENCH_ALLOW_CPU_STANDIN marks an envelope-test invocation; the
+# headline-redefining overrides (image size / iters) are honored ONLY
+# then, so a leaked BENCH_IMG can never silently inflate a real round's
+# 224px headline series
+_TEST_MODE = _env_flag("BENCH_ALLOW_CPU_STANDIN")
+
 BATCH = 32
-IMG = 224
+IMG = int(os.environ.get("BENCH_IMG", "224")) if _TEST_MODE else 224
 N_CLASSES = 1000
-ITERS = 10
+ITERS = int(os.environ.get("BENCH_ITERS", "10")) if _TEST_MODE else 10
 
 # batch sweep (VERDICT r2 #2): batch 32 underfeeds the MXU; measure a
 # sweep and report the best operating point as the headline.  PRIORITY
@@ -58,7 +72,7 @@ ITERS = 10
 # batch-32 number.
 SWEEP_BATCHES = tuple(
     int(b) for b in os.environ.get("BENCH_BATCHES", "128,256,64,32").split(",")
-)
+) if _TEST_MODE else (128, 256, 64, 32)
 
 # CPU fallback must finish on one core: tiny shapes, clearly labelled
 # (env-overridable so the envelope test can compress them further)
@@ -396,19 +410,22 @@ def _bench_ptb(batch=64, num_steps=20, iters=20):
     return ips * num_steps  # tokens/sec
 
 
-def _bench_transformer(batch=16, seq=512, iters=10):
+def _bench_transformer(batch=16, seq=512, iters=10, *, vocab=8192,
+                       dim=512, n_head=8, n_layer=8):
     """Beyond-parity flagship: decoder-only TransformerLM (Pallas flash
-    attention) — tokens/sec/chip at a long-context operating point."""
+    attention) — tokens/sec/chip at a long-context operating point.
+    The CPU fallback passes a tiny config so the metric is at least
+    populated (VERDICT r4 item 4)."""
     import jax
     import jax.numpy as jnp
 
     from bigdl_tpu.models.transformer import build_transformer_lm
 
-    vocab, dim = 8192, 512
-    model = build_transformer_lm(vocab, dim=dim, n_head=8, n_layer=8,
-                                 max_len=seq)
+    model = build_transformer_lm(vocab, dim=dim, n_head=n_head,
+                                 n_layer=n_layer, max_len=seq)
     rs = np.random.RandomState(0)
-    x = jnp.asarray(rs.randint(1, vocab + 1, (batch, seq)).astype(np.float32))
+    # TokenEmbedding is 0-based (models/transformer.py): ids in [0, vocab)
+    x = jnp.asarray(rs.randint(0, vocab, (batch, seq)).astype(np.float32))
     y = rs.randint(0, vocab, (batch, seq))
 
     params = model.params()
@@ -506,6 +523,7 @@ def _child_platform_setup(platform: str):
     raise / hang — the parent's probe + deadline own that risk)."""
     import jax
 
+    tpu_platform = None
     if platform == "cpu":
         jax.config.update("jax_platforms", "cpu")
     else:
@@ -522,7 +540,12 @@ def _child_platform_setup(platform: str):
     t0 = time.time()
     dev = jax.devices()[0]
     init_s = round(time.time() - t0, 1)
-    if platform != "cpu" and dev.platform == "cpu":
+    # BENCH_TPU_PLATFORM=cpu + BENCH_ALLOW_CPU_STANDIN is the envelope
+    # tests' stand-in chip; BOTH are required so a leaked
+    # BENCH_TPU_PLATFORM alone can never reinstate the silent-CPU
+    # fallback this guard exists to prevent
+    standin = tpu_platform == "cpu" and _TEST_MODE
+    if platform != "cpu" and not standin and dev.platform == "cpu":
         raise RuntimeError(
             f"requested accelerator platform but got {dev.platform!r}"
         )
@@ -533,7 +556,14 @@ def _probe_child(platform: str):
     """--probe mode: bring-up only.  Proves the platform answers fast
     enough to be worth a measurement budget."""
     if os.environ.get("BENCH_FAKE_PROBE_HANG"):  # envelope test hook
-        time.sleep(float(os.environ["BENCH_FAKE_PROBE_HANG"]))
+        # hang-once variant: a marker file makes only the FIRST probe
+        # hang — the flapping-tunnel-recovers scenario (VERDICT r4 1a)
+        once = os.environ.get("BENCH_FAKE_PROBE_HANG_ONCE_FILE")
+        if once is None or not os.path.exists(once):
+            if once is not None:
+                with open(once, "w") as f:
+                    f.write("1")
+            time.sleep(float(os.environ["BENCH_FAKE_PROBE_HANG"]))
     if os.environ.get("BENCH_FAKE_PROBE_ERROR"):  # envelope test hook
         raise RuntimeError("BENCH_FAKE_PROBE_ERROR injected")
     dev, init_s = _child_platform_setup(platform)
@@ -552,8 +582,12 @@ def _run_child(platform: str):
     child_t0 = time.time()
     child_budget = float(os.environ.get("BENCH_CHILD_BUDGET", "86400"))
     # don't START a segment when less than this remains: a ResNet-50
-    # fwd+bwd compile alone can take ~60-120s on first trace
-    seg_reserve = float(os.environ.get("BENCH_SEG_RESERVE", "150"))
+    # fwd+bwd compile alone can take ~60-120s on first trace — but the
+    # CPU fallback's tiny (batch-4, 64px) headline compiles far faster,
+    # and a 150s reserve there would let the secondaries-first reorder
+    # starve the headline out of a 225s child budget
+    seg_reserve = float(os.environ.get(
+        "BENCH_SEG_RESERVE", "150" if platform != "cpu" else "60"))
 
     if platform == "cpu":
         img, iters = CPU_IMG, CPU_ITERS
@@ -602,6 +636,10 @@ def _run_child(platform: str):
     def remaining():
         return child_budget - (time.time() - child_t0)
 
+    def ok_segments():
+        return [s for s in ex["completed_segments"]
+                if not s.endswith(":failed")]
+
     def data(b):
         x = np.random.RandomState(0).randn(b, 3, img, img).astype(np.float32)
         y = (np.random.RandomState(1).randint(0, N_CLASSES, b) + 1).astype(
@@ -626,13 +664,59 @@ def _run_child(platform: str):
             result["vs_baseline"] = round(
                 fw / ex["baseline_images_per_sec"], 4)
 
-    # --- segment plan, headline-first -------------------------------
-    # 1..n: framework std at each sweep batch (first = priority batch)
-    # then: baseline at the best batch (gives vs_baseline)
-    # then: fused at the best batch (extras)
-    # then: secondaries lenet/ptb/transformer/dlframes
+    def run_secondaries():
+        # CPU tiny configs are cheap-first: a truncated CPU fallback
+        # must still deliver every secondary metric (VERDICT r4 item 4);
+        # their reserve is far below seg_reserve because none of them
+        # needs a ResNet-50-sized compile
+        sec_reserve = float(os.environ.get(
+            "BENCH_SEC_RESERVE", "30" if platform == "cpu" else str(
+                seg_reserve)))
+        if platform == "cpu":
+            plan = [
+                ("lenet", "lenet_local_images_per_sec",
+                 lambda: _bench_lenet(64, iters=4)),
+                ("dlframes", "dlframes_fit_transform_rows_per_sec",
+                 lambda: _bench_dlframes(1024, 32, 1)),
+                ("ptb", "ptb_lstm_tokens_per_sec",
+                 lambda: _bench_ptb(batch=16, num_steps=10, iters=4)),
+                ("transformer", "transformer_lm_tokens_per_sec",
+                 lambda: _bench_transformer(batch=2, seq=64, iters=3,
+                                            vocab=512, dim=64, n_head=2,
+                                            n_layer=2)),
+            ]
+        else:
+            plan = [
+                ("lenet", "lenet_local_images_per_sec", _bench_lenet),
+                ("ptb", "ptb_lstm_tokens_per_sec", _bench_ptb),
+                ("transformer", "transformer_lm_tokens_per_sec",
+                 _bench_transformer),
+                ("dlframes", "dlframes_fit_transform_rows_per_sec",
+                 _bench_dlframes),
+            ]
+        for name, key, fn in plan:
+            if remaining() < sec_reserve:
+                ex["skipped_segments"].append(name)
+                continue
+            try:
+                v = fn()
+                ex[key] = round(v, 1) if v else None
+                emit(name)
+            except Exception as e:  # secondary must not sink the bench
+                ex.setdefault("secondary_errors", {})[name] = (
+                    f"{type(e).__name__}: {str(e)[:160]}")
+                emit(f"{name}:failed")
+
+    # --- segment plan -----------------------------------------------
+    # TPU: headline-first — framework std sweep, baseline, fused, then
+    # secondaries.  CPU fallback: the cheap secondaries FIRST (they have
+    # been null in every driver artifact; the ResNet compile alone can
+    # eat a truncated window), then the std headline + baseline.
+    if platform == "cpu":
+        run_secondaries()
+
     for i, b in enumerate(batches):
-        if i > 0 and remaining() < seg_reserve:
+        if remaining() < seg_reserve and (i > 0 or ok_segments()):
             ex["skipped_segments"].append(f"std_b{b}")
             continue
         x, y = data(b)
@@ -656,7 +740,17 @@ def _run_child(platform: str):
         emit(f"std_b{b}")
 
     if best is None:
-        raise RuntimeError(f"all sweep batches failed: {ex['batch_sweep']}")
+        if not ok_segments():
+            raise RuntimeError(
+                f"all sweep batches failed: {ex['batch_sweep']}")
+        # secondaries are banked but the headline never ran (truncated
+        # CPU fallback): emit a final value-less result instead of
+        # throwing the secondaries away
+        ex["skipped_segments"].append("baseline")
+        result["error"] = "headline segment truncated; secondaries only"
+        result["partial"] = False
+        print(PARTIAL_MARK + json.dumps(result), flush=True)
+        return
     batch = best[2]
 
     if remaining() >= seg_reserve:
@@ -692,26 +786,8 @@ def _run_child(platform: str):
         else:
             ex["skipped_segments"].append("fused_conv_bn")
 
-    secondaries = [
-        ("lenet", "lenet_local_images_per_sec", _bench_lenet),
-        ("ptb", "ptb_lstm_tokens_per_sec", _bench_ptb),
-        ("transformer", "transformer_lm_tokens_per_sec",
-         _bench_transformer if platform != "cpu" else None),
-        ("dlframes", "dlframes_fit_transform_rows_per_sec",
-         _bench_dlframes),
-    ]
-    for name, key, fn in secondaries:
-        if fn is None:
-            continue
-        if remaining() < seg_reserve:
-            ex["skipped_segments"].append(name)
-            continue
-        try:
-            v = fn()
-            ex[key] = round(v, 1) if v else None
-        except Exception:  # secondary metric must not sink the bench
-            pass
-        emit(name)
+    if platform != "cpu":
+        run_secondaries()
 
     result["partial"] = False
     print(PARTIAL_MARK + json.dumps(result), flush=True)
@@ -730,7 +806,24 @@ def _partial_path():
                         "BENCH_PARTIAL.json")
 
 
+def _measured(d):
+    """A result is 'measured' if it carries a headline value OR any
+    successfully completed segment (the secondaries-only CPU fallback
+    has value=None by design but is still a banked measurement)."""
+    if d.get("value") is not None:
+        return True
+    return any(not s.endswith(":failed")
+               for s in (d.get("extras") or {}).get(
+                   "completed_segments", []))
+
+
 def _record_partial(d):
+    # dominance rule: an unmeasured partial never clobbers a measured
+    # result already in hand — otherwise the post-fallback TPU re-run's
+    # early (possibly failed) partials would overwrite the banked CPU
+    # fallback, and a driver SIGTERM would dump an empty artifact
+    if not _measured(d) and _LATEST and _measured(_LATEST):
+        return
     _LATEST.clear()
     _LATEST.update(d)
     try:
@@ -837,8 +930,9 @@ def _empty_result(errors):
 
 
 # default budgets; the envelope invariant (tests/test_bench_envelope.py):
-# PROBE + TPU + CPU + 90s orchestration slop <= TIMEOUT, and every spawn
-# is additionally capped by remaining() so the sum can never overshoot.
+# PROBE + CPU + RE-PROBE + TPU + 90s orchestration slop <= TIMEOUT, and
+# every spawn is additionally capped by remaining() so the sum can never
+# overshoot.
 DEFAULT_TIMEOUT = 1500.0
 DEFAULT_PROBE_TIMEOUT = 120.0
 DEFAULT_TPU_TIMEOUT = 900.0
@@ -912,6 +1006,17 @@ def main():
     # probe) and the remaining window still covers tpu+cpu budgets — a
     # timeout or a mid-run crash with partials is never retried
     result = None
+    cpu_res = None
+    cpu_child_err = None
+
+    def _cpu_error_label():
+        msg = ("TPU unavailable — CPU fallback with tiny shapes "
+               "(batch %d, %dpx): " % (CPU_BATCH, CPU_IMG)
+               + " | ".join(errors))
+        if cpu_child_err:
+            msg += " | child: " + cpu_child_err
+        return msg
+
     if tpu_ok:
         for attempt in (1, 2):
             budget = min(tpu_budget, remaining() - cpu_budget - 30)
@@ -934,22 +1039,58 @@ def main():
             time.sleep(10)
 
     if result is None or result.get("value") is None:
-        # CPU fallback: tiny shapes, labelled, still a full JSON line
+        # CPU fallback: tiny shapes, labelled, still a full JSON line.
+        # Leave headroom for the post-fallback re-probe when the window
+        # still covers one (VERDICT r4 item 1a).
         budget = max(60.0, min(cpu_budget, remaining() - 15))
         cpu_res, err = _spawn_streaming(
             "--run", "cpu", budget,
             extra_env={"BENCH_CHILD_BUDGET": max(45.0, budget - 15)})
         if err:
             errors.append(err)
-        if cpu_res is not None and cpu_res.get("value") is not None:
+        if cpu_res is not None and _measured(cpu_res):
             result = cpu_res
-            result["error"] = (
-                "TPU unavailable — CPU fallback with tiny shapes "
-                "(batch %d, %dpx): " % (CPU_BATCH, CPU_IMG)
-                + " | ".join(errors))
+            # label IMMEDIATELY (and mirror to _LATEST): a driver
+            # SIGTERM during the post-fallback re-probe window must dump
+            # a labelled artifact, not a clean-looking CPU number
+            cpu_child_err = cpu_res.get("error")
+            result["error"] = _cpu_error_label()
+            _record_partial(result)
+
+    # --- post-fallback re-probe (VERDICT r4 item 1a) ----------------
+    # The tunnel flaps on tens-of-minutes timescales (it recovered
+    # mid-round in r03; r04 lost the whole round to ONE early timeout).
+    # After the CPU fallback, if the first probe never succeeded and the
+    # window still covers a probe + a useful TPU measurement, probe once
+    # more and upgrade to the real number.
+    if not tpu_ok and remaining() - 180 >= 20:
+        budget = min(probe_budget, remaining() - 180)
+        reprobe, err = _spawn_streaming("--probe", "tpu", budget)
+        if reprobe and reprobe.get("probe"):
+            budget = min(tpu_budget, remaining() - 30)
+            if budget >= 120:
+                tpu_res, err = _spawn_streaming(
+                    "--run", "tpu", budget,
+                    extra_env={
+                        "BENCH_CHILD_BUDGET": max(60.0, budget - 30)})
+                if err:
+                    errors.append(f"post-fallback run: {err}")
+                if tpu_res is not None and tpu_res.get("value") is not None:
+                    tpu_res["error"] = None
+                    result = tpu_res
+        else:
+            errors.append(f"re-probe: {err or 'no output'}")
 
     if result is None:
         result = _empty_result(errors)
+    elif result is cpu_res:
+        # re-bake the label LAST so the re-probe attempt's outcome
+        # (failure appended to `errors`; success replaced `result`) and
+        # the child's own cause (e.g. "headline truncated") both land
+        # in the round artifact
+        result["error"] = (_cpu_error_label()
+                           + (" [truncated]" if result.get("partial")
+                              else ""))
     elif result.get("partial"):
         result["error"] = ((result.get("error") or "") + " truncated: " +
                            " | ".join(errors)).strip()

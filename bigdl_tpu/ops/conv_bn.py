@@ -34,13 +34,37 @@ the activation anyway, there is no second pass to save).
 from __future__ import annotations
 
 import functools
+import logging
 
 import jax
 import jax.numpy as jnp
 
+_log = logging.getLogger(__name__)
+
 # per-core VMEM working budget for tile selection: real VMEM is ~16MB
 # on v4/v5e; leave headroom for double-buffering + compiler temporaries
 _VMEM_BUDGET = 10 * 1024 * 1024
+
+# trace-time fallback ledger (VERDICT r4 item 3): every silent
+# `_reference` bail used to be invisible — a production shape quietly
+# regressing to XLA would never show in the headline number.  Each bail
+# now appends {reason, x_shape, w_shape, stride, pad} here (shapes are
+# static, so this fires once per compile, not per step) and logs a
+# warning.  tests/test_conv_bn_paths.py pins every ResNet-50 fused
+# call site to the Pallas path via `kernel_path`.
+FALLBACK_LOG: list = []
+
+
+def _note_fallback(reason, x_shape, w_shape, stride, pad):
+    rec = {
+        "reason": reason,
+        "x_shape": tuple(int(s) for s in x_shape),
+        "w_shape": tuple(int(s) for s in w_shape),
+        "stride": int(stride),
+        "pad": int(pad),
+    }
+    FALLBACK_LOG.append(rec)
+    _log.warning("conv_bn_stats fell back to XLA: %s", rec)
 
 
 def _conv_ref(x, w, stride, pad):
@@ -216,6 +240,38 @@ def _fwd_kernel_kxk(x_ref, w_ref, shift_ref, y_ref, s1_ref, s2_ref, *,
     y_ref[0] = acc.astype(y_ref.dtype)
 
 
+def _kxk_plan(c: int, h: int, wd: int, o: int, k: int, stride: int,
+              pad: int, xbytes: int):
+    """Static kxk feasibility + tile plan.  Returns
+    (block_o, ho, wo, reason) — ``reason`` is None when the Pallas
+    kernel applies, else a human-readable bail cause (the kernel then
+    uses the XLA reference path)."""
+    hp, wp_ = h + 2 * pad, wd + 2 * pad
+    ho = (hp - k) // stride + 1
+    wo = (wp_ - k) // stride + 1
+
+    # stride-2 reshape trick needs dy + 2*ho <= Hp for dy <= k-1;
+    # guaranteed for ResNet shapes, bail to reference otherwise
+    if stride not in (1, 2):
+        return None, ho, wo, f"stride {stride} not in (1, 2)"
+    if stride == 2 and (k - 1 + 2 * ho > hp or k - 1 + 2 * wo > wp_):
+        return None, ho, wo, "stride-2 reshape-parity bounds"
+
+    block_o = min(256, _round_up(o, 8))
+    while block_o > 8:
+        # padded image and weight block (both grid-varying, so Pallas
+        # double-buffers them) + tap-concat im2col + f32 acc/output
+        vmem = (2 * c * hp * wp_ * xbytes + k * k * c * ho * wo * xbytes
+                + 2 * k * k * block_o * c * xbytes
+                + block_o * ho * wo * (4 + xbytes))
+        if vmem <= _VMEM_BUDGET:
+            break
+        block_o //= 2
+    if (2 * c * hp * wp_ + k * k * c * ho * wo) * xbytes > _VMEM_BUDGET:
+        return None, ho, wo, "padded image + im2col exceed VMEM budget"
+    return block_o, ho, wo, None
+
+
 def _fwd_kxk(x, w, shift, stride, pad, interpret):
     """x (N,C,H,W), w (O,C,k,k), shift (O,) f32 ->
     (y (N,O,Ho,Wo), s1, s2).  Torch-style symmetric padding."""
@@ -224,28 +280,12 @@ def _fwd_kxk(x, w, shift, stride, pad, interpret):
     n, c, h, wd = x.shape
     o, _, k, _ = w.shape
     hp, wp_ = h + 2 * pad, wd + 2 * pad
-    ho = (hp - k) // stride + 1
-    wo = (wp_ - k) // stride + 1
-    xb = x.dtype.itemsize
 
-    # stride-2 reshape trick needs dy + 2*ho <= Hp for dy <= k-1;
-    # guaranteed for ResNet shapes, bail to reference otherwise
-    if stride not in (1, 2) or (
-            stride == 2 and (k - 1 + 2 * ho > hp or k - 1 + 2 * wo > wp_)):
+    block_o, ho, wo, reason = _kxk_plan(c, h, wd, o, k, stride, pad,
+                                        x.dtype.itemsize)
+    if reason is not None:
+        _note_fallback(reason, x.shape, w.shape, stride, pad)
         return _reference(x, w, shift, stride, pad)
-
-    block_o = min(256, _round_up(o, 8))
-    while block_o > 8:
-        # padded image and weight block (both grid-varying, so Pallas
-        # double-buffers them) + tap-concat im2col + f32 acc/output
-        vmem = (2 * c * hp * wp_ * xb + k * k * c * ho * wo * xb
-                + 2 * k * k * block_o * c * xb
-                + block_o * ho * wo * (4 + xb))
-        if vmem <= _VMEM_BUDGET:
-            break
-        block_o //= 2
-    if (2 * c * hp * wp_ + k * k * c * ho * wo) * xb > _VMEM_BUDGET:
-        return _reference(x, w, shift, stride, pad)  # image too big
     o_pad = _round_up(o, block_o)
 
     xpad = jnp.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
@@ -358,3 +398,26 @@ def conv1x1_bn_stats(x, w, shift, *, stride: int = 1,
     """1x1 fast path, kept as the r02 API: w (O, C)."""
     return conv_bn_stats(x, w, shift, stride=stride, pad=0,
                          interpret=interpret)
+
+
+def kernel_path(x_shape, w_shape, *, stride: int = 1, pad: int = 0,
+                itemsize: int = 2) -> str:
+    """Which path ``conv_bn_stats`` takes for these STATIC shapes —
+    ``"pallas_1x1"``, ``"pallas_kxk"``, or ``"xla:<reason>"``.
+
+    Mirrors the exact dispatch in ``_conv_bn_stats_vjp`` / ``_kxk_plan``
+    without tracing anything, so tests can pin every production call
+    site to the Pallas path (VERDICT r4 item 3).  ``itemsize`` is the
+    activation dtype's byte width (2 = bf16, the training compute
+    dtype).  Decisions are batch-independent: the kxk grid iterates
+    samples and the 1x1 kernel tiles (O, HW), so a shape proven at one
+    batch holds at any batch.
+    """
+    n, c, h, wd = (int(s) for s in x_shape)
+    w_shape = tuple(int(s) for s in w_shape)
+    o = w_shape[0]
+    k = 1 if len(w_shape) == 2 else w_shape[2]
+    if k == 1 and (len(w_shape) == 2 or w_shape[3] == 1) and pad == 0:
+        return "pallas_1x1"  # handles any (O, HW): padded + masked tiles
+    _, _, _, reason = _kxk_plan(c, h, wd, o, k, stride, pad, itemsize)
+    return "pallas_kxk" if reason is None else f"xla:{reason}"

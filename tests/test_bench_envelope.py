@@ -36,11 +36,14 @@ def _load_bench_module():
 
 
 def test_default_envelope_arithmetic():
-    """probe + tpu + cpu + orchestration slop must fit the deadline —
-    this is the inequality whose violation made round 3 blind."""
+    """probe + cpu + re-probe + tpu + orchestration slop must fit the
+    deadline — this is the inequality whose violation made round 3
+    blind.  The r05 worst case is the probe-timeout path: probe times
+    out, CPU fallback runs, the re-probe succeeds, and a full TPU
+    measurement follows (VERDICT r4 item 1a)."""
     b = _load_bench_module()
-    worst = (b.DEFAULT_PROBE_TIMEOUT + b.DEFAULT_TPU_TIMEOUT
-             + b.DEFAULT_CPU_TIMEOUT + 90.0)
+    worst = (b.DEFAULT_PROBE_TIMEOUT + b.DEFAULT_CPU_TIMEOUT
+             + b.DEFAULT_PROBE_TIMEOUT + b.DEFAULT_TPU_TIMEOUT + 90.0)
     assert worst <= b.DEFAULT_TIMEOUT, (
         f"worst-case child budgets {worst}s exceed BENCH_TIMEOUT "
         f"{b.DEFAULT_TIMEOUT}s")
@@ -50,9 +53,10 @@ def test_default_envelope_arithmetic():
 
 def _bench_env(**over):
     env = dict(os.environ)
-    env.pop("BENCH_FAKE_PROBE_HANG", None)
-    env.pop("BENCH_FAKE_PROBE_ERROR", None)
-    env.pop("BENCH_FAKE_TPU_HANG", None)
+    for k in ("BENCH_FAKE_PROBE_HANG", "BENCH_FAKE_PROBE_ERROR",
+              "BENCH_FAKE_TPU_HANG", "BENCH_FAKE_PROBE_HANG_ONCE_FILE",
+              "BENCH_TPU_PLATFORM", "BENCH_ALLOW_CPU_STANDIN"):
+        env.pop(k, None)
     env.update({k: str(v) for k, v in over.items()})
     return env
 
@@ -74,6 +78,7 @@ def test_hung_probe_falls_back_to_cpu_json():
         BENCH_CPU_TIMEOUT=150,
         BENCH_CPU_BATCH=2, BENCH_CPU_IMG=32, BENCH_CPU_ITERS=2,
         BENCH_SEG_RESERVE=10_000,       # CPU child: headline segment only
+        BENCH_SEC_RESERVE=10_000,       # ... and skip the secondaries
         JAX_PLATFORMS="cpu",
     )
     t0 = time.time()
@@ -93,6 +98,42 @@ def test_hung_probe_falls_back_to_cpu_json():
     with open(os.path.join(REPO, "BENCH_PARTIAL.json")) as f:
         disk = json.load(f)
     assert disk["value"] == res["value"]
+
+
+@pytest.mark.slow
+def test_tunnel_recovers_after_cpu_fallback(tmp_path):
+    """VERDICT r4 item 1a: a probe timeout must no longer forfeit the
+    round.  The first probe hangs (tunnel down), the CPU fallback runs,
+    the re-probe succeeds (tunnel recovered), and the parent upgrades to
+    a full measurement from the 'tpu' branch (stubbed onto CPU via
+    BENCH_TPU_PLATFORM with tiny shapes)."""
+    once = tmp_path / "probe_hung_once"
+    env = _bench_env(
+        BENCH_FAKE_PROBE_HANG=120,
+        BENCH_FAKE_PROBE_HANG_ONCE_FILE=str(once),
+        BENCH_PROBE_TIMEOUT=21,
+        BENCH_TIMEOUT=420,
+        BENCH_CPU_TIMEOUT=90,
+        BENCH_CPU_BATCH=2, BENCH_CPU_IMG=32, BENCH_CPU_ITERS=2,
+        BENCH_TPU_PLATFORM="cpu",       # stand-in chip for the test
+        BENCH_ALLOW_CPU_STANDIN=1,      # both required by the guard
+        BENCH_BATCHES="2", BENCH_IMG=32, BENCH_ITERS=2,
+        BENCH_SEG_RESERVE=10_000,       # headline segment only
+        BENCH_SEC_RESERVE=10_000,
+        JAX_PLATFORMS="cpu",
+    )
+    proc = subprocess.run(
+        [sys.executable, BENCH], stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True, env=env, cwd=REPO, timeout=460,
+    )
+    res = _last_json_line(proc.stdout)
+    assert proc.returncode == 0
+    assert once.exists(), "hang-once marker never written — hook dead"
+    # the final result came from the post-fallback TPU branch, not the
+    # CPU fallback: its error is cleared and the headline is measured
+    assert res["error"] is None, res["error"]
+    assert res["value"] is not None and res["value"] > 0
+    assert res["extras"]["batch"] == 2
 
 
 def test_sigterm_mid_probe_prints_json_and_exits_zero():
