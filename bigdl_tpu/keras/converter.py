@@ -113,6 +113,41 @@ def _build_layer(class_name: str, cfg: dict) -> Optional[KL.KerasLayer]:
             b_regularizer=_regularizer(cfg.get("b_regularizer")),
             name=name,
         )
+    if class_name == "Convolution3D":
+        if cfg.get("dim_ordering", "th") == "tf":
+            raise KerasConversionException(
+                "tf dim_ordering Convolution3D configs are not supported; "
+                "re-save the model with dim_ordering='th'"
+            )
+        return KL.Convolution3D(
+            cfg["nb_filter"], cfg["kernel_dim1"], cfg["kernel_dim2"],
+            cfg["kernel_dim3"],
+            activation=cfg.get("activation"),
+            border_mode=cfg.get("border_mode", "valid"),
+            subsample=_tuple(cfg.get("subsample", (1, 1, 1))),
+            input_shape=input_shape,
+            bias=cfg.get("bias", True),
+            name=name,
+        )
+    if class_name in ("MaxPooling3D", "AveragePooling3D"):
+        if cfg.get("dim_ordering", "th") == "tf":
+            raise KerasConversionException(
+                f"tf dim_ordering {class_name} unsupported")
+        cls = getattr(KL, class_name)
+        return cls(
+            pool_size=_tuple(cfg.get("pool_size", (2, 2, 2))),
+            strides=_tuple(cfg["strides"]) if cfg.get("strides") else None,
+            border_mode=cfg.get("border_mode", "valid"),
+            input_shape=input_shape,
+            name=name,
+        )
+    if class_name == "Highway":
+        return KL.Highway(
+            activation=cfg.get("activation"),
+            bias=cfg.get("bias", True),
+            input_shape=input_shape,
+            name=name,
+        )
     if class_name == "AtrousConvolution2D":
         if cfg.get("dim_ordering", "th") == "tf":
             raise KerasConversionException(
@@ -225,16 +260,18 @@ def _build_layer(class_name: str, cfg: dict) -> Optional[KL.KerasLayer]:
             kw["inner_activation"] = cfg.get("inner_activation",
                                              "hard_sigmoid")
         if cfg.get("stateful"):
+            # documented design decision (not an omission): stateful
+            # recurrents carry hidden state ACROSS batches, which the
+            # jit-pure per-batch recurrence deliberately resets; failing
+            # loudly beats silently training different semantics
             raise KerasConversionException(
                 f"stateful {class_name} {name}: cross-batch state is not "
                 "supported by the jit-pure recurrence")
-        if cfg.get("go_backwards"):
-            raise KerasConversionException(
-                f"go_backwards {class_name} unsupported")
         return cls(
             cfg["output_dim"],
             activation=cfg.get("activation", "tanh"),
             return_sequences=cfg.get("return_sequences", False),
+            go_backwards=cfg.get("go_backwards", False),
             input_shape=input_shape,
             dropout_W=cfg.get("dropout_W", 0.0) or 0.0,
             dropout_U=cfg.get("dropout_U", 0.0) or 0.0,
@@ -527,6 +564,35 @@ def _assign_weights(mod, lname, weight_names, arrays):
         mod.weight = jnp.asarray(w.reshape(np.asarray(mod.weight).shape))
         if len(arrays) > 1 and mod.bias is not None:
             mod.bias = jnp.asarray(arrays[1])
+    elif type(mod).__name__ == "VolumetricConvolution":
+        w = arrays[0]  # th: (nb_filter, in, k1, k2, k3) == OIDHW
+        mod.weight = jnp.asarray(w.reshape(np.asarray(mod.weight).shape))
+        if len(arrays) > 1 and mod.bias is not None:
+            mod.bias = jnp.asarray(arrays[1])
+    elif type(mod).__name__ == "Highway":
+        # keras-1.2.2 trainable order: W, W_carry, b, b_carry; keras
+        # stores (in, out) — transpose for the y = x W^T convention
+        named = {}
+        for n, a in zip(weight_names, arrays):
+            tail = n.rsplit("/", 1)[-1]
+            for suffix in ("W_carry", "b_carry", "W", "b"):
+                if tail.endswith(suffix):
+                    named.setdefault(suffix, a)
+                    break
+        if len(named) == len(arrays):
+            mod.weight = jnp.asarray(named["W"].T)
+            mod.carry_weight = jnp.asarray(named["W_carry"].T)
+            if "b" in named:
+                mod.bias = jnp.asarray(named["b"])
+            if "b_carry" in named:
+                mod.carry_bias = jnp.asarray(named["b_carry"])
+        else:  # positional fallback
+            mod.weight = jnp.asarray(arrays[0].T)
+            mod.carry_weight = jnp.asarray(arrays[1].T)
+            if len(arrays) > 2:
+                mod.bias = jnp.asarray(arrays[2])
+            if len(arrays) > 3:
+                mod.carry_bias = jnp.asarray(arrays[3])
     elif isinstance(mod, (L.BatchNormalization,)):
         mod.weight = jnp.asarray(arrays[0])
         mod.bias = jnp.asarray(arrays[1])

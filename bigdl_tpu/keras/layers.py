@@ -352,7 +352,8 @@ class _KerasRecurrent(KerasLayer):
     def __init__(self, output_dim: int, activation="tanh",
                  inner_activation="hard_sigmoid", return_sequences=False,
                  input_shape=None, input_dim=None, input_length=None,
-                 stateful=False, dropout_W=0.0, dropout_U=0.0,
+                 stateful=False, go_backwards=False,
+                 dropout_W=0.0, dropout_U=0.0,
                  W_regularizer=None, U_regularizer=None, b_regularizer=None,
                  name=None):
         if input_shape is None and input_dim is not None:
@@ -374,6 +375,7 @@ class _KerasRecurrent(KerasLayer):
         self.activation = activation
         self.inner_activation = inner_activation
         self.return_sequences = return_sequences
+        self.go_backwards = go_backwards
         self.dropout_W = dropout_W
         self.W_regularizer = W_regularizer
         self.U_regularizer = U_regularizer
@@ -385,6 +387,14 @@ class _KerasRecurrent(KerasLayer):
     def build(self, input_shape):
         n_in = int(input_shape[-1])
         core = M.Sequential()
+        if self.go_backwards:
+            # Keras-1.2.2 go_backwards: iterate the sequence reversed;
+            # returned sequences stay in PROCESSING order (keras does
+            # not re-flip them), so one time-axis Reverse before the
+            # scan reproduces both return_sequences modes
+            from bigdl_tpu.nn.layers_extra import Reverse as _Rev
+
+            core.add(_Rev(2))
         core.add(R.Recurrent().add(self._cell(n_in)))
         if not self.return_sequences:
             core.add(R.Select(2, -1))
@@ -442,6 +452,13 @@ class Bidirectional(KerasLayer):
         if merge_mode not in ("concat", "sum", "mul", "ave"):
             raise ValueError(f"Bidirectional merge_mode {merge_mode!r} "
                              "unsupported")
+        if getattr(layer, "go_backwards", False):
+            # BiRecurrent drives both directions itself; building from
+            # layer._cell would silently ignore the inner flag (which in
+            # keras swaps which wrapped copy sees the reversed sequence)
+            raise ValueError(
+                "Bidirectional(go_backwards=True) unsupported: the "
+                "direction pair is already covered by BiRecurrent")
         self.layer = layer
         self.merge_mode = merge_mode
 
@@ -796,10 +813,117 @@ class MaxoutDense(KerasLayer):
         return tuple(input_shape[:-1]) + (self.output_dim,)
 
 
+def _triple(v):
+    if isinstance(v, (tuple, list)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * 3
+
+
+class Convolution3D(KerasLayer):
+    """keras.layers.Convolution3D — NCDHW ("th") layout; kernel_dim1/2/3
+    map to the volumetric (T, H, W) axes."""
+
+    def __init__(self, nb_filter: int, kernel_dim1: int, kernel_dim2: int,
+                 kernel_dim3: int, activation=None,
+                 border_mode: str = "valid", subsample=(1, 1, 1),
+                 input_shape=None, bias=True, name=None):
+        super().__init__(input_shape, name)
+        self.nb_filter = nb_filter
+        self.kdims = (kernel_dim1, kernel_dim2, kernel_dim3)
+        self.activation = activation
+        self.border_mode = border_mode
+        self.subsample = _triple(subsample)
+        self.bias = bias
+
+    def build(self, input_shape):
+        from bigdl_tpu.nn.volumetric import VolumetricConvolution
+
+        n_in = int(input_shape[0])
+        pad = -1 if self.border_mode == "same" else 0
+        k1, k2, k3 = self.kdims
+        s1, s2, s3 = self.subsample
+        core = M.Sequential()
+        core.add(VolumetricConvolution(
+            n_in, self.nb_filter, k1, k3, k2, s1, s3, s2,
+            pad, pad, pad, with_bias=self.bias,
+        ))
+        act = _activation_module(self.activation)
+        if act is not None:
+            core.add(act)
+        return core
+
+    def compute_output_shape(self, input_shape):
+        _, d1, d2, d3 = input_shape
+        dims = []
+        for size, k, s in zip((d1, d2, d3), self.kdims, self.subsample):
+            if self.border_mode == "same":
+                dims.append(-(-size // s))
+            else:
+                dims.append((size - k) // s + 1)
+        return (self.nb_filter,) + tuple(dims)
+
+
+class MaxPooling3D(KerasLayer):
+    """keras.layers.MaxPooling3D — NCDHW."""
+
+    _pool_cls_name = "VolumetricMaxPooling"
+
+    def __init__(self, pool_size=(2, 2, 2), strides=None,
+                 border_mode: str = "valid", input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.pool_size = _triple(pool_size)
+        self.strides = _triple(strides) if strides else self.pool_size
+        self.border_mode = border_mode
+
+    def build(self, input_shape):
+        import bigdl_tpu.nn.volumetric as V
+
+        cls = getattr(V, self._pool_cls_name)
+        k1, k2, k3 = self.pool_size
+        s1, s2, s3 = self.strides
+        pad = -1 if self.border_mode == "same" else 0
+        return cls(k1, k3, k2, s1, s3, s2, pad, pad, pad)
+
+    def compute_output_shape(self, input_shape):
+        c = input_shape[0]
+        dims = []
+        for size, k, s in zip(input_shape[1:], self.pool_size,
+                              self.strides):
+            if self.border_mode == "same":
+                dims.append(-(-size // s))
+            else:
+                dims.append((size - k) // s + 1)
+        return (c,) + tuple(dims)
+
+
+class AveragePooling3D(MaxPooling3D):
+    _pool_cls_name = "VolumetricAveragePooling"
+
+
+class Highway(KerasLayer):
+    """keras.layers.core.Highway — gated identity-skip dense block."""
+
+    def __init__(self, activation=None, input_shape=None, bias=True,
+                 name=None):
+        super().__init__(input_shape, name)
+        self.activation = activation
+        self.bias = bias
+
+    def build(self, input_shape):
+        from bigdl_tpu.nn.layers_extra import Highway as _HW
+
+        return _HW(int(input_shape[-1]), with_bias=self.bias,
+                   activation=_activation_module(self.activation))
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape)
+
+
 __all__ += [
     "Convolution1D", "MaxPooling1D", "AveragePooling1D",
     "GlobalMaxPooling1D", "GlobalAveragePooling1D", "AtrousConvolution2D",
     "ZeroPadding1D", "ZeroPadding3D", "Cropping2D", "UpSampling2D",
     "LeakyReLU", "ELU", "ThresholdedReLU", "Masking",
     "GaussianNoise", "GaussianDropout", "MaxoutDense",
+    "Convolution3D", "MaxPooling3D", "AveragePooling3D", "Highway",
 ]

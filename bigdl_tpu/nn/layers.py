@@ -982,6 +982,88 @@ class Negative(_Elementwise):
         return -input
 
 
+class Floor(_Elementwise):
+    """TF-interop vocabulary («bigdl»/utils/tf/loaders/Floor.scala)."""
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        return _jnp().floor(input)
+
+
+class Ceil(_Elementwise):
+    """TF-interop vocabulary («bigdl»/utils/tf/loaders/Ceil.scala)."""
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        return _jnp().ceil(input)
+
+
+class Round(_Elementwise):
+    """TF-interop vocabulary («bigdl»/utils/tf/loaders/Round.scala)."""
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        return _jnp().round(input)
+
+
+class Sign(_Elementwise):
+    """TF-interop vocabulary («bigdl»/utils/tf/loaders/Sign.scala)."""
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        return _jnp().sign(input)
+
+
+class Log1p(_Elementwise):
+    """«bigdl»/nn/Log1p — numerically stable log(1 + x)."""
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        return _jnp().log1p(input)
+
+
+class Expm1(_Elementwise):
+    """TF-interop vocabulary — numerically stable exp(x) - 1."""
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        return _jnp().expm1(input)
+
+
+class Erf(_Elementwise):
+    """TF-interop vocabulary («bigdl»/utils/tf/loaders/Erf.scala)."""
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        import jax
+
+        return jax.scipy.special.erf(input)
+
+
+class Sin(_Elementwise):
+    """TF-interop vocabulary («bigdl»/utils/tf/loaders/Sin.scala)."""
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        return _jnp().sin(input)
+
+
+class Cos(_Elementwise):
+    """TF-interop vocabulary («bigdl»/utils/tf/loaders/Cos.scala)."""
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        return _jnp().cos(input)
+
+
+class ArgMax(_Elementwise):
+    """TF-interop vocabulary («bigdl»/utils/tf/loaders/ArgMax.scala).
+
+    Returns float32 indices along ``dim`` (1-based, counting the batch
+    axis, matching :class:`Max`'s convention).  Non-differentiable: the
+    integer argmax carries no tangent, so gradients through it are zero.
+    """
+
+    def __init__(self, dim=1):
+        super().__init__(dim=dim)
+        self.dim = dim
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        axis = self.dim - 1 if self.dim > 0 else self.dim
+        return _jnp().argmax(input, axis=axis).astype("float32")
+
+
 class AddConstant(_Elementwise):
     """«bigdl»/nn/AddConstant.scala"""
 
@@ -991,6 +1073,22 @@ class AddConstant(_Elementwise):
 
     def update_output_pure(self, params, input, *, training=False, rng=None):
         return input + self.constant_scalar
+
+
+class DivConstant(_Elementwise):
+    """TF-interop vocabulary — exact ``x / constant``.
+
+    FloorDiv lowering needs true division: multiplying by a rounded
+    reciprocal is off by one ulp at exact multiples, which Floor
+    amplifies into an off-by-one result.
+    """
+
+    def __init__(self, constant_scalar):
+        super().__init__(constant_scalar=constant_scalar)
+        self.constant_scalar = constant_scalar
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        return input / self.constant_scalar
 
 
 class MulConstant(_Elementwise):
@@ -1843,7 +1941,9 @@ __all__ = [
     "Clamp", "Threshold", "PReLU", "GELU",
     "SELU",
     "Abs", "Square", "Sqrt", "Power", "Log", "Exp", "Negative",
-    "AddConstant", "MulConstant",
+    "Floor", "Ceil", "Round", "Sign", "Log1p", "Expm1", "Erf",
+    "Sin", "Cos", "ArgMax",
+    "AddConstant", "MulConstant", "DivConstant",
     "CMul", "CAdd", "Add", "Mul", "Scale",
     "BatchNormalization", "SpatialBatchNormalization", "Normalize",
     "SpatialCrossMapLRN",

@@ -1092,6 +1092,70 @@ class Maxout(AbstractModule):
                 f"x{self.maxout_number})")
 
 
+class Highway(AbstractModule):
+    """Keras-1.2.2 ``Highway`` (⟦«py»/nn/keras⟧ converter vocabulary):
+    ``y = t * h + (1 - t) * x`` with ``h = act(x W^T + b)`` and the
+    carry gate ``t = sigmoid(x W_carry^T + b_carry)``.
+
+    TPU note: both projections are same-shaped MXU matmuls over one
+    operand; XLA fuses the gate blend into their epilogue.
+    """
+
+    param_names = ("weight", "bias", "carry_weight", "carry_bias")
+
+    def __init__(self, size: int, with_bias: bool = True, activation=None):
+        super().__init__()
+        if isinstance(activation, str):
+            from bigdl_tpu.utils.serializer import lookup_module_class
+
+            activation = lookup_module_class(activation)()
+        self._config = dict(
+            size=size, with_bias=with_bias,
+            activation=(type(activation).__name__
+                        if activation is not None else None))
+        self.size = size
+        self.with_bias = with_bias
+        self.activation = activation  # an activation module or None
+        self.reset()
+
+    def reset(self):
+        bound = 1.0 / math.sqrt(self.size)
+
+        def w():
+            return _to_device(RandomGenerator.RNG.uniform(
+                -bound, bound, (self.size, self.size)).astype(np.float32))
+
+        self.weight = w()
+        self.carry_weight = w()
+        if self.with_bias:
+            self.bias = _to_device(np.zeros(self.size, np.float32))
+            # keras initialises the carry bias at -2 so early training
+            # passes the input through (transform gate mostly closed)
+            self.carry_bias = _to_device(
+                np.full(self.size, -2.0, np.float32))
+        else:
+            self.bias = None
+            self.carry_bias = None
+        return self
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        import jax
+
+        h = input @ params["weight"].T
+        t = input @ params["carry_weight"].T
+        if self.with_bias:
+            h = h + params["bias"]
+            t = t + params["carry_bias"]
+        if self.activation is not None:
+            h = self.activation.update_output_pure(
+                {}, h, training=training, rng=rng)
+        t = jax.nn.sigmoid(t)
+        return t * h + (1.0 - t) * input
+
+    def __repr__(self):
+        return f"Highway({self.size})"
+
+
 class SReLU(AbstractModule):
     """⟦«bigdl»/nn/SReLU.scala⟧ — S-shaped ReLU with four learnable
     per-channel parameters:
@@ -1268,6 +1332,7 @@ __all__ = [
     "Reverse",
     "MaskedSelect",
     "Maxout",
+    "Highway",
     "SReLU",
     "RoiPooling",
     "PairwiseDistance",

@@ -50,6 +50,8 @@ from bigdl_tpu.utils.caffe import (
 # tf DataType enum values
 _DT_FLOAT, _DT_DOUBLE, _DT_INT32, _DT_INT64 = 1, 2, 3, 9
 _DT_BOOL, _DT_HALF, _DT_BFLOAT16 = 10, 19, 14
+_DT_UINT8, _DT_INT16, _DT_INT8, _DT_STRING = 4, 5, 6, 7
+_DT_QINT8, _DT_QUINT8, _DT_UINT16 = 11, 12, 17
 
 _DT_NP = {
     _DT_FLOAT: np.float32,
@@ -57,6 +59,12 @@ _DT_NP = {
     _DT_INT32: np.int32,
     _DT_INT64: np.int64,
     _DT_BOOL: np.bool_,
+    _DT_UINT8: np.uint8,
+    _DT_INT16: np.int16,
+    _DT_INT8: np.int8,
+    _DT_UINT16: np.uint16,
+    _DT_QINT8: np.int8,
+    _DT_QUINT8: np.uint8,
 }
 
 
@@ -97,14 +105,22 @@ def _numpy_strided_slice(arr, begin, end, strides, nd):
 
 def _decode_tensor(tp: Dict[int, list]) -> np.ndarray:
     dtype = _w_int(tp, 1, _DT_FLOAT)
-    np_dt = _DT_NP.get(dtype)
-    if np_dt is None:
-        raise TFConversionException(f"unsupported tensor dtype {dtype}")
     shape_msg = _w_msgs(tp, 2)
     dims = []
     if shape_msg:
         for d in _w_msgs(shape_msg[0], 2):  # TensorShapeProto.dim
             dims.append(_w_int(d, 1, -1))
+    if dtype == _DT_STRING:
+        # string_val = repeated bytes field 8 — an object array of bytes
+        vals = [bytes(v) for wt, v in tp.get(8, []) if wt == 2]
+        arr = np.empty(len(vals), dtype=object)
+        arr[:] = vals
+        if dims:
+            arr = arr.reshape(dims)
+        return arr
+    np_dt = _DT_NP.get(dtype)
+    if np_dt is None:
+        raise TFConversionException(f"unsupported tensor dtype {dtype}")
     content = tp.get(4)
     if content:
         arr = np.frombuffer(content[-1][1], dtype=np_dt)
@@ -139,8 +155,21 @@ def _decode_tensor(tp: Dict[int, list]) -> np.ndarray:
 
 def _encode_tensor(arr: np.ndarray) -> _WireWriter:
     w = _WireWriter()
+    if arr.dtype == object or arr.dtype.kind in ("S", "U"):
+        # DT_STRING: string_val = repeated bytes field 8
+        w.varint(1, _DT_STRING)
+        shape = _WireWriter()
+        for d in arr.shape:
+            dim = _WireWriter()
+            dim.varint(1, d)
+            shape.message(2, dim)
+        w.message(2, shape)
+        for s in arr.reshape(-1):
+            w.bytes_(8, s.encode() if isinstance(s, str) else bytes(s))
+        return w
     dt = {np.float32: _DT_FLOAT, np.float64: _DT_DOUBLE,
-          np.int32: _DT_INT32, np.int64: _DT_INT64}[arr.dtype.type]
+          np.int32: _DT_INT32, np.int64: _DT_INT64,
+          np.uint8: _DT_UINT8, np.int8: _DT_INT8}[arr.dtype.type]
     w.varint(1, dt)
     shape = _WireWriter()
     for d in arr.shape:
@@ -191,6 +220,45 @@ class _Attr:
     def ints(self) -> List[int]:
         msgs = _w_msgs(self.f, 1)  # list value
         return _w_ints(msgs[0], 3) if msgs else []
+
+    @property
+    def types(self) -> List[int]:
+        """list(type) — AttrValue.ListValue.type (field 6, may be packed)."""
+        msgs = _w_msgs(self.f, 1)
+        if not msgs:
+            return []
+        out: List[int] = []
+        for wt, v in msgs[0].get(6, []):
+            if wt == 0:
+                out.append(int(v))
+            else:  # packed
+                from bigdl_tpu.utils.caffe import _read_varint
+
+                mv = memoryview(v)
+                pos = 0
+                while pos < len(mv):
+                    x, pos = _read_varint(mv, pos)
+                    out.append(x)
+        return out
+
+    @property
+    def shapes(self) -> List[List[int]]:
+        """list(shape) — AttrValue.ListValue.shape (field 7)."""
+        msgs = _w_msgs(self.f, 1)
+        if not msgs:
+            return []
+        out = []
+        for sh in _w_msgs(msgs[0], 7):
+            out.append([_w_int(d, 1, -1) for d in _w_msgs(sh, 2)])
+        return out
+
+    @property
+    def shape(self) -> Optional[List[int]]:
+        """shape — AttrValue.shape (field 7)."""
+        msgs = _w_msgs(self.f, 7)
+        if not msgs:
+            return None
+        return [_w_int(d, 1, -1) for d in _w_msgs(msgs[0], 2)]
 
 
 class _NodeDef:
@@ -403,6 +471,25 @@ class TensorflowLoader:
                         "Minimum": np.minimum}[op](a, b)
             if op == "Neg":
                 return -self._const(ins[0])
+            if op == "Dequantize":
+                # quantized weights in frozen graphs: MIN_COMBINED maps
+                # the integer range linearly onto [min_range, max_range]
+                mode = nd.attr("mode")
+                mode = mode.s if mode and mode.s else "MIN_COMBINED"
+                if mode != "MIN_COMBINED":
+                    return None
+                q = self._const(ins[0])
+                lo = float(self._const(ins[1]).reshape(-1)[0])
+                hi = float(self._const(ins[2]).reshape(-1)[0])
+                info = np.iinfo(q.dtype)
+                span = float(int(info.max) - int(info.min))
+                scale = (hi - lo) / span
+                if info.min == 0:  # quint8
+                    return (q.astype(np.float32) * scale + lo).astype(
+                        np.float32)
+                # qint8: zero maps to the range midpoint
+                return ((q.astype(np.float32) - info.min) * scale
+                        + lo).astype(np.float32)
         except TFConversionException:
             return None
         return None
@@ -555,6 +642,10 @@ class TensorflowLoader:
     def _build(self, name: str):
         """Recursively convert node ``name``; returns a wired graph Node."""
         raw = name[1:] if name.startswith("^") else name
+        if raw in self._built:
+            # covers explicit "node:k" seam inputs (input-pipeline
+            # boundaries) as well as plain seeded names
+            return self._built[raw]
         base, _, idx = raw.partition(":")
         out_idx = int(idx) if idx else 0
         src_nd = self.nodes.get(base)
@@ -854,7 +945,9 @@ class TensorflowLoader:
 
         if op in ("Relu", "Relu6", "Elu", "Tanh", "Sigmoid", "Softplus",
                   "Softmax", "LogSoftmax", "Rsqrt", "Sqrt", "Square",
-                  "Exp", "Log", "Abs", "Neg"):
+                  "Exp", "Log", "Abs", "Neg", "Floor", "Ceil", "Round",
+                  "Rint", "Sign", "Log1p", "Expm1", "Erf", "Sin", "Cos",
+                  "Reciprocal", "Inv"):
             mod = {
                 "Relu": L.ReLU, "Relu6": L.ReLU6, "Elu": L.ELU,
                 "Tanh": L.Tanh, "Sigmoid": L.Sigmoid,
@@ -862,12 +955,38 @@ class TensorflowLoader:
                 "LogSoftmax": L.LogSoftMax, "Sqrt": L.Sqrt,
                 "Square": L.Square, "Exp": L.Exp, "Log": L.Log,
                 "Abs": L.Abs, "Neg": L.Negative,
+                "Floor": L.Floor, "Ceil": L.Ceil, "Round": L.Round,
+                "Rint": L.Round, "Sign": L.Sign, "Log1p": L.Log1p,
+                "Expm1": L.Expm1, "Erf": L.Erf, "Sin": L.Sin,
+                "Cos": L.Cos,
             }.get(op)
             if mod is None:
-                mod = L.Power(-0.5) if op == "Rsqrt" else None
+                mod = L.Power(-0.5) if op == "Rsqrt" else L.Power(-1.0)
             else:
                 mod = mod()
             return self._named(mod, nd)(self._build(ins[0]))
+
+        if op == "ArgMax":
+            axis = int(self._const(ins[1]).reshape(-1)[0])
+            dim1 = self._axis_dim(axis, self._is_image(ins[0]))
+            return self._named(L.ArgMax(dim1), nd)(self._build(ins[0]))
+
+        if op == "FloorDiv":
+            from bigdl_tpu.nn.module import Sequential
+
+            consts = []
+            for i in ins:
+                try:
+                    consts.append(self._const(i))
+                except TFConversionException:
+                    consts.append(None)
+            if consts[1] is not None and consts[1].size == 1:
+                c = float(consts[1].reshape(-1)[0])
+                mod = Sequential().add(L.DivConstant(c)).add(L.Floor())
+                return self._named(mod, nd)(self._build(ins[0]))
+            mod = Sequential().add(T.CDivTable()).add(L.Floor())
+            return self._named(mod, nd)(
+                self._build(ins[0]), self._build(ins[1]))
 
         if op == "Reshape":
             shape = self._const(ins[1]).reshape(-1).astype(int).tolist()
@@ -1228,6 +1347,220 @@ class TensorflowLoader:
         mod.set_name(nd.name)
         return mod
 
+    # ------------------------------------------------------------------
+    # input-pipeline extraction (the reference BigDLSessionImpl's reason
+    # to exist: run TF graphs whose INPUT side is a reader/queue/
+    # ParseExample pipeline — SURVEY.md §2.1 "TensorFlow interop")
+    # ------------------------------------------------------------------
+
+    _QUEUE_OPS = ("FIFOQueueV2", "FIFOQueue", "RandomShuffleQueueV2",
+                  "RandomShuffleQueue", "PaddingFIFOQueueV2",
+                  "PaddingFIFOQueue")
+    _PIPELINE_OPS = _QUEUE_OPS + (
+        "TFRecordReaderV2", "TFRecordReader", "ReaderReadV2", "ReaderRead",
+        "QueueEnqueueV2", "QueueEnqueue", "QueueEnqueueManyV2",
+        "QueueEnqueueMany", "QueueDequeueV2", "QueueDequeue",
+        "QueueDequeueManyV2", "QueueDequeueMany", "QueueDequeueUpToV2",
+        "QueueCloseV2", "QueueClose", "ParseExample", "DecodeRaw",
+    )
+
+    def has_input_pipeline(self) -> bool:
+        return any(n.op == "ParseExample" for n in self.nodes.values())
+
+    def extract_input_pipeline(self, filenames=None):
+        """Lift the reader -> queue -> ParseExample (-> DecodeRaw)
+        subgraph out of the GraphDef into a host-side
+        :class:`~bigdl_tpu.utils.tf_records.TFRecordExampleDataset`.
+
+        The queue-dequeue boundary becomes an iterator seam: the parse/
+        decode output tensors turn into the converted model's Input
+        nodes, and the records themselves are read host-side (CPU
+        decode feeding the device — the TPU-native shape of the
+        reference's executor-side queue runners).  ``filenames``
+        overrides the file list baked into the graph's string Consts.
+        """
+        from bigdl_tpu.utils.tf_records import (
+            FixedLenFeature,
+            TFRecordExampleDataset,
+        )
+
+        if not hasattr(self, "_consts"):
+            self._consts = {}
+        parse_nodes = [n for n in self.nodes.values()
+                       if n.op == "ParseExample"]
+        if not parse_nodes:
+            raise TFConversionException("graph has no ParseExample node")
+        if len(parse_nodes) > 1:
+            raise TFConversionException(
+                "multiple ParseExample pipelines unsupported")
+        parse = parse_nodes[0]
+        ins = self._data_inputs(parse)
+        nsparse = int(parse.attr("Nsparse").i or 0) \
+            if parse.attr("Nsparse") else 0
+        if nsparse:
+            raise TFConversionException(
+                "ParseExample sparse features unsupported")
+        tdense = parse.attr("Tdense").types if parse.attr("Tdense") else []
+        nd_attr = parse.attr("Ndense")
+        ndense = int(nd_attr.i) if nd_attr and nd_attr.i else len(tdense)
+        shapes = parse.attr("dense_shapes").shapes \
+            if parse.attr("dense_shapes") else []
+        serialized = ins[0]
+        key_refs = ins[2:2 + ndense]
+        default_refs = ins[2 + ndense:2 + 2 * ndense]
+        keys = []
+        for r in key_refs:
+            kv = self._const(r).reshape(-1)[0]
+            keys.append(kv.decode() if isinstance(kv, bytes) else str(kv))
+
+        # upstream walk from the serialized tensor: collect every
+        # pipeline-side node, the dequeue batch size, and the filename
+        # string Consts feeding the reader chain (enqueue ops CONSUME
+        # their queue, so each queue hop restarts the walk from its
+        # enqueues' values)
+        pipeline_nodes = {parse.name}
+        batch_size = None
+        graph_files: List[str] = []
+        frontier = [_clean(serialized)]
+        seen = set()
+        while frontier:
+            name = frontier.pop()
+            if name in seen or name not in self.nodes:
+                continue
+            seen.add(name)
+            nd = self.nodes[name]
+            pipeline_nodes.add(name)
+            if nd.op in ("QueueDequeueManyV2", "QueueDequeueMany",
+                         "QueueDequeueUpToV2") and batch_size is None:
+                try:
+                    batch_size = int(
+                        self._const(
+                            self._data_inputs(nd)[1]).reshape(-1)[0])
+                except TFConversionException:
+                    pass
+            if nd.op in self._QUEUE_OPS:
+                for other in self.nodes.values():
+                    if not other.op.startswith("QueueEnqueue"):
+                        continue
+                    oins = self._data_inputs(other)
+                    if oins and _clean(oins[0]) == name:
+                        pipeline_nodes.add(other.name)
+                        frontier.extend(_clean(i) for i in oins[1:])
+            if nd.op == "Const":
+                a = nd.attr("value")
+                arr = a.tensor if a else None
+                if arr is not None and arr.dtype == object:
+                    graph_files.extend(
+                        b.decode() if isinstance(b, bytes) else str(b)
+                        for b in arr.reshape(-1))
+            frontier.extend(_clean(i) for i in self._data_inputs(nd))
+
+        # consumer map (raw "node:k" spelling, as consumers write it)
+        consumers: Dict[str, List[str]] = {}
+        for n in self.nodes.values():
+            for i in n.inputs:
+                raw = i[1:] if i.startswith("^") else i
+                consumers.setdefault(raw, []).append(n.name)
+
+        spec: Dict[str, FixedLenFeature] = {}
+        transforms: Dict[str, object] = {}
+        seam_refs: List[str] = []
+        seam_keys: List[str] = []
+        def _cons_of(*refs):
+            out = []
+            for r in refs:
+                out.extend(consumers.get(r, []))
+            return out
+
+        for k, key in enumerate(keys):
+            ref = parse.name if k == 0 else f"{parse.name}:{k}"
+            dt = tdense[k] if k < len(tdense) else _DT_FLOAT
+            shape = tuple(s for s in (shapes[k] if k < len(shapes) else [])
+                          if s >= 0)
+            default = None
+            if k < len(default_refs):
+                try:
+                    dv = self._const(default_refs[k])
+                    if dv.size:
+                        default = dv.reshape(-1)[0]
+                except TFConversionException:
+                    pass
+            # output 0 may be spelled "name" or "name:0" by consumers
+            refs = (ref, f"{ref}:0") if k == 0 else (ref,)
+            cons = [c for c in _cons_of(*refs)
+                    if c not in pipeline_nodes]
+            decoders = [c for c in cons
+                        if self.nodes[c].op == "DecodeRaw"]
+            if dt == _DT_STRING or decoders:
+                if not decoders:
+                    raise TFConversionException(
+                        f"string feature {key!r} has no DecodeRaw "
+                        "consumer — cannot feed the device")
+                dr = self.nodes[decoders[0]]
+                out_t = dr.attr("out_type")
+                np_dt = _DT_NP.get(out_t.type if out_t else _DT_FLOAT,
+                                   np.float32)
+                le = dr.attr("little_endian")
+                le = bool(le.b) if le and le.b is not None else True
+                wire_dt = np.dtype(np_dt).newbyteorder("<" if le else ">")
+                spec[key] = FixedLenFeature((), bytes)
+                transforms[key] = (
+                    lambda b, _w=wire_dt, _n=np_dt: np.frombuffer(
+                        b, dtype=_w).astype(_n))
+                pipeline_nodes.add(dr.name)
+                seam = dr.name
+                consumed = any(c not in pipeline_nodes
+                               for c in _cons_of(seam, seam + ":0"))
+            else:
+                np_dt = _DT_NP.get(dt, np.float32)
+                spec[key] = FixedLenFeature(shape, np_dt, default)
+                seam = ref
+                consumed = bool(cons)
+            if consumed:
+                seam_refs.append(seam)
+                seam_keys.append(key)
+
+        dataset = TFRecordExampleDataset(
+            list(filenames) if filenames is not None else graph_files,
+            spec, batch_size=batch_size or 32, transforms=transforms)
+        return TFInputPipeline(dataset, seam_refs, seam_keys,
+                               batch_size or 32, pipeline_nodes)
+
+    def model_outputs(self, exclude=()):
+        """Auto-detect output nodes, ignoring the pipeline side (queue
+        enqueues/closers are sinks but not model outputs)."""
+        exclude = set(exclude)
+        consumed = set()
+        for n in self.nodes.values():
+            if n.name in exclude:
+                continue
+            consumed.update(_clean(i) for i in n.inputs)
+        return [name for name, n in self.nodes.items()
+                if name not in consumed and name not in exclude
+                and n.op not in ("Const", "Placeholder")
+                and n.op not in self._PIPELINE_OPS]
+
+
+class TFInputPipeline:
+    """A lifted TF-graph input pipeline: the host-side dataset plus the
+    seam tensors where data crosses into the converted model."""
+
+    def __init__(self, dataset, seam_refs, seam_keys, batch_size, nodes):
+        self.dataset = dataset
+        self.seam_refs = list(seam_refs)  # model Input refs, in order
+        self.seam_keys = list(seam_keys)  # Example key per seam
+        self.batch_size = batch_size
+        self.nodes = set(nodes)  # pipeline-side node names
+
+    def feature_table(self):
+        """Materialize the records: ([per-seam array, ...], full table)."""
+        table = self.dataset.materialize()
+        return [table[k] for k in self.seam_keys], table
+
+    def batches(self, drop_remainder=False):
+        for b in self.dataset.batches(drop_remainder=drop_remainder):
+            yield [b[k] for k in self.seam_keys], b
+
 
 def load_tf(path: str, inputs=None, outputs=None):
     """Reference: ``Module.loadTF(path, inputs, outputs)``."""
@@ -1247,8 +1580,20 @@ class TFTrainingSession:
     """
 
     def __init__(self, path: Optional[str] = None,
-                 data: Optional[bytes] = None, inputs=None, outputs=None):
+                 data: Optional[bytes] = None, inputs=None, outputs=None,
+                 filenames=None):
         self.loader = TensorflowLoader(path=path, data=data)
+        self.pipeline = None
+        if inputs is None and self.loader.has_input_pipeline():
+            # graph ships its own input pipeline (reader/queue/
+            # ParseExample): lift it host-side, make the seam tensors
+            # the model inputs
+            self.pipeline = self.loader.extract_input_pipeline(
+                filenames=filenames)
+            inputs = self.pipeline.seam_refs
+            if outputs is None:
+                outputs = self.loader.model_outputs(
+                    exclude=self.pipeline.nodes)
         self.model = self.loader.load(inputs=inputs, outputs=outputs)
         self._optimizer = None
 
@@ -1278,6 +1623,33 @@ class TFTrainingSession:
             opt.set_end_when(end_trigger)
         self._optimizer = opt
         return opt.optimize()
+
+    def train_with_pipeline(self, criterion, label_key,
+                            label_transform=None, optim_method=None,
+                            batch_size=None, end_trigger=None,
+                            distributed=False):
+        """Fine-tune end-to-end from the graph's OWN input pipeline:
+        records are read host-side through the lifted TFRecord/
+        ParseExample dataset, features feed the seam Inputs, and
+        ``label_key`` names the Example feature used as the target
+        (``label_transform`` adapts conventions, e.g. 0-based int64 ->
+        1-based float for ClassNLLCriterion)."""
+        if self.pipeline is None:
+            raise TFConversionException(
+                "graph has no input pipeline; use train(dataset, ...)")
+        xs, table = self.pipeline.feature_table()
+        if label_key not in table:
+            raise KeyError(
+                f"label key {label_key!r} not among parsed features "
+                f"{sorted(table)}")
+        y = np.asarray(table[label_key])
+        if label_transform is not None:
+            y = label_transform(y)
+        x = xs[0] if len(xs) == 1 else tuple(xs)
+        return self.train(
+            (x, y), criterion, optim_method=optim_method,
+            batch_size=batch_size or self.pipeline.batch_size,
+            end_trigger=end_trigger, distributed=distributed)
 
 
 # reference spelling
@@ -1351,6 +1723,31 @@ class GraphDefBuilder:
         lst = _WireWriter()
         for v in vals:
             lst.varint(3, v)
+        a = _WireWriter()
+        a.message(1, lst)
+        return a
+
+    @staticmethod
+    def attr_types(vals: List[int]) -> _WireWriter:
+        """list(type) — ListValue.type (field 6)."""
+        lst = _WireWriter()
+        for v in vals:
+            lst.varint(6, v)
+        a = _WireWriter()
+        a.message(1, lst)
+        return a
+
+    @staticmethod
+    def attr_shapes(shapes: List[List[int]]) -> _WireWriter:
+        """list(shape) — ListValue.shape (field 7)."""
+        lst = _WireWriter()
+        for sh in shapes:
+            shape = _WireWriter()
+            for d in sh:
+                dim = _WireWriter()
+                dim.varint(1, d)
+                shape.message(2, dim)
+            lst.message(7, shape)
         a = _WireWriter()
         a.message(1, lst)
         return a

@@ -152,9 +152,13 @@ def _layer_cases():
         (L.Clamp(-1, 1), v), (L.Threshold(0.1, 0.0), v), (L.PReLU(), v),
         (L.GELU(), v), (L.SELU(), v), (L.Abs(), v), (L.Square(), pos),
         (L.Sqrt(), pos),
-        (N.Maxout(6, 4, 3), v), (N.SReLU((6,)), v),
+        (N.Maxout(6, 4, 3), v), (N.SReLU((6,)), v), (N.Highway(6), v),
         (L.Power(2.0, 1.5, 0.1), pos), (L.Log(), pos), (L.Exp(), v),
         (L.Negative(), v), (L.AddConstant(1.5), v), (L.MulConstant(2.0), v),
+        (L.Floor(), v), (L.Ceil(), v), (L.Round(), v), (L.Sign(), v),
+        (L.DivConstant(41.0), v),
+        (L.Log1p(), pos), (L.Expm1(), v), (L.Erf(), v), (L.Sin(), v),
+        (L.Cos(), v), (L.ArgMax(2), v),
         (L.CMul((6,)), v), (L.CAdd((6,)), v),
         (L.Add(6), v), (L.Mul(), v),
         (L.Scale((6,)), v),

@@ -720,3 +720,100 @@ def test_while_loop_import():
     out2 = model.forward((np.asarray(3.0, np.float32),
                           np.asarray(2.0, np.float32)))
     assert float(np.asarray(out2)) == 12.0  # cnt 2,3 -> 2 doublings
+
+
+def test_loader_round5_elementwise_vocabulary():
+    """VERDICT r4 item 5: widen the frozen-graph op set — Floor/Ceil/
+    Round/Sign/Log1p/Expm1/Erf/Sin/Cos/Reciprocal chains."""
+    rs = np.random.RandomState(7)
+    b = GraphDefBuilder()
+    b.placeholder("x")
+    b.op("fl", "Floor", ["x"])
+    b.op("s", "Sin", ["fl"])
+    b.op("c", "Cos", ["s"])
+    b.op("sg", "Sign", ["c"])
+    model = TensorflowLoader(data=b.tobytes()).load(
+        inputs=["x"], outputs=["sg"])
+    model.evaluate()
+    x = rs.randn(3, 5).astype(np.float32) * 3
+    out = np.asarray(model.forward(x))
+    np.testing.assert_allclose(
+        out, np.sign(np.cos(np.sin(np.floor(x)))), rtol=1e-5, atol=1e-6)
+
+    b = GraphDefBuilder()
+    b.placeholder("x")
+    b.op("l1p", "Log1p", ["x"])
+    b.op("e1", "Expm1", ["l1p"])
+    b.op("erf", "Erf", ["e1"])
+    b.op("r", "Reciprocal", ["erf"])
+    model = TensorflowLoader(data=b.tobytes()).load(
+        inputs=["x"], outputs=["r"])
+    model.evaluate()
+    x = np.abs(rs.randn(3, 5).astype(np.float32)) + 0.5
+    out = np.asarray(model.forward(x))
+    import math
+
+    expect = 1.0 / np.vectorize(math.erf)(np.expm1(np.log1p(x)))
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_loader_argmax_and_floordiv():
+    rs = np.random.RandomState(9)
+    b = GraphDefBuilder()
+    b.placeholder("x")
+    b.const("axis", np.asarray(1, np.int32))
+    b.const("seven", np.asarray(7.0, np.float32))
+    b.op("am", "ArgMax", ["x", "axis"])
+    model = TensorflowLoader(data=b.tobytes()).load(
+        inputs=["x"], outputs=["am"])
+    model.evaluate()
+    x = rs.randn(6, 10).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(model.forward(x)), np.argmax(x, axis=1).astype(np.float32))
+
+    b = GraphDefBuilder()
+    b.placeholder("x")
+    b.const("seven", np.asarray(7.0, np.float32))
+    b.op("fd", "FloorDiv", ["x", "seven"])
+    model = TensorflowLoader(data=b.tobytes()).load(
+        inputs=["x"], outputs=["fd"])
+    model.evaluate()
+    x = (rs.randn(4, 6) * 20).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(model.forward(x)), np.floor(x / 7.0), rtol=1e-6)
+
+    # exact multiples: the const path must divide, not multiply by a
+    # rounded reciprocal (41 * float32(1/41) < 1 would floor to 0)
+    b = GraphDefBuilder()
+    b.placeholder("x")
+    b.const("c", np.asarray(41.0, np.float32))
+    b.op("fd", "FloorDiv", ["x", "c"])
+    model = TensorflowLoader(data=b.tobytes()).load(
+        inputs=["x"], outputs=["fd"])
+    model.evaluate()
+    mult = np.asarray([[41.0, 82.0, 123.0, -41.0]], np.float32)
+    np.testing.assert_allclose(
+        np.asarray(model.forward(mult)), [[1.0, 2.0, 3.0, -1.0]])
+
+
+def test_loader_dequantize_weight():
+    """Dequantize in weight position const-folds (MIN_COMBINED)."""
+    rs = np.random.RandomState(4)
+    w = rs.rand(8, 3).astype(np.float32)  # in [0, 1)
+    lo, hi = -1.0, 1.0
+    q = np.clip(np.round((w - lo) / (hi - lo) * 255), 0, 255).astype(
+        np.uint8)
+    b = GraphDefBuilder()
+    b.placeholder("x")
+    b.const("wq", q)
+    b.const("lo", np.asarray(lo, np.float32))
+    b.const("hi", np.asarray(hi, np.float32))
+    b.op("w", "Dequantize", ["wq", "lo", "hi"])
+    b.op("mm", "MatMul", ["x", "w"])
+    model = TensorflowLoader(data=b.tobytes()).load(
+        inputs=["x"], outputs=["mm"])
+    model.evaluate()
+    x = rs.randn(5, 8).astype(np.float32)
+    wdq = q.astype(np.float32) * (hi - lo) / 255.0 + lo
+    np.testing.assert_allclose(
+        np.asarray(model.forward(x)), x @ wdq, rtol=1e-4, atol=1e-4)

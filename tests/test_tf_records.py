@@ -1,0 +1,292 @@
+"""TFRecord / tf.train.Example codec + graph-side input-pipeline tests.
+
+Reference analogue: «bigdl»/utils/tf/BigDLSessionImpl — the session's
+stated purpose is running TF graphs whose input side is a reader/queue/
+ParseExample pipeline (SURVEY.md §2.1 "TensorFlow interop").  VERDICT
+r4 item 5's done-gate lives here: import a frozen graph WITH its input
+pipeline attached and fine-tune under DistriOptimizer in one test.
+"""
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.utils.tf_interop import (
+    _DT_FLOAT,
+    _DT_INT64,
+    _DT_STRING,
+    GraphDefBuilder,
+    TensorflowLoader,
+)
+from bigdl_tpu.utils.tf_records import (
+    FixedLenFeature,
+    TFRecordExampleDataset,
+    TFRecordWriter,
+    encode_example,
+    parse_example,
+    tfrecord_iterator,
+)
+
+
+# ------------------------------------------------------------------ codec
+
+
+def test_tfrecord_roundtrip(tmp_path):
+    path = str(tmp_path / "data.tfrecord")
+    records = [b"alpha", b"", b"x" * 1000]
+    with TFRecordWriter(path) as w:
+        for r in records:
+            w.write(r)
+    assert list(tfrecord_iterator(path)) == records
+
+
+def test_tfrecord_crc_detects_corruption(tmp_path):
+    path = str(tmp_path / "bad.tfrecord")
+    with TFRecordWriter(path) as w:
+        w.write(b"payload-payload")
+    raw = bytearray(open(path, "rb").read())
+    raw[14] ^= 0xFF  # flip a payload byte
+    open(path, "wb").write(bytes(raw))
+    with pytest.raises(ValueError):
+        list(tfrecord_iterator(path))
+    # verify_crc=False reads it anyway
+    assert len(list(tfrecord_iterator(path, verify_crc=False))) == 1
+
+
+def test_example_roundtrip_all_kinds():
+    ex = encode_example({
+        "img": np.arange(6, dtype=np.float32),
+        "label": np.asarray([3], dtype=np.int64),
+        "neg": [-5, 7],
+        "raw": b"\x01\x02\xff",
+        "name": "sample-1",
+    })
+    spec = {
+        "img": FixedLenFeature((2, 3), np.float32),
+        "label": FixedLenFeature((), np.int64),
+        "neg": FixedLenFeature((2,), np.int64),
+        "raw": FixedLenFeature((), bytes),
+        "name": FixedLenFeature((), bytes),
+    }
+    out = parse_example(ex, spec)
+    np.testing.assert_allclose(
+        out["img"], np.arange(6, dtype=np.float32).reshape(2, 3))
+    assert out["label"].tolist() == [3]
+    assert out["neg"].tolist() == [-5, 7]  # zigzag-free two's complement
+    assert out["raw"] == b"\x01\x02\xff"
+    assert out["name"] == b"sample-1"
+
+
+def test_example_default_and_missing():
+    ex = encode_example({"a": np.ones(2, np.float32)})
+    spec = {
+        "a": FixedLenFeature((2,), np.float32),
+        "b": FixedLenFeature((3,), np.float32, default_value=0.5),
+    }
+    out = parse_example(ex, spec)
+    np.testing.assert_allclose(out["b"], np.full(3, 0.5, np.float32))
+    with pytest.raises(KeyError):
+        parse_example(ex, {"c": FixedLenFeature((1,), np.float32)})
+
+
+def test_example_dataset_batches(tmp_path):
+    path = str(tmp_path / "ds.tfrecord")
+    with TFRecordWriter(path) as w:
+        for i in range(10):
+            w.write(encode_example({
+                "x": np.full(4, i, np.float32),
+                "y": np.asarray([i % 3], np.int64),
+            }))
+    ds = TFRecordExampleDataset(
+        [path],
+        {"x": FixedLenFeature((4,), np.float32),
+         "y": FixedLenFeature((1,), np.int64)},
+        batch_size=4,
+    )
+    batches = list(ds.batches())
+    assert [b["x"].shape[0] for b in batches] == [4, 4, 2]
+    assert list(ds.batches(drop_remainder=True))[-1]["x"].shape[0] == 4
+    table = ds.materialize()
+    assert table["x"].shape == (10, 4)
+    np.testing.assert_allclose(table["x"][:, 0], np.arange(10))
+
+
+# ------------------------------------------------- pipeline graph helpers
+
+
+def _pipeline_graphdef(filenames, d=8, k=4, raw_features=False, rs=None):
+    """A TF1-style training graph WITH its input pipeline attached:
+
+    Const(files) -> FIFOQueue(fq) <- QueueEnqueueMany
+    TFRecordReader + ReaderRead(fq) -> FIFOQueue(eq) <- QueueEnqueue
+    QueueDequeueMany(eq, 16) -> ParseExample -> [DecodeRaw ->] model
+    """
+    rs = rs or np.random.RandomState(3)
+    b = GraphDefBuilder()
+    b.const("files", np.asarray(filenames, dtype=object))
+    b.op("fq", "FIFOQueueV2", [],
+         component_types=b.attr_types([_DT_STRING]))
+    b.op("enq_files", "QueueEnqueueManyV2", ["fq", "files"])
+    b.op("reader", "TFRecordReaderV2", [])
+    b.op("read", "ReaderReadV2", ["reader", "fq"])
+    b.op("eq", "FIFOQueueV2", [],
+         component_types=b.attr_types([_DT_STRING]))
+    b.op("enq_ex", "QueueEnqueueV2", ["eq", "read:1"])
+    b.const("batch", np.asarray(16, np.int32))
+    b.op("deq", "QueueDequeueManyV2", ["eq", "batch"],
+         component_types=b.attr_types([_DT_STRING]))
+    b.const("key_x", np.asarray(["x"], dtype=object))
+    b.const("key_y", np.asarray(["y"], dtype=object))
+    b.const("names", np.asarray([], dtype=object))
+    if raw_features:
+        b.const("def_x", np.asarray([], dtype=object))
+    else:
+        b.const("def_x", np.zeros(0, np.float32))
+    b.const("def_y", np.zeros(0, np.float32))
+    x_type = _DT_STRING if raw_features else _DT_FLOAT
+    b.op("parse", "ParseExample",
+         ["deq", "names", "key_x", "key_y", "def_x", "def_y"],
+         Nsparse=b.attr_i(0), Ndense=b.attr_i(2),
+         Tdense=b.attr_types([x_type, _DT_FLOAT]),
+         dense_shapes=b.attr_shapes(
+             [[] if raw_features else [d], [1]]))
+    feat = "parse"
+    if raw_features:
+        b.op("decoded", "DecodeRaw", ["parse"],
+             out_type=b.attr_type(_DT_FLOAT))
+        feat = "decoded"
+    # the model: Linear(d->k) + LogSoftmax, deliberately random init
+    w1 = (rs.randn(d, 32) * 0.3).astype(np.float32)
+    w2 = (rs.randn(32, k) * 0.3).astype(np.float32)
+    b.const("w1", w1)
+    b.const("w2", w2)
+    b.op("mm1", "MatMul", [feat, "w1"])
+    b.op("r", "Relu", ["mm1"])
+    b.op("mm2", "MatMul", ["r", "w2"])
+    b.op("logp", "LogSoftmax", ["mm2"])
+    return b.tobytes()
+
+
+def _write_records(tmp_path, x, y, raw=False, shard=1):
+    files = []
+    shards = np.array_split(np.arange(len(x)), shard)
+    for si, idx in enumerate(shards):
+        path = str(tmp_path / f"train-{si}.tfrecord")
+        with TFRecordWriter(path) as w:
+            for i in idx:
+                feats = {"y": np.asarray([y[i]], np.float32)}
+                if raw:
+                    feats["x"] = x[i].astype("<f4").tobytes()
+                else:
+                    feats["x"] = x[i]
+                w.write(encode_example(feats))
+        files.append(path)
+    return files
+
+
+# ------------------------------------------------------- extraction tests
+
+
+def test_extract_input_pipeline(tmp_path):
+    rs = np.random.RandomState(0)
+    x = rs.randn(32, 8).astype(np.float32)
+    y = rs.randint(1, 5, 32).astype(np.float32)
+    files = _write_records(tmp_path, x, y, shard=2)
+    loader = TensorflowLoader(data=_pipeline_graphdef(files))
+    pipe = loader.extract_input_pipeline()
+    # filename consts discovered from the graph, dequeue batch size kept
+    assert pipe.dataset.filenames == files
+    assert pipe.batch_size == 16
+    # only the feature tensor is model input; the label seam is
+    # host-side only (nothing downstream consumes it)
+    assert pipe.seam_refs == ["parse"]
+    assert pipe.seam_keys == ["x"]
+    xs, table = pipe.feature_table()
+    np.testing.assert_allclose(xs[0], x, rtol=1e-6)
+    np.testing.assert_allclose(table["y"].reshape(-1), y)
+
+
+def test_pipeline_model_outputs_exclude_queue_sinks(tmp_path):
+    rs = np.random.RandomState(0)
+    x = rs.randn(8, 8).astype(np.float32)
+    y = np.ones(8, np.float32)
+    files = _write_records(tmp_path, x, y)
+    loader = TensorflowLoader(data=_pipeline_graphdef(files))
+    pipe = loader.extract_input_pipeline()
+    # enqueue ops are sinks but NOT model outputs
+    assert loader.model_outputs(exclude=pipe.nodes) == ["logp"]
+
+
+def test_session_trains_from_graph_input_pipeline(tmp_path):
+    """The VERDICT r4 item-5 gate: frozen graph + its own input
+    pipeline, fine-tuned end-to-end under DistriOptimizer."""
+    from bigdl_tpu.engine import Engine
+    from bigdl_tpu.nn import ClassNLLCriterion
+    from bigdl_tpu.optim import SGD, Top1Accuracy, Trigger
+    from bigdl_tpu.optim.evaluator import evaluate_dataset
+    from bigdl_tpu.dataset import ArrayDataSet
+    from bigdl_tpu.utils.tf_interop import BigDLSessionImpl
+
+    rs = np.random.RandomState(11)
+    d, k, n = 8, 4, 256
+    wtrue = rs.randn(d, k)
+    x = rs.randn(n, d).astype(np.float32)
+    y = (np.argmax(x @ wtrue, axis=1) + 1).astype(np.float32)
+    files = _write_records(tmp_path, x, y, shard=3)
+
+    Engine.reset()
+    Engine.init()
+    try:
+        sess = BigDLSessionImpl(data=_pipeline_graphdef(files, d=d, k=k))
+        assert sess.pipeline is not None
+        trained = sess.train_with_pipeline(
+            ClassNLLCriterion(), label_key="y",
+            label_transform=lambda a: a.reshape(-1),
+            optim_method=SGD(learningrate=0.5),
+            end_trigger=Trigger.max_epoch(8), distributed=True)
+        (acc,) = evaluate_dataset(trained, ArrayDataSet(x, y, 64),
+                                  [Top1Accuracy()])
+        value, _ = acc.result()
+        assert value > 0.9, f"pipeline fine-tune accuracy {value}"
+    finally:
+        Engine.reset()
+
+
+def test_session_pipeline_decode_raw(tmp_path):
+    """Bytes features + DecodeRaw: the decode happens host-side, the
+    DecodeRaw node becomes the model's Input seam."""
+    from bigdl_tpu.nn import ClassNLLCriterion
+    from bigdl_tpu.optim import SGD, Trigger
+    from bigdl_tpu.utils.tf_interop import BigDLSessionImpl
+
+    rs = np.random.RandomState(5)
+    d, k, n = 8, 4, 64
+    x = rs.randn(n, d).astype(np.float32)
+    y = (rs.randint(0, k, n) + 1).astype(np.float32)
+    files = _write_records(tmp_path, x, y, raw=True)
+
+    sess = BigDLSessionImpl(
+        data=_pipeline_graphdef(files, d=d, k=k, raw_features=True))
+    assert sess.pipeline.seam_refs == ["decoded"]
+    xs, table = sess.pipeline.feature_table()
+    np.testing.assert_allclose(xs[0], x, rtol=1e-6)
+    loss = sess.train_with_pipeline(
+        ClassNLLCriterion(), label_key="y",
+        label_transform=lambda a: a.reshape(-1),
+        optim_method=SGD(learningrate=0.1),
+        end_trigger=Trigger.max_epoch(1))
+    assert loss is not None
+
+
+def test_pipeline_filename_override(tmp_path):
+    """filenames= beats the paths baked into the graph (the graph may
+    ship cluster paths that do not exist locally)."""
+    rs = np.random.RandomState(0)
+    x = rs.randn(8, 8).astype(np.float32)
+    y = np.ones(8, np.float32)
+    files = _write_records(tmp_path, x, y)
+    gd = _pipeline_graphdef(["/nonexistent/path.tfrecord"])
+    loader = TensorflowLoader(data=gd)
+    pipe = loader.extract_input_pipeline(filenames=files)
+    assert pipe.dataset.filenames == files
+    xs, _ = pipe.feature_table()
+    assert xs[0].shape == (8, 8)
