@@ -712,9 +712,12 @@ def _run_child(platform: str):
     # secondaries.  CPU fallback: the cheap secondaries FIRST (they have
     # been null in every driver artifact; the ResNet compile alone can
     # eat a truncated window), then the std headline + baseline.
+    ran_secondaries = False
     if platform == "cpu":
         run_secondaries()
+        ran_secondaries = True
 
+    failed_streak = 0
     for i, b in enumerate(batches):
         if remaining() < seg_reserve and (i > 0 or ok_segments()):
             ex["skipped_segments"].append(f"std_b{b}")
@@ -727,7 +730,18 @@ def _run_child(platform: str):
             ex["batch_sweep"][str(b)] = {
                 "error": f"{type(e).__name__}: {str(e)[:200]}"}
             emit(f"std_b{b}:failed")
+            failed_streak += 1
+            if failed_streak == 2 and not ran_secondaries:
+                # two consecutive headline failures smell like a broken
+                # remote-compile service for BIG programs (observed: the
+                # relay 500s every ResNet batch, then HANGS one, losing
+                # the whole child to the parent's kill) — bank the small
+                # cheap segments NOW, then come back for the rest of the
+                # sweep
+                run_secondaries()
+                ran_secondaries = True
             continue
+        failed_streak = 0
         entry = {"images_per_sec": round(fw_b, 2),
                  "step_time_s": round(step_b, 4)}
         if peak and dev.platform != "cpu":
@@ -743,11 +757,12 @@ def _run_child(platform: str):
         if not ok_segments():
             raise RuntimeError(
                 f"all sweep batches failed: {ex['batch_sweep']}")
-        # secondaries are banked but the headline never ran (truncated
-        # CPU fallback): emit a final value-less result instead of
-        # throwing the secondaries away
+        # secondaries are banked but the headline never succeeded
+        # (truncated CPU fallback, or every TPU compile failed): emit a
+        # final value-less result instead of throwing them away
         ex["skipped_segments"].append("baseline")
-        result["error"] = "headline segment truncated; secondaries only"
+        result["error"] = ("headline segments failed or truncated; "
+                           "secondaries only")
         result["partial"] = False
         print(PARTIAL_MARK + json.dumps(result), flush=True)
         return
@@ -786,7 +801,7 @@ def _run_child(platform: str):
         else:
             ex["skipped_segments"].append("fused_conv_bn")
 
-    if platform != "cpu":
+    if platform != "cpu" and not ran_secondaries:
         run_secondaries()
 
     result["partial"] = False
@@ -1042,6 +1057,7 @@ def main():
         # CPU fallback: tiny shapes, labelled, still a full JSON line.
         # Leave headroom for the post-fallback re-probe when the window
         # still covers one (VERDICT r4 item 1a).
+        tpu_partial = result  # may hold TPU secondaries w/o a headline
         budget = max(60.0, min(cpu_budget, remaining() - 15))
         cpu_res, err = _spawn_streaming(
             "--run", "cpu", budget,
@@ -1050,6 +1066,22 @@ def main():
             errors.append(err)
         if cpu_res is not None and _measured(cpu_res):
             result = cpu_res
+            if tpu_partial is not None and _measured(tpu_partial):
+                # the chip answered but the headline compiles failed:
+                # keep the REAL-chip secondary numbers alongside the
+                # CPU-fallback headline instead of discarding them
+                tex = tpu_partial.get("extras") or {}
+                result["extras"]["tpu_secondaries"] = {
+                    k: tex.get(k) for k in (
+                        "lenet_local_images_per_sec",
+                        "ptb_lstm_tokens_per_sec",
+                        "transformer_lm_tokens_per_sec",
+                        "dlframes_fit_transform_rows_per_sec")
+                    if tex.get(k) is not None}
+                result["extras"]["tpu_headline_errors"] = {
+                    b: v.get("error") for b, v in
+                    (tex.get("batch_sweep") or {}).items()
+                    if isinstance(v, dict) and v.get("error")}
             # label IMMEDIATELY (and mirror to _LATEST): a driver
             # SIGTERM during the post-fallback re-probe window must dump
             # a labelled artifact, not a clean-looking CPU number

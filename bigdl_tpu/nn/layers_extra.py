@@ -712,44 +712,131 @@ class UpSampling2D(_Stateless):
         return y[0] if squeezed else y
 
 
+def _resize_src_coords(jnp, out_size, in_size, align_corners,
+                       half_pixel_centers):
+    """Source sample coordinates for one axis, matching TF's three
+    sampling conventions (legacy default, align_corners, half-pixel)."""
+    d = jnp.arange(out_size, dtype=jnp.float32)
+    if align_corners and out_size > 1:
+        return d * ((in_size - 1.0) / (out_size - 1.0))
+    scale = in_size / out_size
+    if half_pixel_centers:
+        return (d + 0.5) * scale - 0.5
+    return d * scale  # TF legacy kernel: src = dst * in / out
+
+
 class ResizeBilinear(_Stateless):
     """⟦«bigdl»/nn/ResizeBilinear.scala⟧ — bilinear resize of NCHW to
-    (output_height, output_width); align_corners like the reference."""
+    (output_height, output_width).  The reference mirrors TF's kernel,
+    so all three TF sampling conventions are implemented: the legacy
+    default ``src = dst * in/out``, ``align_corners``, and
+    ``half_pixel_centers``."""
 
     def __init__(self, output_height: int, output_width: int,
-                 align_corners: bool = False):
+                 align_corners: bool = False,
+                 half_pixel_centers: bool = False):
         super().__init__(output_height=output_height,
                          output_width=output_width,
-                         align_corners=align_corners)
+                         align_corners=align_corners,
+                         half_pixel_centers=half_pixel_centers)
         self.oh, self.ow = output_height, output_width
         self.align_corners = align_corners
+        self.half_pixel_centers = half_pixel_centers
 
     def update_output_pure(self, params, input, *, training=False, rng=None):
-        import jax
-
         jnp = _jnp()
         x, squeezed = _auto_batch(input, 4)
-        if self.align_corners and self.oh > 1 and self.ow > 1:
-            # jax.image.resize has no align_corners: build the grid by hand
-            h, w = x.shape[2], x.shape[3]
-            ys = jnp.linspace(0.0, h - 1.0, self.oh)
-            xs = jnp.linspace(0.0, w - 1.0, self.ow)
-            y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, h - 1)
-            x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, w - 1)
-            y1 = jnp.clip(y0 + 1, 0, h - 1)
-            x1 = jnp.clip(x0 + 1, 0, w - 1)
-            wy = (ys - y0).reshape(1, 1, -1, 1)
-            wx = (xs - x0).reshape(1, 1, 1, -1)
-            g = lambda yy, xx: x[:, :, yy][:, :, :, xx]
-            top = g(y0, x0) * (1 - wx) + g(y0, x1) * wx
-            bot = g(y1, x0) * (1 - wx) + g(y1, x1) * wx
-            out = top * (1 - wy) + bot * wy
-            out = out.astype(x.dtype)
+        h, w = x.shape[2], x.shape[3]
+        ys = _resize_src_coords(jnp, self.oh, h, self.align_corners,
+                                self.half_pixel_centers)
+        xs = _resize_src_coords(jnp, self.ow, w, self.align_corners,
+                                self.half_pixel_centers)
+        ys = jnp.clip(ys, 0.0, h - 1.0)
+        xs = jnp.clip(xs, 0.0, w - 1.0)
+        y0 = jnp.floor(ys).astype(jnp.int32)
+        x0 = jnp.floor(xs).astype(jnp.int32)
+        y1 = jnp.clip(y0 + 1, 0, h - 1)
+        x1 = jnp.clip(x0 + 1, 0, w - 1)
+        wy = (ys - y0).reshape(1, 1, -1, 1)
+        wx = (xs - x0).reshape(1, 1, 1, -1)
+        g = lambda yy, xx: x[:, :, yy][:, :, :, xx]
+        top = g(y0, x0) * (1 - wx) + g(y0, x1) * wx
+        bot = g(y1, x0) * (1 - wx) + g(y1, x1) * wx
+        out = (top * (1 - wy) + bot * wy).astype(x.dtype)
+        return out[0] if squeezed else out
+
+
+class ResizeNearestNeighbor(_Stateless):
+    """TF-interop vocabulary («bigdl»/utils/tf/loaders/
+    ResizeNearestNeighbor) — nearest resize of NCHW to a fixed size,
+    honouring TF's align_corners / half_pixel_centers conventions."""
+
+    def __init__(self, output_height: int, output_width: int,
+                 align_corners: bool = False,
+                 half_pixel_centers: bool = False):
+        super().__init__(output_height=output_height,
+                         output_width=output_width,
+                         align_corners=align_corners,
+                         half_pixel_centers=half_pixel_centers)
+        self.oh, self.ow = output_height, output_width
+        self.align_corners = align_corners
+        self.half_pixel_centers = half_pixel_centers
+
+    def _indices(self, jnp, out_size, in_size):
+        src = _resize_src_coords(jnp, out_size, in_size,
+                                 self.align_corners,
+                                 self.half_pixel_centers)
+        if self.align_corners:
+            idx = jnp.round(src).astype(jnp.int32)  # TF rounds here
+        elif self.half_pixel_centers:
+            # TF's HalfPixelScalerForNN omits the -0.5 shift the
+            # bilinear scaler applies: idx = floor((d + 0.5) * scale)
+            idx = jnp.floor(src + 0.5).astype(jnp.int32)
         else:
-            out = jax.image.resize(
-                x, (x.shape[0], x.shape[1], self.oh, self.ow),
-                method="linear",
-            ).astype(x.dtype)
+            idx = jnp.floor(src).astype(jnp.int32)
+        return jnp.clip(idx, 0, in_size - 1)
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        jnp = _jnp()
+        x, squeezed = _auto_batch(input, 4)
+        ys = self._indices(jnp, self.oh, x.shape[2])
+        xs = self._indices(jnp, self.ow, x.shape[3])
+        out = x[:, :, ys][:, :, :, xs]
+        return out[0] if squeezed else out
+
+
+class DepthToSpace(_Stateless):
+    """TF DepthToSpace (DCR mode) on the NCHW layout: channel blocks of
+    ``block_size**2`` fan out onto the spatial grid."""
+
+    def __init__(self, block_size: int):
+        super().__init__(block_size=block_size)
+        self.block_size = block_size
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        x, squeezed = _auto_batch(input, 4)
+        n, c, h, w = x.shape
+        b = self.block_size
+        x = x.reshape(n, b, b, c // (b * b), h, w)
+        x = x.transpose(0, 3, 4, 1, 5, 2)
+        out = x.reshape(n, c // (b * b), h * b, w * b)
+        return out[0] if squeezed else out
+
+
+class SpaceToDepth(_Stateless):
+    """TF SpaceToDepth (DCR mode) on NCHW — inverse of DepthToSpace."""
+
+    def __init__(self, block_size: int):
+        super().__init__(block_size=block_size)
+        self.block_size = block_size
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        x, squeezed = _auto_batch(input, 4)
+        n, c, h, w = x.shape
+        b = self.block_size
+        x = x.reshape(n, c, h // b, b, w // b, b)
+        x = x.transpose(0, 3, 5, 1, 2, 4)
+        out = x.reshape(n, c * b * b, h // b, w // b)
         return out[0] if squeezed else out
 
 
@@ -1318,6 +1405,7 @@ __all__ = [
     "UpSampling1D",
     "UpSampling2D",
     "ResizeBilinear",
+    "ResizeNearestNeighbor", "DepthToSpace", "SpaceToDepth",
     "SpatialWithinChannelLRN",
     "SpatialSubtractiveNormalization",
     "SpatialDivisiveNormalization",

@@ -10,23 +10,32 @@ generic wire reader/writer in :mod:`bigdl_tpu.utils.caffe`.
 
 Supported ops cover the classic frozen-inference vocabulary: Const,
 Placeholder, Identity, MatMul, BiasAdd, Add/AddV2/Sub/Mul/Maximum/
-Minimum/RealDiv/Pow, Conv2D, DepthwiseConv2dNative, Relu, Relu6, Elu,
-LeakyRelu, Selu, Tanh, Sigmoid, Softplus, Softsign, MaxPool, AvgPool,
-Mean (global pool) / Sum / Max / Min reductions, Pad, Reshape, Squeeze,
-Tile, Cast, Slice, StridedSlice, Split/SplitV/Unpack/Pack, GatherV2,
-Transpose, BatchMatMul(V2), ExpandDims, Softmax, ConcatV2,
-FusedBatchNorm(V2/V3), AddN, SquaredDifference, Less/Greater/Equal
-comparisons (const operand), plus the FULL control-flow family via
-DynamicGraph: Switch/Merge conditionals AND while frames
-(Enter/Merge/Switch/LoopCond/NextIteration/Exit -> NextIteration
-feedback edges + a masked-scan loop; trip count >= 1).  Shape-arithmetic subgraphs over Consts
-(Fill/Range/Pack/StridedSlice/Shape-of-const chains) are constant-
-folded the way the reference loader folds them.
+Minimum/RealDiv/Pow/FloorDiv, Conv2D, DepthwiseConv2dNative, Relu,
+Relu6, Elu, LeakyRelu, Selu, Tanh, Sigmoid, Softplus, Softsign,
+Floor/Ceil/Round/Sign/Log1p/Expm1/Erf/Sin/Cos/Reciprocal, MaxPool,
+AvgPool, Mean (global pool) / Sum / Max / Min reductions, ArgMax, Pad,
+Reshape, Squeeze, Tile, Cast, Slice, StridedSlice,
+Split/SplitV/Unpack/Pack, GatherV2, Transpose, BatchMatMul(V2),
+ExpandDims, Softmax, ConcatV2, FusedBatchNorm(V2/V3),
+ResizeBilinear/ResizeNearestNeighbor, DepthToSpace/SpaceToDepth, AddN,
+SquaredDifference, Less/Greater/Equal comparisons (const operand),
+plus the FULL control-flow family via DynamicGraph: Switch/Merge
+conditionals AND while frames (Enter/Merge/Switch/LoopCond/
+NextIteration/Exit -> NextIteration feedback edges + a masked-scan
+loop; trip count >= 1).  Shape-arithmetic subgraphs over Consts
+(Fill/Range/Pack/StridedSlice/Shape-of-const/OneHot/Rank/Size chains)
+are constant-folded the way the reference loader folds them;
+Dequantize in weight position folds via MIN_COMBINED.
 
 ``TFTrainingSession`` (reference BigDLSessionImpl) runs an imported
 graph as a TRAINING pipeline: converted weights are live module
 parameters, gradients flow through every imported op, and the graph
-fine-tunes under Local- or DistriOptimizer.
+fine-tunes under Local- or DistriOptimizer.  Graphs that ship their
+OWN input side — TFRecordReader / queue / ParseExample / DecodeRaw —
+are handled end-to-end: ``extract_input_pipeline`` lifts the reader
+chain into a host-side :mod:`bigdl_tpu.utils.tf_records` dataset (the
+queue-dequeue boundary becomes an iterator seam) and
+``train_with_pipeline`` fine-tunes from the graph's own TFRecord files.
 """
 
 from __future__ import annotations
@@ -471,6 +480,31 @@ class TensorflowLoader:
                         "Minimum": np.minimum}[op](a, b)
             if op == "Neg":
                 return -self._const(ins[0])
+            if op == "Rank":
+                return np.asarray(self._const(ins[0]).ndim, np.int32)
+            if op == "Size":
+                return np.asarray(self._const(ins[0]).size, np.int32)
+            if op in ("Sqrt", "Floor", "Ceil", "Round", "Abs"):
+                return {"Sqrt": np.sqrt, "Floor": np.floor,
+                        "Ceil": np.ceil, "Round": np.round,
+                        "Abs": np.abs}[op](self._const(ins[0]))
+            if op == "OneHot":
+                idx = self._const(ins[0]).astype(int)
+                depth = int(self._const(ins[1]).reshape(-1)[0])
+                on = float(self._const(ins[2]).reshape(-1)[0]) \
+                    if len(ins) > 2 else 1.0
+                off = float(self._const(ins[3]).reshape(-1)[0]) \
+                    if len(ins) > 3 else 0.0
+                ax = nd.attr("axis")
+                ax = int(ax.i) if ax and ax.i is not None else -1
+                if ax not in (-1, idx.ndim):
+                    return None
+                out = np.full(idx.shape + (depth,), off, np.float32)
+                ok = (idx >= 0) & (idx < depth)
+                np.put_along_axis(
+                    out, np.clip(idx, 0, depth - 1)[..., None],
+                    np.where(ok, on, off)[..., None], axis=-1)
+                return out
             if op == "Dequantize":
                 # quantized weights in frozen graphs: MIN_COMBINED maps
                 # the integer range linearly onto [min_range, max_range]
@@ -502,7 +536,9 @@ class TensorflowLoader:
     # the tensor flowing through them is an image (4-D conv-path) tensor
     _IMG_PRODUCERS = ("Conv2D", "DepthwiseConv2dNative", "MaxPool",
                       "AvgPool", "FusedBatchNorm", "FusedBatchNormV2",
-                      "FusedBatchNormV3")
+                      "FusedBatchNormV3", "ResizeBilinear",
+                      "ResizeNearestNeighbor", "DepthToSpace",
+                      "SpaceToDepth")
     _IMG_PROPAGATORS = ("Identity", "StopGradient", "CheckNumerics",
                         "Relu", "Relu6", "Elu", "Tanh", "Sigmoid",
                         "Softplus", "BiasAdd", "Add", "AddV2", "Sub",
@@ -1322,6 +1358,37 @@ class TensorflowLoader:
             image = self._is_image(ins[0])
             dim = self._map_axis(axis, image) if axis else axis
             mod = L.Unsqueeze(dim + 1)
+            return self._named(mod, nd)(self._build(ins[0]))
+
+        if op in ("ResizeBilinear", "ResizeNearestNeighbor"):
+            from bigdl_tpu.nn.layers_extra import (
+                ResizeBilinear as _RB,
+                ResizeNearestNeighbor as _RN,
+            )
+
+            size = self._const(ins[1]).reshape(-1).astype(int)
+            oh, ow = int(size[0]), int(size[1])
+            ac = nd.attr("align_corners")
+            ac = bool(ac.b) if ac else False
+            hp = nd.attr("half_pixel_centers")
+            hp = bool(hp.b) if hp else False
+            cls = _RB if op == "ResizeBilinear" else _RN
+            mod = cls(oh, ow, align_corners=ac, half_pixel_centers=hp)
+            return self._named(mod, nd)(self._build(ins[0]))
+
+        if op in ("DepthToSpace", "SpaceToDepth"):
+            from bigdl_tpu.nn.layers_extra import (
+                DepthToSpace as _D2S,
+                SpaceToDepth as _S2D,
+            )
+
+            bs = nd.attr("block_size")
+            bs = int(bs.i) if bs and bs.i else 2
+            fmt = nd.attr("data_format")
+            if fmt and fmt.s and fmt.s not in ("NHWC", "NCHW"):
+                raise TFConversionException(
+                    f"{op} data_format {fmt.s!r} unsupported")
+            mod = _D2S(bs) if op == "DepthToSpace" else _S2D(bs)
             return self._named(mod, nd)(self._build(ins[0]))
 
         if op in ("FusedBatchNorm", "FusedBatchNormV2", "FusedBatchNormV3"):

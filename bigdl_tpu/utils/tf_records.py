@@ -76,6 +76,8 @@ def tfrecord_iterator(path: str, verify_crc: bool = True):
             if len(header) < 8:
                 return
             crc_len = f.read(4)
+            if len(crc_len) < 4:
+                raise ValueError(f"{path}: truncated record")
             (n,) = struct.unpack("<Q", header)
             if verify_crc and struct.unpack("<I", crc_len)[0] != _masked_crc(
                 header
@@ -85,6 +87,8 @@ def tfrecord_iterator(path: str, verify_crc: bool = True):
             if len(data) < n:
                 raise ValueError(f"{path}: truncated record")
             crc_data = f.read(4)
+            if len(crc_data) < 4:
+                raise ValueError(f"{path}: truncated record")
             if verify_crc and struct.unpack("<I", crc_data)[0] != _masked_crc(
                 data
             ):

@@ -78,3 +78,11 @@ def test_finetune_frozen_backbone_example():
 
     acc = main(["-e", "5"])
     assert acc > 0.9, f"fine-tune accuracy {acc}"
+
+
+@pytest.mark.slow
+def test_tensorflow_pipeline_example():
+    from examples.tensorflow.train_from_tf_pipeline import main
+
+    acc = main(["-e", "8"])
+    assert acc > 0.9, f"tf pipeline fine-tune accuracy {acc}"

@@ -214,6 +214,9 @@ def _layer_cases():
         (N.Cropping2D((1, 1), (1, 1)), img),
         (N.UpSampling1D(2), seq), (N.UpSampling2D((2, 2)), img),
         (N.ResizeBilinear(12, 12), img),
+        (N.ResizeNearestNeighbor(12, 12), img),
+        (N.DepthToSpace(2), rs.randn(2, 8, 4, 4).astype(np.float32)),
+        (N.SpaceToDepth(2), img),
         (N.SpatialWithinChannelLRN(3), img),
         (N.SpatialSubtractiveNormalization(3), img),
         (N.SpatialDivisiveNormalization(3), img),

@@ -817,3 +817,118 @@ def test_loader_dequantize_weight():
     wdq = q.astype(np.float32) * (hi - lo) / 255.0 + lo
     np.testing.assert_allclose(
         np.asarray(model.forward(x)), x @ wdq, rtol=1e-4, atol=1e-4)
+
+
+def _np_tf_bilinear(x, oh, ow, align_corners=False, half_pixel=False):
+    """TF ResizeBilinear oracle (NCHW): legacy src = dst*in/out by
+    default, the other two conventions on request."""
+    n, c, h, w = x.shape
+
+    def coords(out_size, in_size):
+        d = np.arange(out_size, dtype=np.float64)
+        if align_corners and out_size > 1:
+            return d * (in_size - 1) / (out_size - 1)
+        s = in_size / out_size
+        return (d + 0.5) * s - 0.5 if half_pixel else d * s
+
+    ys = np.clip(coords(oh, h), 0, h - 1)
+    xs = np.clip(coords(ow, w), 0, w - 1)
+    y0 = np.floor(ys).astype(int)
+    x0 = np.floor(xs).astype(int)
+    y1 = np.minimum(y0 + 1, h - 1)
+    x1 = np.minimum(x0 + 1, w - 1)
+    wy = (ys - y0)[None, None, :, None]
+    wx = (xs - x0)[None, None, None, :]
+    g = lambda yy, xx: x[:, :, yy][:, :, :, xx]
+    top = g(y0, x0) * (1 - wx) + g(y0, x1) * wx
+    bot = g(y1, x0) * (1 - wx) + g(y1, x1) * wx
+    return top * (1 - wy) + bot * wy
+
+
+def test_loader_resize_and_pixel_shuffle_ops():
+    """ResizeBilinear / DepthToSpace / SpaceToDepth on the conv path
+    (NHWC graph -> NCHW modules); D2S/S2D at the same block size
+    round-trip, so the resize input equals the conv output."""
+    rs = np.random.RandomState(6)
+    w = rs.randn(1, 1, 3, 8).astype(np.float32)  # HWIO 1x1, 3->8
+
+    b = GraphDefBuilder()
+    b.placeholder("x")
+    b.const("w", w)
+    b.op("conv", "Conv2D", ["x", "w"],
+         strides=b.attr_ints([1, 1, 1, 1]), padding=b.attr_s("SAME"))
+    b.op("d2s", "DepthToSpace", ["conv"], block_size=b.attr_i(2))
+    b.op("s2d", "SpaceToDepth", ["d2s"], block_size=b.attr_i(2))
+    b.const("size", np.asarray([8, 8], np.int32))
+    b.op("rs", "ResizeBilinear", ["s2d", "size"])
+    model = TensorflowLoader(data=b.tobytes()).load(
+        inputs=["x"], outputs=["rs"])
+    model.evaluate()
+    x = rs.randn(2, 3, 4, 4).astype(np.float32)  # NCHW feed
+    out = np.asarray(model.forward(x))
+    assert out.shape == (2, 8, 8, 8)
+
+    conv = np.einsum("nchw,oc->nohw", x, w[0, 0].T)
+    expect = _np_tf_bilinear(conv, 8, 8)  # TF legacy sampling
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_loader_bilinear_matches_tf_legacy_kernel():
+    """The TF-default (align_corners=false, half_pixel_centers=false)
+    kernel samples src = dst*in/out: upscaling [[0,1],[2,3]] to 4x4
+    gives row0 [0, 0.5, 1, 1] — NOT the half-pixel [0, .25, .75, 1]."""
+    b = GraphDefBuilder()
+    b.placeholder("x")
+    b.const("size", np.asarray([4, 4], np.int32))
+    b.op("rs", "ResizeBilinear", ["x", "size"])
+    model = TensorflowLoader(data=b.tobytes()).load(
+        inputs=["x"], outputs=["rs"])
+    model.evaluate()
+    x = np.arange(4, dtype=np.float32).reshape(1, 1, 2, 2)
+    out = np.asarray(model.forward(x))
+    np.testing.assert_allclose(out[0, 0, 0], [0.0, 0.5, 1.0, 1.0])
+    np.testing.assert_allclose(out[0, 0, :, 0], [0.0, 1.0, 2.0, 2.0])
+
+
+def test_loader_nearest_resize_conventions():
+    x = np.arange(9, dtype=np.float32).reshape(1, 1, 3, 3)
+
+    def run(**attrs):
+        b = GraphDefBuilder()
+        b.placeholder("x")
+        b.const("size", np.asarray([2, 2], np.int32))
+        kw = {k: b.attr_b(v) for k, v in attrs.items()}
+        b.op("rn", "ResizeNearestNeighbor", ["x", "size"], **kw)
+        model = TensorflowLoader(data=b.tobytes()).load(
+            inputs=["x"], outputs=["rn"])
+        model.evaluate()
+        return np.asarray(model.forward(x))[0, 0]
+
+    # legacy: rows floor(d*3/2) = [0, 1]
+    np.testing.assert_allclose(run(), x[0, 0][[0, 1]][:, [0, 1]])
+    # align_corners: round(d*2/1) = [0, 2]
+    np.testing.assert_allclose(run(align_corners=True),
+                               x[0, 0][[0, 2]][:, [0, 2]])
+    # half_pixel_centers: floor((d+0.5)*1.5) = [0, 2]
+    np.testing.assert_allclose(run(half_pixel_centers=True),
+                               x[0, 0][[0, 2]][:, [0, 2]])
+
+
+def test_fold_onehot_rank_size():
+    rs = np.random.RandomState(2)
+    b = GraphDefBuilder()
+    b.placeholder("x")
+    b.const("idx", np.asarray([0, 2, 1], np.int32))
+    b.const("depth", np.asarray(4, np.int32))
+    b.const("on", np.asarray(1.0, np.float32))
+    b.const("off", np.asarray(0.0, np.float32))
+    b.op("oh", "OneHot", ["idx", "depth", "on", "off"])
+    # (3,4) one-hot const lands in weight position of a MatMul
+    b.op("mm", "MatMul", ["x", "oh"], transpose_b=b.attr_b(True))
+    model = TensorflowLoader(data=b.tobytes()).load(
+        inputs=["x"], outputs=["mm"])
+    model.evaluate()
+    x = rs.randn(2, 4).astype(np.float32)
+    expect = x @ np.eye(4, dtype=np.float32)[[0, 2, 1]].T
+    np.testing.assert_allclose(np.asarray(model.forward(x)), expect,
+                               rtol=1e-5)
