@@ -1,0 +1,154 @@
+"""Escalating TPU compile/run probe — attributes relay failures.
+
+The round-5 outage mode: the probe reaches the chip, but the relay's
+remote_compile service 500s (or hangs) on large programs.  This script
+runs an escalating ladder of programs, each in its OWN subprocess with
+a hard timeout, and prints one status line per rung — so a single run
+says exactly where the tunnel/compiler breaks.
+
+    python scripts/tpu_debug.py            # full ladder
+    python scripts/tpu_debug.py --rung 4   # one rung, in-process
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+RUNGS = [
+    ("matmul_1k", "1k x 1k bf16 matmul"),
+    ("conv_small", "3x3 conv 16ch @64px"),
+    ("bottleneck_fwd", "one ResNet bottleneck fwd, batch 32"),
+    ("resnet_fwd", "full ResNet-50 fwd, batch 32"),
+    ("resnet_step", "ResNet-50 train step (no scan), batch 32"),
+    ("resnet_scan", "ResNet-50 train step in a 10-step scan, batch 32"),
+    ("resnet_scan_b128", "scan step at the bench operating point b128"),
+    ("fused_scan_b128", "fused conv+BN scan step, b128"),
+]
+
+
+def _run_rung(name: str):
+    import jax
+    import jax.numpy as jnp
+
+    jax.config.update("jax_platforms", "axon")
+    import numpy as np
+
+    dev = jax.devices()[0]
+    t0 = time.time()
+
+    if name == "matmul_1k":
+        x = jnp.ones((1024, 1024), jnp.bfloat16)
+        jax.jit(lambda a: a @ a)(x).block_until_ready()
+    elif name == "conv_small":
+        from jax import lax
+
+        img = jnp.ones((8, 16, 64, 64), jnp.bfloat16)
+        k = jnp.ones((16, 16, 3, 3), jnp.bfloat16)
+        jax.jit(lambda i, w: lax.conv_general_dilated(
+            i, w, (1, 1), "SAME",
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        ))(img, k).block_until_ready()
+    elif name in ("bottleneck_fwd", "resnet_fwd", "resnet_step",
+                  "resnet_scan", "resnet_scan_b128", "fused_scan_b128"):
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        import bench as B
+
+        batch = 128 if name.endswith("b128") else 32
+        rs = np.random.RandomState(0)
+        if name == "bottleneck_fwd":
+            from bigdl_tpu.nn import (
+                ReLU,
+                Sequential,
+                SpatialBatchNormalization,
+                SpatialConvolution,
+            )
+
+            m = Sequential()
+            m.add(SpatialConvolution(256, 64, 1, 1, with_bias=False))
+            m.add(SpatialBatchNormalization(64)).add(ReLU())
+            m.add(SpatialConvolution(64, 64, 3, 3, 1, 1, -1, -1,
+                                     with_bias=False))
+            m.add(SpatialBatchNormalization(64)).add(ReLU())
+            m.add(SpatialConvolution(64, 256, 1, 1, with_bias=False))
+            m.add(SpatialBatchNormalization(256))
+            params, state = m.params(), m.state()
+            x = jnp.asarray(rs.randn(32, 256, 56, 56).astype(np.float32))
+
+            @jax.jit
+            def f(p, x):
+                out, _ = m.apply(p, state, x, training=True,
+                                 rng=jax.random.key(0))
+                return out.sum()
+
+            f(params, x).block_until_ready()
+        else:
+            x = rs.randn(batch, 3, 224, 224).astype(np.float32)
+            y = (rs.randint(0, 1000, batch) + 1).astype(np.float32)
+            if name == "resnet_fwd":
+                from bigdl_tpu.models import build_resnet_imagenet
+
+                model = build_resnet_imagenet(depth=50, class_num=1000)
+                params, state = model.params(), model.state()
+
+                @jax.jit
+                def f(p, xx):
+                    out, _ = model.apply(p, state, xx, training=False,
+                                         rng=None)
+                    return out.sum()
+
+                f(params, jnp.asarray(x)).block_until_ready()
+            elif name == "resnet_step":
+                # the bench's framework step, ONE call, no scan
+                ips, _ = B._bench_framework(x, y, batch, 1,
+                                            compute_dtype="bfloat16")
+            else:
+                fuse = name.startswith("fused")
+                ips, _ = B._bench_framework(x, y, batch, 10,
+                                            compute_dtype="bfloat16",
+                                            fuse=fuse)
+                print(json.dumps({"rung": name,
+                                  "images_per_sec": round(ips, 2)}))
+    else:
+        raise SystemExit(f"unknown rung {name}")
+    print(json.dumps({"rung": name, "ok": True,
+                      "device": dev.device_kind,
+                      "seconds": round(time.time() - t0, 1)}))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--rung", type=int, default=None)
+    p.add_argument("--timeout", type=float, default=300.0)
+    p.add_argument("--stop-on-fail", action="store_true")
+    args = p.parse_args()
+
+    if args.rung is not None:
+        _run_rung(RUNGS[args.rung][0])
+        return
+
+    for i, (name, desc) in enumerate(RUNGS):
+        t0 = time.time()
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--rung", str(i)],
+                capture_output=True, text=True, timeout=args.timeout,
+            )
+            ok = proc.returncode == 0
+            tail = (proc.stdout or proc.stderr or "").strip().splitlines()
+            detail = tail[-1][:240] if tail else ""
+        except subprocess.TimeoutExpired:
+            ok, detail = False, f"TIMEOUT after {args.timeout:.0f}s"
+        print(f"[{i}] {name:18s} {desc:45s} "
+              f"{'OK' if ok else 'FAIL'} {time.time()-t0:6.1f}s  {detail}",
+              flush=True)
+        if not ok and args.stop_on_fail:
+            break
+
+
+if __name__ == "__main__":
+    main()
