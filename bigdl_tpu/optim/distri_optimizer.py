@@ -26,7 +26,10 @@ over the ``data`` mesh axis:
 
 The Spark job-per-iteration barrier becomes the implicit synchrony of the
 jitted step; FP16 wire compression maps to an optional bf16 cast before
-the reduce-scatter (native on TPU ICI).  The same step compiles for a
+the reduce-scatter (native on TPU ICI), or to the stronger
+``wire_dtype="int8"`` blockwise-quantized exchange (int8 payload +
+per-block f32 scales through one all_to_all pair, f32 accumulation —
+EQuARX-style, half the bf16 bytes).  The same step compiles for a
 multi-host DCN+ICI mesh — XLA picks the collective implementation.
 """
 
@@ -62,11 +65,38 @@ def _shard_map(f, mesh, in_specs, out_specs):
               check_rep=False)
 
 
+def int8_blockwise_reduce_scatter(g, axis, n, block):
+    """Quantized reduce-scatter (inside shard_map): ``g`` is the local
+    flat gradient, length divisible by ``n * block``.  Each device
+    quantizes per-destination-chunk, per-block to int8 (symmetric,
+    scale = max|g|/127), ships payload + f32 scales through ONE
+    all_to_all pair, and the owner dequantizes and accumulates in f32.
+
+    This is the int8 analogue of the reference's FP16CompressedTensor
+    wire («bigdl»/parameters/FP16CompressedTensor.scala) at a quarter
+    of the f32 bytes (+4/block for scales); EQuARX-style blockwise
+    scaling bounds the element error by its block's max/254.
+    """
+    import jax
+
+    jnp = _jnp()
+    nb = g.size // n // block
+    gq = g.astype(jnp.float32).reshape(n, nb, block)
+    amax = jnp.max(jnp.abs(gq), axis=2)
+    scale = jnp.maximum(amax / 127.0, jnp.float32(1e-30))
+    q = jnp.clip(jnp.round(gq / scale[..., None]), -127, 127).astype(
+        jnp.int8)
+    q = jax.lax.all_to_all(q, axis, 0, 0, tiled=True)
+    scale = jax.lax.all_to_all(scale, axis, 0, 0, tiled=True)
+    return jnp.sum(q.astype(jnp.float32) * scale[..., None],
+                   axis=0).reshape(-1)
+
+
 class DistriOptimizer(LocalOptimizer):
     """Synchronous data-parallel trainer with ZeRO-1 sharded updates."""
 
     def __init__(self, model, dataset, criterion, batch_size=32, mesh=None,
-                 wire_dtype="bfloat16", data_axes=None):
+                 wire_dtype="bfloat16", data_axes=None, int8_block=512):
         super().__init__(model, dataset, criterion, batch_size)
         from bigdl_tpu.engine import Engine
 
@@ -90,8 +120,24 @@ class DistriOptimizer(LocalOptimizer):
         for a in self.axes:
             self.n_shards *= mesh.shape[a]
         # reference: FP16CompressedTensor on-the-wire compression for
-        # gradient blocks; bf16 is the TPU-native equivalent
+        # gradient blocks; bf16 is the TPU-native equivalent, int8 the
+        # blockwise-quantized EQuARX-style option (half the bf16 bytes)
+        if wire_dtype not in ("bfloat16", "float32", "none", "int8"):
+            # an unknown spelling must not silently train uncompressed
+            raise ValueError(
+                f"wire_dtype {wire_dtype!r} not supported; choose "
+                "'bfloat16', 'int8', 'float32' or 'none'")
         self.wire_dtype = wire_dtype
+        self.int8_block = int(int8_block)
+        if wire_dtype == "int8":
+            if self.int8_block < 1:
+                raise ValueError(
+                    f"int8_block must be positive, got {int8_block}")
+            if len(self.axes) > 1:
+                raise NotImplementedError(
+                    "int8 wire compression over hierarchical data axes "
+                    "is not supported; use a single data axis or "
+                    "bfloat16")
         self._pad = 0
         self._warned_batch_sizes = set()
         self._host_mask = None
@@ -133,7 +179,9 @@ class DistriOptimizer(LocalOptimizer):
 
         jnp = _jnp()
         n = self.n_shards
-        self._pad = (-flat.size) % n
+        # int8 wire needs whole quantization blocks per shard
+        quantum = n * self.int8_block if self.wire_dtype == "int8" else n
+        self._pad = (-flat.size) % quantum
         shard_len = (flat.size + self._pad) // n
         opt = self.optim_method
         if opt.state is not None:
@@ -267,10 +315,14 @@ class DistriOptimizer(LocalOptimizer):
             with jax.named_scope("put_gradient"):
                 # ---- putGradients + aggregateGradientPartition ----------
                 g = jnp.pad(grad, (0, pad))
-                if wire is not None and wire != g.dtype:
-                    g = g.astype(wire)
-                gshard = jax.lax.psum_scatter(
-                    g, axis, scatter_dimension=0, tiled=True)
+                if self.wire_dtype == "int8":
+                    gshard = int8_blockwise_reduce_scatter(
+                        g, axis, n, self.int8_block)
+                else:
+                    if wire is not None and wire != g.dtype:
+                        g = g.astype(wire)
+                    gshard = jax.lax.psum_scatter(
+                        g, axis, scatter_dimension=0, tiled=True)
             with jax.named_scope("aggregate_gradient"):
                 gshard = gshard.astype(flat_p.dtype)
                 # reference: gradient /= numSamples — the global batch,
