@@ -9,6 +9,11 @@ from __future__ import annotations
 
 
 class _TriggerBase:
+    # True when the predicate reads state["loss"]: the optimizer loop
+    # then resolves the device loss synchronously every iteration
+    # instead of pipelining the readback one step behind
+    needs_loss = False
+
     def __call__(self, state: dict) -> bool:
         raise NotImplementedError
 
@@ -56,6 +61,8 @@ class _MaxIteration(_TriggerBase):
 
 
 class _MinLoss(_TriggerBase):
+    needs_loss = True
+
     def __init__(self, m: float):
         self.m = m
 
@@ -76,6 +83,8 @@ class _MaxScore(_TriggerBase):
 class _And(_TriggerBase):
     def __init__(self, *ts):
         self.ts = ts
+        self.needs_loss = any(
+            getattr(t, "needs_loss", False) for t in ts)
 
     def __call__(self, state):
         return all(t(state) for t in self.ts)
@@ -84,6 +93,8 @@ class _And(_TriggerBase):
 class _Or(_TriggerBase):
     def __init__(self, *ts):
         self.ts = ts
+        self.needs_loss = any(
+            getattr(t, "needs_loss", False) for t in ts)
 
     def __call__(self, state):
         return any(t(state) for t in self.ts)

@@ -128,3 +128,69 @@ def test_mixed_precision_bf16_converges():
     from bigdl_tpu.optim.evaluator import predict_class
     acc = (predict_class(trained, x) == y.astype(int)).mean()
     assert acc > 0.95, acc
+
+
+def test_min_loss_trigger_stops_with_current_loss():
+    """Trigger.min_loss reads state['loss']: the pipelined loop must
+    fall back to exact per-step readback (needs_loss) so the stop
+    happens on the iteration the threshold is crossed."""
+    from bigdl_tpu.optim import Trigger
+
+    x, y = _toy_classification()
+    model = Sequential().add(Linear(8, 3)).add(LogSoftMax())
+    opt = LocalOptimizer(model, (x, y), ClassNLLCriterion(), batch_size=64)
+    opt.set_optim_method(SGD(learningrate=0.5))
+    opt.set_end_when(Trigger.or_(Trigger.min_loss(0.35),
+                                 Trigger.max_epoch(30)))
+    opt.optimize()
+    # the toy task crosses 0.35 well before 30 epochs at lr 0.5: the
+    # stop must have come from min_loss READING the current loss, so a
+    # broken sync fallback (stale/None loss) would run to max_epoch
+    assert opt.state["loss"] < 0.35, opt.state["loss"]
+    assert opt.state["epoch"] <= 30, opt.state["epoch"]
+
+
+def test_pipelined_loss_trajectory_matches_sync():
+    """Deferred loss readback must not change the recorded loss
+    trajectory — same values at the same summary steps."""
+    from bigdl_tpu.common import RandomGenerator
+
+    x, y = _toy_classification(192)
+
+    class _Tape:
+        def __init__(self):
+            self.losses = []
+
+        def add_scalar(self, tag, value, step):
+            if tag == "Loss":
+                self.losses.append((step, round(float(value), 6)))
+
+        def add_histogram(self, *a, **k):
+            pass
+
+        def get_summary_trigger(self, name):
+            return None
+
+    tapes = {}
+    for mode in ("pipelined", "sync"):
+        RandomGenerator.RNG.set_seed(5)
+        model = Sequential().add(Linear(8, 3)).add(LogSoftMax())
+        opt = LocalOptimizer(model, (x, y), ClassNLLCriterion(),
+                             batch_size=64)
+        opt.set_optim_method(SGD(learningrate=0.3))
+        if mode == "sync":
+            # a loss-reading end trigger forces per-step readback
+            from bigdl_tpu.optim import Trigger
+
+            opt.set_end_when(Trigger.or_(Trigger.min_loss(-1.0),
+                                         Trigger.max_epoch(3)))
+        else:
+            from bigdl_tpu.optim import Trigger
+
+            opt.set_end_when(Trigger.max_epoch(3))
+        tape = _Tape()
+        opt.train_summary = tape
+        opt.optimize()
+        tapes[mode] = tape.losses
+    assert tapes["pipelined"] == tapes["sync"], (
+        tapes["pipelined"][:3], tapes["sync"][:3])
