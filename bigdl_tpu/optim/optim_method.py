@@ -296,6 +296,12 @@ class OptimMethod:
 
         def walk(prefix, v):
             if isinstance(v, dict):
+                if not v:
+                    # empty pytree node (a parameter-less layer's slot):
+                    # must survive the round trip or the restored state's
+                    # tree structure no longer matches the params tree
+                    out[f"{prefix}/__emptydict__"] = np.zeros(0)
+                    return
                 for k, sub in v.items():
                     walk(f"{prefix}/{k}" if prefix else k, sub)
             else:
@@ -313,6 +319,8 @@ class OptimMethod:
             d = state
             for p in parts[:-1]:
                 d = d.setdefault(p, {})
+            if parts[-1] == "__emptydict__":
+                continue  # the setdefault walk already created the node
             d[parts[-1]] = jnp.asarray(v)
         return state
 
@@ -320,14 +328,105 @@ class OptimMethod:
         self.state = self._unflatten_state(arrays)
 
     def save(self, path: str):
-        np.savez(path, __class__=type(self).__name__, **self.get_state_arrays())
+        """Reference: ``OptimMethod.save(path)`` — persists the method's
+        hyperparameters (incl. LR schedule objects) AND its state table,
+        so ``OptimMethod.load`` reconstructs a resumable method.
+
+        Hyperparameters that cannot be pickled (e.g. a user lambda in
+        ``EpochDecay``) are skipped — save never fails where the old
+        state-only save succeeded; ``load`` reports them."""
+        import pickle
+
+        hyper = {}
+        skipped = []
+        for k, v in vars(self).items():
+            if k == "state":
+                continue
+            try:
+                pickle.dumps(v)
+                hyper[k] = v
+            except Exception:  # noqa: BLE001 — any unpicklable attr
+                skipped.append(k)
+        np.savez(
+            path,
+            __class__=type(self).__name__,
+            __hyper__=np.frombuffer(
+                pickle.dumps(hyper), dtype=np.uint8).copy(),
+            __hyper_skipped__=np.asarray(skipped, dtype=object),
+            **self.get_state_arrays(),
+        )
+
+    _CONTAINER_KEYS = ("__class__", "__hyper__", "__hyper_skipped__",
+                       "__meta__")
 
     @staticmethod
     def load_state(path: str) -> dict:
         data = np.load(path, allow_pickle=True)
         return OptimMethod._unflatten_state(
-            {k: data[k] for k in data.files if k != "__class__"}
+            {k: data[k] for k in data.files
+             if k not in OptimMethod._CONTAINER_KEYS}
         )
+
+    @staticmethod
+    def load(path: str) -> "OptimMethod":
+        """Reference: ``OptimMethod.load(path)`` — rebuild the saved
+        method (class + hyperparameters + state) for
+        ``Optimizer(...).set_optim_method(OptimMethod.load(p))``
+        resume.  Also reads the ``save_checkpoint`` ``.optim.npz``
+        container (state + class, no hyperparameters) and fails fast
+        when hyperparameters are missing or were unpicklable."""
+        import json
+        import pickle
+
+        if not path.endswith(".npz"):
+            path = path + ".npz"
+        data = np.load(path, allow_pickle=True)
+        if "__class__" in data.files:
+            name = str(data["__class__"])
+        elif "__meta__" in data.files:
+            # serializer.save_checkpoint container: class name rides in
+            # the JSON meta; it carries NO hyperparameters
+            name = json.loads(bytes(data["__meta__"]).decode())["class"]
+            raise ValueError(
+                f"{path} is a save_checkpoint optimizer-state container "
+                f"(class {name}, state only): reconstruct the "
+                "OptimMethod with its hyperparameters and use "
+                "load_checkpoint / load_state_arrays to restore state")
+        else:
+            raise ValueError(f"{path} is not an OptimMethod.save file")
+        if "__hyper__" not in data.files:
+            raise ValueError(
+                f"{path} carries no hyperparameters (written by a "
+                "pre-hyper save): reconstruct the OptimMethod manually "
+                "and restore its state with OptimMethod.load_state")
+        skipped = [str(s) for s in data["__hyper_skipped__"].tolist()] \
+            if "__hyper_skipped__" in data.files else []
+        if skipped:
+            raise ValueError(
+                f"{path}: hyperparameters {skipped} were unpicklable at "
+                "save time; reconstruct the OptimMethod manually and "
+                "restore its state with OptimMethod.load_state")
+
+        def subclasses(cls):
+            out = {}
+            for sub in cls.__subclasses__():
+                out[sub.__name__] = sub
+                out.update(subclasses(sub))
+            return out
+
+        klass = subclasses(OptimMethod).get(name)
+        if klass is None:
+            raise ValueError(f"unknown OptimMethod class {name!r}")
+        obj = klass.__new__(klass)
+        obj.state = None
+        vars(obj).update(pickle.loads(data["__hyper__"].tobytes()))
+        state = OptimMethod._unflatten_state(
+            {k: data[k] for k in data.files
+             if k not in OptimMethod._CONTAINER_KEYS}
+        )
+        if state:
+            obj.state = state
+        return obj
 
 
 class SGD(OptimMethod):

@@ -386,11 +386,15 @@ class LocalOptimizer(BaseOptimizer):
         # TPU relay) overlaps compute.  Loss-reading triggers
         # (Trigger.min_loss) force the exact per-step readback instead.
         # unknown user-supplied callables may read state["loss"], so
-        # only triggers that DECLARE needs_loss=False may pipeline
+        # only triggers that DECLARE needs_loss=False may pipeline —
+        # including a Parameters summary trigger, which is evaluated
+        # per-iteration against the same state table
+        _param_trig = (self.train_summary.get_summary_trigger("Parameters")
+                       if self.train_summary is not None else None)
         sync_per_step = any(
             getattr(t, "needs_loss", True)
             for t in (self.end_when, self.validation_trigger,
-                      self.checkpoint_trigger)
+                      self.checkpoint_trigger, _param_trig)
             if t is not None
         )
         pending = []  # [(n, loss_device, batch_size, t_dispatch)]
