@@ -299,8 +299,11 @@ class OptimMethod:
                 if not v:
                     # empty pytree node (a parameter-less layer's slot):
                     # must survive the round trip or the restored state's
-                    # tree structure no longer matches the params tree
-                    out[f"{prefix}/__emptydict__"] = np.zeros(0)
+                    # tree structure no longer matches the params tree.
+                    # An empty TOP-LEVEL state stays {} (prefix ""
+                    # would otherwise round-trip as {'': {}}).
+                    if prefix:
+                        out[f"{prefix}/__emptydict__"] = np.zeros(0)
                     return
                 for k, sub in v.items():
                     walk(f"{prefix}/{k}" if prefix else k, sub)

@@ -82,6 +82,19 @@ _WORKER = textwrap.dedent(
     opt2.set_end_when(Trigger.max_epoch(2))
     opt2.optimize()
     print("RAGGED_LOSS %.9f" % opt2.state["loss"], flush=True)
+
+    # int8 blockwise wire: the quantized all_to_all exchange must work
+    # across REAL process boundaries too (payload + scales cross the
+    # distributed backend), and both hosts must agree bit-for-bit
+    RandomGenerator.RNG.set_seed(44)
+    m3 = Sequential().add(Linear(16, 32)).add(ReLU()) \\
+        .add(Linear(32, 4)).add(LogSoftMax())
+    opt3 = DistriOptimizer(m3, (x, y), ClassNLLCriterion(), batch_size=32,
+                           wire_dtype="int8", int8_block=64)
+    opt3.set_optim_method(SGD(learningrate=0.5))
+    opt3.set_end_when(Trigger.max_epoch(2))
+    opt3.optimize()
+    print("INT8_LOSS %.9f" % opt3.state["loss"], flush=True)
     """
 )
 
@@ -142,9 +155,16 @@ def test_two_process_distri_fit_agrees(tmp_path):
         rline = [l for l in out.splitlines() if l.startswith("RAGGED_LOSS")]
         assert rline, f"worker {i} printed no RAGGED_LOSS:\n{out[-2000:]}"
         ragged.append(rline[-1].split()[1])
+    int8 = []
+    for i, out in enumerate(outs):
+        iline = [l for l in out.splitlines() if l.startswith("INT8_LOSS")]
+        assert iline, f"worker {i} printed no INT8_LOSS:\n{out[-2000:]}"
+        int8.append(iline[-1].split()[1])
     # both processes drive the same global computation: exact agreement
     assert losses[0] == losses[1], losses
     # every host reports the same GLOBAL validation accuracy
     assert accs[0] == accs[1], accs
     # ragged tail (repeat-padded + masked) also agrees bit-for-bit
     assert ragged[0] == ragged[1], ragged
+    # quantized all_to_all across process boundaries agrees too
+    assert int8[0] == int8[1], int8
