@@ -289,7 +289,10 @@ class OptimMethod:
     # checkpoint support («bigdl» OptimMethod.save/load).  State entries
     # may be pytrees (nested string-keyed dicts matching the model's
     # parameter tree); they flatten to "/"-joined keys for npz storage.
-    def get_state_arrays(self):
+    def get_state_arrays(self, materialize: bool = True):
+        """Flatten the state table to "/"-joined keys.  With
+        ``materialize=False`` the values stay device-array REFS (for an
+        async checkpoint snapshot — the host transfer happens later)."""
         if self.state is None:
             return {}
         out = {}
@@ -308,7 +311,7 @@ class OptimMethod:
                 for k, sub in v.items():
                     walk(f"{prefix}/{k}" if prefix else k, sub)
             else:
-                out[prefix] = np.asarray(v)
+                out[prefix] = np.asarray(v) if materialize else v
 
         walk("", self.state)
         return out

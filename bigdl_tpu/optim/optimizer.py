@@ -115,12 +115,18 @@ class BaseOptimizer:
         self.validation_methods = methods
         return self
 
-    def set_checkpoint(self, path, trigger=None):
+    def set_checkpoint(self, path, trigger=None, background=False):
+        """``background=True`` writes checkpoints on a host thread: the
+        synchronous part only captures device-array refs (immutable
+        snapshot), so training resumes immediately while the
+        device->host transfer and file IO happen off-thread.  At most
+        one write is in flight; the next trigger waits for it."""
         from bigdl_tpu.optim.triggers import Trigger
 
         os.makedirs(path, exist_ok=True)
         self.checkpoint_path = path
         self.checkpoint_trigger = trigger or Trigger.every_epoch()
+        self.checkpoint_background = background
         return self
 
     def set_train_summary(self, summary):
@@ -165,17 +171,49 @@ class BaseOptimizer:
     def _checkpoint(self):
         if not self.checkpoint_path:
             return
-        from bigdl_tpu.utils.serializer import save_checkpoint
+        from bigdl_tpu.utils.serializer import (
+            save_checkpoint,
+            snapshot_checkpoint,
+            write_checkpoint,
+        )
 
         tag = f"{self.state['epoch']}_{self.state['neval']}"
-        save_checkpoint(
-            os.path.join(self.checkpoint_path, f"checkpoint_{tag}"),
-            self.model,
-            self.optim_method,
-            extra={"epoch": self.state["epoch"], "neval": self.state["neval"]},
-        )
+        prefix = os.path.join(self.checkpoint_path, f"checkpoint_{tag}")
+        extra = {"epoch": self.state["epoch"], "neval": self.state["neval"]}
+        if getattr(self, "checkpoint_background", False):
+            from concurrent.futures import ThreadPoolExecutor
+
+            if getattr(self, "_ckpt_executor", None) is None:
+                self._ckpt_executor = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="bigdl-ckpt")
+                self._ckpt_future = None
+            self._flush_checkpoints()  # at most one write in flight
+            snap = snapshot_checkpoint(self.model, self.optim_method,
+                                       extra)
+            self._ckpt_future = self._ckpt_executor.submit(
+                write_checkpoint, snap, prefix)
+            log.info("checkpoint scheduled at epoch %s iter %s",
+                     self.state["epoch"], self.state["neval"])
+            return
+        save_checkpoint(prefix, self.model, self.optim_method, extra)
         log.info("checkpoint saved at epoch %s iter %s", self.state["epoch"],
                  self.state["neval"])
+
+    def _flush_checkpoints(self, raise_errors: bool = True):
+        """Wait for an in-flight background checkpoint write — called
+        before reads of the checkpoint dir and at the end of
+        optimize().  ``raise_errors=False`` logs instead (used in the
+        exception-path finally, where raising would mask the original
+        error)."""
+        fut = getattr(self, "_ckpt_future", None)
+        if fut is not None:
+            self._ckpt_future = None
+            try:
+                fut.result()
+            except Exception:
+                if raise_errors:
+                    raise
+                log.exception("background checkpoint write failed")
 
     def _prepare_batch(self, inp, tgt):
         """Hook: adjust a host batch before device transfer, or return
@@ -373,6 +411,16 @@ class LocalOptimizer(BaseOptimizer):
             # DistriOptimizer retry path would otherwise hit "profiler
             # already started" on its next attempt
             profiler.stop()
+            # a background checkpoint still writing must become durable
+            # before optimize() returns or the retry path reads the
+            # checkpoint dir; write errors are logged here (raising in
+            # a finally would mask an in-flight exception)
+            self._flush_checkpoints(raise_errors=False)
+            ex = getattr(self, "_ckpt_executor", None)
+            if ex is not None:
+                # no lingering non-daemon worker thread per optimizer
+                ex.shutdown(wait=True)
+                self._ckpt_executor = None
 
     def _optimize_loop(self, model, pvar, mod_state, opt, opt_state,
                        train_step, base_key, wall_start, records_total,
@@ -529,6 +577,9 @@ class LocalOptimizer(BaseOptimizer):
         self._write_back(pvar, mod_state)
         opt.state = opt_state
         self.model.evaluate()
+        # normal completion: surface any background-checkpoint write
+        # error to the caller instead of just logging it
+        self._flush_checkpoints()
         return self.model
 
     def _write_back(self, pvar, mod_state):

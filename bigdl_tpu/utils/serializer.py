@@ -157,17 +157,24 @@ def save_module(module: AbstractModule, path: str):
     import jax
 
     spec = module_to_spec(module)
-    p_leaves = jax.tree.leaves(module.params())
-    s_leaves = jax.tree.leaves(module.state())
+    arrays = _module_arrays(spec, jax.tree.leaves(module.params()),
+                            jax.tree.leaves(module.state()))
+    if not path.endswith(".npz"):
+        path = path + ".npz"
+    np.savez(path, **arrays)
+    return path
+
+
+def _module_arrays(spec, p_leaves, s_leaves):
+    """The single npz encoding (p{i}/s{i}/__spec__) load_module reads —
+    shared by save_module and write_checkpoint so the two writers can
+    never drift apart."""
     arrays = {f"p{i}": np.asarray(x) for i, x in enumerate(p_leaves)}
     arrays.update({f"s{i}": np.asarray(x) for i, x in enumerate(s_leaves)})
     arrays["__spec__"] = np.frombuffer(
         json.dumps(spec).encode("utf-8"), dtype=np.uint8
     )
-    if not path.endswith(".npz"):
-        path = path + ".npz"
-    np.savez(path, **arrays)
-    return path
+    return arrays
 
 
 def load_module(path: str) -> AbstractModule:
@@ -200,22 +207,78 @@ def load_module(path: str) -> AbstractModule:
 
 
 # ------------------------------------------------------------- checkpoints
+def snapshot_checkpoint(model, optim_method=None, extra: dict = None):
+    """Synchronously capture everything a checkpoint needs — module
+    spec + device-array snapshots; no host transfer happens here.  The
+    returned dict can be written later/off-thread by
+    :func:`write_checkpoint`.
+
+    Model leaves are held by reference (the training loop's write_back
+    already copied them out of the donated buffers); optimizer-state
+    leaves are device-copied HERE because the live opt_state buffers
+    are donated to (and deleted by) the very next train_step."""
+    import jax
+
+    def dev_copy(v):
+        return v.copy() if hasattr(v, "copy") else v
+
+    snap = {
+        "spec": module_to_spec(model),
+        "p_leaves": list(jax.tree.leaves(model.params())),
+        "s_leaves": list(jax.tree.leaves(model.state())),
+        "optim": None,
+    }
+    if optim_method is not None:
+        snap["optim"] = {
+            "class": type(optim_method).__name__,
+            "arrays": {
+                k: dev_copy(v)
+                for k, v in optim_method.get_state_arrays(
+                    materialize=False).items()
+            },
+            "extra": extra or {},
+        }
+    return snap
+
+
+def _atomic_savez(path: str, arrays: dict):
+    """np.savez via tmp + rename so readers (retry-from-checkpoint)
+    never see a torn file."""
+    if not path.endswith(".npz"):
+        path = path + ".npz"
+    tmp = path + ".tmp.npz"
+    np.savez(tmp, **arrays)
+    os.replace(tmp, path)
+    return path
+
+
+def write_checkpoint(snap: dict, path_prefix: str):
+    """Materialize a :func:`snapshot_checkpoint` (device->host
+    transfers happen HERE — safe on a background thread) and write the
+    model/optim pair atomically."""
+    arrays = _module_arrays(snap["spec"], snap["p_leaves"],
+                            snap["s_leaves"])
+    _atomic_savez(path_prefix + ".model", arrays)
+    if snap["optim"] is not None:
+        opt_arrays = {k: np.asarray(v)
+                      for k, v in snap["optim"]["arrays"].items()}
+        meta = {
+            "class": snap["optim"]["class"],
+            "extra": snap["optim"]["extra"],
+        }
+        opt_arrays["__meta__"] = np.frombuffer(
+            json.dumps(meta).encode("utf-8"), dtype=np.uint8
+        )
+        _atomic_savez(path_prefix + ".optim", opt_arrays)
+    return path_prefix
+
+
 def save_checkpoint(path_prefix: str, model, optim_method=None, extra: dict = None):
     """Reference: Optimizer.setCheckpoint cadence saves model +
     OptimMethod (with its internal state table: epoch/neval counters) so
     resume continues Triggers correctly (SURVEY.md §5)."""
-    save_module(model, path_prefix + ".model")
-    if optim_method is not None:
-        arrays = optim_method.get_state_arrays()
-        meta = {
-            "class": type(optim_method).__name__,
-            "extra": extra or {},
-        }
-        arrays["__meta__"] = np.frombuffer(
-            json.dumps(meta).encode("utf-8"), dtype=np.uint8
-        )
-        np.savez(path_prefix + ".optim.npz", **arrays)
-    return path_prefix
+    return write_checkpoint(
+        snapshot_checkpoint(model, optim_method, extra), path_prefix)
 
 
 def load_checkpoint(path_prefix: str, model, optim_method=None) -> dict:

@@ -194,3 +194,41 @@ def test_pipelined_loss_trajectory_matches_sync():
         tapes[mode] = tape.losses
     assert tapes["pipelined"] == tapes["sync"], (
         tapes["pipelined"][:3], tapes["sync"][:3])
+
+
+def test_background_checkpoint_roundtrip(tmp_path):
+    """set_checkpoint(background=True): writes happen off-thread but the
+    files are complete, loadable, and resume-equivalent by the time
+    optimize() returns."""
+    from bigdl_tpu.optim import Trigger
+    from bigdl_tpu.utils.serializer import load_latest_checkpoint
+
+    x, y = _toy_classification()
+    model = Sequential().add(Linear(8, 3)).add(LogSoftMax())
+    opt = LocalOptimizer(model, (x, y), ClassNLLCriterion(), batch_size=64)
+    opt.set_optim_method(SGD(learningrate=0.5, momentum=0.9))
+    opt.set_end_when(Trigger.max_epoch(4))
+    opt.set_checkpoint(str(tmp_path), Trigger.every_epoch(),
+                       background=True)
+    opt.optimize()
+
+    # every epoch's checkpoint pair landed, atomically (no .tmp files)
+    import os
+
+    names = sorted(os.listdir(tmp_path))
+    assert not any(".tmp" in n for n in names), names
+    models = [n for n in names if n.endswith(".model.npz")]
+    optims = [n for n in names if n.endswith(".optim.npz")]
+    assert len(models) == 4 and len(optims) == 4, names
+
+    # the newest checkpoint restores model + optimizer state
+    m2 = Sequential().add(Linear(8, 3)).add(LogSoftMax())
+    method2 = SGD(learningrate=0.5, momentum=0.9)
+    extra = load_latest_checkpoint(str(tmp_path), m2, method2)
+    # epoch-end checkpoints record the NEXT epoch to run (resume target)
+    assert extra["epoch"] == 5
+    for a, b in zip(model.get_weights(), m2.get_weights()):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    np.testing.assert_allclose(
+        np.asarray(method2.state["velocity"]["0"]["weight"]),
+        np.asarray(opt.optim_method.state["velocity"]["0"]["weight"]))
