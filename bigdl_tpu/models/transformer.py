@@ -62,15 +62,22 @@ class TransformerLM(_Composite):
 
     def __init__(self, vocab_size: int, dim: int = 256, n_head: int = 4,
                  n_layer: int = 4, max_len: int = 1024, mlp_ratio: int = 4,
-                 dropout: float = 0.0, attn_impl: str = "auto"):
+                 dropout: float = 0.0, attn_impl: str = "auto",
+                 remat: bool = False):
         super().__init__()
         self._config = dict(vocab_size=vocab_size, dim=dim, n_head=n_head,
                             n_layer=n_layer, max_len=max_len,
                             mlp_ratio=mlp_ratio, dropout=dropout,
-                            attn_impl=attn_impl)
+                            attn_impl=attn_impl, remat=remat)
         self.vocab_size = vocab_size
         self.dim = dim
         self.n_layer = n_layer
+        # remat=True: per-block gradient checkpointing — backward
+        # recomputes each block's forward instead of storing its
+        # activations, cutting peak HBM from O(n_layer * seq * dim)
+        # activations to O(sqrt-ish) at ~1/3 extra FLOPs (the long-
+        # context training lever; pairs with ring/ulysses seq-parallel)
+        self.remat = remat
         self._add_child("wte", TokenEmbedding(vocab_size, dim))
         self._add_child("wpe", PositionalEmbedding(max_len, dim))
         for i in range(n_layer):
@@ -90,8 +97,15 @@ class TransformerLM(_Composite):
             key = None
             if rng is not None:
                 key = jax.random.fold_in(rng, i)
-            x, _ = c[f"h{i}"].apply(params[f"h{i}"], {}, x,
-                                    training=training, rng=key)
+            block = c[f"h{i}"]
+            if self.remat:
+                def blk(p, xx, _b=block, _k=key):
+                    out, _ = _b.apply(p, {}, xx, training=training, rng=_k)
+                    return out
+                x = jax.checkpoint(blk)(params[f"h{i}"], x)
+            else:
+                x, _ = block.apply(params[f"h{i}"], {}, x,
+                                   training=training, rng=key)
         x, _ = c["ln_f"].apply(params["ln_f"], {}, x)
         logits, _ = c["head"].apply(params["head"], {}, x)
         return logits, state

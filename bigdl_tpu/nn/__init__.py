@@ -11,6 +11,7 @@ from bigdl_tpu.nn.module import (
     AbstractModule,
     Container,
     Sequential,
+    Remat,
     Identity,
     Echo,
 )

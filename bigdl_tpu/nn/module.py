@@ -520,6 +520,62 @@ class Container(AbstractModule):
         return None
 
 
+class Remat(Container):
+    """Gradient checkpointing (rematerialisation): the wrapped module's
+    forward activations are NOT stored for backward — they are
+    recomputed from the wrapper's input during the VJP, trading FLOPs
+    for HBM (the standard long-context/deep-model memory lever on TPU;
+    no reference analogue — the reference's hand-written backwards
+    always stored activations).
+
+    ``policy`` optionally names a ``jax.checkpoint_policies`` entry
+    (e.g. ``"dots_with_no_batch_dims_saveable"``) so matmul outputs can
+    be kept while elementwise intermediates are recomputed.
+    """
+
+    def __init__(self, module: AbstractModule = None, policy: str = None):
+        super().__init__()
+        if policy:
+            import jax
+
+            if not hasattr(jax.checkpoint_policies, policy):
+                raise ValueError(
+                    f"unknown jax.checkpoint_policies entry {policy!r}")
+        self._config = dict(policy=policy)
+        self.policy = policy
+        if module is not None:
+            self.add(module)
+
+    def add(self, module: AbstractModule):
+        if self.modules:
+            raise ValueError(
+                "Remat wraps exactly one module; wrap a Sequential for "
+                "multi-layer spans")
+        return super().add(module)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        import jax
+
+        if not self.modules:
+            raise ValueError("Remat has no wrapped module; add() one")
+        child = self.modules[0]
+
+        def fwd(p, s, x):
+            return child.apply(p, s, x, training=training, rng=rng)
+
+        if self.policy:
+            fwd = jax.checkpoint(
+                fwd, policy=getattr(jax.checkpoint_policies, self.policy))
+        else:
+            fwd = jax.checkpoint(fwd)
+        out, new_child_state = fwd(params["0"], state["0"], input)
+        return out, {"0": new_child_state}
+
+    def __repr__(self):
+        inner = self.modules[0] if self.modules else "?"
+        return f"Remat({inner!r})"
+
+
 class Sequential(Container):
     """Feed-forward chain (reference: «bigdl»/nn/Sequential.scala;
     forward loops ``output = module.forward(prevOutput)`` — SURVEY.md

@@ -153,6 +153,7 @@ def _layer_cases():
         (L.GELU(), v), (L.SELU(), v), (L.Abs(), v), (L.Square(), pos),
         (L.Sqrt(), pos),
         (N.Maxout(6, 4, 3), v), (N.SReLU((6,)), v), (N.Highway(6), v),
+        (N.Remat(N.Linear(6, 4)), v),
         (L.Power(2.0, 1.5, 0.1), pos), (L.Log(), pos), (L.Exp(), v),
         (L.Negative(), v), (L.AddConstant(1.5), v), (L.MulConstant(2.0), v),
         (L.Floor(), v), (L.Ceil(), v), (L.Round(), v), (L.Sign(), v),
