@@ -86,3 +86,13 @@ def test_tensorflow_pipeline_example():
 
     acc = main(["-e", "8"])
     assert acc > 0.9, f"tf pipeline fine-tune accuracy {acc}"
+
+
+@pytest.mark.slow
+def test_longcontext_example():
+    from examples.longcontext.train_long_lm import main
+
+    final = main(["--seq", "128", "--steps", "12", "--layers", "2"])
+    # uniform-random start is ln(512) ~ 6.24: require REAL learning,
+    # not an epsilon drop
+    assert final < 6.0, final
