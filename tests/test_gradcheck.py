@@ -126,3 +126,66 @@ def test_standalone_update_grad_input_vs_acc_grad():
     # acc accumulates
     m.acc_grad_parameters(x, jnp.ones((4, 2)))
     np.testing.assert_allclose(np.asarray(m._grad_params["weight"]), 8.0)
+
+
+def test_highway_gradients_match_numeric():
+    from bigdl_tpu.nn import Highway, ReLU as _ReLU
+
+    m = Highway(4, activation=_ReLU())
+    x = np.random.RandomState(1).randn(3, 4).astype(np.float32)
+
+    def scalar_out(xv):
+        out = m.apply(m.params(), m.state(), jnp.asarray(xv, jnp.float32),
+                      training=False)[0]
+        return float(jnp.sum(out * out))
+
+    g_num = numeric_grad(scalar_out, x)
+
+    def f(xv):
+        out = m.apply(m.params(), m.state(), xv, training=False)[0]
+        return jnp.sum(out * out)
+
+    g_ana = np.asarray(jax.grad(f)(jnp.asarray(x)))
+    np.testing.assert_allclose(g_ana, g_num, rtol=2e-2, atol=2e-3)
+
+
+def test_resize_bilinear_gradients_match_numeric():
+    from bigdl_tpu.nn import ResizeBilinear
+
+    m = ResizeBilinear(5, 7)
+    x = np.random.RandomState(2).randn(1, 2, 3, 4).astype(np.float32)
+
+    def scalar_out(xv):
+        out = m.apply(m.params(), m.state(), jnp.asarray(xv, jnp.float32),
+                      training=False)[0]
+        return float(jnp.sum(out * out))
+
+    g_num = numeric_grad(scalar_out, x)
+
+    def f(xv):
+        out = m.apply(m.params(), m.state(), xv, training=False)[0]
+        return jnp.sum(out * out)
+
+    g_ana = np.asarray(jax.grad(f)(jnp.asarray(x)))
+    np.testing.assert_allclose(g_ana, g_num, rtol=2e-2, atol=2e-3)
+
+
+def test_remat_gradients_match_numeric():
+    from bigdl_tpu.nn import Remat
+
+    m = Remat(Sequential().add(Linear(4, 6)).add(Tanh()).add(Linear(6, 2)))
+    x = np.random.RandomState(3).randn(2, 4).astype(np.float32)
+
+    def scalar_out(xv):
+        out = m.apply(m.params(), m.state(), jnp.asarray(xv, jnp.float32),
+                      training=False)[0]
+        return float(jnp.sum(out * out))
+
+    g_num = numeric_grad(scalar_out, x)
+
+    def f(xv):
+        out = m.apply(m.params(), m.state(), xv, training=False)[0]
+        return jnp.sum(out * out)
+
+    g_ana = np.asarray(jax.grad(f)(jnp.asarray(x)))
+    np.testing.assert_allclose(g_ana, g_num, rtol=2e-2, atol=2e-3)
