@@ -110,6 +110,98 @@ class TransformerLM(_Composite):
         logits, _ = c["head"].apply(params["head"], {}, x)
         return logits, state
 
+    def generate(self, params, prompt, max_new_tokens: int, *,
+                 temperature: float = 0.0, rng=None):
+        """Autoregressive decoding with a static-shape KV cache.
+
+        TPU-idiomatic two-phase decode: the prompt is prefetched in ONE
+        batched forward (``TransformerBlock.prefill`` — the identical
+        attention path training uses — also yields each layer's K/V),
+        then a single compiled ``lax.scan`` step generates tokens, with
+        per-layer (B, H, T_total, Dh) cache buffers updated in place by
+        ``dynamic_update_slice`` (``TransformerBlock.decode_step``).
+        All shapes static — no per-token retrace or dispatch.
+
+        ``temperature=0`` is greedy argmax; ``>0`` samples categorical
+        (requires ``rng``).  Returns (B, prompt_len + max_new_tokens)
+        int32 token ids.
+        """
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        prompt = jnp.asarray(prompt).astype(jnp.int32)
+        bsz, t0 = prompt.shape
+        total = t0 + max_new_tokens
+        max_len = self._config["max_len"]
+        if total > max_len:
+            raise ValueError(
+                f"prompt {t0} + {max_new_tokens} new tokens exceeds "
+                f"max_len {max_len}")
+        if temperature > 0.0 and rng is None:
+            raise ValueError("temperature sampling needs an rng key")
+        if max_new_tokens <= 0:
+            return prompt
+        n_head = self._config["n_head"]
+        head_dim = self.dim // n_head
+        c = self._children
+        key = rng if rng is not None else jax.random.key(0)
+
+        def sample(logits, key):
+            if temperature > 0.0:
+                key, sub = jax.random.split(key)
+                nxt = jax.random.categorical(
+                    sub, logits / temperature, axis=-1)
+            else:
+                nxt = jnp.argmax(logits, axis=-1)
+            return nxt.astype(jnp.int32), key
+
+        # ---- prefill: one batched forward over the whole prompt ----
+        x = jnp.take(params["wte"]["weight"], prompt, axis=0)
+        x = x + params["wpe"]["weight"][:t0][None]
+        caches = {}
+        for i in range(self.n_layer):
+            x, kh, vh = c[f"h{i}"].prefill(params[f"h{i}"], x)
+            ck = jnp.zeros((bsz, n_head, total, head_dim), jnp.float32)
+            cv = jnp.zeros((bsz, n_head, total, head_dim), jnp.float32)
+            caches[f"h{i}"] = (
+                lax.dynamic_update_slice(ck, kh, (0, 0, 0, 0)),
+                lax.dynamic_update_slice(cv, vh, (0, 0, 0, 0)),
+            )
+        h, _ = c["ln_f"].apply(params["ln_f"], {}, x[:, -1:, :])
+        logits, _ = c["head"].apply(params["head"], {}, h)
+        first, key = sample(logits[:, 0, :], key)
+
+        tokens = jnp.zeros((bsz, total), jnp.int32)
+        tokens = lax.dynamic_update_slice(tokens, prompt, (0, 0))
+        tokens = lax.dynamic_update_slice(tokens, first[:, None], (0, t0))
+
+        # ---- decode: scan over the remaining new tokens ------------
+        def step(carry, t):
+            tokens, caches, key = carry
+            cur = lax.dynamic_slice(tokens, (0, t), (bsz, 1))
+            x = jnp.take(params["wte"]["weight"], cur, axis=0)
+            x = x + lax.dynamic_slice(
+                params["wpe"]["weight"], (t, 0), (1, self.dim))[None]
+            new_caches = {}
+            for i in range(self.n_layer):
+                ck, cv = caches[f"h{i}"]
+                x, ck, cv = c[f"h{i}"].decode_step(
+                    params[f"h{i}"], x, ck, cv, t)
+                new_caches[f"h{i}"] = (ck, cv)
+            h, _ = c["ln_f"].apply(params["ln_f"], {}, x)
+            logits, _ = c["head"].apply(params["head"], {}, h)
+            nxt, key = sample(logits[:, 0, :], key)
+            tokens = lax.dynamic_update_slice(
+                tokens, nxt[:, None], (0, t + 1))
+            return (tokens, new_caches, key), None
+
+        if max_new_tokens > 1:
+            (tokens, _, _), _ = lax.scan(
+                step, (tokens, caches, key),
+                jnp.arange(t0, total - 1))
+        return tokens
+
     def __repr__(self):
         return (f"TransformerLM(vocab={self.vocab_size}, dim={self.dim}, "
                 f"layers={self.n_layer})")

@@ -228,17 +228,82 @@ class TransformerBlock(_Composite):
         self._add_child("fc2", Linear(mlp_ratio * dim, dim))
 
     def apply(self, params, state, input, *, training=False, rng=None):
-        import jax
-
         c = self._children
         h, _ = c["ln1"].apply(params["ln1"], {}, input)
         a, _ = c["attn"].apply(params["attn"], {}, h, training=training, rng=rng)
         x = input + a
+        return self._mlp(params, x), state
+
+    def _mlp(self, params, x):
+        """Shared pre-LN MLP half — used by apply, prefill and
+        decode_step so the three paths cannot drift apart."""
+        import jax
+
+        c = self._children
         h, _ = c["ln2"].apply(params["ln2"], {}, x)
         h, _ = c["fc1"].apply(params["fc1"], {}, h)
         h = jax.nn.gelu(h)
         h, _ = c["fc2"].apply(params["fc2"], {}, h)
-        return x + h, state
+        return x + h
+
+    def _project_qkv(self, pa, h):
+        jnp = _jnp()
+        q = jnp.matmul(h, pa["wq"].T)
+        k = jnp.matmul(h, pa["wk"].T)
+        v = jnp.matmul(h, pa["wv"].T)
+        if pa.get("bq") is not None:
+            q, k, v = q + pa["bq"], k + pa["bk"], v + pa["bv"]
+        return q, k, v
+
+    def _out_proj(self, pa, o):
+        jnp = _jnp()
+        y = jnp.matmul(o, pa["wo"].T)
+        if pa.get("bo") is not None:
+            y = y + pa["bo"]
+        return y
+
+    def prefill(self, params, x):
+        """Full-prefix block forward that ALSO returns the per-head
+        K/V (B, H, T, Dh) for a decode cache.  Attention math is the
+        identical projection + ``_inner_attention`` path apply() takes
+        (dropout off — decoding is inference)."""
+        attn = self._children["attn"]
+        h, _ = self._children["ln1"].apply(params["ln1"], {}, x)
+        q, k, v = self._project_qkv(params["attn"], h)
+        qh, kh, vh = attn._split(q), attn._split(k), attn._split(v)
+        o = attn._inner_attention(qh, kh, vh)
+        b, nh, t, hd = o.shape
+        o = o.transpose(0, 2, 1, 3).reshape(b, t, nh * hd)
+        x = x + self._out_proj(params["attn"], o)
+        return self._mlp(params, x), kh, vh
+
+    def decode_step(self, params, x, cache_k, cache_v, t):
+        """One-token decode: ``x`` is (B, 1, dim), caches are
+        (B, H, T_total, Dh) buffers updated in place at position ``t``
+        (static shapes; the single query attends over positions <= t).
+        Returns (out, cache_k, cache_v)."""
+        import jax
+        from jax import lax
+
+        jnp = _jnp()
+        attn = self._children["attn"]
+        h, _ = self._children["ln1"].apply(params["ln1"], {}, x)
+        q, k, v = self._project_qkv(params["attn"], h)
+        qh = attn._split(q)
+        cache_k = lax.dynamic_update_slice(
+            cache_k, attn._split(k), (0, 0, t, 0))
+        cache_v = lax.dynamic_update_slice(
+            cache_v, attn._split(v), (0, 0, t, 0))
+        scale = 1.0 / float(np.sqrt(attn.head_dim))
+        scores = jnp.einsum("bhqd,bhkd->bhqk", qh, cache_k) * scale
+        mask = (jnp.arange(cache_k.shape[2]) <= t)[None, None, None, :]
+        scores = jnp.where(mask, scores, -jnp.inf)
+        probs = jax.nn.softmax(scores, axis=-1)
+        o = jnp.einsum("bhqk,bhkd->bhqd", probs, cache_v)
+        b, nh, _, hd = o.shape
+        o = o.transpose(0, 2, 1, 3).reshape(b, 1, nh * hd)
+        x = x + self._out_proj(params["attn"], o)
+        return self._mlp(params, x), cache_k, cache_v
 
     def __repr__(self):
         return f"TransformerBlock(dim={self.dim})"

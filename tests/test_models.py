@@ -285,3 +285,71 @@ def test_transformer_remat_matches_plain():
         assert jax.tree_util.keystr(ka) == jax.tree_util.keystr(kb)
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-5, atol=1e-6)
+
+
+def test_transformer_generate_matches_incremental_forward():
+    """The KV-cache scan decode must produce exactly the tokens a naive
+    loop (full forward over the growing prefix, argmax of the last
+    logits) produces."""
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.common import RandomGenerator
+    from bigdl_tpu.models.transformer import build_transformer_lm
+
+    RandomGenerator.RNG.set_seed(13)
+    model = build_transformer_lm(48, dim=32, n_head=4, n_layer=2,
+                                 max_len=24, attn_impl="xla")
+    params = model.params()
+    rs = np.random.RandomState(0)
+    prompt = rs.randint(0, 48, (2, 5))
+
+    got = np.asarray(model.generate(params, prompt, 8))
+    assert got.shape == (2, 13)
+    np.testing.assert_array_equal(got[:, :5], prompt)
+
+    # prefill IS the training forward: block.prefill output must equal
+    # apply() on the prompt exactly (same projection + attention path)
+    x = jnp.take(params["wte"]["weight"],
+                 jnp.asarray(prompt, jnp.int32), axis=0)
+    x = x + params["wpe"]["weight"][:5][None]
+    xa = x
+    for i in range(model.n_layer):
+        blk = model._children[f"h{i}"]
+        x, _, _ = blk.prefill(params[f"h{i}"], x)
+        xa, _ = blk.apply(params[f"h{i}"], {}, xa)
+    np.testing.assert_allclose(np.asarray(x), np.asarray(xa),
+                               rtol=1e-6, atol=1e-6)
+
+    # naive reference: grow the sequence one full forward at a time
+    seq = prompt.copy()
+    for _ in range(8):
+        logits, _ = model.apply(
+            params, model.state(), jnp.asarray(seq.astype(np.float32)))
+        nxt = np.asarray(jnp.argmax(logits[:, -1, :], axis=-1))
+        seq = np.concatenate([seq, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(got, seq)
+
+
+def test_transformer_generate_sampling_reproducible():
+    import jax
+
+    from bigdl_tpu.common import RandomGenerator
+    from bigdl_tpu.models.transformer import build_transformer_lm
+
+    RandomGenerator.RNG.set_seed(13)
+    model = build_transformer_lm(32, dim=16, n_head=2, n_layer=1,
+                                 max_len=16)
+    params = model.params()
+    prompt = np.random.RandomState(1).randint(0, 32, (1, 3))
+    a = np.asarray(model.generate(params, prompt, 6, temperature=0.8,
+                                  rng=jax.random.key(5)))
+    b = np.asarray(model.generate(params, prompt, 6, temperature=0.8,
+                                  rng=jax.random.key(5)))
+    np.testing.assert_array_equal(a, b)
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="rng"):
+        model.generate(params, prompt, 2, temperature=0.5)
+    with _pytest.raises(ValueError, match="max_len"):
+        model.generate(params, prompt, 100)
