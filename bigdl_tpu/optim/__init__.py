@@ -41,7 +41,12 @@ from bigdl_tpu.optim.validation import (
 from bigdl_tpu.optim.optimizer import Optimizer, LocalOptimizer
 from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
 from bigdl_tpu.optim.metrics import Metrics
-from bigdl_tpu.optim.evaluator import Evaluator, Predictor
+from bigdl_tpu.optim.evaluator import (
+    Evaluator,
+    LocalValidator,
+    Predictor,
+    Validator,
+)
 
 __all__ = [
     "OptimMethod", "SGD", "Adam", "Adagrad", "Adadelta", "Adamax", "RMSprop",
@@ -53,5 +58,5 @@ __all__ = [
     "ValidationMethod", "ValidationResult", "Top1Accuracy", "Top5Accuracy",
     "Loss", "MAE", "TreeNNAccuracy", "HitRatio", "NDCG",
     "Optimizer", "LocalOptimizer", "DistriOptimizer", "Metrics",
-    "Evaluator", "Predictor",
+    "Evaluator", "Predictor", "Validator", "LocalValidator",
 ]

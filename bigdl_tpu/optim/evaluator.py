@@ -249,6 +249,40 @@ class Evaluator:
         )
 
 
+class Validator:
+    """Reference API parity: ``Validator(model, dataset).test(methods)``
+    (⟦«bigdl»/optim/Validator.scala⟧ — the classic validation entry,
+    with ``LocalValidator`` as the local-mode spelling)."""
+
+    def __init__(self, model, dataset=None, batch_size: int = 32):
+        from bigdl_tpu.dataset import to_dataset
+
+        self.model = model
+        self.dataset = (to_dataset(dataset, batch_size)
+                        if dataset is not None else None)
+
+    def test(self, methods: Sequence, dataset=None, batch_size=None,
+             mesh="auto"):
+        from bigdl_tpu.dataset import to_dataset
+
+        if dataset is not None:
+            ds = to_dataset(dataset, batch_size or 32)
+        else:
+            ds = self.dataset
+            if ds is not None and batch_size is not None:
+                # honor an explicit batch size even against the
+                # constructor-supplied dataset
+                ds = to_dataset((ds.features, ds.labels), batch_size) \
+                    if hasattr(ds, "features") else ds
+        if ds is None:
+            raise ValueError("Validator needs a dataset (constructor or "
+                             "test argument)")
+        return evaluate_dataset(self.model, ds, methods, mesh=mesh)
+
+
+LocalValidator = Validator
+
+
 class Predictor:
     """Reference API parity: ``Predictor(model).predict(features)``
     (⟦«bigdl»/optim/Predictor.scala⟧); ``predict_class`` returns 1-based

@@ -284,3 +284,41 @@ def test_optim_state_roundtrip_with_paramless_layers(tmp_path):
     # and a step over the restored state works
     g = jax.tree.map(jnp.ones_like, params)
     m2.step(g, params, m2.state)
+
+
+def test_validator_classic_spelling():
+    """Reference Validator(model, dataset).test(methods) /
+    LocalValidator parity."""
+    import numpy as np
+
+    from bigdl_tpu.nn import Linear, LogSoftMax, Sequential
+    from bigdl_tpu.optim import LocalValidator, Top1Accuracy, Validator
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(40, 6).astype(np.float32)
+    y = (rs.randint(0, 3, 40) + 1).astype(np.float32)
+    m = Sequential().add(Linear(6, 3)).add(LogSoftMax())
+    (acc,) = Validator(m, (x, y)).test([Top1Accuracy()])
+    value, count = acc.result()
+    assert count == 40
+    assert LocalValidator is Validator
+    (acc2,) = LocalValidator(m).test([Top1Accuracy()], dataset=(x, y))
+    assert acc2.result() == (value, count)
+    with pytest.raises(ValueError, match="dataset"):
+        Validator(m).test([Top1Accuracy()])
+
+
+def test_validator_test_batch_size_honored():
+    import numpy as np
+
+    from bigdl_tpu.nn import Linear, LogSoftMax, Sequential
+    from bigdl_tpu.optim import Top1Accuracy, Validator
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(40, 6).astype(np.float32)
+    y = (rs.randint(0, 3, 40) + 1).astype(np.float32)
+    m = Sequential().add(Linear(6, 3)).add(LogSoftMax())
+    v = Validator(m, (x, y))
+    (a32,) = v.test([Top1Accuracy()])
+    (a8,) = v.test([Top1Accuracy()], batch_size=8)
+    assert a32.result() == a8.result()  # same accuracy, either batching
