@@ -640,3 +640,61 @@ def test_wire_dtype_validation():
     with pytest.raises(ValueError, match="int8_block"):
         DistriOptimizer(_model(), (x, y), ClassNLLCriterion(),
                         batch_size=64, wire_dtype="int8", int8_block=0)
+
+
+def test_int8_wire_with_ragged_masked_batches(caplog):
+    """Combination seam: the quantized exchange under the MASKED final
+    -batch step (pad + masked-mean) — both features at once."""
+    import logging
+
+    x, y = _toy(n=166)  # ragged tail: 38 -> padded to 40
+    model = _model()
+    ds = _RaggedDataSet(x, y, 64)
+    opt = DistriOptimizer(model, ds, ClassNLLCriterion(), batch_size=64,
+                          wire_dtype="int8", int8_block=64)
+    opt.set_optim_method(SGD(learningrate=0.5))
+    opt.set_end_when(Trigger.max_epoch(6))
+    with caplog.at_level(logging.INFO, logger="bigdl_tpu.optim"):
+        trained = opt.optimize()
+    assert any("padding with" in r.message for r in caplog.records)
+    (acc,) = evaluate_dataset(trained, ArrayDataSet(x, y, 64),
+                              [Top1Accuracy()])
+    assert acc.result()[0] > 0.85, acc.result()
+
+
+def test_background_checkpoint_with_distri_retry(tmp_path):
+    """Combination seam: background checkpoint writes + the
+    retry-from-checkpoint path — the retry must see complete files."""
+    x, y = _toy(256)
+    model = _model()
+    opt = DistriOptimizer(model, (x, y), ClassNLLCriterion(),
+                          batch_size=64)
+    opt.set_optim_method(SGD(learningrate=0.5))
+    opt.set_end_when(Trigger.max_epoch(4))
+    opt.set_checkpoint(str(tmp_path), Trigger.every_epoch(),
+                       background=True)
+    opt.max_retry = 1
+
+    # inject one failure after epoch 2's checkpoint: monkeypatch the
+    # step dispatcher to throw once
+    orig_build = opt._build_train_step
+    calls = {"n": 0, "failed": False}
+
+    def flaky_build():
+        dispatch = orig_build()
+
+        def wrapper(*a, **k):
+            calls["n"] += 1
+            if calls["n"] == 10 and not calls["failed"]:
+                calls["failed"] = True
+                raise RuntimeError("injected executor loss")
+            return dispatch(*a, **k)
+
+        return wrapper
+
+    opt._build_train_step = flaky_build
+    trained = opt.optimize()  # retries from the background checkpoint
+    assert calls["failed"]
+    (acc,) = evaluate_dataset(trained, ArrayDataSet(x, y, 64),
+                              [Top1Accuracy()])
+    assert acc.result()[0] > 0.9, acc.result()
