@@ -1,0 +1,135 @@
+"""A/B the framework ResNet-50 train step's BatchNormalization on chip.
+
+Round-5 regression hunt: the unchanged-since-r03b framework step dropped
+from 1867 img/s (b32) to 355 under the relay's new AOT compile path,
+while bench.py's raw-JAX baseline (naive two-pass BN) kept its speed.
+This script measures the framework step with the BN training-mode
+formulation swapped, one subprocess per variant so a hung remote compile
+costs only that variant:
+
+  cur     — shipping code (single-pass shifted stats + lax.cond rescue)
+  nocond  — single-pass shifted stats, rescue branch removed
+  twopass — naive two-pass f32 stats (the baseline's formulation)
+
+Usage: python scripts/bn_ab.py [batch] [iters] [variant...]
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BATCH = int(sys.argv[1]) if len(sys.argv) > 1 else 32
+ITERS = int(sys.argv[2]) if len(sys.argv) > 2 else 10
+VARIANTS = sys.argv[3:] or ["cur", "nocond", "twopass"]
+
+
+def _patch_bn(variant: str):
+    import jax.numpy as jnp
+    from jax import lax
+
+    from bigdl_tpu.nn.layers import BatchNormalization
+
+    if variant == "cur":
+        return
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        axes, bshape = self._axes_and_shape(input)
+        if not training:
+            rm = state["running_mean"]
+            scale, offset = self._fold(params, rm, state["running_var"], rm)
+            dt = input.dtype
+            y = (input - rm.astype(dt).reshape(bshape)) \
+                * scale.astype(dt).reshape(bshape) \
+                + offset.astype(dt).reshape(bshape)
+            return y, state
+
+        xf = input.astype(jnp.float32)
+        if variant == "nocond":
+            shift = state["running_mean"].reshape(bshape)
+            xc = xf - shift
+            d = jnp.mean(xc, axis=axes)
+            m2 = jnp.mean(lax.square(xc), axis=axes)
+            mean = state["running_mean"] + d
+            var = jnp.maximum(m2 - lax.square(d), 0.0)
+            scale, offset = self._fold(params, mean, var,
+                                       state["running_mean"])
+            dt = input.dtype
+            y = (input - state["running_mean"].astype(dt).reshape(bshape)) \
+                * scale.astype(dt).reshape(bshape) \
+                + offset.astype(dt).reshape(bshape)
+        elif variant == "twopass":
+            mean = jnp.mean(xf, axis=axes)
+            var = jnp.mean(
+                lax.square(xf - mean.reshape(bshape)), axis=axes)
+            scale, offset = self._fold(params, mean, var, mean)
+            dt = input.dtype
+            y = (input - mean.astype(dt).reshape(bshape)) \
+                * scale.astype(dt).reshape(bshape) \
+                + offset.astype(dt).reshape(bshape)
+        else:
+            raise SystemExit(f"unknown variant {variant}")
+        n = 1
+        for a in axes:
+            n *= input.shape[a]
+        unbiased = var * (n / max(1, n - 1))
+        new_state = {
+            "running_mean": (1 - self.momentum) * state["running_mean"]
+            + self.momentum * mean,
+            "running_var": (1 - self.momentum) * state["running_var"]
+            + self.momentum * unbiased,
+        }
+        return y, new_state
+
+    BatchNormalization.apply = apply
+
+
+def _run_one(variant: str):
+    import numpy as np
+
+    import jax
+
+    jax.config.update("jax_platforms", "axon")
+    _patch_bn(variant)
+    import bench as B
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(BATCH, 3, 224, 224).astype(np.float32)
+    y = (rs.randint(0, 1000, BATCH) + 1).astype(np.float32)
+    t0 = time.time()
+    ips, step_s = B._bench_framework(x, y, BATCH, ITERS,
+                                     compute_dtype="bfloat16")
+    print(json.dumps({
+        "variant": variant, "batch": BATCH,
+        "images_per_sec": round(ips, 1),
+        "step_ms": round(step_s * 1e3, 2),
+        "wall_s": round(time.time() - t0, 1),
+    }), flush=True)
+
+
+def main():
+    if os.environ.get("BN_AB_CHILD"):
+        _run_one(os.environ["BN_AB_CHILD"])
+        return
+    for v in VARIANTS:
+        t0 = time.time()
+        env = dict(os.environ, BN_AB_CHILD=v)
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 str(BATCH), str(ITERS)],
+                capture_output=True, text=True, timeout=420, env=env,
+            )
+            out = (proc.stdout or "").strip().splitlines()
+            line = out[-1] if out else (proc.stderr or "")[-240:]
+        except subprocess.TimeoutExpired:
+            line = f'{{"variant": "{v}", "error": "TIMEOUT 420s"}}'
+        print(f"{line}   [{time.time()-t0:.0f}s]", flush=True)
+
+
+if __name__ == "__main__":
+    main()
