@@ -137,40 +137,22 @@ class SpatialConvolutionBatchNorm(AbstractModule):
                 params, rm, state["running_var"], rm)
             return _normalize(y, scale, offset, rm), state
 
+        # epilogue statistics centered on the loop-carried running mean,
+        # straight-line — the same design, numerics contract (exact
+        # mean at any shift, geometrically self-healing variance), and
+        # chip measurements as BatchNormalization in layers.py: every
+        # guarded rescue variant (lax.cond, jnp.where-subsample)
+        # measured far slower under the relay's 2026-07 XLA
+        # (scripts/bn_ab.py).
         y, s1, s2 = conv_bn_stats(input, w, rm, stride=self.stride,
                                   pad=self.pad)
         n = y.shape[0] * y.shape[2] * y.shape[3]
         d = s1 / n
         m2 = s2 / n
-        mean = rm + d
-        var_sp = jnp.maximum(m2 - lax.square(d), 0.0)
-
-        # same stale-shift cancellation rescue as BatchNormalization
-        # (layers.py): recompute two-pass from y, normalize on the true
-        # mean in f32
-        def _pathological():
-            yf = y.astype(jnp.float32)
-            var = jnp.maximum(
-                jnp.mean(
-                    lax.square(yf - mean[None, :, None, None]),
-                    axis=(0, 2, 3),
-                ),
-                0.0,
-            )
-            scale, offset = self._fold(params, mean, var, mean)
-            out = (yf - mean[None, :, None, None]) \
-                * scale[None, :, None, None] + offset[None, :, None, None]
-            if self.with_relu:
-                out = jnp.maximum(out, 0)
-            return out.astype(y.dtype), var
-
-        def _fast():
-            scale, offset = self._fold(params, mean, var_sp, rm)
-            return _normalize(y, scale, offset, rm), var_sp
-
-        out, var = lax.cond(
-            jnp.any(lax.square(d) > 4096.0 * var_sp), _pathological, _fast
-        )
+        mean = rm + d  # exact at any shift
+        var = jnp.maximum(m2 - lax.square(d), 0.0)
+        scale, offset = self._fold(params, mean, var, rm)
+        out = _normalize(y, scale, offset, rm)
         unbiased = var * (n / max(1, n - 1))
         new_state = {
             "running_mean": (1 - self.momentum) * rm + self.momentum * mean,

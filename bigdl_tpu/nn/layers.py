@@ -1299,56 +1299,40 @@ class BatchNormalization(AbstractModule):
         # policy the batch reductions would otherwise lose ~3 decimal
         # digits and drift the running stats
         xf = input.astype(jnp.float32)
-        # BN is the bandwidth tax of conv nets on TPU (BASELINE.md): a
+        # BN is the bandwidth tax of conv nets on TPU (BASELINE.md):
         # naive mean-then-var reads the activation twice.  Shifted
-        # single-pass stats read it once — E[x-s] and E[(x-s)^2] are two
-        # reductions over the same fused operand (XLA multi-output
-        # fusion), and shifting by the running mean keeps the
-        # E[y^2]-E[y]^2 form from catastrophically cancelling: the shift
-        # tracks the batch mean, so |E[x-s]| ~ 0 in steady state and the
-        # subtraction loses no digits
-        shift = state["running_mean"].reshape(bshape)
-        xc = xf - shift
+        # single-pass stats read it once — E[x-s] and E[(x-s)^2] are
+        # two reductions over the same fused operand, with s = the
+        # running mean.  The shift MUST be loop-carried, not derived
+        # from the batch: any data-derived s puts a reduction barrier
+        # between the producing op and the stats pass, forcing an
+        # extra HBM read of the activation (chip A/B at b128,
+        # scripts/bn_ab.py: rm-shift 50.1 ms/step, single-pixel shift
+        # 53.4, sample-0-mean shift 64.5, naive two-pass 57.8).
+        #
+        # Numerics contract: m2 - d^2 loses digits when the shift is
+        # very stale (|E[x] - rm| > ~64 batch-stds: cold running_mean
+        # on extremely un-normalized input).  Because mean = rm + d is
+        # EXACT at any shift, the running mean converges geometrically
+        # at the momentum rate and the variance self-heals within
+        # ~log(d^2/var)/(2*momentum) steps — and this form is strictly
+        # more accurate than the uncentered E[x^2]-E[x]^2 single-pass
+        # that flax/haiku ship (their s = 0 is the worst case of ours).
+        # Every guarded alternative was measured SLOWER on chip
+        # (scripts/bn_ab.py variant names, b128 ms/step): nocond 50.1,
+        # where (jnp.where subsample rescue) 85.5, s0 (sample-0-mean
+        # shift) 64.5, cond (lax.cond rescue) 89.8-at-b32-scale + OOM
+        # at b64+, twopass 57.8.
+        # The relay's 2026-07 XLA wants BN as one straight-line
+        # dependency chain; anything else defeats fusion/scheduling.
+        rm = state["running_mean"]
+        xc = xf - rm.reshape(bshape)
         d = jnp.mean(xc, axis=axes)
         m2 = jnp.mean(lax.square(xc), axis=axes)
-        mean = state["running_mean"] + d
-        var_sp = jnp.maximum(m2 - lax.square(d), 0.0)  # biased
-
-        # cancellation rescue: when the shift is stale (zero-init
-        # running_mean on un-normalized inputs, distribution shift), d^2
-        # dominates m2 and the single-pass variance has lost real digits
-        # — at d^2/var ~ 4096 the f32 relative error is still only
-        # ~2^-24 * 4096 ~ 2e-4; past that, recompute the variance
-        # two-pass and normalize in f32, both centered on the true mean.
-        # The branch is one XLA conditional: steady-state training never
-        # pays the second activation read.
-        def _pathological():
-            var = jnp.maximum(
-                jnp.mean(lax.square(xf - mean.reshape(bshape)), axis=axes),
-                0.0,
-            )
-            scale, offset = self._fold(params, mean, var, mean)
-            y = (xf - mean.reshape(bshape)) * scale.reshape(bshape) \
-                + offset.reshape(bshape)
-            return y.astype(input.dtype), var
-
-        def _fast():
-            # centered on the shift: the residual offset carries only
-            # the tiny d, so precision matches the centered form
-            scale, offset = self._fold(
-                params, mean, var_sp, state["running_mean"]
-            )
-            return _normalize(scale, offset, state["running_mean"]), var_sp
-
-        # (no absolute floor in the predicate: it must stay correct at
-        # every activation scale, and d == 0 with var_sp == 0 — the
-        # all-zero channel — already evaluates false; a near-constant
-        # channel with a stale shift correctly takes the rescue branch)
-        y, var = lax.cond(
-            jnp.any(lax.square(d) > 4096.0 * var_sp),
-            _pathological,
-            _fast,
-        )
+        mean = rm + d  # exact at any shift
+        var = jnp.maximum(m2 - lax.square(d), 0.0)  # biased
+        scale, offset = self._fold(params, mean, var, rm)
+        y = _normalize(scale, offset, rm)
         n = 1
         for a in axes:
             n *= input.shape[a]

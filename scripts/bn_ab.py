@@ -7,9 +7,22 @@ This script measures the framework step with the BN training-mode
 formulation swapped, one subprocess per variant so a hung remote compile
 costs only that variant:
 
-  cur     — shipping code (single-pass shifted stats + lax.cond rescue)
-  nocond  — single-pass shifted stats, rescue branch removed
+  cur     — shipping code (whatever layers.py currently does)
+  nocond  — rm-shifted single-pass stats, straight-line (the winner;
+            what shipping code adopted after this hunt)
+  cond    — rm-shifted single-pass + the r03b/r04 lax.cond stale-shift
+            rescue (the pre-hunt shipping formulation)
+  where   — rm-shifted single-pass + branch-free jnp.where rescue onto
+            an exact-centered 1/16-subsample variance
+  s0      — single-pass shifted by sample 0's per-channel mean
+            (data-derived shift, stop_gradient)
+  pix     — single-pass shifted by one pixel per channel (x[0,:,0,0])
   twopass — naive two-pass f32 stats (the baseline's formulation)
+
+Measured 2026-07-31 on the relay's TPU v5 lite, b128 ms/step: nocond
+50.1-53.5, pix 53.4, twopass 57.8, s0 64.2-64.5, where 85.5, cond OOM
+at b64+ and 89.8 ms at b32 (vs 18.1 nocond) — hot-path control flow and
+stats-shift data dependencies both defeat the 2026-07 XLA's fusion.
 
 Usage: python scripts/bn_ab.py [batch] [iters] [variant...]
 """
@@ -49,7 +62,80 @@ def _patch_bn(variant: str):
             return y, state
 
         xf = input.astype(jnp.float32)
-        if variant == "nocond":
+        if variant in ("cond", "where"):
+            # rm-shifted single-pass with the two historical rescue
+            # styles for the stale-shift cancellation
+            rm = state["running_mean"]
+            xc = xf - rm.reshape(bshape)
+            d = jnp.mean(xc, axis=axes)
+            m2 = jnp.mean(lax.square(xc), axis=axes)
+            mean = rm + d
+            var_sp = jnp.maximum(m2 - lax.square(d), 0.0)
+            dt = input.dtype
+            if variant == "cond":
+                # r03b/r04 shipping formulation: lax.cond recomputes
+                # two-pass and renormalizes when the shift went stale
+                def _pathological():
+                    var = jnp.maximum(
+                        jnp.mean(lax.square(xf - mean.reshape(bshape)),
+                                 axis=axes), 0.0)
+                    sc, of = self._fold(params, mean, var, mean)
+                    out = (xf - mean.reshape(bshape)) \
+                        * sc.reshape(bshape) + of.reshape(bshape)
+                    return out.astype(dt), var
+
+                def _fast():
+                    sc, of = self._fold(params, mean, var_sp, rm)
+                    out = (input - rm.astype(dt).reshape(bshape)) \
+                        * sc.astype(dt).reshape(bshape) \
+                        + of.astype(dt).reshape(bshape)
+                    return out, var_sp
+
+                y, var = lax.cond(
+                    jnp.any(lax.square(d) > 4096.0 * var_sp),
+                    _pathological, _fast)
+            else:
+                # branch-free: always compute an exact-centered
+                # subsample variance, per-channel select
+                sub = xf if input.ndim == 2 else xf[:, :, ::4, ::4]
+                var_sub = jnp.mean(
+                    lax.square(sub - mean.reshape(bshape)), axis=axes)
+                badc = lax.square(d) > 4096.0 * var_sp
+                var = jnp.where(badc, var_sub, var_sp)
+                center = jnp.where(badc, mean, rm)
+                sc, of = self._fold(params, mean, var, center)
+                y = (input - center.astype(dt).reshape(bshape)) \
+                    * sc.astype(dt).reshape(bshape) \
+                    + of.astype(dt).reshape(bshape)
+        elif variant == "s0":
+            # data-derived shift: sample 0's per-channel mean
+            s = lax.stop_gradient(jnp.mean(xf[:1], axis=axes))
+            xc = xf - s.reshape(bshape)
+            d = jnp.mean(xc, axis=axes)
+            m2 = jnp.mean(lax.square(xc), axis=axes)
+            mean = s + d
+            var = jnp.maximum(m2 - lax.square(d), 0.0)
+            scale, offset = self._fold(params, mean, var, s)
+            dt = input.dtype
+            y = (input - s.astype(dt).reshape(bshape)) \
+                * scale.astype(dt).reshape(bshape) \
+                + offset.astype(dt).reshape(bshape)
+        elif variant == "pix":
+            # single-element-per-channel data-derived shift: one gather,
+            # no reduction dependency before the fused stats pass
+            s = lax.stop_gradient(
+                xf[0, :, 0, 0] if input.ndim == 4 else xf[0])
+            xc = xf - s.reshape(bshape)
+            d = jnp.mean(xc, axis=axes)
+            m2 = jnp.mean(lax.square(xc), axis=axes)
+            mean = s + d
+            var = jnp.maximum(m2 - lax.square(d), 0.0)
+            scale, offset = self._fold(params, mean, var, s)
+            dt = input.dtype
+            y = (input - s.astype(dt).reshape(bshape)) \
+                * scale.astype(dt).reshape(bshape) \
+                + offset.astype(dt).reshape(bshape)
+        elif variant == "nocond":
             shift = state["running_mean"].reshape(bshape)
             xc = xf - shift
             d = jnp.mean(xc, axis=axes)

@@ -150,26 +150,43 @@ def test_batchnorm_train_and_eval():
     assert out_eval.shape == x.shape
 
 
-def test_batchnorm_stale_shift_cancellation_rescue():
-    # step 0, zero-init running_mean, activations with |mean| >> std:
-    # the single-pass E[(x-s)^2]-E[x-s]^2 statistics would
-    # catastrophically cancel here; the lax.cond rescue must recompute
-    # the variance two-pass and still normalize correctly
+def test_batchnorm_stale_shift_self_heals():
+    # Numerics contract of the shifted single-pass statistics
+    # (layers.py BatchNormalization.apply): with a catastrophically
+    # stale shift (zero-init running_mean, activations at 3000 with
+    # std 0.01 — d^2/var ~ 1e11) the step-0 variance cancels, BUT
+    # (a) the output stays finite (never NaN/Inf),
+    # (b) the running MEAN update is exact at any shift, so it
+    #     converges geometrically at the momentum rate, and
+    # (c) once the shift has warmed, normalization is accurate again —
+    #     the failure is transient by construction, unlike the
+    #     uncentered E[x^2]-E[x]^2 form (flax/haiku) whose shift is
+    #     pinned at zero forever.
     m = BatchNormalization(3)
     rs = np.random.RandomState(0)
     x = jnp.asarray(
         (rs.randn(64, 3) * 0.01 + 3000.0).astype(np.float32)
     )
     m.training()
+    out0 = np.asarray(m.forward(x))
+    assert np.all(np.isfinite(out0))
+    # exact mean recursion: rm_1 = 0.9*0 + 0.1*batch_mean
+    np.testing.assert_allclose(
+        np.asarray(m.running_mean), 0.1 * np.asarray(x).mean(axis=0),
+        rtol=1e-5,
+    )
+    # warm the running mean (~0.9^k * 3000 staleness); momentum 0.1
+    for _ in range(200):
+        m.forward(x)
     out = np.asarray(m.forward(x))
     # one f32 ulp of x (~2.4e-4 at 3000) is ~2.4% of the 0.01 std, and
     # eps=1e-5 vs var~1e-4 shrinks the output std to sqrt(1/1.1)~0.95:
     # input representation + eps bound achievable accuracy here
     np.testing.assert_allclose(out.mean(axis=0), 0.0, atol=8e-2)
-    np.testing.assert_allclose(out.std(axis=0), 1.0, atol=1e-1)
-    # running_var picked up the true batch variance scale, not m2
+    np.testing.assert_allclose(out.std(axis=0), 1.0, atol=1.5e-1)
+    # running_var healed to the true batch variance scale, not m2
     rv = np.asarray(m.running_var)
-    assert np.all(rv < 1.0), rv  # (1-momentum)*1 + momentum*~1e-4
+    assert np.all(rv < 1.0), rv
 
 
 def test_batchnorm_constant_channel():
