@@ -7,7 +7,7 @@ convolution with the BN statistics accumulated in the conv epilogue
 (ops/conv_bn.py Pallas kernels), so training-mode BN never re-reads
 the activation.  Semantics match ``SpatialConvolution(with_bias=False)
 -> SpatialBatchNormalization (-> ReLU)`` exactly: same shifted
-single-pass statistics, same cancellation rescue, same running-stat
+single-pass statistics and numerics contract, same running-stat
 EMA conventions (layers.py BatchNormalization).
 
 ``fuse_conv_bn(model)`` rewrites those triples inside ``Sequential``
@@ -168,7 +168,7 @@ class SpatialConvolutionBatchNorm(AbstractModule):
                 f"/{self.stride}{tail})")
 
 
-def _is_fusable_conv(m):
+def _is_fusable_conv(m, kernels=(1, 3)):
     # 1x1 and 3x3 torch-padded convs have Pallas epilogue-stats kernels
     # (ops/conv_bn.py); the 7x7 stem stays on XLA's native conv — its
     # C=3 tap dots would starve the MXU
@@ -176,7 +176,7 @@ def _is_fusable_conv(m):
         isinstance(m, SpatialConvolution)
         and type(m) is SpatialConvolution
         and m.kernel_w == m.kernel_h
-        and m.kernel_w in (1, 3)
+        and m.kernel_w in kernels
         and m.stride_w == m.stride_h
         and m.stride_w in (1, 2)
         and m.pad_w == m.pad_h == (m.kernel_w - 1) // 2
@@ -184,13 +184,15 @@ def _is_fusable_conv(m):
     )
 
 
-def fuse_conv_bn(model):
+def fuse_conv_bn(model, kernels=(1, 3)):
     """Rewrite every ``[1x1/3x3 conv (no bias),
     SpatialBatchNormalization, (ReLU)]`` run inside ``Sequential``
     containers into one ``SpatialConvolutionBatchNorm``, recursively.
-    In-place; returns the model."""
+    In-place; returns the model.  ``kernels`` restricts which conv
+    sizes fuse — ``(1,)`` keeps 3x3s on XLA (useful when a toolchain
+    rejects the kxk Pallas kernel; see scripts/mosaic_probe.py)."""
     for child in getattr(model, "modules", []):
-        fuse_conv_bn(child)
+        fuse_conv_bn(child, kernels)
     if isinstance(model, Sequential):
         mods = model.modules
         out = []
@@ -199,7 +201,7 @@ def fuse_conv_bn(model):
             m = mods[i]
             nxt = mods[i + 1] if i + 1 < len(mods) else None
             if (
-                _is_fusable_conv(m)
+                _is_fusable_conv(m, kernels)
                 and isinstance(nxt, SpatialBatchNormalization)
                 and type(nxt) is SpatialBatchNormalization
                 and nxt.affine

@@ -1,0 +1,95 @@
+"""Which Pallas/Mosaic programs does the relay's remote-compile accept?
+
+Round-5 context: bench.py's fused segment died with MosaicError (HTTP
+500 from the relay's tpu_compile_helper) while the transformer secondary
+— whose attention layer auto-routes to the Pallas flash kernel on TPU —
+completed.  This probe runs each Mosaic kernel in its own subprocess
+with a hard timeout and prints one status line per rung, so one run says
+whether Mosaic is rejected wholesale or per-kernel.
+
+    python scripts/mosaic_probe.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+RUNGS = [
+    ("flash_attn", "flash attention fwd (2,4,256,64)"),
+    ("flash_attn_bwd", "flash attention + lax-recompute bwd"),
+    ("conv_bn_1x1", "fused 1x1 conv+BN stats (8,64,16,16)"),
+    ("conv_bn_3x3", "fused 3x3 conv+BN stats (8,64,16,16)"),
+]
+
+
+def _run_rung(name: str):
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    jax.config.update("jax_platforms", "axon")
+    dev = jax.devices()[0]
+    t0 = time.time()
+    rs = np.random.RandomState(0)
+
+    if name.startswith("flash_attn"):
+        from bigdl_tpu.ops.attention import flash_attention
+
+        q = jnp.asarray(rs.randn(2, 4, 256, 64).astype(np.float32))
+        if name == "flash_attn":
+            flash_attention(q, q, q, causal=True).block_until_ready()
+        else:
+            jax.grad(
+                lambda a: flash_attention(a, a, a, causal=True).sum()
+            )(q).block_until_ready()
+    else:
+        from bigdl_tpu.ops.conv_bn import conv_bn_stats
+
+        x = jnp.asarray(rs.randn(8, 64, 16, 16),
+                        dtype=jnp.bfloat16)
+        k = 1 if name.endswith("1x1") else 3
+        w = jnp.asarray(rs.randn(64, 64, k, k) * 0.05,
+                        dtype=jnp.bfloat16)
+        shift = jnp.zeros(64, jnp.float32)
+
+        @jax.jit
+        def f(x, w, shift):
+            y, s1, s2 = conv_bn_stats(x, w, shift,
+                                      pad=(k - 1) // 2)
+            return y.sum() + s1.sum() + s2.sum()
+
+        f(x, w, shift).block_until_ready()
+    print(json.dumps({"rung": name, "ok": True,
+                      "device": dev.device_kind,
+                      "seconds": round(time.time() - t0, 1)}))
+
+
+def main():
+    if os.environ.get("MOSAIC_PROBE_CHILD"):
+        _run_rung(os.environ["MOSAIC_PROBE_CHILD"])
+        return
+    for name, desc in RUNGS:
+        t0 = time.time()
+        env = dict(os.environ, MOSAIC_PROBE_CHILD=name)
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                capture_output=True, text=True, timeout=240, env=env,
+            )
+            ok = proc.returncode == 0
+            tail = (proc.stdout or proc.stderr or "").strip().splitlines()
+            detail = tail[-1][:200] if tail else ""
+        except subprocess.TimeoutExpired:
+            ok, detail = False, "TIMEOUT 240s"
+        print(f"{name:16s} {desc:42s} {'OK' if ok else 'FAIL'} "
+              f"{time.time()-t0:6.1f}s  {detail}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

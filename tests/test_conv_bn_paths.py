@@ -65,12 +65,23 @@ def test_all_resnet50_fused_sites_take_pallas(monkeypatch):
     assert all(c[1][-1] != 7 for c in calls), "stem unexpectedly fused"
 
     bad = []
+    stride2 = []
     for xs, ws, stride, pad, itemsize in calls:
         path = conv_bn.kernel_path(xs, ws, stride=stride, pad=pad,
                                    itemsize=itemsize)
+        if stride == 2 and len(ws) == 4 and ws[2] == 3:
+            # the 3 stage-transition 3x3s: the pure-2-D lane-shift
+            # kernel is stride-1 only (2026-07 Mosaic rejects the old
+            # reshape-parity trick), so these take XLA BY DESIGN — the
+            # assertion documents the known, bounded exception
+            stride2.append(path)
+            continue
         if not path.startswith("pallas"):
             bad.append((xs, ws, stride, pad, path))
     assert not bad, f"fused call sites silently on XLA: {bad}"
+    assert len(stride2) == 3
+    assert all(p == "xla:stride 2 != 1 (lane-shift kernel)"
+               for p in stride2), stride2
 
 
 def test_kernel_path_matches_runtime_dispatch():
@@ -96,7 +107,9 @@ def test_kernel_path_matches_runtime_dispatch():
 
 def test_kernel_path_rejects_unsupported_stride():
     assert conv_bn.kernel_path((2, 8, 16, 16), (8, 8, 3, 3), stride=3,
-                               pad=1) == "xla:stride 3 not in (1, 2)"
+                               pad=1) == "xla:stride 3 != 1 (lane-shift kernel)"
+    assert conv_bn.kernel_path((2, 8, 16, 16), (8, 8, 3, 3), stride=2,
+                               pad=1) == "xla:stride 2 != 1 (lane-shift kernel)"
 
 
 def test_feasible_shape_stays_pallas_and_logs_nothing():
