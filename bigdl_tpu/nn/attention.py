@@ -5,8 +5,9 @@ sequence handling is `Recurrent`'s per-timestep loop; long-context is
 explicitly absent).  These layers are the rebuild's new capability,
 designed TPU-first:
 
-* the hot op is ``bigdl_tpu.ops.dot_product_attention`` (Pallas flash
-  kernel on TPU, lax reference elsewhere);
+* the hot op is ``bigdl_tpu.ops.dot_product_attention`` (measured
+  ``auto`` policy: lax reference until the long-context regime, the
+  Pallas flash kernel at T >= 4096 on TPU — see ops/attention.py);
 * all shapes are static, heads are a batch dimension for the MXU;
 * the sequence axis is left shardable: ``MultiHeadAttention`` accepts an
   ``attn_impl`` override so ``parallel.ring_attention`` can slot in a
@@ -73,8 +74,9 @@ class MultiHeadAttention(AbstractModule):
 
     Input (batch, seq, dim) -> output (batch, seq, dim).  Projections are
     single fused matmuls (one MXU call each); head split/merge are free
-    reshapes.  ``attn_impl`` picks the inner kernel ("auto" routes to the
-    Pallas flash kernel on TPU).
+    reshapes.  ``attn_impl`` picks the inner kernel ("auto" is the
+    measured policy in ops/attention.py: lax below T=4096, Pallas
+    flash in the long-context regime on TPU).
     """
 
     param_names = ("wq", "wk", "wv", "wo", "bq", "bk", "bv", "bo")

@@ -16,11 +16,15 @@ Design for the MXU/VMEM (pallas_guide.md):
 * matmuls carry ``preferred_element_type=jnp.float32`` so bf16 inputs
   accumulate in fp32 on the MXU.
 
-``dot_product_attention`` is the public entry: it picks the Pallas
-kernel on TPU backends when shapes tile cleanly, else the lax reference
-(which XLA fuses well on CPU and still decently on TPU).  Both paths
-are differentiable — the Pallas path via ``jax.custom_vjp`` with a
-flash-style backward that recomputes scores blockwise.
+``dot_product_attention`` is the public entry.  ``impl="auto"`` is
+measurement-driven (see the dispatcher): the lax reference wins
+throughput on the 2026-07 toolchain at every length whose softmax
+residuals fit, so auto takes lax below T=4096 and the Pallas kernel in
+the long-context regime, where saving only (q, k, v) instead of
+per-layer (B, H, T, T) residuals is the difference between fitting and
+OOM.  Both paths are differentiable — the Pallas path via
+``jax.custom_vjp`` with a lax-reference recompute backward (transient
+per-layer T^2, not blockwise).
 """
 
 from __future__ import annotations
@@ -228,8 +232,10 @@ def dot_product_attention(q, k, v, *, causal: bool = False, mask=None,
 
     q, k, v: (batch, heads, seq, head_dim).
 
-    impl: "auto" (Pallas on TPU when shapes tile, else lax), "pallas",
-    "pallas_interpret" (testing), or "lax".
+    impl: "auto" (measured policy — lax below T=4096, the Pallas flash
+    kernel on TPU in the long-context regime where lax's per-layer
+    (B, H, T, T) residuals stop fitting), "pallas", "pallas_interpret"
+    (testing), or "lax".
     """
     import jax
 
@@ -243,7 +249,21 @@ def dot_product_attention(q, k, v, *, causal: bool = False, mask=None,
             and q.shape == k.shape == v.shape
             and t >= 128 and t % 128 == 0
         )
-        impl = "pallas" if (on_tpu and tiles) else "lax"
+        # Measured on the 2026-07 toolchain (TransformerLM train step,
+        # TPU v5 lite, ms/step): XLA's fused attention beats the Pallas
+        # flash forward at every length that fits its residuals —
+        # T=512: 59.3 lax vs 64.7 pallas; T=1024: 76.2 vs 80.2;
+        # T=2048: 114.1 vs 124.6.  What flash buys on TPU is MEMORY:
+        # under jax.grad the lax path saves (B, H, T, T) softmax
+        # residuals for EVERY layer simultaneously — the long-context
+        # cliff.  The flash path saves only (q, k, v): its backward
+        # recompute (see _flash_bwd_rule) still materializes O(T^2)
+        # scores, but transiently, one layer at a time — an
+        # n_layers-fold cut in live memory, not a blockwise-backward
+        # elimination of T^2 (that kernel does not exist here yet).
+        # So auto prefers lax until the quadratic-residual regime and
+        # flips to the kernel there (validated on chip at T=4096).
+        impl = "pallas" if (on_tpu and tiles and t >= 4096) else "lax"
     if impl in ("pallas", "pallas_interpret"):
         if mask is not None or seq_offset:
             raise ValueError(

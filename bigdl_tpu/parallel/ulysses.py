@@ -50,9 +50,9 @@ def ulysses_attention(q, k, v, axis_name: str, *, causal: bool = False,
                               tiled=True)
 
     qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
-    # full sequence present locally: the standard kernel applies,
-    # including plain causal masking ("auto" takes the Pallas flash
-    # path on TPU when the tiles fit, the lax reference elsewhere)
+    # full sequence present locally: the standard op applies,
+    # including plain causal masking ("auto" = the measured policy in
+    # ops/attention.py — lax below T=4096, Pallas flash beyond)
     out = dot_product_attention(qh, kh, vh, causal=causal, scale=scale)
     # head-sharded -> seq-sharded
     return lax.all_to_all(out, axis_name, split_axis=2, concat_axis=1,
