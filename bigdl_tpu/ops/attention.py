@@ -20,11 +20,11 @@ Design for the MXU/VMEM (pallas_guide.md):
 measurement-driven (see the dispatcher): the lax reference wins
 throughput on the 2026-07 toolchain at every length whose softmax
 residuals fit, so auto takes lax below T=4096 and the Pallas kernel in
-the long-context regime, where saving only (q, k, v) instead of
-per-layer (B, H, T, T) residuals is the difference between fitting and
-OOM.  Both paths are differentiable — the Pallas path via
-``jax.custom_vjp`` with a lax-reference recompute backward (transient
-per-layer T^2, not blockwise).
+the long-context regime, where flash's O(T) residuals — (q, k, v,
+out, logsumexp) instead of per-layer (B, H, T, T) — are the
+difference between fitting and OOM.  Both paths are differentiable —
+the Pallas path via ``jax.custom_vjp`` with blockwise backward
+kernels that never materialize a (T, T) array in either direction.
 """
 
 from __future__ import annotations
@@ -80,10 +80,35 @@ def _reference_attention(q, k, v, *, causal: bool, scale: float,
 # --------------------------------------------------------------------------
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
-                      scale: float, causal: bool, seq_len: int):
+def _mask_causal(s, qi, block_q, ki, block_k):
+    """-inf the future positions of a (block_q, block_k) score tile at
+    block coordinates (qi, ki).  Single definition shared by the
+    forward and both backward kernels so the mask convention can never
+    desynchronize between them."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    qpos = qi * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    kpos = ki * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(qpos >= kpos, s, -jnp.inf)
+
+
+def _diag_kblocks(qi, block_q, block_k):
+    """Number of key blocks a causal q-block touches (through its
+    diagonal), shared by the forward and dq kernels."""
+    from jax import lax
+
+    return lax.div((qi + 1) * block_q + block_k - 1, block_k)
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                      block_k: int, scale: float, causal: bool,
+                      seq_len: int):
     """One (batch*head, q-block) program: stream key blocks, online
-    softmax.  Refs are VMEM blocks: q (1, block_q, d), k/v (1, T, d)."""
+    softmax.  Refs are VMEM blocks: q (1, block_q, d), k/v (1, T, d).
+    Also writes the per-row logsumexp (in scaled-score units) so the
+    blockwise backward can reconstruct P = exp(s - lse) without a
+    second softmax pass."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -107,13 +132,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
             preferred_element_type=jnp.float32,
         )  # (block_q, block_k)
         if causal:
-            qpos = qi * block_q + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            kpos = ki * block_k + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(qpos >= kpos, s, -jnp.inf)
+            s = _mask_causal(s, qi, block_q, ki, block_k)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         # fully-masked rows keep m=-inf; use 0 shift there to avoid NaNs
         shift = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
@@ -128,14 +147,20 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
 
     if causal:
         # process key blocks up to and including the diagonal
-        last = (qi + 1) * block_q  # exclusive end of query positions
-        nk = lax.div(last + block_k - 1, block_k)
+        nk = _diag_kblocks(qi, block_q, block_k)
         m, l, acc = lax.fori_loop(0, nk, body, (m0, l0, acc0))
     else:
         m, l, acc = lax.fori_loop(0, seq_len // block_k, body, (m0, l0, acc0))
 
     out = acc / jnp.maximum(l, 1e-30)[:, None]
     o_ref[0] = out.astype(o_ref.dtype)
+    # lse rides as (1, T//block_q, block_q): Mosaic's block rule wants
+    # the last two dims (8, 128)-divisible-or-full, which a (1, block_q)
+    # row block violates.  The full plane is mapped for every j and
+    # revisited (same block index), so each program writes only its row
+    # and the block flushes once per batch*head.
+    lse = m + jnp.log(jnp.maximum(l, 1e-30))
+    lse_ref[0, pl.ds(qi, 1), :] = lse[None, :]
 
 
 def _pick_block(t: int, preferred: int = 128) -> int:
@@ -153,9 +178,11 @@ def flash_attention(q, k, v, *, causal: bool = False,
     """Pallas flash attention.  q/k/v: (B, H, T, D) with T a multiple of
     8 and D a multiple of... anything (padded to 128 lanes by Mosaic).
 
-    Differentiable: the backward recomputes attention with the lax
-    reference (rematerialisation — trading FLOPs for HBM, the standard
-    TPU bargain) so only the forward needs a hand kernel.
+    Differentiable with a true blockwise backward: the forward saves
+    (q, k, v, out, logsumexp) — O(T) extra — and the backward kernels
+    (_flash_bwd_dq_kernel / _flash_bwd_dkv_kernel) rebuild the score
+    tiles from the logsumexp, so no (T, T) array is ever materialized,
+    as residual OR transient, in either direction.
     """
     return _flash_attention_vjp(q, k, v, causal,
                                 scale if scale is not None else q.shape[-1] ** -0.5,
@@ -167,7 +194,8 @@ def _flash_attention_vjp(q, k, v, causal, scale, interpret):
     return _flash_forward(q, k, v, causal, scale, interpret)
 
 
-def _flash_forward(q, k, v, causal, scale, interpret):
+def _flash_forward(q, k, v, causal, scale, interpret, *,
+                   with_lse: bool = False):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -176,7 +204,8 @@ def _flash_forward(q, k, v, causal, scale, interpret):
     block_q = _pick_block(t)
     block_k = _pick_block(t)
     if not block_q:
-        return _reference_attention(q, k, v, causal=causal, scale=scale)
+        out = _reference_attention(q, k, v, causal=causal, scale=scale)
+        return (out, None) if with_lse else out
 
     kernel = functools.partial(
         _flash_fwd_kernel, block_k=block_k, scale=scale, causal=causal,
@@ -185,7 +214,7 @@ def _flash_forward(q, k, v, causal, scale, interpret):
     qr = q.reshape(b * h, t, d)
     kr = k.reshape(b * h, t, d)
     vr = v.reshape(b * h, t, d)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, t // block_q),
         in_specs=[
@@ -193,28 +222,202 @@ def _flash_forward(q, k, v, causal, scale, interpret):
             pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
         ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, t // block_q, block_q),
+                         lambda i, j: (i, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, t // block_q, block_q),
+                                 jnp.float32),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr)
+    out = out.reshape(b, h, t, d)
+    return (out, lse) if with_lse else out
+
+
+# ---- blockwise backward (the true flash backward: no T^2 residuals,
+# no T^2 transients — scores are rebuilt tile by tile from the saved
+# logsumexp) ----
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                         dq_ref, *, block_k: int, scale: float,
+                         causal: bool, seq_len: int):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import pallas as pl
+
+    block_q = q_ref.shape[1]
+    d = q_ref.shape[2]
+    qi = pl.program_id(1)
+    qs = q_ref[0].astype(jnp.float32) * scale      # (bq, d)
+    do = g_ref[0].astype(jnp.float32)              # (bq, d)
+    lse = lse_ref[0, pl.ds(qi, 1), :][0]           # (bq,)
+    dlt = delta_ref[0, pl.ds(qi, 1), :][0]         # (bq,)
+
+    def body(ki, acc):
+        ks = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        vs = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            qs, ks, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)    # (bq, bk)
+        if causal:
+            s = _mask_causal(s, qi, block_q, ki, block_k)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(
+            do, vs, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)    # (bq, bk)
+        ds = p * (dp - dlt[:, None])
+        return acc + jax.lax.dot_general(
+            ds, ks, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)    # (bq, d)
+
+    if causal:
+        nk = _diag_kblocks(qi, block_q, block_k)
+    else:
+        nk = seq_len // block_k
+    acc = lax.fori_loop(0, nk, body,
+                        jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[0] = (acc * scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, *, block_q: int, scale: float,
+                          causal: bool, seq_len: int):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import pallas as pl
+
+    block_k = k_ref.shape[1]
+    d = k_ref.shape[2]
+    kj = pl.program_id(1)
+    ks = k_ref[0].astype(jnp.float32)              # (bk, d)
+    vs = v_ref[0].astype(jnp.float32)              # (bk, d)
+
+    def body(qi, carry):
+        acc_dk, acc_dv = carry
+        qs = q_ref[0, pl.ds(qi * block_q, block_q), :] \
+            .astype(jnp.float32) * scale           # (bq, d)
+        do = g_ref[0, pl.ds(qi * block_q, block_q), :] \
+            .astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(qi, 1), :][0]       # (bq,)
+        dlt = delta_ref[0, pl.ds(qi, 1), :][0]
+        s = jax.lax.dot_general(
+            qs, ks, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)    # (bq, bk)
+        if causal:
+            s = _mask_causal(s, qi, block_q, kj, block_k)
+        p = jnp.exp(s - lse[:, None])
+        acc_dv = acc_dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)    # (bk, d)
+        dp = jax.lax.dot_general(
+            do, vs, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - dlt[:, None])
+        acc_dk = acc_dk + jax.lax.dot_general(
+            ds, qs, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)    # (bk, d)
+        return acc_dk, acc_dv
+
+    nq = seq_len // block_q
+    q0 = lax.div(kj * block_k, block_q) if causal else 0
+    z = jnp.zeros((block_k, d), jnp.float32)
+    acc_dk, acc_dv = lax.fori_loop(q0, nq, body, (z, z))
+    # qs carried the scale, so acc_dk is dL/dk exactly
+    dk_ref[0] = acc_dk.astype(dk_ref.dtype)
+    dv_ref[0] = acc_dv.astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, g, causal, scale, interpret):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    b, h, t, d = q.shape
+    block_q = _pick_block(t)
+    block_k = _pick_block(t)
+    qr = q.reshape(b * h, t, d)
+    kr = k.reshape(b * h, t, d)
+    vr = v.reshape(b * h, t, d)
+    gr = g.reshape(b * h, t, d)
+    outr = out.reshape(b * h, t, d)
+    # delta_i = sum_d dO_i . O_i — one fused elementwise+reduce in XLA;
+    # carried at the lse layout (bh, T//bq, bq), see the fwd kernel
+    delta = jnp.sum(gr.astype(jnp.float32) * outr.astype(jnp.float32),
+                    axis=-1).reshape(b * h, t // block_q, block_q)
+
+    lse_spec = pl.BlockSpec((1, t // block_q, block_q),
+                            lambda i, j: (i, 0, 0))
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, block_k=block_k,
+                          scale=scale, causal=causal, seq_len=t),
+        grid=(b * h, t // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            lse_spec,
+            lse_spec,
+        ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
         interpret=interpret,
-    )(qr, kr, vr)
-    return out.reshape(b, h, t, d)
+    )(qr, kr, vr, gr, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, block_q=block_q,
+                          scale=scale, causal=causal, seq_len=t),
+        grid=(b * h, t // block_k),
+        in_specs=[
+            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
+            lse_spec,
+            lse_spec,
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, t, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, t, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr, gr, lse, delta)
+
+    shape = (b, h, t, d)
+    return dq.reshape(shape), dk.reshape(shape), dv.reshape(shape)
 
 
 def _flash_fwd_rule(q, k, v, causal, scale, interpret):
-    out = _flash_forward(q, k, v, causal, scale, interpret)
-    return out, (q, k, v)
+    out, lse = _flash_forward(q, k, v, causal, scale, interpret,
+                              with_lse=True)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd_rule(causal, scale, interpret, res, g):
     import jax
 
-    q, k, v = res
+    q, k, v, out, lse = res
+    if lse is None:
+        # the forward fell back to the lax reference (untileable T):
+        # recompute its vjp the same way
+        def ref(q, k, v):
+            return _reference_attention(q, k, v, causal=causal,
+                                        scale=scale)
 
-    def ref(q, k, v):
-        return _reference_attention(q, k, v, causal=causal, scale=scale)
-
-    _, vjp = jax.vjp(ref, q, k, v)
-    return vjp(g)
+        _, vjp = jax.vjp(ref, q, k, v)
+        return vjp(g)
+    return _flash_backward(q, k, v, out, lse, g, causal, scale, interpret)
 
 
 _flash_attention_vjp.defvjp(_flash_fwd_rule, _flash_bwd_rule)
@@ -256,13 +459,11 @@ def dot_product_attention(q, k, v, *, causal: bool = False, mask=None,
         # T=2048: 114.1 vs 124.6.  What flash buys on TPU is MEMORY:
         # under jax.grad the lax path saves (B, H, T, T) softmax
         # residuals for EVERY layer simultaneously — the long-context
-        # cliff.  The flash path saves only (q, k, v): its backward
-        # recompute (see _flash_bwd_rule) still materializes O(T^2)
-        # scores, but transiently, one layer at a time — an
-        # n_layers-fold cut in live memory, not a blockwise-backward
-        # elimination of T^2 (that kernel does not exist here yet).
+        # cliff.  The flash path saves (q, k, v, out, lse) — O(T) —
+        # and its blockwise backward kernels rebuild score tiles from
+        # the logsumexp, so no (T, T) array exists in either direction.
         # So auto prefers lax until the quadratic-residual regime and
-        # flips to the kernel there (validated on chip at T=4096).
+        # flips to the kernel there.
         impl = "pallas" if (on_tpu and tiles and t >= 4096) else "lax"
     if impl in ("pallas", "pallas_interpret"):
         if mask is not None or seq_offset:

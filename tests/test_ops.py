@@ -65,6 +65,35 @@ class TestAttention:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=1e-4, rtol=1e-4)
 
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_flash_blockwise_backward_multiblock(self, causal):
+        # T=256 -> two 128-blocks: exercises the blockwise dq and dk/dv
+        # kernels' inner loops, the causal block-skip bounds, and the
+        # (bh, T//bq, bq) logsumexp layout across block boundaries.
+        # distinct q/k/v gradients (not the q=k=v fold) via argnums.
+        q, k, v = _qkv(t=256, d=16)
+        rs = np.random.RandomState(7)
+        g = jnp.asarray(rs.randn(*q.shape).astype(np.float32))
+
+        def loss_flash(q, k, v):
+            return jnp.sum(
+                flash_attention(q, k, v, causal=causal, interpret=True) * g
+            )
+
+        def loss_ref(q, k, v):
+            return jnp.sum(
+                _reference_attention(
+                    q, k, v, causal=causal, scale=16 ** -0.5
+                ) * g
+            )
+
+        g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip("qkv", g1, g2):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4,
+                err_msg=f"d{name}")
+
     def test_seq_offset_matches_full_causal(self):
         # ring-attention building block: computing the second half of the
         # queries with seq_offset must equal the full causal slice
