@@ -123,3 +123,33 @@ def test_feasible_shape_stays_pallas_and_logs_nothing():
                                       interpret=True)
     assert y.shape == (4, 64, 56, 56)
     assert not conv_bn.FALLBACK_LOG, conv_bn.FALLBACK_LOG
+
+
+def test_kxk_5x5_matches_reference():
+    # the lane-shift kernel is k-generic (any odd k, torch padding,
+    # stride 1): check a 5x5 against the XLA reference end to end,
+    # gradients included
+    import numpy as np
+
+    rs = np.random.RandomState(3)
+    x = jnp.asarray(rs.randn(2, 16, 10, 10).astype(np.float32))
+    w = jnp.asarray(rs.randn(24, 16, 5, 5).astype(np.float32) * 0.1)
+    s = jnp.asarray(rs.randn(24).astype(np.float32))
+    g = jnp.asarray(rs.randn(2, 24, 10, 10).astype(np.float32))
+
+    def f_kernel(x, w):
+        y, s1, s2 = conv_bn.conv_bn_stats(x, w, s, stride=1, pad=2,
+                                          interpret=True)
+        return (y * g).sum() + s1.sum() + (s2 * 0.5).sum()
+
+    def f_ref(x, w):
+        y, s1, s2 = conv_bn._reference(x, w, s, 1, 2)
+        return (y * g).sum() + s1.sum() + (s2 * 0.5).sum()
+
+    np.testing.assert_allclose(float(f_kernel(x, w)), float(f_ref(x, w)),
+                               rtol=1e-5)
+    gk = jax.grad(f_kernel, argnums=(0, 1))(x, w)
+    gr = jax.grad(f_ref, argnums=(0, 1))(x, w)
+    for a, b in zip(gk, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-3, rtol=1e-3)

@@ -128,3 +128,51 @@ class TestInt8Matmul:
         want = x @ w.T
         err = np.abs(np.asarray(got - want)).max()
         assert err < 0.05 * np.abs(np.asarray(want)).max() + 0.05
+
+
+def test_flash_untileable_t_falls_back_with_working_grad():
+    # T=27 tiles to nothing: the vjp must carry the lse=None
+    # reference-fallback residual and still produce correct gradients
+    # (attention.py _flash_bwd_rule's fallback arm).  Distinct q/k/v +
+    # per-argument grads so a permuted (dq, dk, dv) wiring in the
+    # fallback arm cannot cancel out in a shared-input sum.
+    rs = np.random.RandomState(11)
+    q = jnp.asarray(rs.randn(1, 2, 27, 8).astype(np.float32))
+    k = jnp.asarray(rs.randn(1, 2, 27, 8).astype(np.float32))
+    v = jnp.asarray(rs.randn(1, 2, 27, 8).astype(np.float32))
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True,
+                                       interpret=True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_reference_attention(q, k, v, causal=True,
+                                            scale=8 ** -0.5) ** 2)
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4,
+                                   err_msg=f"d{name}")
+
+
+def test_flash_forward_lse_matches_reference_logsumexp():
+    # the blockwise backward trusts the forward's saved logsumexp; pin
+    # it against a direct computation (causal, multi-block)
+    from bigdl_tpu.ops.attention import _flash_forward
+
+    rs = np.random.RandomState(5)
+    b, h, t, d = 1, 2, 256, 16
+    q = jnp.asarray(rs.randn(b, h, t, d).astype(np.float32) * 0.5)
+    k = jnp.asarray(rs.randn(b, h, t, d).astype(np.float32) * 0.5)
+    v = jnp.asarray(rs.randn(b, h, t, d).astype(np.float32))
+    scale = d ** -0.5
+    out, lse = _flash_forward(q, k, v, True, scale, True, with_lse=True)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    qpos = jnp.arange(t)[:, None]
+    kpos = jnp.arange(t)[None, :]
+    s = jnp.where(qpos >= kpos, s, -jnp.inf)
+    want = jax.scipy.special.logsumexp(s, axis=-1).reshape(b * h, -1)
+    got = np.asarray(lse).reshape(b * h, -1)
+    np.testing.assert_allclose(got, np.asarray(want), atol=1e-4, rtol=1e-4)
