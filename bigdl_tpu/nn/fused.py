@@ -18,7 +18,6 @@ architecture's values at fuse time.
 
 from __future__ import annotations
 
-import numpy as np
 
 from bigdl_tpu.nn.layers import (
     MsraFiller,

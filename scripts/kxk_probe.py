@@ -17,7 +17,6 @@ against the XLA reference:
 """
 from __future__ import annotations
 
-import functools
 import json
 import os
 import subprocess
