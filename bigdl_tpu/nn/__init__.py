@@ -56,6 +56,7 @@ from bigdl_tpu.nn.table_ops import (
     CMinTable,
     JoinTable,
     SelectTable,
+    WhereTable,
     FlattenTable,
     MM,
     MV,
@@ -138,6 +139,7 @@ __all__ = (
         "NextIteration", "BinaryTreeLSTM",
         "ConcatTable", "ParallelTable", "CAddTable", "CSubTable", "CMulTable",
         "CDivTable", "CMaxTable", "CMinTable", "JoinTable", "SelectTable",
+        "WhereTable",
         "FlattenTable", "MM", "MV", "CosineDistance", "DotProduct", "Concat",
         "CAveTable", "SplitTable", "BifurcateSplitTable", "NarrowTable",
         "Pack", "MixtureTable", "MapTable", "Bottle",

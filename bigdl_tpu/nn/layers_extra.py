@@ -1091,6 +1091,68 @@ class Reverse(_Stateless):
         return _jnp().flip(input, axis=d)
 
 
+class CumSum(_Stateless):
+    """TF-interop vocabulary («bigdl»/utils/tf/loaders Cumsum) —
+    cumulative sum along 1-based ``dimension`` with TF's exclusive /
+    reverse flags."""
+
+    def __init__(self, dimension: int = 1, exclusive: bool = False,
+                 reverse: bool = False):
+        super().__init__(dimension=dimension, exclusive=exclusive,
+                         reverse=reverse)
+        self.dimension = dimension
+        self.exclusive = exclusive
+        self.reverse = reverse
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        jnp = _jnp()
+        lax = _lax()
+        d = self.dimension - 1
+        x = jnp.flip(input, axis=d) if self.reverse else input
+        y = jnp.cumsum(x, axis=d)
+        if self.exclusive:
+            # TF's exclusive = shifted inclusive ([0, y[:-1]]): exact,
+            # unlike y - x which catastrophically cancels when a large
+            # running sum has absorbed a small element
+            head = jnp.zeros_like(lax.slice_in_dim(y, 0, 1, axis=d))
+            y = jnp.concatenate(
+                [head, lax.slice_in_dim(y, 0, y.shape[d] - 1, axis=d)],
+                axis=d)
+        return jnp.flip(y, axis=d) if self.reverse else y
+
+
+class FillLike(_Stateless):
+    """TF-interop vocabulary (ZerosLike / OnesLike) — a constant tensor
+    of the input's shape.  Ignores the input VALUES (0 * inf is NaN, so
+    a multiply-by-zero lowering corrupts graphs that ZerosLike their
+    -inf attention masks); the input contributes shape only and gets a
+    zero gradient."""
+
+    def __init__(self, value: float = 0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        return _jnp().full_like(input, self.value)
+
+
+class MirrorPad(_Stateless):
+    """TF-interop vocabulary — REFLECT / SYMMETRIC padding.
+    ``paddings`` is the full-rank list of (before, after) pairs,
+    batch row included (TF's layout)."""
+
+    def __init__(self, paddings, mode: str = "REFLECT"):
+        paddings = [list(p) for p in paddings]
+        super().__init__(paddings=paddings, mode=mode)
+        self.paddings = paddings
+        self.mode = mode
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        mode = "reflect" if self.mode == "REFLECT" else "symmetric"
+        return _jnp().pad(input, [tuple(p) for p in self.paddings],
+                          mode=mode)
+
+
 # --------------------------------------------------------------------------
 # Misc
 # --------------------------------------------------------------------------
@@ -1418,6 +1480,9 @@ __all__ = [
     "GatherIndices",
     "CompareConstant",
     "Reverse",
+    "CumSum",
+    "FillLike",
+    "MirrorPad",
     "MaskedSelect",
     "Maxout",
     "Highway",

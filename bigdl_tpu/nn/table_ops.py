@@ -119,6 +119,29 @@ class CMinTable(_TableReduce):
         return y
 
 
+class WhereTable(_TableReduce):
+    """TF-interop vocabulary (Select / SelectV2) — ``cond ? x : y``
+    over a table ``[cond, x, y]``; cond is {0, 1} floats (this f32
+    runtime's boolean convention).  Gradients flow to x and y; the
+    predicate gets none.
+
+    ``leading_broadcast`` encodes TF's two spellings: Select (v1)
+    broadcasts a lower-rank cond along the LEADING axes (a rank-1 cond
+    is a row mask), SelectV2 broadcasts numpy-style (trailing)."""
+
+    def __init__(self, leading_broadcast: bool = False):
+        super().__init__(leading_broadcast=leading_broadcast)
+        self.leading_broadcast = leading_broadcast
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        jnp = _jnp()
+        cond, x, y = input
+        if self.leading_broadcast and cond.ndim < x.ndim:
+            cond = cond.reshape(
+                cond.shape + (1,) * (x.ndim - cond.ndim))
+        return jnp.where(cond != 0, x, y)
+
+
 class JoinTable(_TableReduce):
     """«bigdl»/nn/JoinTable.scala — concat a table along 1-based dim;
     n_input_dims handles the batch-dim shift like the reference."""

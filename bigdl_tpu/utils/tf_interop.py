@@ -1112,6 +1112,82 @@ class TensorflowLoader:
             mod = cls(axes[0] + 1)
             return self._named(mod, nd)(self._build(ins[0]))
 
+        if op in ("All", "Any"):
+            # {0,1}-float booleans: All = min-reduce, Any = max-reduce
+            image = self._is_image(ins[0])
+            axes = [self._map_axis(int(a), image)
+                    for a in self._const(ins[1]).reshape(-1).tolist()]
+            keep = nd.attr("keep_dims")
+            if len(axes) != 1 or (keep and keep.b):
+                raise TFConversionException(
+                    f"{op} over axes {axes} with keep_dims unsupported")
+            mod = (L.Min if op == "All" else L.Max)(axes[0] + 1)
+            return self._named(mod, nd)(self._build(ins[0]))
+
+        if op in ("ZerosLike", "OnesLike"):
+            from bigdl_tpu.nn.layers_extra import FillLike
+
+            mod = FillLike(0.0 if op == "ZerosLike" else 1.0)
+            return self._named(mod, nd)(self._build(ins[0]))
+
+        if op == "LogicalNot":
+            from bigdl_tpu.nn.module import Sequential
+
+            mod = Sequential().add(L.Negative()).add(L.AddConstant(1.0))
+            return self._named(mod, nd)(self._build(ins[0]))
+
+        if op in ("LogicalAnd", "LogicalOr"):
+            table = T.CMinTable() if op == "LogicalAnd" else T.CMaxTable()
+            return self._named(table, nd)(*[self._build(i) for i in ins])
+
+        if op in ("Select", "SelectV2"):
+            # v1 Select broadcasts a low-rank cond along LEADING axes
+            # (rank-1 cond = row mask); SelectV2 is numpy-style
+            table = T.WhereTable(leading_broadcast=(op == "Select"))
+            return self._named(table, nd)(
+                *[self._build(i) for i in ins])
+
+        if op == "Cumsum":
+            from bigdl_tpu.nn.layers_extra import CumSum
+
+            image = self._is_image(ins[0])
+            ax = self._map_axis(
+                int(self._const(ins[1]).reshape(-1)[0]), image)
+            exclusive = nd.attr("exclusive")
+            reverse = nd.attr("reverse")
+            mod = CumSum(ax + 1,
+                         exclusive=bool(exclusive.b) if exclusive else False,
+                         reverse=bool(reverse.b) if reverse else False)
+            return self._named(mod, nd)(self._build(ins[0]))
+
+        if op == "ReverseV2":
+            from bigdl_tpu.nn.layers_extra import Reverse
+            from bigdl_tpu.nn.module import Sequential
+
+            image = self._is_image(ins[0])
+            axes = [self._map_axis(int(a), image)
+                    for a in self._const(ins[1]).reshape(-1).tolist()]
+            seq = Sequential()
+            for a in axes:
+                seq.add(Reverse(a + 1))
+            mod = seq if len(seq.modules) != 1 else seq.modules[0]
+            return self._named(mod, nd)(self._build(ins[0]))
+
+        if op == "MirrorPad":
+            from bigdl_tpu.nn.layers_extra import MirrorPad
+
+            pads = self._const(ins[1]).astype(int)  # (rank, 2) TF layout
+            if pads[0].any():
+                raise TFConversionException(
+                    "MirrorPad on the batch axis unsupported")
+            if self._is_image(ins[0]) and pads.shape[0] == 4:
+                # NHWC rows -> converted NCHW tensor order
+                pads = pads[[0, 3, 1, 2]]
+            mode = nd.attr("mode")
+            mode = mode.s if mode and mode.s else "REFLECT"
+            mod = MirrorPad([list(p) for p in pads.tolist()], mode=mode)
+            return self._named(mod, nd)(self._build(ins[0]))
+
         if op == "Tile":
             image = self._is_image(ins[0])
             mults = self._const(ins[1]).reshape(-1).astype(int).tolist()

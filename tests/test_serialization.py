@@ -185,6 +185,10 @@ def _layer_cases():
         (T.CAddTable(), (v, v)), (T.CSubTable(), (v, v)),
         (T.CMulTable(), (v, v)), (T.CDivTable(), (v, pos)),
         (T.CMaxTable(), (v, v)), (T.CMinTable(), (v, v)),
+        (T.WhereTable(), ((v > 0).astype(np.float32), v, v * 2.0)),
+        (N.FillLike(1.0), v),
+        (N.CumSum(2, exclusive=True, reverse=True), v),
+        (N.MirrorPad([[0, 0], [1, 2]], "SYMMETRIC"), v),
         (T.JoinTable(2), (v, v)), (T.SelectTable(1), (v, v)),
         (T.MM(), (v, v.T.copy())), (T.MV(), (v, rs.randn(2, 6).astype(np.float32)[0] * 0 + 1)),
         (T.DotProduct(), (v, v)), (T.CosineDistance(), (v, v)),

@@ -932,3 +932,119 @@ def test_fold_onehot_rank_size():
     expect = x @ np.eye(4, dtype=np.float32)[[0, 2, 1]].T
     np.testing.assert_allclose(np.asarray(model.forward(x)), expect,
                                rtol=1e-5)
+
+
+def test_loader_logical_select_like_ops():
+    rs = np.random.RandomState(13)
+    # ZerosLike / OnesLike / LogicalNot / LogicalAnd / LogicalOr / Select
+    b = GraphDefBuilder()
+    b.placeholder("c")  # {0,1} floats
+    b.placeholder("d")
+    b.placeholder("x")
+    b.placeholder("y")
+    b.op("z", "ZerosLike", ["x"])
+    b.op("o", "OnesLike", ["x"])
+    b.op("n", "LogicalNot", ["c"])
+    b.op("a", "LogicalAnd", ["c", "d"])
+    b.op("r", "LogicalOr", ["c", "d"])
+    b.op("s", "SelectV2", ["c", "x", "y"])
+    model = TensorflowLoader(data=b.tobytes()).load(
+        inputs=["c", "d", "x", "y"], outputs=["z", "o", "n", "a", "r", "s"])
+    model.evaluate()
+    c = (rs.rand(3, 5) > 0.5).astype(np.float32)
+    d = (rs.rand(3, 5) > 0.5).astype(np.float32)
+    x = rs.randn(3, 5).astype(np.float32)
+    y = rs.randn(3, 5).astype(np.float32)
+    x[0, 0] = np.inf  # ZerosLike/OnesLike must ignore VALUES (0*inf=NaN)
+    z, o, n, a, r, s = [np.asarray(t) for t in model.forward([c, d, x, y])]
+    np.testing.assert_allclose(z, np.zeros_like(x))
+    np.testing.assert_allclose(o, np.ones_like(x))
+    np.testing.assert_allclose(n, 1.0 - c)
+    np.testing.assert_allclose(a, np.minimum(c, d))
+    np.testing.assert_allclose(r, np.maximum(c, d))
+    np.testing.assert_allclose(s, np.where(c != 0, x, y))
+
+    # v1 Select: a rank-1 cond is a ROW mask (leading broadcast)
+    b = GraphDefBuilder()
+    b.placeholder("c")
+    b.placeholder("x")
+    b.placeholder("y")
+    b.op("s", "Select", ["c", "x", "y"])
+    model = TensorflowLoader(data=b.tobytes()).load(
+        inputs=["c", "x", "y"], outputs=["s"])
+    model.evaluate()
+    cv = np.asarray([1.0, 0.0, 1.0], np.float32)
+    xv = rs.randn(3, 4).astype(np.float32)
+    yv = rs.randn(3, 4).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(model.forward([cv, xv, yv])),
+        np.where(cv[:, None] != 0, xv, yv))
+
+
+def test_loader_cumsum_reverse_mirrorpad_all_any():
+    rs = np.random.RandomState(14)
+    x = rs.randn(2, 6).astype(np.float32)
+
+    b = GraphDefBuilder()
+    b.placeholder("x")
+    b.const("ax", np.asarray(1, np.int32))
+    b.op("cs", "Cumsum", ["x", "ax"])
+    model = TensorflowLoader(data=b.tobytes()).load(
+        inputs=["x"], outputs=["cs"])
+    model.evaluate()
+    np.testing.assert_allclose(np.asarray(model.forward(x)),
+                               np.cumsum(x, axis=1), rtol=1e-6)
+
+    b = GraphDefBuilder()
+    b.placeholder("x")
+    b.const("ax", np.asarray(1, np.int32))
+    b.op("cs", "Cumsum", ["x", "ax"],
+         exclusive=GraphDefBuilder.attr_b(True),
+         reverse=GraphDefBuilder.attr_b(True))
+    model = TensorflowLoader(data=b.tobytes()).load(
+        inputs=["x"], outputs=["cs"])
+    model.evaluate()
+    xf = np.flip(x, 1)
+    want = np.flip(np.concatenate(
+        [np.zeros((2, 1), np.float32), np.cumsum(xf, axis=1)[:, :-1]], 1), 1)
+    np.testing.assert_allclose(np.asarray(model.forward(x)), want, rtol=1e-6)
+    # exclusive must be shift-exact, not inclusive-minus-x (which
+    # cancels catastrophically once the running sum absorbs an element)
+    big = np.asarray([[1.0, 3e8, 2.0]], np.float32)
+    out = np.asarray(model.forward(big))  # reverse+exclusive
+    np.testing.assert_allclose(out, [[3e8 + 2.0, 2.0, 0.0]], rtol=1e-6)
+
+    b = GraphDefBuilder()
+    b.placeholder("x")
+    b.const("ax", np.asarray([1], np.int32))
+    b.op("rv", "ReverseV2", ["x", "ax"])
+    model = TensorflowLoader(data=b.tobytes()).load(
+        inputs=["x"], outputs=["rv"])
+    model.evaluate()
+    np.testing.assert_allclose(np.asarray(model.forward(x)),
+                               np.flip(x, axis=1))
+
+    b = GraphDefBuilder()
+    b.placeholder("x")
+    b.const("p", np.asarray([[0, 0], [2, 1]], np.int32))
+    b.op("mp", "MirrorPad", ["x", "p"],
+         mode=GraphDefBuilder.attr_s("REFLECT"))
+    model = TensorflowLoader(data=b.tobytes()).load(
+        inputs=["x"], outputs=["mp"])
+    model.evaluate()
+    np.testing.assert_allclose(
+        np.asarray(model.forward(x)),
+        np.pad(x, [(0, 0), (2, 1)], mode="reflect"))
+
+    c = (rs.rand(4, 3) > 0.4).astype(np.float32)
+    b = GraphDefBuilder()
+    b.placeholder("c")
+    b.const("ax", np.asarray([1], np.int32))
+    b.op("al", "All", ["c", "ax"])
+    b.op("an", "Any", ["c", "ax"])
+    model = TensorflowLoader(data=b.tobytes()).load(
+        inputs=["c"], outputs=["al", "an"])
+    model.evaluate()
+    al, an = [np.asarray(t) for t in model.forward(c)]
+    np.testing.assert_allclose(al, c.min(axis=1))
+    np.testing.assert_allclose(an, c.max(axis=1))
