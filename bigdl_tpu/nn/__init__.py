@@ -57,6 +57,7 @@ from bigdl_tpu.nn.table_ops import (
     JoinTable,
     SelectTable,
     WhereTable,
+    InTopK,
     FlattenTable,
     MM,
     MV,
@@ -139,7 +140,7 @@ __all__ = (
         "NextIteration", "BinaryTreeLSTM",
         "ConcatTable", "ParallelTable", "CAddTable", "CSubTable", "CMulTable",
         "CDivTable", "CMaxTable", "CMinTable", "JoinTable", "SelectTable",
-        "WhereTable",
+        "WhereTable", "InTopK",
         "FlattenTable", "MM", "MV", "CosineDistance", "DotProduct", "Concat",
         "CAveTable", "SplitTable", "BifurcateSplitTable", "NarrowTable",
         "Pack", "MixtureTable", "MapTable", "Bottle",

@@ -119,6 +119,31 @@ class CMinTable(_TableReduce):
         return y
 
 
+class InTopK(_TableReduce):
+    """TF-interop vocabulary (InTopK) — table [predictions (B, C),
+    targets (B,)] -> {0,1} floats: is the target class within the top
+    ``k`` predictions?  TF tie semantics: the target is in the top k
+    iff fewer than k classes score STRICTLY higher."""
+
+    def __init__(self, k: int = 1):
+        super().__init__(k=k)
+        self.k = k
+
+    def update_output_pure(self, params, input, *, training=False, rng=None):
+        jnp = _jnp()
+        preds, tgt = input
+        idx = tgt.astype(jnp.int32)[:, None]
+        score = jnp.take_along_axis(preds, idx, axis=1)
+        n_higher = jnp.sum((preds > score).astype(jnp.int32), axis=1)
+        ok = n_higher < self.k
+        # TF kernel guards (in_topk_op): a non-finite target prediction
+        # is never in the top k, and an out-of-range target index is
+        # false (jnp's gather would silently clamp it)
+        ok = ok & jnp.isfinite(score[:, 0])
+        valid = (tgt >= 0) & (tgt < preds.shape[1])
+        return (ok & valid).astype(jnp.float32)
+
+
 class WhereTable(_TableReduce):
     """TF-interop vocabulary (Select / SelectV2) — ``cond ? x : y``
     over a table ``[cond, x, y]``; cond is {0, 1} floats (this f32

@@ -1140,6 +1140,11 @@ class TensorflowLoader:
             table = T.CMinTable() if op == "LogicalAnd" else T.CMaxTable()
             return self._named(table, nd)(*[self._build(i) for i in ins])
 
+        if op == "InTopK":
+            k = nd.attr("k")
+            mod = T.InTopK(int(k.i) if k else 1)
+            return self._named(mod, nd)(*[self._build(i) for i in ins])
+
         if op in ("Select", "SelectV2"):
             # v1 Select broadcasts a low-rank cond along LEADING axes
             # (rank-1 cond = row mask); SelectV2 is numpy-style

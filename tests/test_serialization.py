@@ -187,6 +187,7 @@ def _layer_cases():
         (T.CMaxTable(), (v, v)), (T.CMinTable(), (v, v)),
         (T.WhereTable(), ((v > 0).astype(np.float32), v, v * 2.0)),
         (N.FillLike(1.0), v),
+        (T.InTopK(2), (v, np.array([1.0, 4.0], np.float32))),
         (N.CumSum(2, exclusive=True, reverse=True), v),
         (N.MirrorPad([[0, 0], [1, 2]], "SYMMETRIC"), v),
         (T.JoinTable(2), (v, v)), (T.SelectTable(1), (v, v)),

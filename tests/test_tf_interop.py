@@ -980,6 +980,23 @@ def test_loader_logical_select_like_ops():
         np.asarray(model.forward([cv, xv, yv])),
         np.where(cv[:, None] != 0, xv, yv))
 
+    # InTopK with TF tie semantics (strictly-higher count)
+    b = GraphDefBuilder()
+    b.placeholder("p")
+    b.placeholder("t")
+    b.op("tk", "InTopK", ["p", "t"], k=GraphDefBuilder.attr_i(2))
+    model = TensorflowLoader(data=b.tobytes()).load(
+        inputs=["p", "t"], outputs=["tk"])
+    model.evaluate()
+    p = np.asarray([[0.1, 0.9, 0.5], [0.3, 0.3, 0.3],
+                    [np.nan, 0.2, 0.3], [0.5, 0.1, 0.2]], np.float32)
+    t = np.asarray([2.0, 0.0, 0.0, 7.0], np.float32)
+    # row 0: one strictly-higher -> in top-2; row 1: all tied -> in;
+    # row 2: NaN target prediction -> TF kernel guard says NO;
+    # row 3: out-of-range target index -> NO (not silently clamped)
+    np.testing.assert_allclose(
+        np.asarray(model.forward([p, t])), [1.0, 1.0, 0.0, 0.0])
+
 
 def test_loader_cumsum_reverse_mirrorpad_all_any():
     rs = np.random.RandomState(14)
