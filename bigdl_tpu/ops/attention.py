@@ -80,30 +80,38 @@ def _reference_attention(q, k, v, *, causal: bool, scale: float,
 # --------------------------------------------------------------------------
 
 
-def _mask_causal(s, qi, block_q, ki, block_k):
+def _mask_causal(s, qi, block_q, ki, block_k, seq_offset=0):
     """-inf the future positions of a (block_q, block_k) score tile at
-    block coordinates (qi, ki).  Single definition shared by the
-    forward and both backward kernels so the mask convention can never
-    desynchronize between them."""
+    block coordinates (qi, ki); ``seq_offset`` (static) shifts the
+    query positions — chunked causal attention where the local query
+    block starts at a nonzero absolute position.  Single definition
+    shared by the forward and both backward kernels so the mask
+    convention can never desynchronize between them."""
     import jax.numpy as jnp
     from jax import lax
 
-    qpos = qi * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    qpos = seq_offset + qi * block_q + lax.broadcasted_iota(
+        jnp.int32, s.shape, 0)
     kpos = ki * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
     return jnp.where(qpos >= kpos, s, -jnp.inf)
 
 
-def _diag_kblocks(qi, block_q, block_k):
+def _diag_kblocks(qi, block_q, block_k, seq_offset=0, kv_len=None):
     """Number of key blocks a causal q-block touches (through its
-    diagonal), shared by the forward and dq kernels."""
+    diagonal at query offset ``seq_offset``), clamped to the kv
+    extent; shared by the forward and dq kernels."""
+    import jax.numpy as jnp
     from jax import lax
 
-    return lax.div((qi + 1) * block_q + block_k - 1, block_k)
+    nk = lax.div(seq_offset + (qi + 1) * block_q + block_k - 1, block_k)
+    if kv_len is not None:
+        nk = jnp.minimum(nk, kv_len // block_k)
+    return nk
 
 
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
                       block_k: int, scale: float, causal: bool,
-                      seq_len: int):
+                      seq_len: int, seq_offset: int = 0):
     """One (batch*head, q-block) program: stream key blocks, online
     softmax.  Refs are VMEM blocks: q (1, block_q, d), k/v (1, T, d).
     Also writes the per-row logsumexp (in scaled-score units) so the
@@ -132,7 +140,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
             preferred_element_type=jnp.float32,
         )  # (block_q, block_k)
         if causal:
-            s = _mask_causal(s, qi, block_q, ki, block_k)
+            s = _mask_causal(s, qi, block_q, ki, block_k, seq_offset)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         # fully-masked rows keep m=-inf; use 0 shift there to avoid NaNs
         shift = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
@@ -147,7 +155,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
 
     if causal:
         # process key blocks up to and including the diagonal
-        nk = _diag_kblocks(qi, block_q, block_k)
+        nk = _diag_kblocks(qi, block_q, block_k, seq_offset, seq_len)
         m, l, acc = lax.fori_loop(0, nk, body, (m0, l0, acc0))
     else:
         m, l, acc = lax.fori_loop(0, seq_len // block_k, body, (m0, l0, acc0))
@@ -163,6 +171,19 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
     lse_ref[0, pl.ds(qi, 1), :] = lse[None, :]
 
 
+# the flash kernels map k and v as whole (1, Tk, d) VMEM blocks per
+# program; cap their combined footprint well under the ~16 MB VMEM so
+# double-buffering and the f32 accumulators still fit.  On-chip
+# validated points: Tk=8192 at d=128 bf16 (4 MB).
+_KV_VMEM_BUDGET = 8 * 1024 * 1024
+
+
+def _kv_fits_vmem(tk: int, d: int, dtype) -> bool:
+    import jax.numpy as jnp
+
+    return 2 * tk * d * jnp.dtype(dtype).itemsize <= _KV_VMEM_BUDGET
+
+
 def _pick_block(t: int, preferred: int = 128) -> int:
     for b in (preferred, 64, 32, 16, 8):
         if t % b == 0:
@@ -171,70 +192,84 @@ def _pick_block(t: int, preferred: int = 128) -> int:
 
 
 @functools.partial(
-    jax.jit, static_argnames=("causal", "scale", "interpret")
+    jax.jit, static_argnames=("causal", "scale", "interpret",
+                              "seq_offset")
 )
 def flash_attention(q, k, v, *, causal: bool = False,
-                    scale: Optional[float] = None, interpret: bool = False):
-    """Pallas flash attention.  q/k/v: (B, H, T, D) with T a multiple of
-    8 and D a multiple of... anything (padded to 128 lanes by Mosaic).
+                    scale: Optional[float] = None, interpret: bool = False,
+                    seq_offset: int = 0):
+    """Pallas flash attention.  q (B, H, Tq, D) against k/v
+    (B, H, Tk, D) — Tq and Tk each a multiple of 8, D anything (padded
+    to 128 lanes by Mosaic).  ``seq_offset`` (STATIC int >= 0) places
+    the query block at a global position for chunked causal
+    attention: q covers absolute positions [seq_offset, seq_offset+Tq)
+    of the kv sequence.
 
     Differentiable with a true blockwise backward: the forward saves
     (q, k, v, out, logsumexp) — O(T) extra — and the backward kernels
     (_flash_bwd_dq_kernel / _flash_bwd_dkv_kernel) rebuild the score
-    tiles from the logsumexp, so no (T, T) array is ever materialized,
-    as residual OR transient, in either direction.
+    tiles from the logsumexp, so no (Tq, Tk) array is ever
+    materialized, as residual OR transient, in either direction.
     """
+    if seq_offset < 0:
+        raise ValueError("seq_offset must be >= 0")
     return _flash_attention_vjp(q, k, v, causal,
                                 scale if scale is not None else q.shape[-1] ** -0.5,
-                                interpret)
+                                interpret, seq_offset)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash_attention_vjp(q, k, v, causal, scale, interpret):
-    return _flash_forward(q, k, v, causal, scale, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_attention_vjp(q, k, v, causal, scale, interpret, seq_offset):
+    return _flash_forward(q, k, v, causal, scale, interpret,
+                          seq_offset=seq_offset)
 
 
 def _flash_forward(q, k, v, causal, scale, interpret, *,
-                   with_lse: bool = False):
+                   with_lse: bool = False, seq_offset: int = 0):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
-    b, h, t, d = q.shape
-    block_q = _pick_block(t)
-    block_k = _pick_block(t)
-    if not block_q:
-        out = _reference_attention(q, k, v, causal=causal, scale=scale)
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    block_q = _pick_block(tq)
+    block_k = _pick_block(tk)
+    if not block_q or not block_k or not _kv_fits_vmem(tk, d, k.dtype):
+        # untileable T, or the whole-kv (1, Tk, d) blocks these kernels
+        # stream per program would blow the VMEM budget: lax reference
+        # (auto dispatch never lands here — its predicate mirrors this)
+        out = _reference_attention(q, k, v, causal=causal, scale=scale,
+                                   seq_offset=seq_offset)
         return (out, None) if with_lse else out
 
     kernel = functools.partial(
         _flash_fwd_kernel, block_k=block_k, scale=scale, causal=causal,
-        seq_len=t,
+        seq_len=tk, seq_offset=seq_offset,
     )
-    qr = q.reshape(b * h, t, d)
-    kr = k.reshape(b * h, t, d)
-    vr = v.reshape(b * h, t, d)
+    qr = q.reshape(b * h, tq, d)
+    kr = k.reshape(b * h, tk, d)
+    vr = v.reshape(b * h, tk, d)
     out, lse = pl.pallas_call(
         kernel,
-        grid=(b * h, t // block_q),
+        grid=(b * h, tq // block_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, t // block_q, block_q),
+            pl.BlockSpec((1, tq // block_q, block_q),
                          lambda i, j: (i, 0, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, t // block_q, block_q),
+            jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, tq // block_q, block_q),
                                  jnp.float32),
         ],
         interpret=interpret,
     )(qr, kr, vr)
-    out = out.reshape(b, h, t, d)
+    out = out.reshape(b, h, tq, d)
     return (out, lse) if with_lse else out
 
 
@@ -245,7 +280,7 @@ def _flash_forward(q, k, v, causal, scale, interpret, *,
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
                          dq_ref, *, block_k: int, scale: float,
-                         causal: bool, seq_len: int):
+                         causal: bool, seq_len: int, seq_offset: int = 0):
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -266,7 +301,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
             qs, ks, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)    # (bq, bk)
         if causal:
-            s = _mask_causal(s, qi, block_q, ki, block_k)
+            s = _mask_causal(s, qi, block_q, ki, block_k, seq_offset)
         p = jnp.exp(s - lse[:, None])
         dp = jax.lax.dot_general(
             do, vs, (((1,), (1,)), ((), ())),
@@ -277,7 +312,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32)    # (bq, d)
 
     if causal:
-        nk = _diag_kblocks(qi, block_q, block_k)
+        nk = _diag_kblocks(qi, block_q, block_k, seq_offset, seq_len)
     else:
         nk = seq_len // block_k
     acc = lax.fori_loop(0, nk, body,
@@ -287,7 +322,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
                           dk_ref, dv_ref, *, block_q: int, scale: float,
-                          causal: bool, seq_len: int):
+                          causal: bool, q_len: int, seq_offset: int = 0):
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -311,7 +346,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
             qs, ks, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)    # (bq, bk)
         if causal:
-            s = _mask_causal(s, qi, block_q, kj, block_k)
+            s = _mask_causal(s, qi, block_q, kj, block_k, seq_offset)
         p = jnp.exp(s - lse[:, None])
         acc_dv = acc_dv + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -325,8 +360,13 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32)    # (bk, d)
         return acc_dk, acc_dv
 
-    nq = seq_len // block_q
-    q0 = lax.div(kj * block_k, block_q) if causal else 0
+    nq = q_len // block_q
+    if causal:
+        # first q block whose global rows reach this key block:
+        # q0 = floor(max(kj*block_k - seq_offset, 0) / block_q)
+        q0 = lax.div(jnp.maximum(kj * block_k - seq_offset, 0), block_q)
+    else:
+        q0 = 0
     z = jnp.zeros((block_k, d), jnp.float32)
     acc_dk, acc_dv = lax.fori_loop(q0, nq, body, (z, z))
     # qs carried the scale, so acc_dk is dL/dk exactly
@@ -334,52 +374,56 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
     dv_ref[0] = acc_dv.astype(dv_ref.dtype)
 
 
-def _flash_backward(q, k, v, out, lse, g, causal, scale, interpret):
+def _flash_backward(q, k, v, out, lse, g, causal, scale, interpret,
+                    seq_offset=0):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
-    b, h, t, d = q.shape
-    block_q = _pick_block(t)
-    block_k = _pick_block(t)
-    qr = q.reshape(b * h, t, d)
-    kr = k.reshape(b * h, t, d)
-    vr = v.reshape(b * h, t, d)
-    gr = g.reshape(b * h, t, d)
-    outr = out.reshape(b * h, t, d)
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    block_q = _pick_block(tq)
+    block_k = _pick_block(tk)
+    qr = q.reshape(b * h, tq, d)
+    kr = k.reshape(b * h, tk, d)
+    vr = v.reshape(b * h, tk, d)
+    gr = g.reshape(b * h, tq, d)
+    outr = out.reshape(b * h, tq, d)
     # delta_i = sum_d dO_i . O_i — one fused elementwise+reduce in XLA;
-    # carried at the lse layout (bh, T//bq, bq), see the fwd kernel
+    # carried at the lse layout (bh, Tq//bq, bq), see the fwd kernel
     delta = jnp.sum(gr.astype(jnp.float32) * outr.astype(jnp.float32),
-                    axis=-1).reshape(b * h, t // block_q, block_q)
+                    axis=-1).reshape(b * h, tq // block_q, block_q)
 
-    lse_spec = pl.BlockSpec((1, t // block_q, block_q),
+    lse_spec = pl.BlockSpec((1, tq // block_q, block_q),
                             lambda i, j: (i, 0, 0))
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, block_k=block_k,
-                          scale=scale, causal=causal, seq_len=t),
-        grid=(b * h, t // block_q),
+                          scale=scale, causal=causal, seq_len=tk,
+                          seq_offset=seq_offset),
+        grid=(b * h, tq // block_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
             lse_spec,
             lse_spec,
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
         interpret=interpret,
     )(qr, kr, vr, gr, lse, delta)
 
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, block_q=block_q,
-                          scale=scale, causal=causal, seq_len=t),
-        grid=(b * h, t // block_k),
+                          scale=scale, causal=causal, q_len=tq,
+                          seq_offset=seq_offset),
+        grid=(b * h, tk // block_k),
         in_specs=[
-            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, tq, d), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, tq, d), lambda i, j: (i, 0, 0)),
             lse_spec,
             lse_spec,
         ],
@@ -388,23 +432,23 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, interpret):
             pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, t, d), k.dtype),
-            jax.ShapeDtypeStruct((b * h, t, d), v.dtype),
+            jax.ShapeDtypeStruct((b * h, tk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, tk, d), v.dtype),
         ],
         interpret=interpret,
     )(qr, kr, vr, gr, lse, delta)
 
-    shape = (b, h, t, d)
-    return dq.reshape(shape), dk.reshape(shape), dv.reshape(shape)
+    return (dq.reshape(b, h, tq, d), dk.reshape(b, h, tk, d),
+            dv.reshape(b, h, tk, d))
 
 
-def _flash_fwd_rule(q, k, v, causal, scale, interpret):
+def _flash_fwd_rule(q, k, v, causal, scale, interpret, seq_offset):
     out, lse = _flash_forward(q, k, v, causal, scale, interpret,
-                              with_lse=True)
+                              with_lse=True, seq_offset=seq_offset)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd_rule(causal, scale, interpret, res, g):
+def _flash_bwd_rule(causal, scale, interpret, seq_offset, res, g):
     import jax
 
     q, k, v, out, lse = res
@@ -413,11 +457,13 @@ def _flash_bwd_rule(causal, scale, interpret, res, g):
         # recompute its vjp the same way
         def ref(q, k, v):
             return _reference_attention(q, k, v, causal=causal,
-                                        scale=scale)
+                                        scale=scale,
+                                        seq_offset=seq_offset)
 
         _, vjp = jax.vjp(ref, q, k, v)
         return vjp(g)
-    return _flash_backward(q, k, v, out, lse, g, causal, scale, interpret)
+    return _flash_backward(q, k, v, out, lse, g, causal, scale,
+                           interpret, seq_offset)
 
 
 _flash_attention_vjp.defvjp(_flash_fwd_rule, _flash_bwd_rule)
@@ -447,10 +493,15 @@ def dot_product_attention(q, k, v, *, causal: bool = False, mask=None,
     t = q.shape[-2]
     if impl == "auto":
         on_tpu = jax.default_backend() == "tpu"
+        tk = k.shape[-2]
         tiles = (
-            mask is None and seq_offset == 0
-            and q.shape == k.shape == v.shape
+            mask is None
+            and k.shape == v.shape and q.shape[:2] == k.shape[:2]
+            and q.shape[-1] == k.shape[-1]
             and t >= 128 and t % 128 == 0
+            and tk >= 128 and tk % 128 == 0
+            and isinstance(seq_offset, int) and seq_offset >= 0
+            and _kv_fits_vmem(tk, q.shape[-1], k.dtype)
         )
         # Measured on the 2026-07 toolchain (TransformerLM train step,
         # TPU v5 lite, ms/step): XLA's fused attention beats the Pallas
@@ -463,15 +514,25 @@ def dot_product_attention(q, k, v, *, causal: bool = False, mask=None,
         # and its blockwise backward kernels rebuild score tiles from
         # the logsumexp, so no (T, T) array exists in either direction.
         # So auto prefers lax until the quadratic-residual regime and
-        # flips to the kernel there.
-        impl = "pallas" if (on_tpu and tiles and t >= 4096) else "lax"
+        # flips to the kernel there.  The residual is (B, H, Tq, Tk),
+        # so the flip watches the PRODUCT — a 2048-query chunk against
+        # a 32k kv is deep in the cliff even though Tq is small.
+        impl = ("pallas" if (on_tpu and tiles and t * tk >= 4096 * 4096)
+                else "lax")
     if impl in ("pallas", "pallas_interpret"):
-        if mask is not None or seq_offset:
+        if mask is not None:
             raise ValueError(
-                "the Pallas flash kernel supports neither an explicit mask "
-                "nor seq_offset; use impl='lax' (ring attention does)"
+                "the Pallas flash kernel has no explicit-mask support; "
+                "use impl='lax'"
+            )
+        if not isinstance(seq_offset, int):
+            raise ValueError(
+                "the Pallas flash kernel needs a STATIC (python int) "
+                "seq_offset; traced offsets (ring attention's hops) "
+                "use impl='lax'"
             )
         return flash_attention(q, k, v, causal=causal, scale=scale,
-                               interpret=(impl == "pallas_interpret"))
+                               interpret=(impl == "pallas_interpret"),
+                               seq_offset=seq_offset)
     return _reference_attention(q, k, v, causal=causal, scale=scale,
                                 mask=mask, seq_offset=seq_offset)

@@ -176,3 +176,53 @@ def test_flash_forward_lse_matches_reference_logsumexp():
     want = jax.scipy.special.logsumexp(s, axis=-1).reshape(b * h, -1)
     got = np.asarray(lse).reshape(b * h, -1)
     np.testing.assert_allclose(got, np.asarray(want), atol=1e-4, rtol=1e-4)
+
+
+def test_flash_chunked_seq_offset_matches_full():
+    # chunked causal attention: two query chunks at static seq_offsets
+    # against the full kv must reproduce the full causal pass, forward
+    # and per-argument gradients (the long-context chunked-training
+    # surface of the flash kernels)
+    rs = np.random.RandomState(21)
+    B, H, Tk, D = 1, 2, 256, 16
+    k = jnp.asarray(rs.randn(B, H, Tk, D).astype(np.float32) * 0.5)
+    v = jnp.asarray(rs.randn(B, H, Tk, D).astype(np.float32))
+    q = jnp.asarray(rs.randn(B, H, Tk, D).astype(np.float32) * 0.5)
+    g = jnp.asarray(rs.randn(B, H, Tk, D).astype(np.float32))
+
+    full = flash_attention(q, k, v, causal=True, interpret=True)
+    chunks = [
+        flash_attention(q[:, :, i:i + 128], k, v, causal=True,
+                        interpret=True, seq_offset=i)
+        for i in (0, 128)
+    ]
+    np.testing.assert_allclose(np.asarray(jnp.concatenate(chunks, axis=2)),
+                               np.asarray(full), atol=1e-5)
+
+    q1, g1 = q[:, :, 128:], g[:, :, 128:]
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True,
+                                       interpret=True, seq_offset=128) * g1)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_reference_attention(
+            q, k, v, causal=True, scale=16 ** -0.5, seq_offset=128) * g1)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q1, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q1, k, v)
+    for name, a, b in zip("qkv", gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4,
+                                   err_msg=f"d{name}")
+
+
+def test_flash_cross_length_non_causal():
+    # Tq != Tk (cross-attention shape) on the kernel path
+    rs = np.random.RandomState(22)
+    q = jnp.asarray(rs.randn(1, 2, 64, 16).astype(np.float32))
+    k = jnp.asarray(rs.randn(1, 2, 256, 16).astype(np.float32))
+    v = jnp.asarray(rs.randn(1, 2, 256, 16).astype(np.float32))
+    out = flash_attention(q, k, v, causal=False, interpret=True)
+    ref = _reference_attention(q, k, v, causal=False, scale=16 ** -0.5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
