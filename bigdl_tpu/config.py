@@ -35,6 +35,11 @@ def _env_int(name: str, default: int) -> int:
     return default if v is None else int(v)
 
 
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    return default if v is None else float(v)
+
+
 def _env_str(name: str, default):
     return os.environ.get(name, default)
 
@@ -69,6 +74,28 @@ class BigDLConfig:
     # directory for a jax.profiler trace of the first optimizer steps
     profile_dir: Optional[str] = None
 
+    # --- resilience (resilience/ package) -------------------------------
+    # deterministic fault-injection plan for chaos tests, e.g.
+    # "step:3:raise,step:7:nan_grad,ckpt:1:truncate" [BIGDL_FAULT_PLAN]
+    fault_plan: Optional[str] = None
+    # classified-retry backoff: base * 2^(attempt-1), capped, with
+    # deterministic jitter [BIGDL_RETRY_BACKOFF_BASE / _MAX]
+    retry_backoff_base: float = 0.5
+    retry_backoff_max: float = 30.0
+    # sliding-window retry budget: more than `budget` transient failures
+    # inside `window` seconds stops retrying even if per-run attempts
+    # remain [BIGDL_RETRY_WINDOW_SECONDS / BIGDL_RETRY_WINDOW_BUDGET]
+    retry_window_seconds: float = 600.0
+    retry_window_budget: int = 16
+    # non-finite step guard: skip the weight update when grads/loss go
+    # NaN/inf; escalate after N consecutive skips
+    # [BIGDL_NONFINITE_GUARD / BIGDL_MAX_NONFINITE_SKIPS]
+    nonfinite_guard: bool = True
+    max_nonfinite_skips: int = 10
+    # checkpoint retention: keep the newest K checkpoint pairs, 0 =
+    # unlimited [BIGDL_CHECKPOINT_KEEP_LAST]
+    checkpoint_keep_last: int = 0
+
     # --- benchmarking [BENCH_* kept for bench.py compat] ----------------
 
     @classmethod
@@ -82,6 +109,15 @@ class BigDLConfig:
             disable_logger=_env_bool("BIGDL_DISABLE_LOGGER", False),
             log_path=_env_str("BIGDL_LOG_PATH", None),
             profile_dir=_env_str("BIGDL_PROFILE", None),
+            fault_plan=_env_str("BIGDL_FAULT_PLAN", None),
+            retry_backoff_base=_env_float("BIGDL_RETRY_BACKOFF_BASE", 0.5),
+            retry_backoff_max=_env_float("BIGDL_RETRY_BACKOFF_MAX", 30.0),
+            retry_window_seconds=_env_float(
+                "BIGDL_RETRY_WINDOW_SECONDS", 600.0),
+            retry_window_budget=_env_int("BIGDL_RETRY_WINDOW_BUDGET", 16),
+            nonfinite_guard=_env_bool("BIGDL_NONFINITE_GUARD", True),
+            max_nonfinite_skips=_env_int("BIGDL_MAX_NONFINITE_SKIPS", 10),
+            checkpoint_keep_last=_env_int("BIGDL_CHECKPOINT_KEEP_LAST", 0),
         )
 
     def describe(self) -> str:

@@ -68,6 +68,11 @@ class Engine:
         # launchers export BIGDL_* after import but before init — honor
         # them (read-at-call-time contract; configure() overrides win)
         refresh_from_env()
+        # same contract for the fault-injection plan: a BIGDL_FAULT_PLAN
+        # exported before init must be live before the first optimizer
+        from bigdl_tpu.resilience.faults import get_injector
+
+        get_injector()
         if cls._state.initialized and config.check_singleton:
             # bigdl.check.singleton analogue
             raise RuntimeError(
@@ -115,7 +120,11 @@ class Engine:
 
     @classmethod
     def reset(cls):
-        """Test hook: drop the singleton (no reference analogue)."""
+        """Test hook: drop the singleton (no reference analogue) and the
+        fault injector's fire-once counters with it."""
+        from bigdl_tpu.resilience.faults import reset_injector
+
+        reset_injector()
         cls._state = _EngineState()
 
     # ------------------------------------------------------------------ mesh

@@ -241,7 +241,10 @@ class DistriOptimizer(LocalOptimizer):
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
+        from bigdl_tpu.config import config
+
         jnp = _jnp()
+        guard = config.nonfinite_guard
         opt = self.optim_method
         clipper = self._clipper
         loss_fn = self._loss_fn(masked=masked)
@@ -336,6 +339,16 @@ class DistriOptimizer(LocalOptimizer):
                 # global norm via psum — matching L2NormClippingProcessor
                 sq = jax.lax.psum(jnp.sum(gshard * gshard), axis)
                 gshard = clipper(gshard, global_sq_norm=sq)
+            if guard:
+                # non-finite step guard: every replica must agree to
+                # skip or the all_gathered weights diverge — pmin of the
+                # local shard's finiteness is the global verdict
+                ok_local = jnp.all(jnp.isfinite(gshard)) \
+                    & jnp.isfinite(loss_aux)
+                ok = jax.lax.pmin(
+                    ok_local.astype(jnp.float32), axis) > 0
+            else:
+                ok = jnp.array(True)
             with jax.named_scope("optimizer_update"):
                 # ---- owner-slice weight update (ZeRO-1) -----------------
                 if isinstance(axis, tuple):
@@ -354,6 +367,15 @@ class DistriOptimizer(LocalOptimizer):
                     (shard_len,)
                 )
                 new_wshard, new_opt = opt.step(gshard, wshard, opt_st)
+                if guard:
+                    # skipped step: owner shard and opt state pass
+                    # through unchanged (graceful degradation — the
+                    # driver counts the skip and may escalate)
+                    new_wshard = jnp.where(ok, new_wshard, wshard)
+                    new_opt = jax.tree.map(
+                        lambda a, b: jnp.where(ok, a, b)
+                        if hasattr(a, "dtype") else a,
+                        new_opt, opt_st)
                 if frozen_intervals is not None:
                     # mask the UPDATE as well as the gradient: optimizer
                     # -internal weight decay adds wd*p past the zeroed
@@ -368,6 +390,13 @@ class DistriOptimizer(LocalOptimizer):
                 # ---- sendWeightPartition + getWeights -------------------
                 new_flat = jax.lax.all_gather(new_wshard, axis, tiled=True)
                 new_flat = new_flat[: flat_p.size]
+            if guard:
+                # a poisoned forward also poisons BN running stats —
+                # a skipped step must not keep NaN statistics either
+                new_mstate = jax.tree.map(
+                    lambda a, b: jnp.where(ok, a, b)
+                    if hasattr(a, "dtype") else a,
+                    new_mstate, mstate)
             # keep BN running stats in sync across replicas (the reference
             # leaves them per-replica; pmean is strictly better and free)
             new_mstate = jax.tree.map(
@@ -382,7 +411,7 @@ class DistriOptimizer(LocalOptimizer):
                 loss = jax.lax.psum(loss_aux, axis) / valid
             else:
                 loss = jax.lax.pmean(loss_aux, axis)
-            return new_flat, new_opt, new_mstate, loss
+            return new_flat, new_opt, new_mstate, loss, ok
 
         opt_state_specs = {k: P(axis) if v.ndim == 1 else P()
                            for k, v in opt.state.items()}
@@ -395,7 +424,7 @@ class DistriOptimizer(LocalOptimizer):
             sharded_step,
             self.mesh,
             in_specs=in_specs,
-            out_specs=(P(), opt_state_specs, mstate_spec, P()),
+            out_specs=(P(), opt_state_specs, mstate_spec, P(), P()),
         )
         # donate params/opt-state/model-state like LocalOptimizer: the
         # step updates in place on-device instead of holding two copies
@@ -527,22 +556,41 @@ class DistriOptimizer(LocalOptimizer):
 
     def optimize(self):
         # reference: retryNum < maxRetry => reload last checkpoint and
-        # continue (SURVEY.md §3.2 tail; §5 failure semantics)
+        # continue (SURVEY.md §3.2 tail; §5 failure semantics).  The
+        # blind retry became a classified policy (resilience/retry.py):
+        # fatal errors (bad config — ValueError/TypeError/…) surface on
+        # the FIRST attempt with zero checkpoint reloads; transient ones
+        # (XLA/OSError/injected faults/non-finite escalation) back off
+        # exponentially and reload the newest INTACT checkpoint.
         import logging
+        import time
+
+        from bigdl_tpu.resilience.retry import RetryPolicy, classify
 
         log = logging.getLogger("bigdl_tpu.optim")
-        retry = 0
+        policy = RetryPolicy.from_config(max_retries=self.max_retry)
         while True:
             try:
                 return super().optimize()
-            except Exception:
-                retry += 1
-                if retry > self.max_retry or not self.checkpoint_path:
+            except Exception as e:
+                if not self.checkpoint_path or classify(e) == "fatal":
+                    raise
+                delay = policy.record_failure(e)
+                if delay is None:
+                    log.error(
+                        "retry budget exhausted after %d transient "
+                        "failures; surfacing the last one", policy.attempts)
                     raise
                 log.exception(
-                    "training failed; retry %d/%d from last checkpoint",
-                    retry, self.max_retry,
+                    "transient training failure (%s); retry %d/%d from "
+                    "last intact checkpoint in %.2fs",
+                    type(e).__name__, policy.attempts, self.max_retry,
+                    delay,
                 )
+                self._summary_resilience(self.state["neval"],
+                                         retries=policy.attempts)
+                if delay > 0:
+                    time.sleep(delay)
                 from bigdl_tpu.utils.serializer import load_latest_checkpoint
 
                 extra = load_latest_checkpoint(

@@ -90,13 +90,22 @@ class BaseOptimizer:
         self.metrics = Metrics()
         self._clipper = _GradClipper()
         self.max_retry = 5
+        self.checkpoint_keep_last = 0
+        # background checkpoint-write failure accounting: the failure is
+        # recorded here and SURFACED on the next _checkpoint/optimize
+        # call instead of dying as a log line (resilience satellite)
+        self.checkpoint_write_failures = 0
+        self._ckpt_write_error = None
+        # non-finite step guard accounting
+        self._nonfinite_consec = 0
+        self._fault_injector = None
         # mixed-precision compute policy: None = full f32; "bfloat16"
         # runs fwd/bwd in bf16 with f32 master params + f32 grads/update
         # (the TPU-native recipe: MXU at 2x, normalizations stay f32)
         self.compute_dtype = None
         # reference: InternalOptimizerUtil state table
         self.state = {"epoch": 1, "neval": 1, "loss": None, "score": None,
-                      "epoch_finished": 0}
+                      "epoch_finished": 0, "nonfinite_skips": 0}
 
     # ---- fluent setters (camelCase parity aliases at the bottom) --------
     def set_optim_method(self, method):
@@ -115,18 +124,26 @@ class BaseOptimizer:
         self.validation_methods = methods
         return self
 
-    def set_checkpoint(self, path, trigger=None, background=False):
+    def set_checkpoint(self, path, trigger=None, background=False,
+                       keep_last=None):
         """``background=True`` writes checkpoints on a host thread: the
         synchronous part only captures device-array refs (immutable
         snapshot), so training resumes immediately while the
         device->host transfer and file IO happen off-thread.  At most
-        one write is in flight; the next trigger waits for it."""
+        one write is in flight; the next trigger waits for it.
+
+        ``keep_last=K`` keeps only the newest K checkpoint pairs on
+        disk (GC after each write); default from
+        ``config.checkpoint_keep_last``, 0 = unlimited."""
+        from bigdl_tpu.config import config
         from bigdl_tpu.optim.triggers import Trigger
 
         os.makedirs(path, exist_ok=True)
         self.checkpoint_path = path
         self.checkpoint_trigger = trigger or Trigger.every_epoch()
         self.checkpoint_background = background
+        self.checkpoint_keep_last = (config.checkpoint_keep_last
+                                     if keep_last is None else int(keep_last))
         return self
 
     def set_train_summary(self, summary):
@@ -168,9 +185,33 @@ class BaseOptimizer:
     setConstantGradientClipping = set_constant_gradient_clipping
 
     # ---- shared helpers -------------------------------------------------
+    def _summary_resilience(self, step, **counters):
+        """Feed resilience counters to the train summary when one is set
+        (guarded: user-supplied summary stubs may lack the method)."""
+        add = getattr(self.train_summary, "add_resilience", None)
+        if add is not None:
+            add(step, **counters)
+
+    def _raise_pending_ckpt_error(self):
+        """Surface a background checkpoint-write failure recorded by
+        ``_flush_checkpoints(raise_errors=False)`` — the next
+        ``_checkpoint``/``optimize`` call must fail loudly, not keep
+        training against a checkpoint sink that silently stopped
+        persisting."""
+        err = self._ckpt_write_error
+        if err is not None:
+            from bigdl_tpu.resilience.retry import CheckpointWriteError
+
+            self._ckpt_write_error = None
+            raise CheckpointWriteError(
+                f"a background checkpoint write failed earlier "
+                f"({self.checkpoint_write_failures} total write "
+                f"failures): {err!r}") from err
+
     def _checkpoint(self):
         if not self.checkpoint_path:
             return
+        self._raise_pending_ckpt_error()
         from bigdl_tpu.utils.serializer import (
             save_checkpoint,
             snapshot_checkpoint,
@@ -180,6 +221,7 @@ class BaseOptimizer:
         tag = f"{self.state['epoch']}_{self.state['neval']}"
         prefix = os.path.join(self.checkpoint_path, f"checkpoint_{tag}")
         extra = {"epoch": self.state["epoch"], "neval": self.state["neval"]}
+        keep = self.checkpoint_keep_last
         if getattr(self, "checkpoint_background", False):
             from concurrent.futures import ThreadPoolExecutor
 
@@ -191,29 +233,38 @@ class BaseOptimizer:
             snap = snapshot_checkpoint(self.model, self.optim_method,
                                        extra)
             self._ckpt_future = self._ckpt_executor.submit(
-                write_checkpoint, snap, prefix)
+                write_checkpoint, snap, prefix, keep)
             log.info("checkpoint scheduled at epoch %s iter %s",
                      self.state["epoch"], self.state["neval"])
             return
-        save_checkpoint(prefix, self.model, self.optim_method, extra)
+        save_checkpoint(prefix, self.model, self.optim_method, extra,
+                        keep_last=keep)
         log.info("checkpoint saved at epoch %s iter %s", self.state["epoch"],
                  self.state["neval"])
 
     def _flush_checkpoints(self, raise_errors: bool = True):
         """Wait for an in-flight background checkpoint write — called
         before reads of the checkpoint dir and at the end of
-        optimize().  ``raise_errors=False`` logs instead (used in the
-        exception-path finally, where raising would mask the original
-        error)."""
+        optimize().  ``raise_errors=False`` records the failure (next
+        ``_checkpoint``/``optimize`` call surfaces it) instead of
+        raising — used in the exception-path finally, where raising
+        would mask the original error."""
         fut = getattr(self, "_ckpt_future", None)
         if fut is not None:
             self._ckpt_future = None
             try:
                 fut.result()
-            except Exception:
+            except Exception as e:
+                self.checkpoint_write_failures += 1
+                self._summary_resilience(
+                    self.state["neval"],
+                    checkpoint_write_failures=self.checkpoint_write_failures)
                 if raise_errors:
                     raise
-                log.exception("background checkpoint write failed")
+                self._ckpt_write_error = e
+                log.exception("background checkpoint write failed "
+                              "(recorded; surfaces on the next "
+                              "checkpoint/optimize call)")
 
     def _prepare_batch(self, inp, tgt):
         """Hook: adjust a host batch before device transfer, or return
@@ -339,9 +390,13 @@ class LocalOptimizer(BaseOptimizer):
     def _build_train_step(self):
         import jax
 
+        from bigdl_tpu.config import config
+
+        jnp = _jnp()
         opt = self.optim_method
         clipper = self._clipper
         loss_fn = self._loss_fn()
+        guard = config.nonfinite_guard
         # freeze support (reference module.freeze): zero the gradients
         # of frozen subtrees — static at trace time, no cost unfrozen
         mask = self.model.grad_mask() if self.model.has_frozen() else None
@@ -366,7 +421,22 @@ class LocalOptimizer(BaseOptimizer):
                 new_p = jax.tree.map(
                     lambda old, new, s: old + s * (new - old),
                     p, new_p, mask)
-            return new_p, new_opt, new_mstate, loss
+            ok = jnp.array(True)
+            if guard:
+                # non-finite step guard: a NaN/inf gradient (or loss)
+                # must not be trained on — params/opt state/model state
+                # pass through unchanged and the driver counts the skip
+                ok = jnp.isfinite(loss)
+                for leaf in jax.tree.leaves(grad):
+                    ok = ok & jnp.all(jnp.isfinite(leaf))
+                keep = lambda new, old: jax.tree.map(
+                    lambda a, b: jnp.where(ok, a, b)
+                    if hasattr(a, "dtype") else a,
+                    new, old)
+                new_p = keep(new_p, p)
+                new_opt = keep(new_opt, opt_st)
+                new_mstate = keep(new_mstate, mstate)
+            return new_p, new_opt, new_mstate, loss, ok
 
         return train_step
 
@@ -376,6 +446,16 @@ class LocalOptimizer(BaseOptimizer):
 
     def optimize(self):
         import jax
+
+        from bigdl_tpu.resilience.faults import get_injector
+
+        # a background checkpoint write that failed in a previous
+        # optimize() (recorded by the exception-path flush) surfaces
+        # here, before any new work trusts the broken sink
+        self._raise_pending_ckpt_error()
+        inj = get_injector()
+        self._fault_injector = inj if inj.active else None
+        self._nonfinite_consec = 0
 
         model = self.model
         model.training()
@@ -427,6 +507,11 @@ class LocalOptimizer(BaseOptimizer):
                        stop, profiler):
         import jax
 
+        from bigdl_tpu.config import config
+        from bigdl_tpu.resilience.retry import NonFiniteStepError
+
+        max_nonfinite = config.max_nonfinite_skips
+
         # Async-dispatch pipelining: the device loss is read back ONE
         # iteration behind, so the next step is dispatched before the
         # host blocks — the device always has a step queued and the
@@ -445,9 +530,9 @@ class LocalOptimizer(BaseOptimizer):
                       self.checkpoint_trigger, _param_trig)
             if t is not None
         )
-        pending = []  # [(n, loss_device, batch_size, t_dispatch)]
+        pending = []  # [(n, loss_device, ok_device, batch_size, t_dispatch)]
 
-        def resolve(n, loss_dev, bs, t0):
+        def resolve(n, loss_dev, ok_dev, bs, t0):
             loss_val = float(loss_dev)
             # in pipelined steady state this spans dispatch -> observed
             # completion (~ device step time + one iteration's host work)
@@ -458,6 +543,25 @@ class LocalOptimizer(BaseOptimizer):
                 self.train_summary.add_scalar(
                     "Throughput",
                     bs / max(1e-9, time.perf_counter() - t0), n)
+            if not bool(ok_dev):
+                # non-finite grads/loss: the guarded step already passed
+                # weights/opt-state through unchanged — count the skip,
+                # escalate after max_nonfinite consecutive ones
+                self.state["nonfinite_skips"] += 1
+                self._nonfinite_consec += 1
+                log.warning(
+                    "non-finite grads/loss at iter %d (loss=%r) — update "
+                    "skipped (%d consecutive, %d total)", n, loss_val,
+                    self._nonfinite_consec, self.state["nonfinite_skips"])
+                self._summary_resilience(
+                    n, nonfinite_skips=self.state["nonfinite_skips"])
+                if self._nonfinite_consec >= max_nonfinite:
+                    raise NonFiniteStepError(
+                        f"{self._nonfinite_consec} consecutive non-finite "
+                        f"training steps (iter {n}): diverged or poisoned "
+                        "input — escalating to the retry policy")
+            else:
+                self._nonfinite_consec = 0
             if n % 20 == 0:
                 log.info(
                     "Epoch %d iter %d loss %.5f (%.1f records/s)",
@@ -496,24 +600,31 @@ class LocalOptimizer(BaseOptimizer):
                 if prepared is None:
                     continue  # dropped (e.g. sub-mesh partial batch)
                 inp, tgt = prepared
+                if self._fault_injector is not None:
+                    # chaos hook: may raise InjectedFault (transient) or
+                    # poison this batch to exercise the non-finite guard
+                    action = self._fault_injector.on_step(
+                        self.state["neval"])
+                    if action == "nan_grad":
+                        inp = self._fault_injector.poison_batch(inp)
                 profiler.step()
                 rng = jax.random.fold_in(base_key, self.state["neval"])
                 with self.metrics.timer("put batch time"):
                     inp_d, tgt_d = self._put_batch(inp, tgt)
                 t0 = time.perf_counter()
-                pvar, opt_state, mod_state, loss = train_step(
+                pvar, opt_state, mod_state, loss, ok = train_step(
                     pvar, opt_state, mod_state, rng, inp_d, tgt_d
                 )
                 n = self.state["neval"]
                 bs = np.asarray(inp).shape[0]
                 records_total += bs
                 if sync_per_step:
-                    resolve(n, loss, bs, t0)
+                    resolve(n, loss, ok, bs, t0)
                 else:
                     # the step is dispatched; reading back the PREVIOUS
                     # loss now lets the device run two-deep
                     flush_pending()
-                    pending.append((n, loss, bs, t0))
+                    pending.append((n, loss, ok, bs, t0))
                 if self.train_summary is not None:
                     # histograms stay on the synchronous path: pvar here
                     # IS step n's output and neval is still n, so the

@@ -1,0 +1,46 @@
+"""bigdl_tpu.resilience — fault tolerance for the training stack.
+
+The reference BigDL leaned on Spark for every failure mode: task retry,
+executor loss, driver ``retryNum < maxRetry`` checkpoint reload
+(SURVEY.md §3.2/§5).  The TPU rebuild owns those semantics itself:
+
+* :mod:`~bigdl_tpu.resilience.faults` — deterministic fault injection
+  (``BIGDL_FAULT_PLAN``) so every recovery path runs in CI on CPU
+* :mod:`~bigdl_tpu.resilience.retry` — transient/fatal error
+  classification + exponential backoff with a sliding-window budget
+* checkpoint integrity lives in ``bigdl_tpu/utils/serializer.py``
+  (manifest checksums, verify-on-load, newest-intact fallback,
+  keep-last-K rotation)
+* the non-finite step guard lives in the jitted train steps
+  (``optim/optimizer.py`` / ``optim/distri_optimizer.py``)
+"""
+
+from bigdl_tpu.resilience.faults import (
+    Fault,
+    FaultInjector,
+    FaultPlan,
+    InjectedFault,
+    get_injector,
+    reset_injector,
+)
+from bigdl_tpu.resilience.retry import (
+    CheckpointWriteError,
+    FATAL_TYPES,
+    NonFiniteStepError,
+    RetryPolicy,
+    classify,
+)
+
+__all__ = [
+    "CheckpointWriteError",
+    "FATAL_TYPES",
+    "Fault",
+    "FaultInjector",
+    "FaultPlan",
+    "InjectedFault",
+    "NonFiniteStepError",
+    "RetryPolicy",
+    "classify",
+    "get_injector",
+    "reset_injector",
+]

@@ -1,0 +1,204 @@
+"""Deterministic fault injection — the chaos half of the resilience layer.
+
+The reference inherits its failure model from Spark: executors die, tasks
+are re-run, the driver reloads the last checkpoint when
+``retryNum < maxRetry`` («bigdl»/optim/DistriOptimizer.scala tail,
+SURVEY.md §3.2/§5).  None of that is exercisable on demand — you wait for
+a preemption.  The rebuild makes every recovery path a *unit test*: a
+config/env-driven fault plan
+
+    BIGDL_FAULT_PLAN="step:3:raise,step:7:nan_grad,ckpt:1:truncate"
+
+injects failures at exact, reproducible points:
+
+* ``step:N:raise``     — raise :class:`InjectedFault` (classified
+  transient) before dispatching training iteration ``neval == N``
+* ``step:N:nan_grad``  — poison iteration N's input batch with NaN so
+  the gradients go non-finite (exercises the non-finite step guard)
+* ``ckpt:K:truncate``  — truncate the K-th checkpoint write's
+  ``.model.npz`` to half its size (torn write / crashed host)
+* ``ckpt:K:corrupt``   — flip bytes in the middle of the K-th write's
+  ``.model.npz`` (bit rot the checksum manifest must catch)
+* ``ckpt:K:delete``    — delete the K-th write's ``.model.npz``
+* ``ckpt:K:drop_optim``— delete the K-th write's ``.optim.npz`` (a
+  checkpoint missing its optimizer pair is not intact)
+
+Every fault fires exactly once per injector lifetime: the retry path
+replays the same ``neval`` range after reloading a checkpoint and must
+not re-trip the fault it is recovering from (deterministic chaos, not a
+crash loop).  Counters survive across retries inside one process;
+``Engine.reset()`` / :func:`reset_injector` start a fresh plan.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+from typing import List, Optional
+
+import numpy as np
+
+log = logging.getLogger("bigdl_tpu.resilience")
+
+_STEP_ACTIONS = ("raise", "nan_grad")
+_CKPT_ACTIONS = ("truncate", "corrupt", "delete", "drop_optim")
+
+
+class InjectedFault(RuntimeError):
+    """A deliberately injected transient failure (retry-classified as
+    transient — the whole point is to drive the recovery path)."""
+
+
+@dataclasses.dataclass
+class Fault:
+    site: str      # "step" | "ckpt"
+    index: int     # step: the neval it fires at; ckpt: 1-based write count
+    action: str
+    fired: bool = False
+
+
+class FaultPlan:
+    """Parsed, validated fault plan (see module docstring for syntax)."""
+
+    def __init__(self, faults: Optional[List[Fault]] = None):
+        self.faults = list(faults or [])
+
+    @classmethod
+    def parse(cls, spec: Optional[str]) -> "FaultPlan":
+        faults = []
+        for part in (spec or "").split(","):
+            part = part.strip()
+            if not part:
+                continue
+            fields = part.split(":")
+            if len(fields) != 3:
+                raise ValueError(
+                    f"bad fault spec {part!r}: want site:index:action, "
+                    f"e.g. 'step:3:raise' (full plan: {spec!r})")
+            site, idx, action = fields
+            if site not in ("step", "ckpt"):
+                raise ValueError(
+                    f"bad fault site {site!r} in {part!r}: "
+                    "want 'step' or 'ckpt'")
+            try:
+                index = int(idx)
+            except ValueError:
+                raise ValueError(
+                    f"bad fault index {idx!r} in {part!r}: want an int")
+            allowed = _STEP_ACTIONS if site == "step" else _CKPT_ACTIONS
+            if action not in allowed:
+                raise ValueError(
+                    f"bad fault action {action!r} for site {site!r} in "
+                    f"{part!r}: want one of {allowed}")
+            faults.append(Fault(site, index, action))
+        return cls(faults)
+
+    def __bool__(self):
+        return bool(self.faults)
+
+
+class FaultInjector:
+    """Stateful executor of a :class:`FaultPlan`.
+
+    Hook points: the optimizer step dispatch calls :meth:`on_step` with
+    the iteration counter; ``write_checkpoint`` calls
+    :meth:`on_checkpoint_write` after the files are durable (so the
+    corruption models post-write damage the integrity manifest must
+    catch, not a failed write).
+    """
+
+    def __init__(self, plan: FaultPlan):
+        self.plan = plan
+        self._step_faults = [f for f in plan.faults if f.site == "step"]
+        self._ckpt_faults = [f for f in plan.faults if f.site == "ckpt"]
+        self.ckpt_writes = 0
+
+    @property
+    def active(self) -> bool:
+        return bool(self.plan)
+
+    # ------------------------------------------------------------- step site
+    def on_step(self, neval: int) -> Optional[str]:
+        """Called before dispatching iteration ``neval``.  Raises
+        :class:`InjectedFault` for a ``raise`` fault; returns the action
+        name for batch-level faults (``nan_grad``) the caller applies;
+        returns None when nothing fires."""
+        for f in self._step_faults:
+            if not f.fired and f.index == neval:
+                f.fired = True
+                log.warning("fault injection: %s at step %d", f.action,
+                            neval)
+                if f.action == "raise":
+                    raise InjectedFault(
+                        f"injected fault at training step {neval}")
+                return f.action
+        return None
+
+    @staticmethod
+    def poison_batch(inp):
+        """``nan_grad``: replace the input batch with NaN so the step's
+        gradients (and loss) go non-finite."""
+        a = np.asarray(inp, dtype=np.float32)
+        return np.full_like(a, np.nan)
+
+    # ------------------------------------------------------------- ckpt site
+    def on_checkpoint_write(self, path_prefix: str):
+        """Called after the ``path_prefix`` checkpoint pair (and its
+        manifest) hit disk; applies any ckpt fault whose 1-based write
+        index matches."""
+        self.ckpt_writes += 1
+        for f in self._ckpt_faults:
+            if not f.fired and f.index == self.ckpt_writes:
+                f.fired = True
+                log.warning("fault injection: %s on checkpoint write #%d "
+                            "(%s)", f.action, self.ckpt_writes, path_prefix)
+                self._apply_ckpt_fault(f.action, path_prefix)
+
+    @staticmethod
+    def _apply_ckpt_fault(action: str, path_prefix: str):
+        model_path = path_prefix + ".model.npz"
+        optim_path = path_prefix + ".optim.npz"
+        if action == "truncate":
+            size = os.path.getsize(model_path)
+            os.truncate(model_path, size // 2)
+        elif action == "corrupt":
+            size = os.path.getsize(model_path)
+            with open(model_path, "r+b") as fh:
+                fh.seek(size // 2)
+                chunk = bytearray(fh.read(64))
+                fh.seek(size // 2)
+                fh.write(bytes(b ^ 0xFF for b in chunk))
+        elif action == "delete":
+            os.remove(model_path)
+        elif action == "drop_optim":
+            if os.path.exists(optim_path):
+                os.remove(optim_path)
+
+
+# -------------------------------------------------------- process singleton
+_injector: Optional[FaultInjector] = None
+_plan_str: Optional[str] = None
+
+
+def get_injector() -> FaultInjector:
+    """The process-global injector, built from ``config.fault_plan``
+    (env ``BIGDL_FAULT_PLAN``, read-at-call-time like Engine.init) and
+    rebuilt whenever the plan string changes.  Fire-once state lives
+    here so it survives optimizer retries within one plan."""
+    global _injector, _plan_str
+    from bigdl_tpu.config import refresh_from_env
+
+    spec = refresh_from_env().fault_plan or ""
+    if _injector is None or spec != _plan_str:
+        _plan_str = spec
+        _injector = FaultInjector(FaultPlan.parse(spec))
+    return _injector
+
+
+def reset_injector():
+    """Drop the global injector (fresh fire-once counters); the next
+    :func:`get_injector` rebuilds from the current config."""
+    global _injector, _plan_str
+    _injector = None
+    _plan_str = None

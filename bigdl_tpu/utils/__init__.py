@@ -6,11 +6,14 @@ TensorflowSaver (tf), File.loadTorch/saveTorch (torch_file).
 """
 
 from bigdl_tpu.utils.serializer import (
+    CheckpointIntegrityError,
+    gc_checkpoints,
     load_checkpoint,
     load_latest_checkpoint,
     load_module,
     save_checkpoint,
     save_module,
+    verify_checkpoint,
 )
 from bigdl_tpu.utils.caffe import (
     CaffeLoader,
@@ -30,8 +33,9 @@ from bigdl_tpu.utils.torch_file import (
 )
 
 __all__ = [
+    "CheckpointIntegrityError", "gc_checkpoints",
     "load_checkpoint", "load_latest_checkpoint", "load_module",
-    "save_checkpoint", "save_module",
+    "save_checkpoint", "save_module", "verify_checkpoint",
     "CaffeLoader", "CaffePersister", "load_caffe_model", "load_caffe_weights",
     "TensorflowLoader", "TensorflowSaver", "load_tf",
     "load_t7", "load_torch_module", "save_t7",

@@ -16,12 +16,20 @@ per-layer ``ModuleSerializable`` converter.
 
 from __future__ import annotations
 
+import hashlib
 import io
 import json
+import logging
 import os
 from typing import Dict
 
 import numpy as np
+
+log = logging.getLogger("bigdl_tpu.serializer")
+
+
+class CheckpointIntegrityError(RuntimeError):
+    """No intact checkpoint could be found/loaded from a directory."""
 
 from bigdl_tpu.nn.module import AbstractModule, Container, Sequential
 from bigdl_tpu.nn.graph import Graph, Node, _InputModule
@@ -241,21 +249,149 @@ def snapshot_checkpoint(model, optim_method=None, extra: dict = None):
     return snap
 
 
+def _fsync_dir(directory: str):
+    """fsync a directory so a completed rename survives a host crash
+    (no-op where directories cannot be opened, e.g. Windows)."""
+    try:
+        fd = os.open(directory or ".", os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
 def _atomic_savez(path: str, arrays: dict):
-    """np.savez via tmp + rename so readers (retry-from-checkpoint)
-    never see a torn file."""
+    """np.savez via tmp + fsync + rename so readers (retry-from-
+    checkpoint) never see a torn file AND a host crash cannot leave a
+    renamed-but-empty file: the data must be durable before the rename,
+    and the rename itself durable via the directory fsync."""
     if not path.endswith(".npz"):
         path = path + ".npz"
     tmp = path + ".tmp.npz"
-    np.savez(tmp, **arrays)
+    with open(tmp, "wb") as fh:
+        np.savez(fh, **arrays)
+        fh.flush()
+        os.fsync(fh.fileno())
     os.replace(tmp, path)
+    _fsync_dir(os.path.dirname(path))
     return path
 
 
-def write_checkpoint(snap: dict, path_prefix: str):
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as fh:
+        for chunk in iter(lambda: fh.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+_CKPT_SUFFIXES = (".model.npz", ".optim.npz")
+
+
+def write_manifest(path_prefix: str) -> str:
+    """Record size + sha256 of every file in the ``path_prefix``
+    checkpoint pair so verify-on-load can tell torn/rotted checkpoints
+    from intact ones.  Written atomically AFTER the pair is durable —
+    a crash between pair and manifest degrades to the legacy
+    no-manifest check, never to a manifest blessing garbage."""
+    files = {}
+    for suffix in _CKPT_SUFFIXES:
+        p = path_prefix + suffix
+        if os.path.exists(p):
+            files[os.path.basename(p)] = {
+                "size": os.path.getsize(p),
+                "sha256": _sha256(p),
+            }
+    manifest_path = path_prefix + ".manifest.json"
+    tmp = manifest_path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump({"format": 1, "files": files}, fh)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, manifest_path)
+    _fsync_dir(os.path.dirname(manifest_path))
+    return manifest_path
+
+
+def verify_checkpoint(path_prefix: str):
+    """Integrity check for one checkpoint pair.  Returns ``(ok,
+    reason)``.  With a manifest: every recorded file must exist with
+    matching size and sha256 (a recorded-but-missing ``.optim`` pair
+    fails the check).  Without one (legacy writer): the model npz must
+    at least open as a zip container."""
+    model_path = path_prefix + ".model.npz"
+    if not os.path.exists(model_path):
+        return False, "missing .model.npz"
+    manifest_path = path_prefix + ".manifest.json"
+    if os.path.exists(manifest_path):
+        try:
+            with open(manifest_path, "r", encoding="utf-8") as fh:
+                manifest = json.load(fh)
+            files = manifest["files"]
+        except Exception as e:  # noqa: BLE001 — any unreadable manifest
+            return False, f"unreadable manifest: {e}"
+        directory = os.path.dirname(path_prefix)
+        for name, rec in files.items():
+            p = os.path.join(directory, name)
+            if not os.path.exists(p):
+                return False, f"missing {name}"
+            if os.path.getsize(p) != rec["size"]:
+                return False, (f"{name}: size {os.path.getsize(p)} != "
+                               f"recorded {rec['size']}")
+            if _sha256(p) != rec["sha256"]:
+                return False, f"{name}: checksum mismatch"
+        return True, "ok"
+    try:
+        with np.load(model_path) as data:
+            data.files  # zip central directory read — catches truncation
+    except Exception as e:  # noqa: BLE001 — any unreadable container
+        return False, f"unreadable .model.npz: {e}"
+    return True, "ok (no manifest)"
+
+
+def checkpoint_prefixes(directory: str):
+    """Checkpoint prefixes in ``directory``, oldest first by model-file
+    mtime."""
+    cands = [
+        f[: -len(".model.npz")]
+        for f in os.listdir(directory)
+        if f.endswith(".model.npz")
+    ]
+    cands.sort(key=lambda f: os.path.getmtime(
+        os.path.join(directory, f + ".model.npz")))
+    return cands
+
+
+def gc_checkpoints(directory: str, keep_last: int):
+    """Keep-last-K retention: delete every checkpoint pair (model +
+    optim + manifest + stale tmp files) older than the newest
+    ``keep_last`` prefixes.  ``keep_last <= 0`` keeps everything."""
+    if keep_last <= 0:
+        return []
+    doomed = checkpoint_prefixes(directory)[:-keep_last]
+    removed = []
+    for prefix in doomed:
+        for f in os.listdir(directory):
+            if f == prefix + ".manifest.json" or (
+                    f.startswith(prefix + ".") and ".npz" in f):
+                try:
+                    os.remove(os.path.join(directory, f))
+                    removed.append(f)
+                except OSError:
+                    pass  # concurrent GC / already gone
+    if removed:
+        log.info("checkpoint GC: removed %d files for %d old prefixes "
+                 "(keep_last=%d)", len(removed), len(doomed), keep_last)
+    return removed
+
+
+def write_checkpoint(snap: dict, path_prefix: str, keep_last: int = 0):
     """Materialize a :func:`snapshot_checkpoint` (device->host
-    transfers happen HERE — safe on a background thread) and write the
-    model/optim pair atomically."""
+    transfers happen HERE — safe on a background thread), write the
+    model/optim pair atomically + its integrity manifest, then apply
+    retention (``keep_last``) and any injected checkpoint fault."""
     arrays = _module_arrays(snap["spec"], snap["p_leaves"],
                             snap["s_leaves"])
     _atomic_savez(path_prefix + ".model", arrays)
@@ -270,15 +406,24 @@ def write_checkpoint(snap: dict, path_prefix: str):
             json.dumps(meta).encode("utf-8"), dtype=np.uint8
         )
         _atomic_savez(path_prefix + ".optim", opt_arrays)
+    write_manifest(path_prefix)
+    # chaos hook: post-write corruption the verify-on-load must catch
+    from bigdl_tpu.resilience.faults import get_injector
+
+    get_injector().on_checkpoint_write(path_prefix)
+    if keep_last:
+        gc_checkpoints(os.path.dirname(path_prefix) or ".", keep_last)
     return path_prefix
 
 
-def save_checkpoint(path_prefix: str, model, optim_method=None, extra: dict = None):
+def save_checkpoint(path_prefix: str, model, optim_method=None,
+                    extra: dict = None, keep_last: int = 0):
     """Reference: Optimizer.setCheckpoint cadence saves model +
     OptimMethod (with its internal state table: epoch/neval counters) so
     resume continues Triggers correctly (SURVEY.md §5)."""
     return write_checkpoint(
-        snapshot_checkpoint(model, optim_method, extra), path_prefix)
+        snapshot_checkpoint(model, optim_method, extra), path_prefix,
+        keep_last=keep_last)
 
 
 def load_checkpoint(path_prefix: str, model, optim_method=None) -> dict:
@@ -302,17 +447,34 @@ def load_checkpoint(path_prefix: str, model, optim_method=None) -> dict:
     return extra
 
 
-def load_latest_checkpoint(directory: str, model, optim_method=None) -> dict:
-    """Find the newest checkpoint_* pair in a checkpoint dir (reference:
-    DistriOptimizer retry reloads the last checkpoint)."""
-    cands = [
-        f[: -len(".model.npz")]
-        for f in os.listdir(directory)
-        if f.endswith(".model.npz")
-    ]
+def load_latest_checkpoint(directory: str, model, optim_method=None,
+                           verify: bool = True) -> dict:
+    """Load the newest *intact* checkpoint_* pair from a checkpoint dir
+    (reference: DistriOptimizer retry reloads the last checkpoint).
+
+    Candidates are tried newest-first; one that is truncated, corrupt,
+    or missing a manifest-recorded ``.optim`` pair is skipped with a
+    warning and the next-newest is tried — a torn write of the latest
+    checkpoint must cost one checkpoint interval, not the run.  Raises
+    :class:`CheckpointIntegrityError` when no candidate survives."""
+    cands = checkpoint_prefixes(directory)
     if not cands:
         raise FileNotFoundError(f"no checkpoints in {directory}")
-    cands.sort(
-        key=lambda f: os.path.getmtime(os.path.join(directory, f + ".model.npz"))
-    )
-    return load_checkpoint(os.path.join(directory, cands[-1]), model, optim_method)
+    failures = []
+    for name in reversed(cands):
+        prefix = os.path.join(directory, name)
+        if verify:
+            ok, reason = verify_checkpoint(prefix)
+            if not ok:
+                log.warning("skipping checkpoint %s: %s", name, reason)
+                failures.append(f"{name}: {reason}")
+                continue
+        try:
+            return load_checkpoint(prefix, model, optim_method)
+        except Exception as e:  # noqa: BLE001 — fall back to older pair
+            if not verify:
+                raise
+            log.warning("failed loading checkpoint %s: %s", name, e)
+            failures.append(f"{name}: load failed: {e}")
+    raise CheckpointIntegrityError(
+        f"no intact checkpoint in {directory}: " + "; ".join(failures))

@@ -189,12 +189,30 @@ class _Summary:
         self.writer.close()
 
 
+# resilience counters the optimizer loop emits (cumulative values):
+# non-finite skipped steps, transient-retry attempts, and background
+# checkpoint-write failures — read back with read_scalar(tag)
+RESILIENCE_TAGS = ("NonFiniteSkips", "RetryCount",
+                   "CheckpointWriteFailures")
+
+
 class TrainSummary(_Summary):
     """«bigdl»/visualization/TrainSummary.scala — loss/throughput/LR per
-    iteration; setSummaryTrigger enables parameter histograms."""
+    iteration; setSummaryTrigger enables parameter histograms.  The
+    resilience layer adds the ``RESILIENCE_TAGS`` scalar streams."""
 
     def __init__(self, log_dir: str, app_name: str):
         super().__init__(log_dir, app_name, "train")
+
+    def add_resilience(self, step: int, nonfinite_skips=None, retries=None,
+                       checkpoint_write_failures=None):
+        """Record the resilience counters that changed at ``step``."""
+        for tag, value in zip(RESILIENCE_TAGS,
+                              (nonfinite_skips, retries,
+                               checkpoint_write_failures)):
+            if value is not None:
+                self.add_scalar(tag, float(value), step)
+        return self
 
     def set_summary_trigger(self, name: str, trigger):
         """name in {"Parameters", "Loss", "Throughput", "LearningRate"}"""

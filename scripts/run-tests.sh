@@ -3,10 +3,20 @@
 # Forces the 8-virtual-device CPU backend the suite expects (the
 # reference's local[4]-Spark-master trick, SURVEY.md §4.5) and runs
 # pytest.  Usage: scripts/run-tests.sh [pytest args]
+#   scripts/run-tests.sh --chaos [pytest args]   # only the fault-injection
+#                                                # / recovery specs (-m chaos)
+# The chaos specs are deterministic and part of the default selection;
+# --chaos is the focused loop for hacking on the resilience layer.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
 export JAX_PLATFORMS=cpu
 
-exec python -m pytest tests/ -q "$@"
+MARKER=()
+if [[ "${1:-}" == "--chaos" ]]; then
+  shift
+  MARKER=(-m chaos)
+fi
+
+exec python -m pytest tests/ -q "${MARKER[@]}" "$@"

@@ -1,0 +1,494 @@
+"""Resilience specs — fault injection, classified retry, checkpoint
+integrity, and the non-finite step guard.
+
+The reference inherited all of this from Spark (task retry + driver
+``retryNum < maxRetry`` checkpoint reload, SURVEY.md §3.2/§5) and tested
+none of it deterministically.  Here every recovery path runs on CPU in
+CI, driven by ``BIGDL_FAULT_PLAN`` (resilience/faults.py): crash/resume
+equivalence, newest-intact checkpoint fallback, fatal-error
+classification, and NaN-step skip/escalation.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.engine import Engine
+from bigdl_tpu.dataset import ArrayDataSet
+from bigdl_tpu.nn import ClassNLLCriterion, Linear, LogSoftMax, ReLU, Sequential
+from bigdl_tpu.optim import DistriOptimizer, LocalOptimizer, SGD, Trigger
+from bigdl_tpu.resilience import (
+    CheckpointWriteError,
+    FaultInjector,
+    FaultPlan,
+    InjectedFault,
+    NonFiniteStepError,
+    RetryPolicy,
+    classify,
+    get_injector,
+    reset_injector,
+)
+from bigdl_tpu.utils.serializer import (
+    CheckpointIntegrityError,
+    gc_checkpoints,
+    load_latest_checkpoint,
+    save_checkpoint,
+    verify_checkpoint,
+)
+
+pytestmark = pytest.mark.chaos  # deterministic chaos — runs in tier-1
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    """Fresh injector + instant retries for every test."""
+    monkeypatch.delenv("BIGDL_FAULT_PLAN", raising=False)
+    monkeypatch.setenv("BIGDL_RETRY_BACKOFF_BASE", "0")
+    reset_injector()
+    yield
+    reset_injector()
+
+
+# ------------------------------------------------------------- fault plans
+class TestFaultPlan:
+    def test_parse(self):
+        plan = FaultPlan.parse(
+            "step:3:raise, step:7:nan_grad ,ckpt:1:truncate")
+        assert [(f.site, f.index, f.action) for f in plan.faults] == [
+            ("step", 3, "raise"), ("step", 7, "nan_grad"),
+            ("ckpt", 1, "truncate")]
+        assert not FaultPlan.parse(None)
+        assert not FaultPlan.parse("")
+
+    @pytest.mark.parametrize("bad", [
+        "step:3",               # missing action
+        "disk:1:raise",         # unknown site
+        "step:x:raise",         # non-int index
+        "step:3:explode",       # unknown step action
+        "ckpt:1:nan_grad",      # step action on ckpt site
+    ])
+    def test_parse_rejects(self, bad):
+        with pytest.raises(ValueError):
+            FaultPlan.parse(bad)
+
+    def test_step_faults_fire_once(self):
+        inj = FaultInjector(FaultPlan.parse("step:3:raise,step:7:nan_grad"))
+        assert inj.on_step(1) is None
+        with pytest.raises(InjectedFault):
+            inj.on_step(3)
+        # the retry path replays neval 3 — the fault must not re-trip
+        assert inj.on_step(3) is None
+        assert inj.on_step(7) == "nan_grad"
+        assert inj.on_step(7) is None
+
+    def test_injector_from_env(self, monkeypatch):
+        assert not get_injector().active
+        monkeypatch.setenv("BIGDL_FAULT_PLAN", "step:5:raise")
+        inj = get_injector()
+        assert inj.active
+        # same plan -> same injector (fire-once state survives)
+        assert get_injector() is inj
+        monkeypatch.setenv("BIGDL_FAULT_PLAN", "step:9:raise")
+        assert get_injector() is not inj
+
+
+# ----------------------------------------------------------- classification
+class TestClassify:
+    @pytest.mark.parametrize("exc,verdict", [
+        (ValueError("bad wire_dtype"), "fatal"),
+        (TypeError("x"), "fatal"),
+        (KeyError("x"), "fatal"),
+        (NotImplementedError("x"), "fatal"),
+        (CheckpointWriteError("x"), "fatal"),
+        (KeyboardInterrupt(), "fatal"),
+        (RuntimeError("xla"), "transient"),
+        (OSError("io"), "transient"),
+        (InjectedFault("x"), "transient"),
+        (NonFiniteStepError("x"), "transient"),
+        (Exception("unknown"), "transient"),
+    ])
+    def test_table(self, exc, verdict):
+        assert classify(exc) == verdict
+
+
+class TestRetryPolicy:
+    def test_backoff_doubles_and_caps(self):
+        p = RetryPolicy(max_retries=4, backoff_base=1.0, backoff_max=4.0,
+                        jitter=0.0)
+        delays = [p.record_failure(now=float(i)) for i in range(4)]
+        assert delays == [1.0, 2.0, 4.0, 4.0]
+        assert p.record_failure(now=5.0) is None  # attempts exhausted
+
+    def test_jitter_is_deterministic(self):
+        a = RetryPolicy(backoff_base=1.0, jitter=0.5, seed=3)
+        b = RetryPolicy(backoff_base=1.0, jitter=0.5, seed=3)
+        assert a.record_failure(now=0.0) == b.record_failure(now=0.0)
+
+    def test_sliding_window_budget(self):
+        p = RetryPolicy(max_retries=100, backoff_base=0.0, jitter=0.0,
+                        window_seconds=10.0, window_budget=2)
+        assert p.record_failure(now=0.0) == 0.0
+        assert p.record_failure(now=1.0) == 0.0
+        assert p.record_failure(now=2.0) is None  # 3 failures in 10s
+        # an old burst outside the window does not count
+        q = RetryPolicy(max_retries=100, backoff_base=0.0, jitter=0.0,
+                        window_seconds=10.0, window_budget=2)
+        q.record_failure(now=0.0)
+        q.record_failure(now=1.0)
+        assert q.record_failure(now=50.0) == 0.0
+
+
+# ----------------------------------------------------- checkpoint integrity
+def _ckpt(tmp_path, tag, epoch, neval, mtime=None):
+    prefix = os.path.join(str(tmp_path), f"checkpoint_{tag}")
+    save_checkpoint(prefix, Linear(4, 2), SGD(learningrate=0.1),
+                    extra={"epoch": epoch, "neval": neval})
+    if mtime is not None:
+        os.utime(prefix + ".model.npz", (mtime, mtime))
+    return prefix
+
+
+class TestCheckpointIntegrity:
+    def test_atomic_savez_fsyncs_file_and_dir(self, tmp_path, monkeypatch):
+        from bigdl_tpu.utils import serializer
+
+        real = os.fsync
+        calls = []
+
+        def counting(fd):
+            calls.append(fd)
+            return real(fd)
+
+        monkeypatch.setattr(os, "fsync", counting)
+        out = serializer._atomic_savez(
+            str(tmp_path / "a"), {"x": np.arange(3)})
+        assert out.endswith(".npz") and os.path.exists(out)
+        assert len(calls) >= 2  # tmp file + containing directory
+        assert not [f for f in os.listdir(tmp_path) if ".tmp" in f]
+
+    def test_manifest_verifies_intact_pair(self, tmp_path):
+        prefix = _ckpt(tmp_path, "1_1", 1, 1)
+        assert os.path.exists(prefix + ".manifest.json")
+        ok, reason = verify_checkpoint(prefix)
+        assert ok, reason
+
+    def test_verify_catches_truncation(self, tmp_path):
+        prefix = _ckpt(tmp_path, "1_1", 1, 1)
+        os.truncate(prefix + ".model.npz",
+                    os.path.getsize(prefix + ".model.npz") // 2)
+        ok, reason = verify_checkpoint(prefix)
+        assert not ok and "size" in reason
+
+    def test_verify_catches_bit_rot(self, tmp_path):
+        prefix = _ckpt(tmp_path, "1_1", 1, 1)
+        FaultInjector._apply_ckpt_fault("corrupt", prefix)
+        ok, reason = verify_checkpoint(prefix)
+        assert not ok and "checksum" in reason
+
+    def test_verify_catches_missing_optim_pair(self, tmp_path):
+        prefix = _ckpt(tmp_path, "1_1", 1, 1)
+        os.remove(prefix + ".optim.npz")
+        ok, reason = verify_checkpoint(prefix)
+        assert not ok and "optim" in reason
+
+    def test_verify_without_manifest(self, tmp_path):
+        prefix = _ckpt(tmp_path, "1_1", 1, 1)
+        os.remove(prefix + ".manifest.json")
+        ok, reason = verify_checkpoint(prefix)
+        assert ok
+        os.truncate(prefix + ".model.npz", 10)
+        ok, _ = verify_checkpoint(prefix)
+        assert not ok
+
+    def test_load_latest_falls_back_to_intact(self, tmp_path):
+        now = time.time()
+        _ckpt(tmp_path, "1_5", 1, 5, mtime=now - 20)
+        newest = _ckpt(tmp_path, "2_9", 2, 9, mtime=now)
+        os.truncate(newest + ".model.npz",
+                    os.path.getsize(newest + ".model.npz") // 2)
+        model, method = Linear(4, 2), SGD(learningrate=0.1)
+        extra = load_latest_checkpoint(str(tmp_path), model, method)
+        assert extra == {"epoch": 1, "neval": 5}
+
+    def test_load_latest_all_corrupt(self, tmp_path):
+        prefix = _ckpt(tmp_path, "1_1", 1, 1)
+        os.truncate(prefix + ".model.npz", 10)
+        with pytest.raises(CheckpointIntegrityError):
+            load_latest_checkpoint(str(tmp_path), Linear(4, 2))
+
+    def test_load_latest_empty_dir(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            load_latest_checkpoint(str(tmp_path), Linear(4, 2))
+
+    def test_gc_keeps_last_k(self, tmp_path):
+        now = time.time()
+        for i in range(4):
+            _ckpt(tmp_path, f"1_{i}", 1, i, mtime=now - 40 + 10 * i)
+        gc_checkpoints(str(tmp_path), keep_last=2)
+        kept = sorted(f for f in os.listdir(tmp_path)
+                      if f.endswith(".model.npz"))
+        assert kept == ["checkpoint_1_2.model.npz",
+                        "checkpoint_1_3.model.npz"]
+        # manifests of GC'd pairs are gone too
+        assert sorted(f for f in os.listdir(tmp_path)
+                      if f.endswith(".manifest.json")) == [
+            "checkpoint_1_2.manifest.json", "checkpoint_1_3.manifest.json"]
+
+
+# ------------------------------------------------- background write failure
+def _toy(n=256, d=16, k=4, seed=0):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(d, k)
+    x = rng.randn(n, d).astype(np.float32)
+    y = (np.argmax(x @ w, axis=1) + 1).astype(np.float32)
+    return x, y
+
+
+def _model(d=16, k=4):
+    return Sequential().add(Linear(d, 32)).add(ReLU()).add(Linear(32, k)) \
+        .add(LogSoftMax())
+
+
+class TestBackgroundWriteFailure:
+    def test_recorded_failure_surfaces_and_counts(self, tmp_path,
+                                                  monkeypatch):
+        import bigdl_tpu.utils.serializer as ser
+
+        x, y = _toy(64)
+        opt = LocalOptimizer(_model(), (x, y), ClassNLLCriterion(),
+                             batch_size=32)
+        opt.set_checkpoint(str(tmp_path), background=True)
+
+        def boom(snap, prefix, keep_last=0):
+            raise OSError("disk full")
+
+        monkeypatch.setattr(ser, "write_checkpoint", boom)
+        opt._checkpoint()                        # schedules the failing write
+        opt._flush_checkpoints(raise_errors=False)   # exception-path flush
+        assert opt.checkpoint_write_failures == 1
+        # the NEXT checkpoint call surfaces the recorded failure
+        with pytest.raises(CheckpointWriteError):
+            opt._checkpoint()
+        # ...and a failure recorded before optimize() surfaces there too
+        opt._checkpoint()
+        opt._flush_checkpoints(raise_errors=False)
+        assert opt.checkpoint_write_failures == 2
+        with pytest.raises(CheckpointWriteError):
+            opt.optimize()
+        opt._ckpt_executor.shutdown(wait=True)
+
+
+# --------------------------------------------------------- training chaos
+class _Tape:
+    """Train-summary stub: keeps the LAST loss recorded per step (the
+    retry path re-records replayed steps) plus resilience counters."""
+
+    def __init__(self):
+        self.loss = {}
+        self.resilience = {}
+
+    def add_scalar(self, tag, value, step):
+        if tag == "Loss":
+            self.loss[step] = float(value)
+
+    def add_histogram(self, *a, **k):
+        pass
+
+    def get_summary_trigger(self, name):
+        return None
+
+    def add_resilience(self, step, **counters):
+        for k, v in counters.items():
+            if v is not None:
+                self.resilience[k] = v
+
+
+@pytest.fixture
+def _engine():
+    Engine.reset()
+    Engine.init()
+    yield
+    Engine.reset()
+
+
+def _train_distri(ckpt_dir, plan, monkeypatch, epochs=3, lr=0.5):
+    """One deterministic DistriOptimizer run (8 iters/epoch, checkpoint
+    every epoch) under the given fault plan; returns (weights, tape)."""
+    from bigdl_tpu.common import RandomGenerator
+
+    if plan:
+        monkeypatch.setenv("BIGDL_FAULT_PLAN", plan)
+    else:
+        monkeypatch.delenv("BIGDL_FAULT_PLAN", raising=False)
+    reset_injector()
+    RandomGenerator.RNG.set_seed(7)
+    model = _model()
+    x, y = _toy(256)
+    ds = ArrayDataSet(x, y, 32, shuffle=False)
+    opt = DistriOptimizer(model, ds, ClassNLLCriterion(), batch_size=32,
+                          wire_dtype="none")
+    opt.set_optim_method(SGD(learningrate=lr))
+    opt.set_end_when(Trigger.max_epoch(epochs))
+    opt.set_checkpoint(str(ckpt_dir), Trigger.every_epoch())
+    tape = _Tape()
+    opt.set_train_summary(tape)
+    opt.optimize()
+    return [np.asarray(w) for w in model.get_weights()], tape
+
+
+class TestCrashResumeEquivalence:
+    def test_step_fault_resumes_with_identical_trajectory(
+            self, _engine, tmp_path, monkeypatch):
+        """ISSUE acceptance: an injected step exception is classified
+        transient, the retry policy reloads the epoch-1 checkpoint, and
+        the replayed run's loss trajectory and final weights match the
+        fault-free run from the same seed exactly."""
+        clean_w, clean_tape = _train_distri(
+            tmp_path / "clean", None, monkeypatch)
+        fault_w, fault_tape = _train_distri(
+            tmp_path / "fault", "step:12:raise", monkeypatch)
+        assert fault_tape.resilience.get("retries") == 1
+        assert clean_tape.loss.keys() == fault_tape.loss.keys()
+        for step in clean_tape.loss:
+            np.testing.assert_allclose(
+                fault_tape.loss[step], clean_tape.loss[step], rtol=1e-6,
+                err_msg=f"loss diverged at step {step}")
+        for a, b in zip(fault_w, clean_w):
+            np.testing.assert_allclose(a, b, rtol=1e-6)
+
+    def test_truncated_newest_checkpoint_falls_back(
+            self, _engine, tmp_path, monkeypatch):
+        """ISSUE acceptance: step exception at epoch 3 + the 2nd
+        checkpoint write truncated — recovery must skip the torn newest
+        checkpoint, reload the older intact one, and still reproduce the
+        fault-free trajectory."""
+        clean_w, clean_tape = _train_distri(
+            tmp_path / "clean", None, monkeypatch)
+        fault_w, fault_tape = _train_distri(
+            tmp_path / "fault", "step:20:raise,ckpt:2:truncate",
+            monkeypatch)
+        assert fault_tape.resilience.get("retries") == 1
+        for step in clean_tape.loss:
+            np.testing.assert_allclose(
+                fault_tape.loss[step], clean_tape.loss[step], rtol=1e-6,
+                err_msg=f"loss diverged at step {step}")
+        for a, b in zip(fault_w, clean_w):
+            np.testing.assert_allclose(a, b, rtol=1e-6)
+
+    def test_fatal_error_surfaces_with_zero_reloads(
+            self, _engine, tmp_path, monkeypatch):
+        """Regression (ISSUE satellite): a ValueError (bad config /
+        mismatched grad-mask) must NOT burn max_retry checkpoint
+        reloads — it surfaces on the first attempt."""
+        import bigdl_tpu.utils.serializer as ser
+
+        x, y = _toy(64)
+        opt = DistriOptimizer(_model(), (x, y), ClassNLLCriterion(),
+                              batch_size=32)
+        opt.set_checkpoint(str(tmp_path))
+        save_checkpoint(os.path.join(str(tmp_path), "checkpoint_1_1"),
+                        _model(), opt.optim_method,
+                        extra={"epoch": 1, "neval": 1})
+        reloads = []
+        monkeypatch.setattr(ser, "load_latest_checkpoint",
+                            lambda *a, **k: reloads.append(1) or {})
+
+        def bad_config():
+            raise ValueError("mismatched grad-mask")
+
+        monkeypatch.setattr(opt, "_build_train_step", bad_config)
+        with pytest.raises(ValueError, match="grad-mask"):
+            opt.optimize()
+        assert reloads == []
+
+    def test_transient_error_exhausts_budget_then_raises(
+            self, _engine, tmp_path, monkeypatch):
+        import bigdl_tpu.utils.serializer as ser
+
+        x, y = _toy(64)
+        opt = DistriOptimizer(_model(), (x, y), ClassNLLCriterion(),
+                              batch_size=32)
+        opt.max_retry = 2
+        opt.set_checkpoint(str(tmp_path))
+        reloads = []
+        monkeypatch.setattr(ser, "load_latest_checkpoint",
+                            lambda *a, **k: reloads.append(1) or {})
+
+        def flaky():
+            raise RuntimeError("xla hiccup")
+
+        monkeypatch.setattr(opt, "_build_train_step", flaky)
+        with pytest.raises(RuntimeError, match="xla hiccup"):
+            opt.optimize()
+        assert len(reloads) == 2  # retried exactly max_retry times
+
+
+class TestNonFiniteGuard:
+    def test_nan_step_is_skipped(self, _engine, monkeypatch):
+        """A poisoned batch must not move the weights: with the only
+        iteration NaN'd, the trained weights equal the initial ones."""
+        from bigdl_tpu.common import RandomGenerator
+
+        monkeypatch.setenv("BIGDL_FAULT_PLAN", "step:1:nan_grad")
+        reset_injector()
+        RandomGenerator.RNG.set_seed(5)
+        model = _model()
+        before = [np.array(w, copy=True) for w in model.get_weights()]
+        x, y = _toy(64)
+        opt = LocalOptimizer(model, (x, y), ClassNLLCriterion(),
+                             batch_size=32)
+        opt.set_optim_method(SGD(learningrate=0.5))
+        opt.set_end_when(Trigger.max_iteration(1))
+        opt.optimize()
+        assert opt.state["nonfinite_skips"] == 1
+        for a, b in zip(model.get_weights(), before):
+            np.testing.assert_array_equal(np.asarray(a), b)
+
+    def test_skip_then_recover(self, _engine, monkeypatch):
+        """One NaN iteration mid-run: skipped, counted, and training
+        continues to finite weights."""
+        monkeypatch.setenv("BIGDL_FAULT_PLAN", "step:2:nan_grad")
+        reset_injector()
+        x, y = _toy(128)
+        model = _model()
+        opt = LocalOptimizer(model, (x, y), ClassNLLCriterion(),
+                             batch_size=32)
+        opt.set_optim_method(SGD(learningrate=0.5))
+        opt.set_end_when(Trigger.max_epoch(2))
+        tape = _Tape()
+        opt.set_train_summary(tape)
+        opt.optimize()
+        assert opt.state["nonfinite_skips"] == 1
+        assert tape.resilience.get("nonfinite_skips") == 1
+        for w in model.get_weights():
+            assert np.all(np.isfinite(np.asarray(w)))
+
+    def test_consecutive_skips_escalate(self, _engine, monkeypatch):
+        monkeypatch.setenv("BIGDL_FAULT_PLAN",
+                           "step:1:nan_grad,step:2:nan_grad")
+        monkeypatch.setenv("BIGDL_MAX_NONFINITE_SKIPS", "2")
+        reset_injector()
+        x, y = _toy(128)
+        opt = LocalOptimizer(_model(), (x, y), ClassNLLCriterion(),
+                             batch_size=32)
+        opt.set_optim_method(SGD(learningrate=0.5))
+        opt.set_end_when(Trigger.max_epoch(1))
+        with pytest.raises(NonFiniteStepError):
+            opt.optimize()
+
+    def test_escalation_recovers_via_retry_policy(self, _engine, tmp_path,
+                                                  monkeypatch):
+        """DistriOptimizer: N consecutive NaN steps escalate to the
+        retry policy, which reloads the last checkpoint and completes
+        (the fired-once faults don't re-trip on replay)."""
+        monkeypatch.setenv("BIGDL_FAULT_PLAN",
+                           "step:10:nan_grad,step:11:nan_grad")
+        monkeypatch.setenv("BIGDL_MAX_NONFINITE_SKIPS", "2")
+        fault_w, tape = _train_distri(
+            tmp_path, "step:10:nan_grad,step:11:nan_grad", monkeypatch,
+            epochs=2)
+        assert tape.resilience.get("retries") == 1
+        for w in fault_w:
+            assert np.all(np.isfinite(w))
