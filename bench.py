@@ -254,9 +254,16 @@ def _timed_scan_throughput(step_fn, carry, x, y, batch, iters):
     overheads that would otherwise dominate; a single call with one
     scalar output measures pure device throughput for both contenders.
     ``float()`` on the result is the barrier (block_until_ready returns
-    early through the relay)."""
+    early through the relay).
+
+    Every segment also feeds the obs runtime profile (compile events
+    from the warmup call, per-step times into the reservoir) so the
+    BENCH JSON carries step-time percentiles + compile count — the
+    trajectory baseline future perf PRs diff against."""
     import jax
     import jax.lax as lax
+
+    from bigdl_tpu import obs
 
     @jax.jit
     def run(carry, x, y):
@@ -267,10 +274,13 @@ def _timed_scan_throughput(step_fn, carry, x, y, batch, iters):
         _, losses = lax.scan(body, carry, None, length=iters)
         return losses[-1]
 
-    float(run(carry, x, y))  # compile + warmup
+    runtime = obs.get_runtime()
+    run = obs.instrument_jit(run, "bench_scan", stats=runtime)
+    float(run(carry, x, y))  # compile + warmup (recorded: compile event)
     t0 = time.perf_counter()
     float(run(carry, x, y))
     dt = time.perf_counter() - t0
+    runtime.record_step(dt / iters)
     return batch * iters / dt, dt / iters
 
 
@@ -519,6 +529,27 @@ def _bench_lenet(platform_batch=256, iters=20):
 PARTIAL_MARK = "@@BENCH_PARTIAL@@"
 
 
+def _obs_runtime_extras():
+    """Step-time p50/p95/p99 + compile count from the obs runtime
+    reservoirs (fed by _timed_scan_throughput) — best-effort, a broken
+    obs layer must never sink the bench."""
+    try:
+        from bigdl_tpu import obs
+
+        snap = obs.get_runtime().snapshot(memory=False)
+        st = snap["step_time_s"]
+        return {
+            "step_time_p50_s": st["p50"],
+            "step_time_p95_s": st["p95"],
+            "step_time_p99_s": st["p99"],
+            "step_samples": st["count"],
+            "compile_count": snap["compile"]["count"],
+            "compile_total_s": snap["compile"]["total_s"],
+        }
+    except Exception:
+        return None
+
+
 def _child_platform_setup(platform: str):
     """Pin jax to the requested platform and return the device (may
     raise / hang — the parent's probe + deadline own that risk)."""
@@ -624,6 +655,7 @@ def _run_child(platform: str):
             "ptb_lstm_tokens_per_sec": None,
             "transformer_lm_tokens_per_sec": None,
             "dlframes_fit_transform_rows_per_sec": None,
+            "obs_runtime": None,
         },
         "error": None,
         "partial": True,
@@ -632,6 +664,7 @@ def _run_child(platform: str):
 
     def emit(segment):
         ex["completed_segments"].append(segment)
+        ex["obs_runtime"] = _obs_runtime_extras()
         print(PARTIAL_MARK + json.dumps(result), flush=True)
 
     def remaining():
@@ -765,6 +798,7 @@ def _run_child(platform: str):
         result["error"] = ("headline segments failed or truncated; "
                            "secondaries only")
         result["partial"] = False
+        ex["obs_runtime"] = _obs_runtime_extras()
         print(PARTIAL_MARK + json.dumps(result), flush=True)
         return
     batch = best[2]
@@ -821,6 +855,7 @@ def _run_child(platform: str):
         run_secondaries()
 
     result["partial"] = False
+    ex["obs_runtime"] = _obs_runtime_extras()
     print(PARTIAL_MARK + json.dumps(result), flush=True)
 
 

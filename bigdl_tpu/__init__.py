@@ -24,5 +24,6 @@ from bigdl_tpu.engine import Engine
 from bigdl_tpu.common import RandomGenerator
 from bigdl_tpu.config import config, configure
 from bigdl_tpu.tensor import Tensor
+from bigdl_tpu import obs  # noqa: F401 — observability layer (obs.get_tracer()…)
 
 __version__ = "0.1.0"

@@ -45,6 +45,41 @@ def _env_str(name: str, default):
 
 
 @dataclasses.dataclass
+class ObsConfig:
+    """Observability layer switches (``bigdl_tpu/obs``).
+
+    Everything is off by default: the train loop takes a no-op fast
+    path (shared null context managers, no per-step host-device sync).
+    Setting ``trace_dir`` or ``metrics_dir`` implies ``enabled``.
+    """
+
+    # master switch for runtime stats (step-time reservoirs, compile
+    # tracking) without any file output [BIGDL_OBS]
+    enabled: bool = False
+    # Chrome trace_event JSON (Perfetto-viewable) + JSONL structured
+    # events are written here [BIGDL_TRACE_DIR]
+    trace_dir: Optional[str] = None
+    # Prometheus text exposition + JSONL metric snapshots are written
+    # here (falls back to trace_dir when unset) [BIGDL_METRICS_DIR]
+    metrics_dir: Optional[str] = None
+    # step-time / dispatch-time reservoir capacity [BIGDL_OBS_RESERVOIR]
+    reservoir_size: int = 4096
+
+    @property
+    def active(self) -> bool:
+        return bool(self.enabled or self.trace_dir or self.metrics_dir)
+
+    @classmethod
+    def from_env(cls) -> "ObsConfig":
+        return cls(
+            enabled=_env_bool("BIGDL_OBS", False),
+            trace_dir=_env_str("BIGDL_TRACE_DIR", None),
+            metrics_dir=_env_str("BIGDL_METRICS_DIR", None),
+            reservoir_size=_env_int("BIGDL_OBS_RESERVOIR", 4096),
+        )
+
+
+@dataclasses.dataclass
 class BigDLConfig:
     """Process-global framework configuration.
 
@@ -96,6 +131,12 @@ class BigDLConfig:
     # unlimited [BIGDL_CHECKPOINT_KEEP_LAST]
     checkpoint_keep_last: int = 0
 
+    # --- observability (obs/ package) -----------------------------------
+    # span tracer / metrics registry / runtime profiling switches
+    # [BIGDL_OBS / BIGDL_TRACE_DIR / BIGDL_METRICS_DIR /
+    #  BIGDL_OBS_RESERVOIR]
+    obs: ObsConfig = dataclasses.field(default_factory=ObsConfig)
+
     # --- benchmarking [BENCH_* kept for bench.py compat] ----------------
 
     @classmethod
@@ -118,6 +159,7 @@ class BigDLConfig:
             nonfinite_guard=_env_bool("BIGDL_NONFINITE_GUARD", True),
             max_nonfinite_skips=_env_int("BIGDL_MAX_NONFINITE_SKIPS", 10),
             checkpoint_keep_last=_env_int("BIGDL_CHECKPOINT_KEEP_LAST", 0),
+            obs=ObsConfig.from_env(),
         )
 
     def describe(self) -> str:

@@ -61,6 +61,8 @@ class Engine:
             all devices on one ``data`` axis (the reference's only
             parallelism, SURVEY.md §2.4).
         """
+        import time
+
         import jax
 
         from bigdl_tpu.config import config, refresh_from_env
@@ -68,6 +70,7 @@ class Engine:
         # launchers export BIGDL_* after import but before init — honor
         # them (read-at-call-time contract; configure() overrides win)
         refresh_from_env()
+        t_init = time.perf_counter()
         # same contract for the fault-injection plan: a BIGDL_FAULT_PLAN
         # exported before init must be live before the first optimizer
         from bigdl_tpu.resilience.faults import get_injector
@@ -97,6 +100,20 @@ class Engine:
         cls._state.mesh = cls.build_mesh(mesh_shape, devices=devices)
         cls._state.engine_type = "xla"
         cls._state.initialized = True
+        # bring-up telemetry: mesh bring-up dominates cold start on
+        # multi-host, and "how long did init take, on what" is the first
+        # question a slow-start incident asks (no-op tracer when off)
+        from bigdl_tpu import obs
+
+        obs.get_tracer().complete(
+            "engine.init", t_init, time.perf_counter() - t_init,
+            devices=n, platform=devices[0].platform if devices else None,
+            mesh={a: int(s) for a, s in
+                  zip(cls._state.mesh.axis_names,
+                      cls._state.mesh.devices.shape)},
+            processes=config.num_processes)
+        obs.get_registry().counter(
+            "bigdl_engine_inits_total", "Engine.init calls").inc()
         return cls
 
     # singleton-ish accessors -------------------------------------------------

@@ -1,0 +1,178 @@
+"""bigdl_tpu.obs — unified observability layer.
+
+One subsystem, three instruments, threaded through the whole training
+stack (optimizers, engine, serializer, resilience, bench):
+
+* :mod:`bigdl_tpu.obs.trace` — contextvar-nested span tracer exporting
+  Chrome ``trace_event`` JSON (Perfetto-viewable) + JSONL structured
+  events.  ``BIGDL_TRACE_DIR=/dir`` turns it on;
+* :mod:`bigdl_tpu.obs.metrics` — labeled Counter/Gauge/Histogram
+  registry with Prometheus text exposition and JSONL snapshots
+  (``BIGDL_METRICS_DIR=/dir``); ``optim.Metrics`` delegates here;
+* :mod:`bigdl_tpu.obs.runtime` — compile-event tracking, step-time
+  p50/p95/p99 reservoirs, host RSS + device memory stats.
+
+Everything is off by default with a no-op fast path: disabled, the
+train loop sees one shared null context manager per span site and adds
+zero host-device synchronizations.  Resolution follows the fault
+injector's read-at-call-time contract — ``BIGDL_TRACE_DIR`` exported
+after import but before the optimizer runs is honored, and the tracer
+is rebuilt whenever the directory changes.
+"""
+
+from __future__ import annotations
+
+import atexit
+import threading
+
+from bigdl_tpu.obs.metrics import DEFAULT_BUCKETS, MetricsRegistry
+from bigdl_tpu.obs.runtime import (
+    Reservoir,
+    RuntimeStats,
+    device_memory_stats,
+    host_rss_bytes,
+    instrument_jit,
+)
+from bigdl_tpu.obs.trace import NULL_TRACER, NullTracer, Tracer
+
+__all__ = [
+    "DEFAULT_BUCKETS", "MetricsRegistry", "Reservoir", "RuntimeStats",
+    "NullTracer", "Tracer", "NULL_TRACER",
+    "active", "get_tracer", "get_registry", "get_runtime",
+    "instrument_jit", "host_rss_bytes", "device_memory_stats",
+    "flush", "reset",
+]
+
+_lock = threading.Lock()
+_tracer = NULL_TRACER
+_tracer_dir = None
+_registry = MetricsRegistry()
+_runtime: RuntimeStats = None
+_atexit_registered = False
+
+
+def _obs_config():
+    from bigdl_tpu.config import refresh_from_env
+
+    return refresh_from_env().obs
+
+
+def active() -> bool:
+    """Is any observability output enabled (BIGDL_OBS / BIGDL_TRACE_DIR
+    / BIGDL_METRICS_DIR)?"""
+    return _obs_config().active
+
+
+def get_tracer():
+    """The process tracer — a recording :class:`Tracer` bound to
+    ``config.obs.trace_dir``, or the shared :data:`NULL_TRACER` when
+    tracing is off.  Rebuilt when the directory changes."""
+    global _tracer, _tracer_dir, _atexit_registered
+    d = _obs_config().trace_dir
+    with _lock:
+        if d != _tracer_dir:
+            if _tracer is not NULL_TRACER:
+                _tracer.close()
+            _tracer_dir = d
+            _tracer = Tracer(d) if d else NULL_TRACER
+            if d and not _atexit_registered:
+                atexit.register(_atexit_close)
+                _atexit_registered = True
+        return _tracer
+
+
+def _atexit_close():
+    try:
+        _tracer.close()
+    except Exception:  # noqa: BLE001 — interpreter teardown
+        pass
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-global metrics registry (always real — counters are
+    host-side dict math; only file output is gated on config)."""
+    return _registry
+
+
+def get_runtime() -> RuntimeStats:
+    """The process-global runtime profile (reservoirs sized from
+    ``config.obs.reservoir_size`` at first use)."""
+    global _runtime
+    with _lock:
+        if _runtime is None:
+            _runtime = RuntimeStats(_obs_config().reservoir_size)
+        return _runtime
+
+
+def publish_runtime(registry: MetricsRegistry = None,
+                    runtime: RuntimeStats = None) -> dict:
+    """Mirror the runtime snapshot into registry gauges (step-time
+    percentiles, compile counters, memory) and return it."""
+    registry = registry if registry is not None else _registry
+    runtime = runtime if runtime is not None else get_runtime()
+    snap = runtime.snapshot()
+    st = snap["step_time_s"]
+    g = registry.gauge(
+        "bigdl_step_time_seconds",
+        "Observed train-step completion time (dispatch -> resolved loss)",
+        labels=("quantile",))
+    for q in ("p50", "p95", "p99"):
+        if st[q] is not None:
+            g.labels(quantile=q).set(st[q])
+    registry.gauge(
+        "bigdl_jit_compile_count",
+        "Distinct jit compile events (new arg signatures)").set(
+        snap["compile"]["count"])
+    registry.gauge(
+        "bigdl_jit_compile_seconds_total",
+        "Wall seconds spent blocked on jit trace+compile").set(
+        snap["compile"]["total_s"])
+    rss = snap.get("host_rss_bytes")
+    if rss:
+        registry.gauge("bigdl_host_rss_bytes",
+                       "Driver-process resident set size").set(rss)
+    dm = snap.get("device_memory")
+    if dm:
+        dg = registry.gauge("bigdl_device_memory_bytes",
+                            "Device 0 memory stats", labels=("stat",))
+        for k, v in dm.items():
+            dg.labels(stat=k).set(v)
+    return snap
+
+
+def flush(extra_registries=()) -> dict:
+    """End-of-run export: publish runtime stats into the registry, write
+    the Prometheus + JSONL metric snapshot (``metrics_dir``, falling
+    back to ``trace_dir``), and flush the Chrome trace.  No-op when
+    observability is off."""
+    cfg = _obs_config()
+    if not cfg.active:
+        return {}
+    publish_runtime()
+    paths = {}
+    out_dir = cfg.metrics_dir or cfg.trace_dir
+    if out_dir:
+        paths = _registry.write_snapshot(out_dir,
+                                         extra_registries=extra_registries)
+    tracer = get_tracer()
+    tracer.flush()
+    if tracer is not NULL_TRACER:
+        paths["trace"] = tracer.trace_path
+        paths["events"] = tracer.jsonl_path
+    return paths
+
+
+def reset():
+    """Test hook: close the tracer, drop the registry and runtime
+    singletons.  The next accessor rebuilds from the current config."""
+    global _tracer, _tracer_dir, _runtime, _registry
+    with _lock:
+        if _tracer is not NULL_TRACER:
+            try:
+                _tracer.close()
+            except Exception:  # noqa: BLE001 — half-torn test dirs
+                pass
+        _tracer = NULL_TRACER
+        _tracer_dir = None
+        _registry = MetricsRegistry()
+        _runtime = None

@@ -1,0 +1,302 @@
+"""Labeled Counter / Gauge / Histogram registry.
+
+The reference aggregated driver metrics through Spark accumulators and
+printed averages («bigdl»/optim/Metrics.scala); this registry is the
+rebuild's production surface for the same numbers and everything new
+(resilience counters, checkpoint writes, compile events):
+
+* three instrument kinds — monotonic :class:`Counter`, settable
+  :class:`Gauge`, bucketed :class:`Histogram` — each optionally
+  labeled (one family, lazily-created children per label combination);
+* Prometheus text exposition (:meth:`MetricsRegistry.to_prometheus`)
+  scrape-able or snapshot-to-file, plus JSON-able
+  :meth:`MetricsRegistry.snapshot` appended as JSONL for log pipelines;
+* thread-safe (the background checkpoint writer counts too), no
+  third-party client library.
+
+``optim/metrics.py::Metrics`` delegates here — the reference's phase
+timers become one ``bigdl_phase_seconds`` histogram family labeled by
+phase, keeping the exact Scala metric names as label values.
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import os
+import threading
+import time
+from typing import Dict, Optional, Sequence, Tuple
+
+# driver-phase oriented defaults: sub-ms host work up to multi-second
+# compiles/checkpoints
+DEFAULT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                   0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+
+
+def _escape(v) -> str:
+    return (str(v).replace("\\", r"\\").replace("\n", r"\n")
+            .replace('"', r'\"'))
+
+
+def _fmt(v: float) -> str:
+    """Prometheus sample value: integers without a trailing .0 noise."""
+    f = float(v)
+    return repr(int(f)) if f == int(f) else repr(f)
+
+
+class Counter:
+    """Monotonic counter child."""
+
+    kind = "counter"
+
+    def __init__(self, lock: threading.Lock):
+        self._lock = lock
+        self.value = 0.0
+
+    def inc(self, amount: float = 1.0):
+        if amount < 0:
+            raise ValueError(f"counters only go up, got {amount}")
+        with self._lock:
+            self.value += amount
+        return self
+
+    def _zero(self):
+        self.value = 0.0
+
+
+class Gauge:
+    """Settable gauge child."""
+
+    kind = "gauge"
+
+    def __init__(self, lock: threading.Lock):
+        self._lock = lock
+        self.value = 0.0
+
+    def set(self, value: float):
+        with self._lock:
+            self.value = float(value)
+        return self
+
+    def inc(self, amount: float = 1.0):
+        with self._lock:
+            self.value += amount
+        return self
+
+    def _zero(self):
+        self.value = 0.0
+
+
+class Histogram:
+    """Bucketed histogram child (per-bucket counts; cumulative form is
+    produced at exposition time)."""
+
+    kind = "histogram"
+
+    def __init__(self, lock: threading.Lock,
+                 buckets: Sequence[float] = DEFAULT_BUCKETS):
+        self._lock = lock
+        self.bounds = tuple(sorted(float(b) for b in buckets))
+        self.bucket_counts = [0] * (len(self.bounds) + 1)  # last = +Inf
+        self.count = 0
+        self.sum = 0.0
+
+    def observe(self, value: float):
+        v = float(value)
+        i = bisect.bisect_left(self.bounds, v)
+        with self._lock:
+            self.bucket_counts[i] += 1
+            self.count += 1
+            self.sum += v
+        return self
+
+    @property
+    def mean(self) -> float:
+        return self.sum / self.count if self.count else 0.0
+
+    def cumulative(self):
+        """[(upper_bound, cumulative_count), ...] ending with +Inf."""
+        out, acc = [], 0
+        with self._lock:
+            counts = list(self.bucket_counts)
+            bounds = self.bounds + (float("inf"),)
+        for b, c in zip(bounds, counts):
+            acc += c
+            out.append((b, acc))
+        return out
+
+    def _zero(self):
+        self.bucket_counts = [0] * (len(self.bounds) + 1)
+        self.count = 0
+        self.sum = 0.0
+
+
+_KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
+
+
+class _Family:
+    """One named metric family: fixed label names, lazily-created
+    children per label-value combination."""
+
+    def __init__(self, name: str, help: str, kind: str,
+                 labelnames: Tuple[str, ...] = (),
+                 buckets: Optional[Sequence[float]] = None):
+        self.name = name
+        self.help = help
+        self.kind = kind
+        self.labelnames = tuple(labelnames)
+        self.buckets = tuple(buckets) if buckets else DEFAULT_BUCKETS
+        self._lock = threading.Lock()
+        self._children: Dict[tuple, object] = {}
+
+    def labels(self, **labelvalues):
+        if set(labelvalues) != set(self.labelnames):
+            raise ValueError(
+                f"{self.name}: labels {sorted(labelvalues)} do not match "
+                f"declared {sorted(self.labelnames)}")
+        key = tuple(str(labelvalues[n]) for n in self.labelnames)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                cls = _KINDS[self.kind]
+                child = (cls(self._lock, self.buckets)
+                         if self.kind == "histogram" else cls(self._lock))
+                self._children[key] = child
+            return child
+
+    # label-less convenience: family acts as its single child
+    def _solo(self):
+        if self.labelnames:
+            raise ValueError(
+                f"{self.name} is labeled {self.labelnames}; use .labels()")
+        return self.labels()
+
+    def inc(self, amount: float = 1.0):
+        return self._solo().inc(amount)
+
+    def set(self, value: float):
+        return self._solo().set(value)
+
+    def observe(self, value: float):
+        return self._solo().observe(value)
+
+    def child_items(self):
+        with self._lock:
+            return list(self._children.items())
+
+    def clear(self):
+        """Zero every child (test/reset hook; children stay registered
+        so held references keep working)."""
+        for _, child in self.child_items():
+            with self._lock:
+                child._zero()
+
+
+class MetricsRegistry:
+    """Named families + exposition.  Registration is idempotent for an
+    identical (kind, labelnames) signature and loud for a conflicting
+    one."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._families: Dict[str, _Family] = {}
+
+    def _family(self, name, help, kind, labels=(), buckets=None) -> _Family:
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is not None:
+                if fam.kind != kind or fam.labelnames != tuple(labels):
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{fam.kind}{fam.labelnames}, not {kind}{tuple(labels)}")
+                return fam
+            fam = _Family(name, help, kind, tuple(labels), buckets)
+            self._families[name] = fam
+            return fam
+
+    def counter(self, name: str, help: str = "", labels=()) -> _Family:
+        return self._family(name, help, "counter", labels)
+
+    def gauge(self, name: str, help: str = "", labels=()) -> _Family:
+        return self._family(name, help, "gauge", labels)
+
+    def histogram(self, name: str, help: str = "", labels=(),
+                  buckets: Optional[Sequence[float]] = None) -> _Family:
+        return self._family(name, help, "histogram", labels, buckets)
+
+    def families(self):
+        with self._lock:
+            return list(self._families.values())
+
+    # -------------------------------------------------------------- export
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition format 0.0.4."""
+        lines = []
+        for fam in sorted(self.families(), key=lambda f: f.name):
+            if fam.help:
+                lines.append(f"# HELP {fam.name} {fam.help}")
+            lines.append(f"# TYPE {fam.name} {fam.kind}")
+            for key, child in sorted(fam.child_items()):
+                pairs = [f'{n}="{_escape(v)}"'
+                         for n, v in zip(fam.labelnames, key)]
+                base = "{" + ",".join(pairs) + "}" if pairs else ""
+                if fam.kind == "histogram":
+                    for bound, acc in child.cumulative():
+                        le = "+Inf" if bound == float("inf") else _fmt(bound)
+                        bpairs = pairs + [f'le="{le}"']
+                        lines.append(
+                            f"{fam.name}_bucket{{{','.join(bpairs)}}} {acc}")
+                    lines.append(f"{fam.name}_sum{base} {_fmt(child.sum)}")
+                    lines.append(f"{fam.name}_count{base} {child.count}")
+                else:
+                    lines.append(f"{fam.name}{base} {_fmt(child.value)}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def snapshot(self) -> dict:
+        """JSON-able snapshot of every family."""
+        metrics = {}
+        for fam in self.families():
+            samples = []
+            for key, child in sorted(fam.child_items()):
+                labels = dict(zip(fam.labelnames, key))
+                if fam.kind == "histogram":
+                    samples.append(
+                        {"labels": labels, "count": child.count,
+                         "sum": child.sum,
+                         "buckets": [
+                             ["+Inf" if b == float("inf") else b, c]
+                             for b, c in child.cumulative()]})
+                else:
+                    samples.append({"labels": labels, "value": child.value})
+            metrics[fam.name] = {"type": fam.kind, "help": fam.help,
+                                 "samples": samples}
+        return {"ts": time.time(), "metrics": metrics}
+
+    def write_snapshot(self, directory: str, extra_registries=()):
+        """Write ``metrics.<pid>.prom`` (atomic replace — always a
+        complete, parseable exposition) and append one JSON line to
+        ``metrics.<pid>.jsonl``.  ``extra_registries`` are concatenated
+        into the same exposition (e.g. an optimizer's private phase-
+        timer registry)."""
+        os.makedirs(directory, exist_ok=True)
+        pid = os.getpid()
+        prom_path = os.path.join(directory, f"metrics.{pid}.prom")
+        text = self.to_prometheus() + "".join(
+            r.to_prometheus() for r in extra_registries)
+        tmp = prom_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            fh.write(text)
+        os.replace(tmp, prom_path)
+        jsonl_path = os.path.join(directory, f"metrics.{pid}.jsonl")
+        snap = self.snapshot()
+        for r in extra_registries:
+            snap["metrics"].update(r.snapshot()["metrics"])
+        with open(jsonl_path, "a", encoding="utf-8") as fh:
+            fh.write(json.dumps(snap, default=str) + "\n")
+        return {"prom": prom_path, "jsonl": jsonl_path}
+
+    def reset(self):
+        """Drop every family (test hook)."""
+        with self._lock:
+            self._families.clear()

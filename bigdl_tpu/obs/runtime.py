@@ -1,0 +1,198 @@
+"""Runtime profiling — compile tracking, step-time reservoirs, memory.
+
+The ROADMAP's "as fast as the hardware allows" needs measurement before
+optimization; this module gives the driver the three numbers every perf
+PR argues from:
+
+* **compile events** — :func:`instrument_jit` wraps a jitted callable
+  and tells a first call on a new arg signature (trace + XLA compile —
+  the call blocks for the whole compilation) from a cached dispatch
+  (async, returns in microseconds).  An unexpected recompile in a
+  steady-state loop shows up as an extra compile event;
+* **step-time reservoirs** — :class:`Reservoir` keeps the most recent N
+  observations (deterministic ring, no sampling RNG) and reports
+  nearest-rank p50/p95/p99;
+* **memory** — host RSS from ``/proc`` and, when the backend exposes
+  it, per-device HBM stats via ``Device.memory_stats()``.
+
+Everything here is host-side bookkeeping: no ``block_until_ready``, no
+device readbacks — instrumentation never adds a host-device sync.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import threading
+import time
+from typing import Optional, Sequence
+
+DEFAULT_RESERVOIR = 4096
+_PCTS = (0.5, 0.95, 0.99)
+
+
+class Reservoir:
+    """Ring buffer of the most recent ``size`` observations with
+    nearest-rank percentiles.  Deterministic: same inputs, same
+    percentiles — no random replacement."""
+
+    def __init__(self, size: int = DEFAULT_RESERVOIR):
+        self.size = max(1, int(size))
+        self._buf: list = []
+        self._idx = 0
+        self.count = 0
+        self.total = 0.0
+        self._lock = threading.Lock()
+
+    def add(self, value: float):
+        v = float(value)
+        with self._lock:
+            self.count += 1
+            self.total += v
+            if len(self._buf) < self.size:
+                self._buf.append(v)
+            else:
+                self._buf[self._idx] = v
+                self._idx = (self._idx + 1) % self.size
+
+    def percentiles(self, qs: Sequence[float] = _PCTS) -> dict:
+        """{q: nearest-rank value} over the retained window; None when
+        empty."""
+        with self._lock:
+            buf = sorted(self._buf)
+        out = {}
+        for q in qs:
+            if not buf:
+                out[q] = None
+            else:
+                k = min(len(buf) - 1, max(0, math.ceil(q * len(buf)) - 1))
+                out[q] = buf[k]
+        return out
+
+    def summary(self) -> dict:
+        p = self.percentiles()
+        return {"p50": p[0.5], "p95": p[0.95], "p99": p[0.99],
+                "count": self.count,
+                "total_s": round(self.total, 6),
+                "mean": self.total / self.count if self.count else None}
+
+
+def host_rss_bytes() -> Optional[int]:
+    """Resident set size of this process, or None when unknowable."""
+    try:
+        with open("/proc/self/statm") as fh:
+            pages = int(fh.read().split()[1])
+        return pages * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        try:
+            import resource
+
+            return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+        except Exception:  # noqa: BLE001 — best-effort on exotic hosts
+            return None
+
+
+def device_memory_stats(device=None) -> Optional[dict]:
+    """``Device.memory_stats()`` of the first local device (TPU backends
+    report bytes_in_use / peak_bytes_in_use; CPU returns None)."""
+    try:
+        import jax
+
+        dev = device if device is not None else jax.local_devices()[0]
+        stats = dev.memory_stats()
+        return dict(stats) if stats else None
+    except Exception:  # noqa: BLE001 — absent backend / no jax yet
+        return None
+
+
+class RuntimeStats:
+    """Aggregated runtime profile for one process."""
+
+    def __init__(self, reservoir_size: int = DEFAULT_RESERVOIR):
+        self.step_times = Reservoir(reservoir_size)
+        self.dispatch_times = Reservoir(reservoir_size)
+        self.compile_count = 0
+        self.compile_seconds = 0.0
+        self.compile_events: list = []  # first 64, [{name, seconds}]
+        self._lock = threading.Lock()
+
+    def record_step(self, seconds: float):
+        """Observed completion time of one train step (dispatch ->
+        resolved loss)."""
+        self.step_times.add(seconds)
+
+    def record_compile(self, name: str, seconds: float):
+        with self._lock:
+            self.compile_count += 1
+            self.compile_seconds += float(seconds)
+            if len(self.compile_events) < 64:
+                self.compile_events.append(
+                    {"name": name, "seconds": round(float(seconds), 6)})
+
+    def record_dispatch(self, name: str, seconds: float):
+        del name  # one reservoir: dispatch cost is fn-agnostic
+        self.dispatch_times.add(seconds)
+
+    def snapshot(self, memory: bool = True) -> dict:
+        out = {
+            "step_time_s": self.step_times.summary(),
+            "dispatch_time_s": self.dispatch_times.summary(),
+            "compile": {"count": self.compile_count,
+                        "total_s": round(self.compile_seconds, 6),
+                        "events": list(self.compile_events)},
+        }
+        if memory:
+            out["host_rss_bytes"] = host_rss_bytes()
+            dm = device_memory_stats()
+            if dm is not None:
+                out["device_memory"] = {
+                    k: dm[k] for k in ("bytes_in_use", "peak_bytes_in_use",
+                                       "bytes_limit") if k in dm}
+        return out
+
+    def reset(self):
+        self.__init__(self.step_times.size)
+
+
+def tree_signature(tree) -> tuple:
+    """Hashable (shape, dtype) signature of a pytree of arrays — the
+    key a jit cache would retrace on.  Host-side metadata only: reading
+    ``.shape``/``.dtype`` never syncs the device."""
+    import jax
+
+    sig = []
+    for leaf in jax.tree.leaves(tree):
+        if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            sig.append((tuple(leaf.shape), str(leaf.dtype)))
+        else:
+            sig.append((type(leaf).__name__,))
+    return tuple(sig)
+
+
+def instrument_jit(fn, name: str = "jit", stats: Optional[RuntimeStats] = None,
+                   tracer=None):
+    """Wrap a jitted callable: a call on an unseen arg signature is a
+    compile event (its wall time ≈ trace + compile, because jit blocks
+    the first call), a seen one is a cached dispatch.  The signature is
+    computed BEFORE the call — donated buffers are deleted by it."""
+    seen = set()
+
+    def wrapped(*args, **kwargs):
+        sig = tree_signature((args, kwargs))
+        t0 = time.perf_counter()
+        out = fn(*args, **kwargs)
+        dt = time.perf_counter() - t0
+        if sig not in seen:
+            seen.add(sig)
+            if stats is not None:
+                stats.record_compile(name, dt)
+            if tracer is not None:
+                tracer.complete(f"{name}.compile", t0, dt,
+                                signatures=len(seen))
+        elif stats is not None:
+            stats.record_dispatch(name, dt)
+        return out
+
+    wrapped.__wrapped__ = fn
+    wrapped.__name__ = getattr(fn, "__name__", name)
+    return wrapped

@@ -1,0 +1,217 @@
+"""Span tracer — Chrome ``trace_event`` JSON + JSONL structured events.
+
+The reference's only timeline attribution was the driver-side phase
+averages in «bigdl»/optim/Metrics.scala; averages cannot answer "where
+did *this* slow step spend its time".  This tracer gives the training
+stack nested wall-clock spans:
+
+* contextvar-based nesting — spans opened inside a span become its
+  children automatically, per thread/task, with deterministic ids
+  (a per-tracer monotonic counter, no uuids);
+* two export formats per run: a Chrome ``trace_event`` JSON file
+  (open in Perfetto / ``chrome://tracing``) and a JSONL stream of
+  structured span/event records for log pipelines;
+* thread-safe — the background checkpoint writer and the training
+  thread record into the same tracer (each gets its own Chrome tid).
+
+Off by default: when ``BIGDL_TRACE_DIR`` is unset, callers get the
+shared :data:`NULL_TRACER` whose ``span()`` returns one reusable no-op
+context manager — no allocation, no clock reads, no device syncs.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import itertools
+import json
+import os
+import threading
+import time
+
+# the active span id for the current thread/task (None at top level)
+_CURRENT: contextvars.ContextVar = contextvars.ContextVar(
+    "bigdl_obs_span", default=None)
+
+
+class _NullSpan:
+    """Reusable no-op context manager — the disabled fast path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class NullTracer:
+    """No-op tracer with the full :class:`Tracer` surface."""
+
+    __slots__ = ()
+    enabled = False
+
+    def span(self, name, **attrs):
+        return _NULL_SPAN
+
+    def event(self, name, **attrs):
+        pass
+
+    def complete(self, name, start_perf, duration_s, **attrs):
+        pass
+
+    def counter(self, name, **values):
+        pass
+
+    def flush(self):
+        pass
+
+    def close(self):
+        pass
+
+
+NULL_TRACER = NullTracer()
+
+
+class Tracer:
+    """Recording tracer bound to one output directory.
+
+    File names carry pid + a process-wide monotonic counter so two
+    tracers created in the same second (fast tests, retries) can never
+    collide or interleave.
+    """
+
+    enabled = True
+    _FILE_SEQ = itertools.count()
+
+    def __init__(self, trace_dir: str, app_name: str = "bigdl_tpu"):
+        os.makedirs(trace_dir, exist_ok=True)
+        self.pid = os.getpid()
+        stem = f"{app_name}.{self.pid}.{next(Tracer._FILE_SEQ)}"
+        self.trace_path = os.path.join(trace_dir, stem + ".trace.json")
+        self.jsonl_path = os.path.join(trace_dir, stem + ".events.jsonl")
+        self._lock = threading.Lock()
+        self._ids = itertools.count(1)
+        self._events: list = []
+        self._tids: dict = {}
+        self._closed = False
+        # one wall-clock anchor + perf_counter timeline: Chrome wants a
+        # monotonic microsecond ts, the JSONL wants wall time
+        self._epoch_wall = time.time()
+        self._epoch_perf = time.perf_counter()
+        self._jsonl = open(self.jsonl_path, "a", encoding="utf-8")
+        self._events.append({"name": "process_name", "ph": "M",
+                             "pid": self.pid, "tid": 0,
+                             "args": {"name": app_name}})
+
+    # ------------------------------------------------------------- internals
+    def _tid(self) -> int:
+        ident = threading.get_ident()
+        with self._lock:
+            tid = self._tids.get(ident)
+            if tid is None:
+                tid = len(self._tids) + 1
+                self._tids[ident] = tid
+                self._events.append(
+                    {"name": "thread_name", "ph": "M", "pid": self.pid,
+                     "tid": tid,
+                     "args": {"name": threading.current_thread().name}})
+            return tid
+
+    def _record(self, chrome_ev: dict, jsonl_rec: dict = None):
+        line = None
+        if jsonl_rec is not None:
+            line = json.dumps(jsonl_rec, default=str) + "\n"
+        with self._lock:
+            if self._closed:
+                return
+            self._events.append(chrome_ev)
+            if line is not None:
+                self._jsonl.write(line)
+
+    def _ts_us(self, perf_t: float) -> float:
+        return round((perf_t - self._epoch_perf) * 1e6, 3)
+
+    def _wall(self, perf_t: float) -> float:
+        return self._epoch_wall + (perf_t - self._epoch_perf)
+
+    # ------------------------------------------------------------------ API
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs):
+        """Timed nested span; yields its deterministic span id."""
+        sid = next(self._ids)
+        parent = _CURRENT.get()
+        token = _CURRENT.set(sid)
+        tid = self._tid()
+        t0 = time.perf_counter()
+        try:
+            yield sid
+        finally:
+            _CURRENT.reset(token)
+            dur = time.perf_counter() - t0
+            self._record(
+                {"name": name, "ph": "X", "ts": self._ts_us(t0),
+                 "dur": round(dur * 1e6, 3), "pid": self.pid, "tid": tid,
+                 "args": attrs},
+                {"kind": "span", "name": name, "id": sid, "parent": parent,
+                 "wall_time": self._wall(t0), "dur_s": round(dur, 9),
+                 "attrs": attrs})
+
+    def event(self, name: str, **attrs):
+        """Instant (zero-duration) structured event."""
+        t = time.perf_counter()
+        self._record(
+            {"name": name, "ph": "i", "s": "t", "ts": self._ts_us(t),
+             "pid": self.pid, "tid": self._tid(), "args": attrs},
+            {"kind": "event", "name": name, "id": next(self._ids),
+             "parent": _CURRENT.get(), "wall_time": self._wall(t),
+             "attrs": attrs})
+
+    def complete(self, name: str, start_perf: float, duration_s: float,
+                 **attrs):
+        """Retroactive span from a ``perf_counter()`` start + duration —
+        for phases measured outside the contextvar flow (e.g. the
+        pipelined loss readback that resolves one iteration late)."""
+        self._record(
+            {"name": name, "ph": "X", "ts": self._ts_us(start_perf),
+             "dur": round(duration_s * 1e6, 3), "pid": self.pid,
+             "tid": self._tid(), "args": attrs},
+            {"kind": "span", "name": name, "id": next(self._ids),
+             "parent": _CURRENT.get(), "wall_time": self._wall(start_perf),
+             "dur_s": round(duration_s, 9), "attrs": attrs})
+
+    def counter(self, name: str, **values):
+        """Chrome counter track (e.g. host RSS over time)."""
+        t = time.perf_counter()
+        self._record({"name": name, "ph": "C", "ts": self._ts_us(t),
+                      "pid": self.pid, "tid": 0, "args": values})
+
+    def flush(self):
+        """Write the full Chrome trace JSON (atomic replace) and flush
+        the JSONL stream.  Safe to call repeatedly; the trace file is
+        valid after every flush."""
+        with self._lock:
+            events = list(self._events)
+            if not self._jsonl.closed:
+                self._jsonl.flush()
+        doc = {"traceEvents": events, "displayTimeUnit": "ms",
+               "otherData": {"pid": self.pid,
+                             "wall_epoch": self._epoch_wall}}
+        tmp = self.trace_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(doc, fh, default=str)
+        os.replace(tmp, self.trace_path)
+
+    def close(self):
+        """Flush and stop recording (idempotent)."""
+        if self._closed:
+            return
+        self.flush()
+        with self._lock:
+            self._closed = True
+            if not self._jsonl.closed:
+                self._jsonl.close()

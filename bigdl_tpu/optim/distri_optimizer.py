@@ -565,21 +565,42 @@ class DistriOptimizer(LocalOptimizer):
         import logging
         import time
 
+        from bigdl_tpu import obs
         from bigdl_tpu.resilience.retry import RetryPolicy, classify
 
         log = logging.getLogger("bigdl_tpu.optim")
         policy = RetryPolicy.from_config(max_retries=self.max_retry)
+        retry_counter = obs.get_registry().counter(
+            "bigdl_retry_attempts_total",
+            "Training failures handled by the retry policy",
+            labels=("classification", "error"))
         while True:
             try:
                 return super().optimize()
             except Exception as e:
-                if not self.checkpoint_path or classify(e) == "fatal":
+                kind = classify(e)
+                if not self.checkpoint_path or kind == "fatal":
+                    # structured telemetry even for the non-retried path:
+                    # a fatal config error at step N is exactly what a
+                    # post-mortem trace must show
+                    retry_counter.labels(classification=kind,
+                                         error=type(e).__name__).inc()
+                    obs.get_tracer().event(
+                        "resilience.failure", classification=kind,
+                        error=type(e).__name__, step=self.state["neval"],
+                        retried=False)
                     raise
                 delay = policy.record_failure(e)
+                retry_counter.labels(classification="transient",
+                                     error=type(e).__name__).inc()
                 if delay is None:
                     log.error(
                         "retry budget exhausted after %d transient "
                         "failures; surfacing the last one", policy.attempts)
+                    obs.get_tracer().event(
+                        "resilience.retry_budget_exhausted",
+                        attempts=policy.attempts,
+                        error=type(e).__name__, step=self.state["neval"])
                     raise
                 log.exception(
                     "transient training failure (%s); retry %d/%d from "
@@ -587,6 +608,11 @@ class DistriOptimizer(LocalOptimizer):
                     type(e).__name__, policy.attempts, self.max_retry,
                     delay,
                 )
+                obs.get_tracer().event(
+                    "resilience.retry", classification="transient",
+                    error=type(e).__name__, attempt=policy.attempts,
+                    max_retries=self.max_retry,
+                    delay_s=round(delay, 4), step=self.state["neval"])
                 self._summary_resilience(self.state["neval"],
                                          retries=policy.attempts)
                 if delay > 0:

@@ -3,26 +3,50 @@
 Rebuild of «bigdl»/optim/Metrics.scala (SURVEY.md §5 "Tracing"):
 driver-side aggregated counters for "computing time average", "get weights
 average", "aggregate gradient time" etc., logged per iteration/epoch.  The
-reference aggregates via Spark accumulators; here a plain dict suffices
-(one process drives the jitted step), with the same metric names so log
-parsers carry over.
+reference aggregates via Spark accumulators; here the timers delegate to
+the observability layer's labeled histogram registry
+(:mod:`bigdl_tpu.obs.metrics`) — one ``bigdl_phase_seconds`` family
+labeled by phase, with the reference's metric names kept verbatim as
+label values so existing log parsers carry over, and Prometheus/JSONL
+exposition for free through the registry.
 """
 
 from __future__ import annotations
 
 import time
-from collections import defaultdict
 from contextlib import contextmanager
+from typing import Optional
+
+from bigdl_tpu.obs.metrics import MetricsRegistry
+
+# per-phase driver wall time spans ~100us host phases to multi-second
+# checkpoint/validation phases
+PHASE_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
 
 
 class Metrics:
-    def __init__(self):
-        self._sums = defaultdict(float)
-        self._counts = defaultdict(int)
+    """Per-phase timer facade over a metrics registry.
+
+    Each optimizer owns a private registry by default (so two trainers
+    in one process never cross-pollute their averages, matching the
+    reference's per-Optimizer accumulators); pass ``registry=`` to
+    aggregate into a shared one.  The optimizer's end-of-run snapshot
+    concatenates this registry into the global Prometheus exposition.
+    """
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self._family = self.registry.histogram(
+            "bigdl_phase_seconds",
+            "Per-phase driver wall time (reference Metrics.scala names)",
+            labels=("phase",), buckets=PHASE_BUCKETS)
+
+    def _child(self, name: str):
+        return self._family.labels(phase=name)
 
     def add(self, name: str, value: float):
-        self._sums[name] += value
-        self._counts[name] += 1
+        self._child(name).observe(float(value))
 
     @contextmanager
     def timer(self, name: str):
@@ -33,14 +57,33 @@ class Metrics:
             self.add(name, time.perf_counter() - t0)
 
     def value(self, name: str) -> float:
-        c = self._counts[name]
-        return self._sums[name] / c if c else 0.0
+        """Mean seconds per observation (the reference's "average")."""
+        return self._child(name).mean
+
+    def count(self, name: str) -> int:
+        return self._child(name).count
+
+    def total(self, name: str) -> float:
+        return self._child(name).sum
+
+    def snapshot(self) -> dict:
+        """{phase: {count, total, mean}} — the registry-bridge form the
+        obs layer and tests consume."""
+        out = {}
+        for (phase,), child in self._family.child_items():
+            out[phase] = {"count": child.count, "total": child.sum,
+                          "mean": child.mean}
+        return out
 
     def summary(self) -> str:
+        """Human log line: keeps the reference's "<phase> average: Xms"
+        spelling (log parsers match on it) and appends count + total."""
+        snap = self.snapshot()
         return ", ".join(
-            f"{k} average: {self.value(k) * 1000:.2f}ms" for k in sorted(self._sums)
+            f"{k} average: {v['mean'] * 1000:.2f}ms "
+            f"(n={v['count']}, total={v['total'] * 1000:.1f}ms)"
+            for k, v in sorted(snap.items())
         )
 
     def reset(self):
-        self._sums.clear()
-        self._counts.clear()
+        self._family.clear()

@@ -99,6 +99,12 @@ class BaseOptimizer:
         # non-finite step guard accounting
         self._nonfinite_consec = 0
         self._fault_injector = None
+        # observability session handles; optimize() rebinds them from
+        # the live config (NULL tracer / None reservoir = disabled)
+        from bigdl_tpu.obs.trace import NULL_TRACER
+
+        self._obs_tracer = NULL_TRACER
+        self._obs_runtime = None
         # mixed-precision compute policy: None = full f32; "bfloat16"
         # runs fwd/bwd in bf16 with f32 master params + f32 grads/update
         # (the TPU-native recipe: MXU at 2x, normalizations stay f32)
@@ -259,6 +265,15 @@ class BaseOptimizer:
                 self._summary_resilience(
                     self.state["neval"],
                     checkpoint_write_failures=self.checkpoint_write_failures)
+                from bigdl_tpu import obs
+
+                obs.get_tracer().event(
+                    "resilience.checkpoint_write_failed",
+                    step=self.state["neval"], error=type(e).__name__,
+                    total=self.checkpoint_write_failures)
+                obs.get_registry().counter(
+                    "bigdl_checkpoint_write_failures_total",
+                    "Background checkpoint writes that raised").inc()
                 if raise_errors:
                     raise
                 self._ckpt_write_error = e
@@ -447,6 +462,7 @@ class LocalOptimizer(BaseOptimizer):
     def optimize(self):
         import jax
 
+        from bigdl_tpu import obs
         from bigdl_tpu.resilience.faults import get_injector
 
         # a background checkpoint write that failed in a previous
@@ -456,6 +472,13 @@ class LocalOptimizer(BaseOptimizer):
         inj = get_injector()
         self._fault_injector = inj if inj.active else None
         self._nonfinite_consec = 0
+        # observability session: the tracer is NULL (shared no-op
+        # context managers) and the runtime reservoir None when obs is
+        # off, so the hot loop pays nothing — and nothing here ever
+        # reads a device value, so enabling obs adds zero per-step
+        # host-device synchronizations either way
+        tracer = self._obs_tracer = obs.get_tracer()
+        self._obs_runtime = obs.get_runtime() if obs.active() else None
 
         model = self.model
         model.training()
@@ -472,7 +495,15 @@ class LocalOptimizer(BaseOptimizer):
         opt = self.optim_method
         opt_state = copy(self._init_opt_state(pvar))
         opt.state = opt_state
-        train_step = self._build_train_step()
+        # the build itself is traced; the returned step is wrapped so
+        # first-call (trace+compile) vs cached-dispatch timing feeds the
+        # runtime profile (obs/runtime.py)
+        with tracer.span("build_train_step"):
+            train_step = self._build_train_step()
+        if self._obs_runtime is not None:
+            train_step = obs.instrument_jit(
+                train_step, "train_step", stats=self._obs_runtime,
+                tracer=tracer)
 
         base_key = jax.random.key(1234)
         wall_start = time.time()
@@ -501,16 +532,26 @@ class LocalOptimizer(BaseOptimizer):
                 # no lingering non-daemon worker thread per optimizer
                 ex.shutdown(wait=True)
                 self._ckpt_executor = None
+            # export the observability artifacts LAST so the snapshot
+            # sees the final counter values (incl. any failure recorded
+            # by the flush above); off = no-op
+            if obs.active():
+                obs.flush(extra_registries=[self.metrics.registry])
 
     def _optimize_loop(self, model, pvar, mod_state, opt, opt_state,
                        train_step, base_key, wall_start, records_total,
                        stop, profiler):
         import jax
 
+        from bigdl_tpu import obs
         from bigdl_tpu.config import config
         from bigdl_tpu.resilience.retry import NonFiniteStepError
 
         max_nonfinite = config.max_nonfinite_skips
+        # session-local obs handles (set up by optimize()): tracer is the
+        # shared no-op when disabled, runtime None — zero hot-loop cost
+        tracer = self._obs_tracer
+        runtime = self._obs_runtime
 
         # Async-dispatch pipelining: the device loss is read back ONE
         # iteration behind, so the next step is dispatched before the
@@ -536,7 +577,14 @@ class LocalOptimizer(BaseOptimizer):
             loss_val = float(loss_dev)
             # in pipelined steady state this spans dispatch -> observed
             # completion (~ device step time + one iteration's host work)
-            self.metrics.add("computing time", time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            self.metrics.add("computing time", dt)
+            if runtime is not None:
+                # feeds the step-time p50/p95/p99 reservoir; the span is
+                # retroactive (complete) because under pipelining this
+                # resolves one iteration after its dispatch
+                runtime.record_step(dt)
+                tracer.complete("computing", t0, dt, step=n)
             self.state["loss"] = loss_val
             if self.train_summary is not None:
                 self.train_summary.add_scalar("Loss", loss_val, n)
@@ -555,6 +603,15 @@ class LocalOptimizer(BaseOptimizer):
                     self._nonfinite_consec, self.state["nonfinite_skips"])
                 self._summary_resilience(
                     n, nonfinite_skips=self.state["nonfinite_skips"])
+                # structured resilience telemetry: an instant trace
+                # event per skip (not only the cumulative counter)
+                tracer.event("resilience.nonfinite_skip", step=n,
+                             loss=loss_val,
+                             consecutive=self._nonfinite_consec,
+                             total=self.state["nonfinite_skips"])
+                obs.get_registry().counter(
+                    "bigdl_nonfinite_skips_total",
+                    "Train steps skipped by the non-finite guard").inc()
                 if self._nonfinite_consec >= max_nonfinite:
                     raise NonFiniteStepError(
                         f"{self._nonfinite_consec} consecutive non-finite "
@@ -594,67 +651,76 @@ class LocalOptimizer(BaseOptimizer):
                 except StopIteration:
                     batch_exhausted = True
                     break
-                self.metrics.add("data wait time",
-                                 time.perf_counter() - t_wait)
-                prepared = self._prepare_batch(inp, tgt)
-                if prepared is None:
-                    continue  # dropped (e.g. sub-mesh partial batch)
-                inp, tgt = prepared
-                if self._fault_injector is not None:
-                    # chaos hook: may raise InjectedFault (transient) or
-                    # poison this batch to exercise the non-finite guard
-                    action = self._fault_injector.on_step(
-                        self.state["neval"])
-                    if action == "nan_grad":
-                        inp = self._fault_injector.poison_batch(inp)
-                profiler.step()
-                rng = jax.random.fold_in(base_key, self.state["neval"])
-                with self.metrics.timer("put batch time"):
-                    inp_d, tgt_d = self._put_batch(inp, tgt)
-                t0 = time.perf_counter()
-                pvar, opt_state, mod_state, loss, ok = train_step(
-                    pvar, opt_state, mod_state, rng, inp_d, tgt_d
-                )
+                dt_wait = time.perf_counter() - t_wait
+                self.metrics.add("data wait time", dt_wait)
                 n = self.state["neval"]
-                bs = np.asarray(inp).shape[0]
-                records_total += bs
-                if sync_per_step:
-                    resolve(n, loss, ok, bs, t0)
-                else:
-                    # the step is dispatched; reading back the PREVIOUS
-                    # loss now lets the device run two-deep
-                    flush_pending()
-                    pending.append((n, loss, ok, bs, t0))
-                if self.train_summary is not None:
-                    # histograms stay on the synchronous path: pvar here
-                    # IS step n's output and neval is still n, so the
-                    # trigger timing and logged params match sync mode
-                    # exactly (reference setSummaryTrigger("Parameters"))
-                    ptrig = self.train_summary.get_summary_trigger(
-                        "Parameters")
-                    if ptrig is not None and ptrig(self.state):
-                        self._write_param_histograms(pvar, n)
-                self.state["neval"] = n + 1
-                opt.state = opt_state
-                if self.validation_trigger is not None and self.validation_trigger(
-                    self.state
-                ):
-                    flush_pending()
-                    # device-resident params: no host weight copy per
-                    # validation trigger (VERDICT r2 #3)
-                    self._run_validation(pvar, mod_state)
-                    model.training()
-                if self.checkpoint_trigger is not None and self.checkpoint_trigger(
-                    self.state
-                ):
-                    flush_pending()
-                    with self.metrics.timer("write back time"):
-                        self._write_back(pvar, mod_state)
+                # trace phases mirror the reference Metrics names + the
+                # named_scope phases of the jitted step; tracer is the
+                # shared no-op object when observability is off
+                tracer.complete("data_wait", t_wait, dt_wait, step=n)
+                with tracer.span("iteration", step=n):
+                    with tracer.span("batch_prep"):
+                        prepared = self._prepare_batch(inp, tgt)
+                    if prepared is None:
+                        continue  # dropped (e.g. sub-mesh partial batch)
+                    inp, tgt = prepared
+                    if self._fault_injector is not None:
+                        # chaos hook: may raise InjectedFault (transient)
+                        # or poison this batch to exercise the non-finite
+                        # guard
+                        action = self._fault_injector.on_step(n)
+                        if action == "nan_grad":
+                            inp = self._fault_injector.poison_batch(inp)
+                    profiler.step()
+                    rng = jax.random.fold_in(base_key, n)
+                    with self.metrics.timer("put batch time"), \
+                            tracer.span("device_put"):
+                        inp_d, tgt_d = self._put_batch(inp, tgt)
+                    t0 = time.perf_counter()
+                    with tracer.span("step_dispatch"):
+                        pvar, opt_state, mod_state, loss, ok = train_step(
+                            pvar, opt_state, mod_state, rng, inp_d, tgt_d
+                        )
+                    bs = np.asarray(inp).shape[0]
+                    records_total += bs
+                    if sync_per_step:
+                        resolve(n, loss, ok, bs, t0)
+                    else:
+                        # the step is dispatched; reading back the
+                        # PREVIOUS loss now lets the device run two-deep
+                        flush_pending()
+                        pending.append((n, loss, ok, bs, t0))
+                    if self.train_summary is not None:
+                        # histograms stay on the synchronous path: pvar
+                        # here IS step n's output and neval is still n,
+                        # so the trigger timing and logged params match
+                        # sync mode exactly (reference
+                        # setSummaryTrigger("Parameters"))
+                        ptrig = self.train_summary.get_summary_trigger(
+                            "Parameters")
+                        if ptrig is not None and ptrig(self.state):
+                            self._write_param_histograms(pvar, n)
+                    self.state["neval"] = n + 1
                     opt.state = opt_state
-                    self._checkpoint()
-                if self.end_when(self.state):
-                    stop = True
-                    break
+                    if self.validation_trigger is not None and \
+                            self.validation_trigger(self.state):
+                        flush_pending()
+                        # device-resident params: no host weight copy per
+                        # validation trigger (VERDICT r2 #3)
+                        with tracer.span("validation", step=n):
+                            self._run_validation(pvar, mod_state)
+                        model.training()
+                    if self.checkpoint_trigger is not None and \
+                            self.checkpoint_trigger(self.state):
+                        flush_pending()
+                        with tracer.span("checkpoint", step=n):
+                            with self.metrics.timer("write back time"):
+                                self._write_back(pvar, mod_state)
+                            opt.state = opt_state
+                            self._checkpoint()
+                    if self.end_when(self.state):
+                        stop = True
+                        break
             flush_pending()
             if batch_exhausted and not stop:
                 # epoch finished
@@ -674,14 +740,16 @@ class LocalOptimizer(BaseOptimizer):
                 if self.validation_trigger is not None and self.validation_trigger(
                     self.state
                 ):
-                    self._run_validation(pvar, mod_state)
+                    with tracer.span("validation", epoch=epoch):
+                        self._run_validation(pvar, mod_state)
                     model.training()
                 if self.checkpoint_trigger is not None and self.checkpoint_trigger(
                     self.state
                 ):
-                    self._write_back(pvar, mod_state)
-                    opt.state = opt_state
-                    self._checkpoint()
+                    with tracer.span("checkpoint", epoch=epoch):
+                        self._write_back(pvar, mod_state)
+                        opt.state = opt_state
+                        self._checkpoint()
                 if self.end_when(self.state):
                     stop = True
         flush_pending()

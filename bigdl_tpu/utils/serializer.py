@@ -321,6 +321,25 @@ def verify_checkpoint(path_prefix: str):
     matching size and sha256 (a recorded-but-missing ``.optim`` pair
     fails the check).  Without one (legacy writer): the model npz must
     at least open as a zip container."""
+    from bigdl_tpu import obs
+
+    tracer = obs.get_tracer()
+    with tracer.span("checkpoint.verify",
+                     prefix=os.path.basename(path_prefix)):
+        ok, reason = _verify_checkpoint_impl(path_prefix)
+    if not ok:
+        # integrity failures are first-class telemetry: the retry path
+        # skipping a torn checkpoint must be visible in the trace, not
+        # only in a log line
+        tracer.event("resilience.checkpoint_verify_failed",
+                     prefix=os.path.basename(path_prefix), reason=reason)
+        obs.get_registry().counter(
+            "bigdl_checkpoint_verify_failures_total",
+            "Checkpoint pairs that failed the integrity check").inc()
+    return ok, reason
+
+
+def _verify_checkpoint_impl(path_prefix: str):
     model_path = path_prefix + ".model.npz"
     if not os.path.exists(model_path):
         return False, "missing .model.npz"
@@ -392,27 +411,37 @@ def write_checkpoint(snap: dict, path_prefix: str, keep_last: int = 0):
     transfers happen HERE — safe on a background thread), write the
     model/optim pair atomically + its integrity manifest, then apply
     retention (``keep_last``) and any injected checkpoint fault."""
-    arrays = _module_arrays(snap["spec"], snap["p_leaves"],
-                            snap["s_leaves"])
-    _atomic_savez(path_prefix + ".model", arrays)
-    if snap["optim"] is not None:
-        opt_arrays = {k: np.asarray(v)
-                      for k, v in snap["optim"]["arrays"].items()}
-        meta = {
-            "class": snap["optim"]["class"],
-            "extra": snap["optim"]["extra"],
-        }
-        opt_arrays["__meta__"] = np.frombuffer(
-            json.dumps(meta).encode("utf-8"), dtype=np.uint8
-        )
-        _atomic_savez(path_prefix + ".optim", opt_arrays)
-    write_manifest(path_prefix)
-    # chaos hook: post-write corruption the verify-on-load must catch
-    from bigdl_tpu.resilience.faults import get_injector
+    from bigdl_tpu import obs
 
-    get_injector().on_checkpoint_write(path_prefix)
-    if keep_last:
-        gc_checkpoints(os.path.dirname(path_prefix) or ".", keep_last)
+    # the span lands on the writer's own thread (the background ckpt
+    # thread gets its own Chrome tid), so async writes overlapping the
+    # train loop are visible as exactly that on the timeline
+    with obs.get_tracer().span("checkpoint.write",
+                               prefix=os.path.basename(path_prefix)):
+        arrays = _module_arrays(snap["spec"], snap["p_leaves"],
+                                snap["s_leaves"])
+        _atomic_savez(path_prefix + ".model", arrays)
+        if snap["optim"] is not None:
+            opt_arrays = {k: np.asarray(v)
+                          for k, v in snap["optim"]["arrays"].items()}
+            meta = {
+                "class": snap["optim"]["class"],
+                "extra": snap["optim"]["extra"],
+            }
+            opt_arrays["__meta__"] = np.frombuffer(
+                json.dumps(meta).encode("utf-8"), dtype=np.uint8
+            )
+            _atomic_savez(path_prefix + ".optim", opt_arrays)
+        write_manifest(path_prefix)
+        # chaos hook: post-write corruption the verify-on-load must catch
+        from bigdl_tpu.resilience.faults import get_injector
+
+        get_injector().on_checkpoint_write(path_prefix)
+        if keep_last:
+            gc_checkpoints(os.path.dirname(path_prefix) or ".", keep_last)
+    obs.get_registry().counter(
+        "bigdl_checkpoint_writes_total",
+        "Checkpoint pairs written (model + optim + manifest)").inc()
     return path_prefix
 
 
@@ -429,9 +458,14 @@ def save_checkpoint(path_prefix: str, model, optim_method=None,
 def load_checkpoint(path_prefix: str, model, optim_method=None) -> dict:
     """Load weights into ``model`` (in place) and state into
     ``optim_method``; returns the extra dict (epoch/neval)."""
-    import jax
-    import jax.numpy as jnp
+    from bigdl_tpu import obs
 
+    with obs.get_tracer().span("checkpoint.load",
+                               prefix=os.path.basename(path_prefix)):
+        return _load_checkpoint_impl(path_prefix, model, optim_method)
+
+
+def _load_checkpoint_impl(path_prefix, model, optim_method):
     loaded = load_module(path_prefix + ".model")
     model.set_params(loaded.params())
     model.set_state(loaded.state())

@@ -21,6 +21,7 @@ all the reference emits, so a protobuf compiler would be overkill:
 
 from __future__ import annotations
 
+import itertools
 import os
 import struct
 import time
@@ -130,11 +131,20 @@ def _encode_histogram_event(tag: str, values: np.ndarray, step: int,
 
 class FileWriter:
     """«bigdl»/visualization/tensorboard/FileWriter.scala — appends
-    framed events to an events.out.tfevents.* file."""
+    framed events to an events.out.tfevents.* file.
+
+    The file name carries pid + a process-wide monotonic counter on top
+    of the timestamp: two writers created in the same second in the
+    same dir (fast tests, per-retry summaries) must get distinct files,
+    never silently append to one stream.
+    """
+
+    _SEQ = itertools.count()
 
     def __init__(self, log_dir: str):
         os.makedirs(log_dir, exist_ok=True)
-        fname = f"events.out.tfevents.{int(time.time())}.bigdl_tpu"
+        fname = (f"events.out.tfevents.{int(time.time())}"
+                 f".{os.getpid()}.{next(FileWriter._SEQ)}.bigdl_tpu")
         self.path = os.path.join(log_dir, fname)
         self._f = open(self.path, "ab")
         # file-version header event
@@ -158,7 +168,17 @@ class FileWriter:
         return self
 
     def close(self):
-        self._f.close()
+        """Idempotent: a double close (user + context manager, or an
+        exception path re-running cleanup) is a no-op."""
+        if not self._f.closed:
+            self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
 
 
 class _Summary:
@@ -185,8 +205,27 @@ class _Summary:
             out.extend(_read_scalars(os.path.join(self.log_dir, fname), tag))
         return out
 
+    def read_histogram(self, tag: str):
+        """Read back (step, histogram-dict) pairs of a tag — the
+        reader-side half of the hand-rolled HistogramProto framing, so
+        writer→reader parity is testable without TensorBoard."""
+        out = []
+        for fname in sorted(os.listdir(self.log_dir)):
+            if "tfevents" not in fname:
+                continue
+            out.extend(_read_histograms(
+                os.path.join(self.log_dir, fname), tag))
+        return out
+
     def close(self):
         self.writer.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
 
 
 # resilience counters the optimizer loop emits (cumulative values):
@@ -242,8 +281,10 @@ def _read_varint(buf: bytes, pos: int):
         shift += 7
 
 
-def _read_scalars(path: str, want_tag: str):
-    out = []
+def _iter_summary_values(path: str):
+    """Walk the framed event file, yielding (step, value_msg bytes) for
+    every Summary.Value — the shared framing layer under the scalar and
+    histogram readers (one decoder, so the two can never drift)."""
     with open(path, "rb") as f:
         data = f.read()
     pos = 0
@@ -277,28 +318,92 @@ def _read_scalars(path: str, want_tag: str):
             key, spos = _read_varint(summary, spos)
             if key >> 3 == 1 and key & 7 == 2:
                 ln, spos = _read_varint(summary, spos)
-                value_msg = summary[spos : spos + ln]
+                yield step, summary[spos : spos + ln]
                 spos += ln
-                tag, simple = None, None
-                vpos = 0
-                while vpos < len(value_msg):
-                    k2, vpos = _read_varint(value_msg, vpos)
-                    f2, w2 = k2 >> 3, k2 & 7
-                    if w2 == 2:
-                        ln2, vpos = _read_varint(value_msg, vpos)
-                        if f2 == 1:
-                            tag = value_msg[vpos : vpos + ln2].decode()
-                        vpos += ln2
-                    elif w2 == 5:
-                        if f2 == 2:
-                            (simple,) = struct.unpack_from("<f", value_msg, vpos)
-                        vpos += 4
-                    elif w2 == 1:
-                        vpos += 8
-                    elif w2 == 0:
-                        _, vpos = _read_varint(value_msg, vpos)
-                if tag == want_tag and simple is not None:
-                    out.append((step, simple))
             else:
                 break
+
+
+def _read_scalars(path: str, want_tag: str):
+    out = []
+    for step, value_msg in _iter_summary_values(path):
+        tag, simple = None, None
+        vpos = 0
+        while vpos < len(value_msg):
+            k2, vpos = _read_varint(value_msg, vpos)
+            f2, w2 = k2 >> 3, k2 & 7
+            if w2 == 2:
+                ln2, vpos = _read_varint(value_msg, vpos)
+                if f2 == 1:
+                    tag = value_msg[vpos : vpos + ln2].decode()
+                vpos += ln2
+            elif w2 == 5:
+                if f2 == 2:
+                    (simple,) = struct.unpack_from("<f", value_msg, vpos)
+                vpos += 4
+            elif w2 == 1:
+                vpos += 8
+            elif w2 == 0:
+                _, vpos = _read_varint(value_msg, vpos)
+        if tag == want_tag and simple is not None:
+            out.append((step, simple))
+    return out
+
+
+def _parse_histo(histo: bytes) -> dict:
+    """Decode a HistogramProto (fields as in the module docstring)."""
+    out = {"min": 0.0, "max": 0.0, "num": 0.0, "sum": 0.0,
+           "sum_squares": 0.0, "bucket_limit": [], "bucket": []}
+    names = {1: "min", 2: "max", 3: "num", 4: "sum", 5: "sum_squares"}
+    pos = 0
+    while pos < len(histo):
+        key, pos = _read_varint(histo, pos)
+        field, wire = key >> 3, key & 7
+        if wire == 1:
+            (v,) = struct.unpack_from("<d", histo, pos)
+            pos += 8
+            if field in names:
+                out[names[field]] = v
+            elif field == 6:
+                out["bucket_limit"].append(v)  # unpacked repeated form
+            elif field == 7:
+                out["bucket"].append(v)
+        elif wire == 2:
+            ln, pos = _read_varint(histo, pos)
+            payload = histo[pos : pos + ln]
+            pos += ln
+            if field in (6, 7):  # packed repeated doubles
+                vals = [struct.unpack_from("<d", payload, i)[0]
+                        for i in range(0, len(payload) - 7, 8)]
+                out["bucket_limit" if field == 6 else "bucket"].extend(vals)
+        elif wire == 0:
+            _, pos = _read_varint(histo, pos)
+        elif wire == 5:
+            pos += 4
+    return out
+
+
+def _read_histograms(path: str, want_tag: str):
+    out = []
+    for step, value_msg in _iter_summary_values(path):
+        tag, histo = None, None
+        vpos = 0
+        while vpos < len(value_msg):
+            k2, vpos = _read_varint(value_msg, vpos)
+            f2, w2 = k2 >> 3, k2 & 7
+            if w2 == 2:
+                ln2, vpos = _read_varint(value_msg, vpos)
+                if f2 == 1:
+                    tag = value_msg[vpos : vpos + ln2].decode()
+                elif f2 == 5:
+                    histo = value_msg[vpos : vpos + ln2]
+                vpos += ln2
+            elif w2 == 5:
+                vpos += 4
+            elif w2 == 1:
+                vpos += 8
+            elif w2 == 0:
+                _, vpos = _read_varint(value_msg, vpos)
+        if tag == want_tag and histo is not None:
+            out.append((step, _parse_histo(histo)))
     return out

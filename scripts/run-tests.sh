@@ -5,8 +5,13 @@
 # pytest.  Usage: scripts/run-tests.sh [pytest args]
 #   scripts/run-tests.sh --chaos [pytest args]   # only the fault-injection
 #                                                # / recovery specs (-m chaos)
-# The chaos specs are deterministic and part of the default selection;
-# --chaos is the focused loop for hacking on the resilience layer.
+#   scripts/run-tests.sh --trace [pytest args]   # observability smoke: tiny
+#                                                # traced train loops that
+#                                                # assert a well-formed Chrome
+#                                                # trace + Prometheus snapshot
+#                                                # (-m obs)
+# The chaos and obs specs are deterministic and part of the default
+# selection; the flags are the focused loops for hacking on those layers.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -17,6 +22,9 @@ MARKER=()
 if [[ "${1:-}" == "--chaos" ]]; then
   shift
   MARKER=(-m chaos)
+elif [[ "${1:-}" == "--trace" ]]; then
+  shift
+  MARKER=(-m obs)
 fi
 
 exec python -m pytest tests/ -q "${MARKER[@]}" "$@"

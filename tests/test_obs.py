@@ -1,0 +1,667 @@
+"""Observability-layer specs — span tracer, metrics registry, runtime
+profiling, and the cross-stack instrumentation (ISSUE 2).
+
+The acceptance gate lives here: a chaos-free 20-step DistriOptimizer
+run with ``BIGDL_TRACE_DIR`` set must produce a Chrome trace JSON that
+loads (nested per-phase spans), a parseable Prometheus text snapshot,
+and step-time percentiles — and with observability disabled the train
+loop must take the shared no-op fast path (NULL tracer, no reservoir,
+no output files).
+"""
+
+import json
+import os
+import re
+import threading
+
+import numpy as np
+import pytest
+
+from bigdl_tpu import obs
+from bigdl_tpu.engine import Engine
+from bigdl_tpu.nn import ClassNLLCriterion, Linear, LogSoftMax, ReLU, Sequential
+from bigdl_tpu.obs.metrics import MetricsRegistry
+from bigdl_tpu.obs.runtime import Reservoir, RuntimeStats, instrument_jit
+from bigdl_tpu.obs.trace import NULL_TRACER, Tracer
+from bigdl_tpu.optim import DistriOptimizer, LocalOptimizer, SGD, Trigger
+from bigdl_tpu.optim.metrics import Metrics
+from bigdl_tpu.resilience import reset_injector
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs(monkeypatch):
+    """Every spec starts with observability OFF and fresh singletons."""
+    for var in ("BIGDL_OBS", "BIGDL_TRACE_DIR", "BIGDL_METRICS_DIR",
+                "BIGDL_FAULT_PLAN"):
+        monkeypatch.delenv(var, raising=False)
+    reset_injector()
+    obs.reset()
+    yield
+    obs.reset()
+    reset_injector()
+
+
+def _toy(n=256, d=16, k=4, seed=0):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(d, k)
+    x = rng.randn(n, d).astype(np.float32)
+    y = (np.argmax(x @ w, axis=1) + 1).astype(np.float32)
+    return x, y
+
+
+def _model(d=16, k=4):
+    return Sequential().add(Linear(d, 32)).add(ReLU()).add(Linear(32, k)) \
+        .add(LogSoftMax())
+
+
+# one sample line of Prometheus text exposition format 0.0.4
+_PROM_SAMPLE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*'                      # metric name
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"'              # first label
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\})?'         # more labels
+    r' [-+0-9.eE]+(inf|nan)?$')
+
+
+def _assert_prometheus_parses(text):
+    assert text.strip(), "empty exposition"
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        assert _PROM_SAMPLE.match(line), f"bad exposition line: {line!r}"
+
+
+def _prom_value(text, name, **labels):
+    """Value of the sample `name{labels}` in an exposition text."""
+    for line in text.splitlines():
+        if not line.startswith(name):
+            continue
+        rest = line[len(name):]
+        if rest.startswith("{"):
+            body, value = rest[1:].split("}", 1)
+            got = dict(p.split("=", 1) for p in body.split(",") if p)
+            got = {k: v.strip('"') for k, v in got.items()}
+        else:
+            got, value = {}, rest
+        if all(got.get(k) == str(v) for k, v in labels.items()):
+            return float(value)
+    return None
+
+
+# ------------------------------------------------------------- registry
+class TestMetricsRegistry:
+    def test_counter_inc_and_labels(self):
+        reg = MetricsRegistry()
+        fam = reg.counter("requests_total", "reqs", labels=("code",))
+        fam.labels(code=200).inc()
+        fam.labels(code=200).inc(2)
+        fam.labels(code=500).inc()
+        assert fam.labels(code=200).value == 3
+        assert fam.labels(code=500).value == 1
+        with pytest.raises(ValueError):
+            fam.labels(code=200).inc(-1)  # counters only go up
+        with pytest.raises(ValueError):
+            fam.labels(status=200)        # undeclared label name
+
+    def test_labelless_convenience(self):
+        reg = MetricsRegistry()
+        reg.counter("c_total").inc(5)
+        reg.gauge("g").set(2.5)
+        reg.histogram("h_seconds").observe(0.3)
+        assert reg.counter("c_total").labels().value == 5
+        assert reg.gauge("g").labels().value == 2.5
+        assert reg.histogram("h_seconds").labels().count == 1
+        with pytest.raises(ValueError):  # labeled family has no solo child
+            reg.counter("lc_total", labels=("x",)).inc()
+
+    def test_histogram_buckets_cumulative(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("h", buckets=(0.1, 1.0, 10.0)).labels()
+        for v in (0.05, 0.5, 5.0, 50.0):
+            h.observe(v)
+        cum = dict(h.cumulative())
+        assert cum[0.1] == 1 and cum[1.0] == 2 and cum[10.0] == 3
+        assert cum[float("inf")] == 4
+        assert h.count == 4
+        np.testing.assert_allclose(h.sum, 55.55)
+        np.testing.assert_allclose(h.mean, 55.55 / 4)
+
+    def test_registration_idempotent_and_conflict_loud(self):
+        reg = MetricsRegistry()
+        a = reg.counter("x_total", labels=("k",))
+        assert reg.counter("x_total", labels=("k",)) is a
+        with pytest.raises(ValueError):
+            reg.gauge("x_total")               # kind conflict
+        with pytest.raises(ValueError):
+            reg.counter("x_total", labels=())  # label conflict
+
+    def test_prometheus_exposition(self):
+        reg = MetricsRegistry()
+        reg.counter("bigdl_retries_total", "retries",
+                    labels=("classification",)).labels(
+            classification="transient").inc(3)
+        reg.gauge("bigdl_rss_bytes", "rss").set(12345)
+        reg.histogram("bigdl_lat_seconds", "latency",
+                      buckets=(0.5, 1.0)).observe(0.7)
+        text = reg.to_prometheus()
+        _assert_prometheus_parses(text)
+        assert "# TYPE bigdl_retries_total counter" in text
+        assert _prom_value(text, "bigdl_retries_total",
+                           classification="transient") == 3
+        assert _prom_value(text, "bigdl_rss_bytes") == 12345
+        assert _prom_value(text, "bigdl_lat_seconds_bucket", le="0.5") == 0
+        assert _prom_value(text, "bigdl_lat_seconds_bucket", le="1") == 1
+        assert _prom_value(text, "bigdl_lat_seconds_bucket", le="+Inf") == 1
+        assert _prom_value(text, "bigdl_lat_seconds_count") == 1
+
+    def test_snapshot_json_roundtrip(self):
+        reg = MetricsRegistry()
+        reg.counter("c_total").inc()
+        reg.histogram("h").observe(0.2)
+        snap = json.loads(json.dumps(reg.snapshot()))
+        assert snap["metrics"]["c_total"]["samples"][0]["value"] == 1
+        hsamp = snap["metrics"]["h"]["samples"][0]
+        assert hsamp["count"] == 1
+        assert hsamp["buckets"][-1][0] == "+Inf"
+
+    def test_write_snapshot_files(self, tmp_path):
+        reg = MetricsRegistry()
+        reg.counter("c_total").inc(2)
+        paths = reg.write_snapshot(str(tmp_path))
+        text = open(paths["prom"]).read()
+        _assert_prometheus_parses(text)
+        assert _prom_value(text, "c_total") == 2
+        reg.write_snapshot(str(tmp_path))  # JSONL appends
+        lines = open(paths["jsonl"]).read().splitlines()
+        assert len(lines) == 2
+        assert all(json.loads(ln)["metrics"]["c_total"] for ln in lines)
+
+    def test_thread_safety(self):
+        reg = MetricsRegistry()
+        c = reg.counter("n_total").labels()
+        h = reg.histogram("h").labels()
+
+        def work():
+            for _ in range(1000):
+                c.inc()
+                h.observe(0.01)
+
+        threads = [threading.Thread(target=work) for _ in range(8)]
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        assert c.value == 8000
+        assert h.count == 8000
+
+
+# --------------------------------------------------------------- tracer
+class TestTracer:
+    def _events(self, tracer):
+        tracer.close()
+        with open(tracer.jsonl_path) as fh:
+            return [json.loads(ln) for ln in fh if ln.strip()]
+
+    def test_nested_spans_and_deterministic_ids(self, tmp_path):
+        tr = Tracer(str(tmp_path))
+        with tr.span("outer") as outer_id:
+            with tr.span("inner") as inner_id:
+                tr.event("mark", detail="x")
+        assert (outer_id, inner_id) == (1, 2)  # counter ids, no uuids
+        recs = {r["name"]: r for r in self._events(tr)}
+        assert recs["inner"]["parent"] == outer_id
+        assert recs["mark"]["parent"] == inner_id
+        assert recs["outer"]["parent"] is None
+        assert recs["mark"]["attrs"] == {"detail": "x"}
+        # durations nest: outer covers inner
+        assert recs["outer"]["dur_s"] >= recs["inner"]["dur_s"]
+
+    def test_chrome_trace_loads_and_nests(self, tmp_path):
+        tr = Tracer(str(tmp_path))
+        with tr.span("iteration", step=1):
+            with tr.span("device_put"):
+                pass
+        tr.close()
+        doc = json.load(open(tr.trace_path))
+        evs = doc["traceEvents"]
+        assert isinstance(evs, list) and evs
+        by_name = {e["name"]: e for e in evs if e.get("ph") == "X"}
+        it, dp = by_name["iteration"], by_name["device_put"]
+        for e in (it, dp):
+            assert {"ts", "dur", "pid", "tid", "ph"} <= set(e)
+        # timestamp containment = nesting on the Chrome timeline
+        assert it["ts"] <= dp["ts"]
+        assert dp["ts"] + dp["dur"] <= it["ts"] + it["dur"] + 1e-3
+        assert it["args"] == {"step": 1}
+
+    def test_same_dir_tracers_never_collide(self, tmp_path):
+        a = Tracer(str(tmp_path))
+        b = Tracer(str(tmp_path))  # same second, same dir
+        assert a.trace_path != b.trace_path
+        assert a.jsonl_path != b.jsonl_path
+        a.close()
+        b.close()
+
+    def test_threads_get_own_tid(self, tmp_path):
+        tr = Tracer(str(tmp_path))
+        with tr.span("main"):
+            pass
+        t = threading.Thread(target=lambda: tr.event("bg"))
+        t.start()
+        t.join()
+        tr.close()
+        doc = json.load(open(tr.trace_path))
+        tids = {e["tid"] for e in doc["traceEvents"]
+                if e["ph"] in ("X", "i")}
+        assert len(tids) == 2
+
+    def test_complete_and_counter(self, tmp_path):
+        import time
+
+        tr = Tracer(str(tmp_path))
+        t0 = time.perf_counter()
+        tr.complete("computing", t0, 0.25, step=3)
+        tr.counter("host_rss", bytes=1024)
+        tr.close()
+        doc = json.load(open(tr.trace_path))
+        comp = next(e for e in doc["traceEvents"] if e["name"] == "computing")
+        assert comp["dur"] == 250000.0  # 0.25s in us
+        ctr = next(e for e in doc["traceEvents"] if e["ph"] == "C")
+        assert ctr["args"] == {"bytes": 1024}
+
+    def test_close_idempotent_and_drops_late_records(self, tmp_path):
+        tr = Tracer(str(tmp_path))
+        tr.event("before")
+        tr.close()
+        tr.close()  # idempotent
+        tr.event("after")  # silently dropped, no crash
+        doc = json.load(open(tr.trace_path))
+        names = [e["name"] for e in doc["traceEvents"]]
+        assert "before" in names and "after" not in names
+
+    def test_flush_always_leaves_valid_json(self, tmp_path):
+        tr = Tracer(str(tmp_path))
+        for i in range(3):
+            tr.event("e", i=i)
+            tr.flush()
+            assert json.load(open(tr.trace_path))["traceEvents"]
+        tr.close()
+
+    def test_disabled_fast_path_is_shared_noop(self):
+        t = obs.get_tracer()
+        assert t is NULL_TRACER
+        # one shared context manager object — no per-span allocation
+        assert t.span("a") is t.span("b", step=1)
+        with t.span("a") as sid:
+            assert sid is None
+        t.event("x")  # all no-ops
+        t.flush()
+
+
+# -------------------------------------------------------------- runtime
+class TestRuntime:
+    def test_reservoir_percentiles_nearest_rank(self):
+        r = Reservoir(size=1000)
+        for v in range(1, 101):
+            r.add(float(v))
+        p = r.percentiles()
+        assert (p[0.5], p[0.95], p[0.99]) == (50.0, 95.0, 99.0)
+        s = r.summary()
+        assert s["count"] == 100 and s["p50"] == 50.0
+        np.testing.assert_allclose(s["mean"], 50.5)
+
+    def test_reservoir_ring_keeps_most_recent(self):
+        r = Reservoir(size=10)
+        for v in range(1, 21):
+            r.add(float(v))
+        assert r.count == 20
+        assert r.percentiles([1.0])[1.0] == 20.0
+        assert r.percentiles([0.0])[0.0] == 11.0  # oldest retained
+
+    def test_empty_reservoir(self):
+        s = Reservoir().summary()
+        assert s["p50"] is None and s["count"] == 0 and s["mean"] is None
+
+    def test_instrument_jit_compile_vs_dispatch(self):
+        import jax
+        import jax.numpy as jnp
+
+        stats = RuntimeStats()
+        fn = instrument_jit(jax.jit(lambda a: a * 2), "mul", stats=stats)
+        x4 = jnp.ones((4,), jnp.float32)
+        fn(x4)
+        fn(x4)
+        fn(x4)
+        assert stats.compile_count == 1          # one signature, one compile
+        assert stats.dispatch_times.count == 2   # two cached dispatches
+        fn(jnp.ones((8,), jnp.float32))          # new shape -> recompile
+        assert stats.compile_count == 2
+        assert stats.compile_events[0]["name"] == "mul"
+        assert stats.compile_events[0]["seconds"] > 0
+
+    def test_snapshot_shape_and_memory(self):
+        stats = RuntimeStats()
+        stats.record_step(0.01)
+        snap = stats.snapshot()
+        assert snap["step_time_s"]["count"] == 1
+        assert snap["compile"]["count"] == 0
+        assert snap["host_rss_bytes"] is None or snap["host_rss_bytes"] > 0
+
+    def test_host_rss_positive_on_linux(self):
+        from bigdl_tpu.obs.runtime import host_rss_bytes
+
+        rss = host_rss_bytes()
+        if os.path.exists("/proc/self/statm"):
+            assert rss > 10 * 1024 * 1024  # a python+jax process is >10MB
+
+
+# -------------------------------------------- Metrics delegation bridge
+class TestMetricsDelegation:
+    def test_value_is_mean(self):
+        m = Metrics()
+        m.add("computing time", 0.1)
+        m.add("computing time", 0.3)
+        np.testing.assert_allclose(m.value("computing time"), 0.2)
+        assert m.count("computing time") == 2
+        np.testing.assert_allclose(m.total("computing time"), 0.4)
+        assert m.value("never seen") == 0.0
+
+    def test_summary_reports_mean_count_total(self):
+        m = Metrics()
+        m.add("computing time", 0.010)
+        m.add("computing time", 0.030)
+        m.add("data wait time", 0.002)
+        s = m.summary()
+        # the reference's parseable "X average: Yms" spelling survives
+        assert "computing time average: 20.00ms" in s
+        assert "(n=2, total=40.0ms)" in s
+        assert "data wait time average: 2.00ms" in s
+
+    def test_snapshot_dict(self):
+        m = Metrics()
+        m.add("put batch time", 0.5)
+        snap = m.snapshot()
+        assert snap == {"put batch time":
+                        {"count": 1, "total": 0.5, "mean": 0.5}}
+
+    def test_timer_and_reset(self):
+        m = Metrics()
+        with m.timer("phase"):
+            pass
+        assert m.count("phase") == 1
+        m.reset()
+        assert m.count("phase") == 0 and m.value("phase") == 0.0
+
+    def test_delegates_to_registry_exposition(self):
+        m = Metrics()
+        m.add("computing time", 0.25)
+        text = m.registry.to_prometheus()
+        _assert_prometheus_parses(text)
+        assert _prom_value(text, "bigdl_phase_seconds_count",
+                           phase="computing time") == 1
+        np.testing.assert_allclose(
+            _prom_value(text, "bigdl_phase_seconds_sum",
+                        phase="computing time"), 0.25)
+
+    def test_shared_registry_optin(self):
+        reg = MetricsRegistry()
+        a, b = Metrics(registry=reg), Metrics(registry=reg)
+        a.add("computing time", 0.1)
+        b.add("computing time", 0.3)
+        assert a.count("computing time") == 2  # aggregated on purpose
+
+
+# ------------------------------------------------ stack instrumentation
+def _spans_by_name(jsonl_path):
+    spans = {}
+    with open(jsonl_path) as fh:
+        for ln in fh:
+            rec = json.loads(ln)
+            spans.setdefault(rec["name"], []).append(rec)
+    return spans
+
+
+def _find_obs_files(trace_dir):
+    traces = sorted(f for f in os.listdir(trace_dir)
+                    if f.endswith(".trace.json"))
+    jsonls = sorted(f for f in os.listdir(trace_dir)
+                    if f.endswith(".events.jsonl"))
+    assert traces and jsonls
+    return (os.path.join(trace_dir, traces[-1]),
+            os.path.join(trace_dir, jsonls[-1]))
+
+
+class TestTrainingInstrumentation:
+    def test_distri_20_steps_trace_prometheus_percentiles(
+            self, tmp_path, monkeypatch):
+        """THE acceptance gate: chaos-free 20-step DistriOptimizer run
+        with BIGDL_TRACE_DIR set -> Chrome trace with nested per-phase
+        spans, parseable Prometheus snapshot, step-time percentiles."""
+        trace_dir = str(tmp_path / "trace")
+        metrics_dir = str(tmp_path / "metrics")
+        monkeypatch.setenv("BIGDL_TRACE_DIR", trace_dir)
+        monkeypatch.setenv("BIGDL_METRICS_DIR", metrics_dir)
+        obs.reset()
+        Engine.reset()
+        Engine.init()
+        try:
+            x, y = _toy(n=640)
+            opt = DistriOptimizer(_model(), (x, y), ClassNLLCriterion(),
+                                  batch_size=32)
+            opt.set_optim_method(SGD(learningrate=0.1))
+            opt.set_end_when(Trigger.max_iteration(20))
+            opt.optimize()
+        finally:
+            Engine.reset()
+        assert opt.state["neval"] == 21  # exactly 20 steps ran
+
+        # --- Chrome trace loads, with nested per-phase spans ---------
+        trace_path, jsonl_path = _find_obs_files(trace_dir)
+        doc = json.load(open(trace_path))
+        evs = doc["traceEvents"]
+        assert isinstance(evs, list) and evs
+        names = {e["name"] for e in evs}
+        for phase in ("iteration", "batch_prep", "device_put",
+                      "step_dispatch", "computing", "build_train_step",
+                      "engine.init"):
+            assert phase in names, f"missing {phase} in trace"
+        # nesting: every device_put/step_dispatch sits inside an
+        # iteration span on the timeline (ts containment, same tid)
+        its = [e for e in evs
+               if e.get("ph") == "X" and e["name"] == "iteration"]
+        assert len(its) == 20
+        for child_name in ("device_put", "step_dispatch"):
+            children = [e for e in evs
+                        if e.get("ph") == "X" and e["name"] == child_name]
+            assert len(children) == 20
+            for c in children:
+                assert any(
+                    p["ts"] <= c["ts"] and
+                    c["ts"] + c["dur"] <= p["ts"] + p["dur"] + 1e-3 and
+                    p["tid"] == c["tid"]
+                    for p in its), f"unnested {child_name}"
+        # the structured JSONL agrees on parentage (contextvar nesting)
+        spans = _spans_by_name(jsonl_path)
+        iter_ids = {s["id"] for s in spans["iteration"]}
+        assert all(s["parent"] in iter_ids for s in spans["step_dispatch"])
+        assert all(s["parent"] in iter_ids for s in spans["batch_prep"])
+
+        # --- Prometheus snapshot parses and carries the numbers ------
+        prom = [f for f in os.listdir(metrics_dir) if f.endswith(".prom")]
+        assert prom
+        text = open(os.path.join(metrics_dir, prom[0])).read()
+        _assert_prometheus_parses(text)
+        # reference phase timers via the Metrics delegation bridge
+        assert _prom_value(text, "bigdl_phase_seconds_count",
+                           phase="computing time") == 20
+        assert _prom_value(text, "bigdl_phase_seconds_count",
+                           phase="put batch time") == 20
+        # step-time percentiles from the runtime reservoir
+        p50 = _prom_value(text, "bigdl_step_time_seconds", quantile="p50")
+        p95 = _prom_value(text, "bigdl_step_time_seconds", quantile="p95")
+        p99 = _prom_value(text, "bigdl_step_time_seconds", quantile="p99")
+        assert p50 is not None and 0 < p50 <= p95 <= p99
+        # compile tracking saw the first-call trace+compile
+        assert _prom_value(text, "bigdl_jit_compile_count") >= 1
+        assert _prom_value(text, "bigdl_engine_inits_total") == 1
+        # runtime reservoir really holds 20 step samples
+        snap = obs.get_runtime().snapshot(memory=False)
+        assert snap["step_time_s"]["count"] == 20
+        assert snap["compile"]["count"] >= 1
+        # JSONL metric snapshot parses too
+        jsonl = [f for f in os.listdir(metrics_dir)
+                 if f.endswith(".jsonl")]
+        assert jsonl
+        rec = json.loads(open(
+            os.path.join(metrics_dir, jsonl[0])).readline())
+        assert "bigdl_step_time_seconds" in rec["metrics"]
+
+    def test_disabled_is_noop_and_writes_nothing(self, tmp_path,
+                                                 monkeypatch):
+        """Observability off (the default): the loop binds the shared
+        NULL tracer, no runtime reservoir is fed, and no obs files are
+        written anywhere near the run."""
+        x, y = _toy(n=64)
+        opt = LocalOptimizer(_model(), (x, y), ClassNLLCriterion(),
+                             batch_size=32)
+        opt.set_optim_method(SGD(learningrate=0.1))
+        opt.set_end_when(Trigger.max_epoch(1))
+        opt.optimize()
+        assert opt._obs_tracer is NULL_TRACER
+        assert opt._obs_runtime is None
+        assert obs.get_runtime().step_times.count == 0
+        assert os.listdir(tmp_path) == []
+
+    def test_local_optimizer_traces_too(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("BIGDL_TRACE_DIR", str(tmp_path))
+        obs.reset()
+        x, y = _toy(n=64)
+        opt = LocalOptimizer(_model(), (x, y), ClassNLLCriterion(),
+                             batch_size=32)
+        opt.set_optim_method(SGD(learningrate=0.1))
+        opt.set_end_when(Trigger.max_epoch(1))
+        opt.optimize()
+        trace_path, _ = _find_obs_files(str(tmp_path))
+        names = {e["name"] for e in
+                 json.load(open(trace_path))["traceEvents"]}
+        assert {"iteration", "step_dispatch", "computing"} <= names
+
+    def test_checkpoint_spans_recorded(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("BIGDL_TRACE_DIR", str(tmp_path / "trace"))
+        obs.reset()
+        x, y = _toy(n=64)
+        opt = LocalOptimizer(_model(), (x, y), ClassNLLCriterion(),
+                             batch_size=32)
+        opt.set_optim_method(SGD(learningrate=0.1))
+        opt.set_end_when(Trigger.max_epoch(1))
+        opt.set_checkpoint(str(tmp_path / "ckpt"),
+                           Trigger.several_iteration(1))
+        opt.optimize()
+        trace_path, _ = _find_obs_files(str(tmp_path / "trace"))
+        names = {e["name"] for e in
+                 json.load(open(trace_path))["traceEvents"]}
+        assert "checkpoint" in names
+        assert "checkpoint.write" in names  # serializer-level span
+        from bigdl_tpu.utils.serializer import verify_checkpoint, \
+            checkpoint_prefixes
+
+        prefix = os.path.join(str(tmp_path / "ckpt"),
+                              checkpoint_prefixes(str(tmp_path / "ckpt"))[0])
+        assert verify_checkpoint(prefix)[0]
+        obs.get_tracer().flush()
+        names = {e["name"] for e in
+                 json.load(open(trace_path))["traceEvents"]}
+        assert "checkpoint.verify" in names
+
+    def test_nonfinite_skip_emits_structured_event(self, tmp_path,
+                                                   monkeypatch):
+        """resilience -> obs bridge: a poisoned batch (nan_grad fault)
+        shows up as a resilience.nonfinite_skip trace event AND a
+        registry counter, not only the cumulative summary scalar."""
+        monkeypatch.setenv("BIGDL_TRACE_DIR", str(tmp_path))
+        monkeypatch.setenv("BIGDL_FAULT_PLAN", "step:2:nan_grad")
+        obs.reset()
+        reset_injector()
+        x, y = _toy(n=128)
+        opt = LocalOptimizer(_model(), (x, y), ClassNLLCriterion(),
+                             batch_size=32)
+        opt.set_optim_method(SGD(learningrate=0.1))
+        opt.set_end_when(Trigger.max_epoch(1))
+        opt.optimize()
+        assert opt.state["nonfinite_skips"] == 1
+        _, jsonl_path = _find_obs_files(str(tmp_path))
+        events = _spans_by_name(jsonl_path)
+        skip = events["resilience.nonfinite_skip"][0]
+        assert skip["attrs"]["step"] == 2
+        assert skip["attrs"]["consecutive"] == 1
+        text = obs.get_registry().to_prometheus()
+        assert _prom_value(text, "bigdl_nonfinite_skips_total") == 1
+
+    def test_retry_emits_structured_event(self, tmp_path, monkeypatch):
+        """An injected transient step fault retried from checkpoint
+        leaves a resilience.retry event with classification + attempt
+        + backoff in the JSONL stream and a labeled counter."""
+        monkeypatch.setenv("BIGDL_TRACE_DIR", str(tmp_path / "trace"))
+        # 128 samples / batch 32 = 4 iters per epoch; the fault fires in
+        # epoch 2, after the epoch-1 checkpoint the retry reloads
+        monkeypatch.setenv("BIGDL_FAULT_PLAN", "step:6:raise")
+        monkeypatch.setenv("BIGDL_RETRY_BACKOFF_BASE", "0")
+        obs.reset()
+        reset_injector()
+        Engine.reset()
+        Engine.init()
+        try:
+            x, y = _toy(n=128)
+            opt = DistriOptimizer(_model(), (x, y), ClassNLLCriterion(),
+                                  batch_size=32, wire_dtype="none")
+            opt.set_optim_method(SGD(learningrate=0.1))
+            opt.set_end_when(Trigger.max_epoch(2))
+            opt.set_checkpoint(str(tmp_path / "ckpt"),
+                               Trigger.every_epoch())
+            opt.optimize()
+        finally:
+            Engine.reset()
+        _, jsonl_path = _find_obs_files(str(tmp_path / "trace"))
+        events = _spans_by_name(jsonl_path)
+        retry = events["resilience.retry"][0]
+        assert retry["attrs"]["classification"] == "transient"
+        assert retry["attrs"]["error"] == "InjectedFault"
+        assert retry["attrs"]["attempt"] == 1
+        text = obs.get_registry().to_prometheus()
+        assert _prom_value(text, "bigdl_retry_attempts_total",
+                           classification="transient",
+                           error="InjectedFault") == 1
+        # the recovery reload is visible as checkpoint.load spans
+        assert "checkpoint.load" in events
+
+
+# --------------------------------------------------------------- config
+class TestObsConfig:
+    def test_off_by_default(self):
+        from bigdl_tpu.config import refresh_from_env
+
+        cfg = refresh_from_env().obs
+        assert not cfg.active
+        assert not obs.active()
+
+    def test_trace_dir_implies_active(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("BIGDL_TRACE_DIR", str(tmp_path))
+        assert obs.active()
+        t = obs.get_tracer()
+        assert t is not NULL_TRACER
+        assert t.trace_path.startswith(str(tmp_path))
+
+    def test_enabled_without_dirs(self, monkeypatch):
+        monkeypatch.setenv("BIGDL_OBS", "1")
+        assert obs.active()
+        assert obs.get_tracer() is NULL_TRACER  # stats only, no files
+        assert obs.flush() == {}  # nothing to write, no crash
+
+    def test_tracer_rebuilds_on_dir_change(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("BIGDL_TRACE_DIR", str(tmp_path / "a"))
+        a = obs.get_tracer()
+        monkeypatch.setenv("BIGDL_TRACE_DIR", str(tmp_path / "b"))
+        b = obs.get_tracer()
+        assert a is not b
+        assert b.trace_path.startswith(str(tmp_path / "b"))
+        # the replaced tracer was closed -> its trace file exists
+        assert os.path.exists(a.trace_path)

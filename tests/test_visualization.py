@@ -6,8 +6,8 @@ import struct
 
 import numpy as np
 
-from bigdl_tpu.visualization import TrainSummary, ValidationSummary
-from bigdl_tpu.visualization.summary import crc32c
+from bigdl_tpu.visualization import FileWriter, TrainSummary, ValidationSummary
+from bigdl_tpu.visualization.summary import RESILIENCE_TAGS, crc32c
 
 
 def test_crc32c_known_vectors():
@@ -35,6 +35,90 @@ def test_validation_summary_and_histogram(tmp_path):
     vs.close()
     back = vs.read_scalar("Top1Accuracy")
     assert back == [(100, np.float32(0.9))]
+
+
+def test_filewriter_same_second_no_collision(tmp_path):
+    """ISSUE satellite: two writers created in the same second in the
+    same dir must get distinct event files (pid + monotonic counter in
+    the name), never interleave into one stream."""
+    a = FileWriter(str(tmp_path))
+    b = FileWriter(str(tmp_path))
+    assert a.path != b.path
+    a.add_scalar("A", 1.0, 1)
+    b.add_scalar("B", 2.0, 1)
+    a.close()
+    b.close()
+    files = [f for f in os.listdir(tmp_path) if "tfevents" in f]
+    assert len(files) == 2
+
+
+def test_filewriter_close_idempotent_and_context_manager(tmp_path):
+    w = FileWriter(str(tmp_path))
+    w.add_scalar("x", 1.0, 0)
+    w.close()
+    w.close()  # idempotent — a double close must not raise
+    with FileWriter(str(tmp_path)) as w2:
+        w2.add_scalar("y", 2.0, 0)
+    w2.close()  # already closed by __exit__; still fine
+
+
+def test_summary_context_manager(tmp_path):
+    with TrainSummary(str(tmp_path), "app") as ts:
+        ts.add_scalar("Loss", 0.5, 1)
+    ts.close()  # idempotent after __exit__
+    assert ts.read_scalar("Loss") == [(1, np.float32(0.5))]
+
+
+def test_resilience_tags_roundtrip(tmp_path):
+    """ISSUE satellite: the RESILIENCE_TAGS scalar streams round-trip
+    through the hand-rolled event framing — write via add_resilience,
+    read back per tag via read_scalar."""
+    ts = TrainSummary(str(tmp_path), "app")
+    ts.add_resilience(3, nonfinite_skips=1)
+    ts.add_resilience(7, nonfinite_skips=2, retries=1,
+                      checkpoint_write_failures=1)
+    ts.add_resilience(9, retries=2)
+    ts.close()
+    expect = {
+        "NonFiniteSkips": [(3, 1.0), (7, 2.0)],
+        "RetryCount": [(7, 1.0), (9, 2.0)],
+        "CheckpointWriteFailures": [(7, 1.0)],
+    }
+    assert set(expect) == set(RESILIENCE_TAGS)
+    for tag, want in expect.items():
+        got = ts.read_scalar(tag)
+        assert [(s, float(v)) for s, v in got] == want, tag
+
+
+def test_histogram_writer_reader_parity(tmp_path):
+    """ISSUE satellite: histogram events survive the writer -> reader
+    round trip bit-exactly on the framing level — counts, edges and
+    moments match numpy's histogram of the same data."""
+    ts = TrainSummary(str(tmp_path), "app")
+    rs = np.random.RandomState(0)
+    values = rs.randn(1000)
+    ts.add_histogram("weights", values, 5)
+    ts.add_histogram("other", rs.rand(10), 6)  # different tag: filtered out
+    ts.close()
+    back = ts.read_histogram("weights")
+    assert len(back) == 1
+    step, h = back[0]
+    assert step == 5
+    counts, edges = np.histogram(values, bins=30)
+    assert h["num"] == 1000
+    np.testing.assert_allclose(h["min"], values.min())
+    np.testing.assert_allclose(h["max"], values.max())
+    np.testing.assert_allclose(h["sum"], values.sum())
+    np.testing.assert_allclose(h["sum_squares"], (values * values).sum())
+    np.testing.assert_allclose(h["bucket_limit"], edges[1:])
+    np.testing.assert_allclose(h["bucket"], counts)
+    # scalar reader still filters correctly in a file that mixes kinds
+    ts2 = TrainSummary(str(tmp_path), "app2")
+    ts2.add_scalar("Loss", 1.5, 1)
+    ts2.add_histogram("Loss", values, 2)  # same tag, histogram kind
+    ts2.close()
+    assert ts2.read_scalar("Loss") == [(1, np.float32(1.5))]
+    assert [s for s, _ in ts2.read_histogram("Loss")] == [2]
 
 
 def test_optimizer_writes_summaries(tmp_path):
