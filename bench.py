@@ -1164,6 +1164,29 @@ def main():
         else:
             errors.append(f"re-probe: {err or 'no output'}")
 
+    def _apply_regression_gate(res):
+        # opt-in perf-regression gate (obs/regress.py): compare this
+        # run's extras.obs_runtime against the BENCH_r*.json trajectory
+        # in $BIGDL_REGRESS_TRAJECTORY; the verdict rides in
+        # extras.regression and, on violation, a flight-recorder bundle
+        # lands in $BIGDL_REGRESS_FLIGHT_DIR.  Best-effort: the gate
+        # must never sink the bench or touch its exit code.
+        traj = os.environ.get("BIGDL_REGRESS_TRAJECTORY")
+        if not traj:
+            return
+        try:
+            from bigdl_tpu.obs import regress
+
+            verdict = regress.gate(
+                res, traj,
+                flight_dir=os.environ.get("BIGDL_REGRESS_FLIGHT_DIR"),
+                trace_dir=os.environ.get("BIGDL_TRACE_DIR"))
+            res.setdefault("extras", {})["regression"] = verdict
+        except Exception as e:  # noqa: BLE001 — never sink the bench
+            res.setdefault("extras", {})["regression"] = {
+                "status": "error",
+                "error": f"{type(e).__name__}: {str(e)[:200]}"}
+
     if result is None:
         result = _empty_result(errors)
     elif result is cpu_res:
@@ -1178,6 +1201,7 @@ def main():
         result["error"] = ((result.get("error") or "") + " truncated: " +
                            " | ".join(errors)).strip()
     result.pop("partial", None)
+    _apply_regression_gate(result)
     _record_partial(result)
     print(json.dumps(result))
 

@@ -64,6 +64,18 @@ class ObsConfig:
     metrics_dir: Optional[str] = None
     # step-time / dispatch-time reservoir capacity [BIGDL_OBS_RESERVOIR]
     reservoir_size: int = 4096
+    # slow-step anomaly detector: a step slower than
+    # median * slow_step_factor emits a structured `slow_step` trace
+    # event with its child-span breakdown; <= 0 disables
+    # [BIGDL_SLOW_STEP_FACTOR]
+    slow_step_factor: float = 3.0
+    # flight recorder: how many recent span/event records the tracer
+    # retains in memory for postmortem bundles [BIGDL_FLIGHT_SPANS]
+    flight_spans: int = 512
+    # perf-regression gate: fail when the fresh step time exceeds the
+    # trajectory's best by this factor (obs/regress.py)
+    # [BIGDL_REGRESS_TOLERANCE]
+    regress_tolerance: float = 1.5
 
     @property
     def active(self) -> bool:
@@ -76,6 +88,9 @@ class ObsConfig:
             trace_dir=_env_str("BIGDL_TRACE_DIR", None),
             metrics_dir=_env_str("BIGDL_METRICS_DIR", None),
             reservoir_size=_env_int("BIGDL_OBS_RESERVOIR", 4096),
+            slow_step_factor=_env_float("BIGDL_SLOW_STEP_FACTOR", 3.0),
+            flight_spans=_env_int("BIGDL_FLIGHT_SPANS", 512),
+            regress_tolerance=_env_float("BIGDL_REGRESS_TOLERANCE", 1.5),
         )
 
 

@@ -112,6 +112,14 @@ class Engine:
                   zip(cls._state.mesh.axis_names,
                       cls._state.mesh.devices.shape)},
             processes=config.num_processes)
+        # the trace-merge alignment anchor (obs/aggregate.py): in a
+        # multi-host world this fires right after
+        # jax.distributed.initialize returned on EVERY process — the
+        # closest thing the program has to a simultaneous global event,
+        # so per-host wall clocks are aligned on it when shards merge
+        obs.get_tracer().event(
+            "engine.init_barrier", host=config.process_id,
+            processes=config.num_processes, devices=n)
         obs.get_registry().counter(
             "bigdl_engine_inits_total", "Engine.init calls").inc()
         return cls

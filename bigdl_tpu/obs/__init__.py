@@ -10,7 +10,14 @@ stack (optimizers, engine, serializer, resilience, bench):
   registry with Prometheus text exposition and JSONL snapshots
   (``BIGDL_METRICS_DIR=/dir``); ``optim.Metrics`` delegates here;
 * :mod:`bigdl_tpu.obs.runtime` — compile-event tracking, step-time
-  p50/p95/p99 reservoirs, host RSS + device memory stats.
+  p50/p95/p99 reservoirs, host RSS + device memory stats;
+* :mod:`bigdl_tpu.obs.collectives` — wire-byte accounting for every
+  programmed collective, from static shapes only;
+* :mod:`bigdl_tpu.obs.aggregate` — offline merge of N per-host trace
+  shards into one clock-aligned Perfetto timeline (CLI);
+* :mod:`bigdl_tpu.obs.report` — run-report CLI over trace/metrics dirs;
+* :mod:`bigdl_tpu.obs.regress` — perf-regression gate against the
+  BENCH_r*.json trajectory + flight-recorder bundles.
 
 Everything is off by default with a no-op fast path: disabled, the
 train loop sees one shared null context manager per span site and adds
@@ -68,13 +75,15 @@ def get_tracer():
     ``config.obs.trace_dir``, or the shared :data:`NULL_TRACER` when
     tracing is off.  Rebuilt when the directory changes."""
     global _tracer, _tracer_dir, _atexit_registered
-    d = _obs_config().trace_dir
+    cfg = _obs_config()
+    d = cfg.trace_dir
     with _lock:
         if d != _tracer_dir:
             if _tracer is not NULL_TRACER:
                 _tracer.close()
             _tracer_dir = d
-            _tracer = Tracer(d) if d else NULL_TRACER
+            _tracer = (Tracer(d, ring_size=cfg.flight_spans)
+                       if d else NULL_TRACER)
             if d and not _atexit_registered:
                 atexit.register(_atexit_close)
                 _atexit_registered = True

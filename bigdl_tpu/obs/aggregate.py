@@ -1,0 +1,211 @@
+"""Multi-host trace aggregation — N per-host shards, one timeline.
+
+Each process's :class:`~bigdl_tpu.obs.trace.Tracer` writes a private
+``<app>.h<host>.<pid>.<seq>.events.jsonl`` shard into the (shared)
+trace directory; nothing at runtime ever crosses hosts.  This module is
+the offline half: it merges every shard in a directory into ONE
+Perfetto-loadable Chrome ``trace_event`` JSON, with
+
+* **host-tagged spans** — every merged event carries ``host``/``pid``
+  in its args and renders under a ``host<h> pid<p>`` process track;
+* **clock alignment on a shared barrier** — hosts' wall clocks disagree
+  (NTP skew is routinely milliseconds, and the per-process
+  ``time.time()`` anchor adds more).  ``Engine.init`` emits an
+  ``engine.init_barrier`` instant event right after the multi-host
+  bring-up (``jax.distributed.initialize`` returns on every process
+  only once all have joined — the closest thing a JAX program has to a
+  global barrier), so shifting each shard to make the barrier events
+  coincide removes the skew instead of baking it silently into the
+  timeline.  The applied per-shard offsets are preserved in
+  ``otherData.offsets_s`` — the skew stays *visible*;
+* shards with no barrier event merge unaligned (offset 0) and are
+  flagged, never dropped.
+
+CLI::
+
+    python -m bigdl_tpu.obs.aggregate TRACE_DIR [-o merged.trace.json]
+
+TensorFlow's system paper made the cross-worker timeline the debugging
+tool for "which worker stalled the collective?"; this is that tool for
+the DistriOptimizer pod-slice runs in MULTICHIP_r*.json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional
+
+# the alignment anchor Engine.init emits after multi-host bring-up
+BARRIER_EVENT = "engine.init_barrier"
+
+
+class Shard:
+    """One per-process events shard: parsed records + identity."""
+
+    def __init__(self, path: str, records: List[dict]):
+        self.path = path
+        self.records = records
+        first = records[0] if records else {}
+        self.host = int(first.get("host", 0))
+        self.pid = int(first.get("pid", 0))
+        self.offset_s = 0.0
+        self.aligned = False
+
+    def barrier_wall(self, barrier: str = BARRIER_EVENT) -> Optional[float]:
+        """Wall time of the FIRST barrier event in this shard (restarts
+        re-emit it; the first is the bring-up one)."""
+        for rec in self.records:
+            if rec.get("name") == barrier:
+                return float(rec["wall_time"])
+        return None
+
+
+def read_shards(trace_dir: str) -> List[Shard]:
+    """Every ``*.events.jsonl`` shard in a directory, malformed lines
+    skipped (a crash mid-write loses at most its last line)."""
+    shards = []
+    for fn in sorted(os.listdir(trace_dir)):
+        if not fn.endswith(".events.jsonl"):
+            continue
+        recs = []
+        with open(os.path.join(trace_dir, fn), encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn tail line
+                if isinstance(rec, dict) and "wall_time" in rec:
+                    recs.append(rec)
+        if recs:
+            shards.append(Shard(os.path.join(trace_dir, fn), recs))
+    return shards
+
+
+def align_shards(shards: List[Shard],
+                 barrier: str = BARRIER_EVENT) -> List[Shard]:
+    """Compute per-shard clock offsets so every shard's barrier event
+    lands at the same merged instant (the latest barrier wall time is
+    the reference — offsets stay additive-positive for the laggards'
+    view, and the choice is arbitrary for correctness)."""
+    walls = {}
+    for s in shards:
+        w = s.barrier_wall(barrier)
+        if w is not None:
+            walls[id(s)] = w
+    if walls:
+        ref = max(walls.values())
+        for s in shards:
+            w = walls.get(id(s))
+            if w is not None:
+                s.offset_s = ref - w
+                s.aligned = True
+    return shards
+
+
+def merge_shards(shards: List[Shard], barrier: str = BARRIER_EVENT) -> dict:
+    """Merge aligned shards into one Chrome ``trace_event`` document.
+
+    Chrome pids must be small ints and hosts may reuse OS pids, so each
+    shard gets a synthetic process id with a ``host<h> pid<p>``
+    process_name; original identities ride in every event's args."""
+    if not shards:
+        raise ValueError("no trace shards to merge")
+    align_shards(shards, barrier)
+    t0 = min(rec["wall_time"] + s.offset_s
+             for s in shards for rec in s.records)
+    meta, events = [], []
+    for i, s in enumerate(sorted(shards, key=lambda s: (s.host, s.pid,
+                                                        s.path))):
+        cpid = i + 1
+        meta.append({"name": "process_name", "ph": "M", "pid": cpid,
+                     "tid": 0,
+                     "args": {"name": f"host{s.host} pid{s.pid}"
+                              + ("" if s.aligned else " (unaligned)")}})
+        meta.append({"name": "process_sort_index", "ph": "M", "pid": cpid,
+                     "tid": 0, "args": {"sort_index": s.host}})
+        for rec in s.records:
+            ts = round((rec["wall_time"] + s.offset_s - t0) * 1e6, 3)
+            args = dict(rec.get("attrs") or {})
+            args["host"] = s.host
+            args["pid"] = s.pid
+            ev = {"name": rec["name"], "ts": ts, "pid": cpid,
+                  "tid": int(rec.get("tid", 1)), "args": args}
+            if rec.get("kind") == "span":
+                ev["ph"] = "X"
+                ev["dur"] = round(float(rec.get("dur_s", 0.0)) * 1e6, 3)
+            else:
+                ev["ph"] = "i"
+                ev["s"] = "t"
+            events.append(ev)
+    # a monotone timeline: Perfetto tolerates disorder, humans and the
+    # monotonicity tests do not
+    events.sort(key=lambda e: e["ts"])
+    return {
+        "traceEvents": meta + events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "merged_shards": len(shards),
+            "barrier": barrier,
+            "wall_epoch": t0,
+            "offsets_s": {
+                f"host{s.host}/pid{s.pid}": round(s.offset_s, 6)
+                for s in shards},
+            "unaligned": [f"host{s.host}/pid{s.pid}"
+                          for s in shards if not s.aligned],
+        },
+    }
+
+
+def merge_trace_dir(trace_dir: str, out_path: Optional[str] = None,
+                    barrier: str = BARRIER_EVENT) -> dict:
+    """Merge every shard under ``trace_dir``; write the merged Chrome
+    trace (atomic replace) when ``out_path`` is given.  Returns a
+    summary dict (shards, events, offsets, output path)."""
+    shards = read_shards(trace_dir)
+    doc = merge_shards(shards, barrier=barrier)
+    if out_path:
+        tmp = out_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(doc, fh, default=str)
+        os.replace(tmp, out_path)
+    return {
+        "shards": len(shards),
+        "hosts": sorted({s.host for s in shards}),
+        "events": sum(len(s.records) for s in shards),
+        "offsets_s": doc["otherData"]["offsets_s"],
+        "unaligned": doc["otherData"]["unaligned"],
+        "out": out_path,
+    }
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m bigdl_tpu.obs.aggregate",
+        description="Merge per-host trace shards into one Perfetto "
+                    "timeline with barrier clock alignment.")
+    ap.add_argument("trace_dir", help="directory holding *.events.jsonl "
+                                      "shards (BIGDL_TRACE_DIR)")
+    ap.add_argument("-o", "--out", default=None,
+                    help="merged Chrome trace path "
+                         "(default: TRACE_DIR/merged.trace.json)")
+    ap.add_argument("--barrier", default=BARRIER_EVENT,
+                    help=f"alignment event name (default {BARRIER_EVENT})")
+    args = ap.parse_args(argv)
+    out = args.out or os.path.join(args.trace_dir, "merged.trace.json")
+    try:
+        summary = merge_trace_dir(args.trace_dir, out, barrier=args.barrier)
+    except ValueError as e:
+        print(json.dumps({"error": str(e)}))
+        return 1
+    print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

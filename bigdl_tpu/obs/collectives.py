@@ -1,0 +1,218 @@
+"""Collective-traffic accounting — bytes on the wire, from static shapes.
+
+EQuARX's (PAPERS.md) case for quantized collectives is a byte count;
+this module makes the rebuild state its own: every collective the
+training stack programs (DistriOptimizer's ZeRO-1 exchange, the ring /
+pipeline ppermutes, MoE's all_to_all pair, tensor-parallel placement)
+is accounted **from static shapes at trace/build time** — never by
+reading a device value, so instrumentation adds zero host-device
+synchronizations.
+
+Cost model: the standard ring-algorithm per-device wire bytes for an
+``n``-way collective over a ``payload``-byte global operand —
+
+====================  =======================================
+op                    bytes sent per device
+====================  =======================================
+all-reduce (psum)     ``2 * payload * (n-1) / n``
+reduce-scatter        ``payload * (n-1) / n``
+all-gather            ``payload * (n-1) / n``
+all-to-all            ``payload * (n-1) / n``
+ppermute              ``payload`` per hop
+====================  =======================================
+
+Hierarchical meshes (``data_axes=('dcn', 'ici')``) are accounted with
+``n`` = the product of the axis sizes — the single-ring upper bound;
+XLA's hierarchical lowering moves fewer bytes over DCN, so the counter
+is conservative, never flattering.
+
+Two surfaces:
+
+* :func:`record` — one-shot accounting (the parallel wrappers call it
+  at trace time): increments ``bigdl_collective_bytes_total{op,dtype}``
+  and emits a ``collective`` trace event when tracing is on;
+* :class:`StepFootprint` — the per-step form DistriOptimizer builds
+  once at step-build time (children pre-bound, gauges published) and
+  ``commit()``s per resolved step: a handful of locked float adds on
+  the host, nothing on the device.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+# jax dtypes numpy can't name, plus the common spellings — fall back to
+# numpy's itemsize for everything else
+_DTYPE_BYTES = {
+    "bfloat16": 2, "float16": 2, "half": 2,
+    "float32": 4, "float": 4, "int32": 4, "uint32": 4,
+    "float64": 8, "int64": 8, "uint64": 8,
+    "int16": 2, "uint16": 2,
+    "int8": 1, "uint8": 1, "bool": 1,
+    "float8_e4m3fn": 1, "float8_e5m2": 1, "float8_e4m3": 1,
+    "float8_e4m3b11_fnuz": 1, "float8_e5m2fnuz": 1, "float8_e4m3fnuz": 1,
+    "float4_e2m1fn": 1,
+}
+
+
+def dtype_bytes(dtype) -> int:
+    """Bytes per element for a dtype given as dtype object, scalar type
+    (``jnp.bfloat16`` is a class, not a dtype), or string."""
+    name = dtype if isinstance(dtype, str) else getattr(dtype, "name", None)
+    if name is None:
+        import numpy as np
+
+        # scalar types (jnp.bfloat16 & co): ml_dtypes registers them
+        # with numpy, so np.dtype() resolves where str() would not
+        return int(np.dtype(dtype).itemsize)
+    b = _DTYPE_BYTES.get(name)
+    if b is not None:
+        return b
+    import numpy as np
+
+    return int(np.dtype(name).itemsize)
+
+
+def all_reduce_bytes(n_elems: int, dtype, axis_size: int) -> float:
+    """psum / pmean / pmin / pmax: ring all-reduce wire bytes per
+    device (reduce-scatter + all-gather phases)."""
+    if axis_size <= 1:
+        return 0.0
+    return 2.0 * n_elems * dtype_bytes(dtype) * (axis_size - 1) / axis_size
+
+
+def reduce_scatter_bytes(n_elems: int, dtype, axis_size: int) -> float:
+    """psum_scatter over a ``n_elems`` global operand."""
+    if axis_size <= 1:
+        return 0.0
+    return float(n_elems) * dtype_bytes(dtype) * (axis_size - 1) / axis_size
+
+
+def all_gather_bytes(n_elems: int, dtype, axis_size: int) -> float:
+    """all_gather producing a ``n_elems`` global result (each device
+    ships its shard around the ring ``n-1`` times)."""
+    if axis_size <= 1:
+        return 0.0
+    return float(n_elems) * dtype_bytes(dtype) * (axis_size - 1) / axis_size
+
+
+def all_to_all_bytes(n_elems: int, dtype, axis_size: int) -> float:
+    """all_to_all of a ``n_elems`` per-device operand: every device
+    keeps 1/n locally and ships the rest."""
+    if axis_size <= 1:
+        return 0.0
+    return float(n_elems) * dtype_bytes(dtype) * (axis_size - 1) / axis_size
+
+
+def ppermute_bytes(n_elems: int, dtype, hops: int = 1) -> float:
+    """ppermute: the full per-device payload moves every hop."""
+    return float(n_elems) * dtype_bytes(dtype) * max(0, hops)
+
+
+def int8_blockwise_exchange_bytes(padded_elems: int, axis_size: int,
+                                  block: int) -> dict:
+    """Wire bytes of ``int8_blockwise_reduce_scatter`` (one all_to_all
+    pair): int8 payload + f32 per-block scales.  ``padded_elems`` must
+    be divisible by ``axis_size * block`` (the optimizer pads to that
+    quantum)."""
+    n_blocks = padded_elems // axis_size // block
+    return {
+        "int8": all_to_all_bytes(padded_elems, "int8", axis_size),
+        "float32": all_to_all_bytes(axis_size * n_blocks, "float32",
+                                    axis_size),
+    }
+
+
+# --------------------------------------------------------------- recording
+_COUNTER_META = (
+    "bigdl_collective_bytes_total",
+    "Wire bytes programmed into collectives, from static shapes "
+    "(ring-algorithm cost model; no device reads)",
+)
+_GAUGE_META = (
+    "bigdl_collective_bytes_per_step",
+    "Static per-train-step wire bytes of the optimizer's collective "
+    "footprint",
+)
+
+
+def _counter(registry=None):
+    if registry is None:
+        from bigdl_tpu import obs
+
+        registry = obs.get_registry()
+    return registry.counter(*_COUNTER_META, labels=("op", "dtype"))
+
+
+def record(op: str, dtype, nbytes: float, *, axis_size: Optional[int] = None,
+           registry=None) -> float:
+    """One-shot accounting: add ``nbytes`` to the labeled counter and
+    emit a ``collective`` trace event (no-op tracer when tracing is
+    off).  Called by the parallel wrappers at trace time — under jit
+    that is once per compile, eagerly once per call."""
+    name = getattr(dtype, "name", None) or str(dtype)
+    nbytes = float(nbytes)
+    _counter(registry).labels(op=op, dtype=name).inc(nbytes)
+    from bigdl_tpu import obs
+
+    tracer = obs.get_tracer()
+    if tracer.enabled:
+        attrs = {"op": op, "dtype": name, "bytes": round(nbytes, 1)}
+        if axis_size is not None:
+            attrs["axis_size"] = int(axis_size)
+        tracer.event("collective", **attrs)
+    return nbytes
+
+
+class StepFootprint:
+    """The static collective byte budget of ONE train step.
+
+    Built host-side while the jitted step is assembled (all shapes are
+    static there), then ``commit()``-ed once per resolved step by the
+    driver loop.  Children are pre-bound so the hot path is a few
+    locked float adds."""
+
+    def __init__(self):
+        self.entries: list = []   # [(op, dtype, bytes_per_step)]
+        self._bound: list = []    # [(counter_child, bytes)]
+
+    def add(self, op: str, dtype, nbytes: float) -> "StepFootprint":
+        name = getattr(dtype, "name", None) or str(dtype)
+        nbytes = float(nbytes)
+        if nbytes > 0:
+            self.entries.append((op, name, nbytes))
+        return self
+
+    def total(self) -> float:
+        return math.fsum(b for _, _, b in self.entries)
+
+    def by_op(self) -> dict:
+        out: dict = {}
+        for op, name, b in self.entries:
+            key = f"{op}:{name}"
+            out[key] = out.get(key, 0.0) + b
+        return out
+
+    def bind(self, registry=None) -> "StepFootprint":
+        """Resolve counter children once and publish the static
+        per-step gauges; idempotent re-binds replace the cache."""
+        if registry is None:
+            from bigdl_tpu import obs
+
+            registry = obs.get_registry()
+        counter = _counter(registry)
+        gauge = registry.gauge(*_GAUGE_META, labels=("op", "dtype"))
+        merged: dict = {}
+        for op, name, b in self.entries:
+            merged[(op, name)] = merged.get((op, name), 0.0) + b
+        self._bound = []
+        for (op, name), b in merged.items():
+            self._bound.append((counter.labels(op=op, dtype=name), b))
+            gauge.labels(op=op, dtype=name).set(b)
+        return self
+
+    def commit(self):
+        """Account one executed step (driver loop, per resolved step)."""
+        for child, b in self._bound:
+            child.inc(b)

@@ -115,16 +115,26 @@ class Histogram:
     def mean(self) -> float:
         return self.sum / self.count if self.count else 0.0
 
-    def cumulative(self):
-        """[(upper_bound, cumulative_count), ...] ending with +Inf."""
-        out, acc = [], 0
+    def snapshot_state(self):
+        """One-lock consistent read: ``(bucket_counts, count, sum)``
+        from a single lock acquisition, so a scrape racing concurrent
+        ``observe()`` calls can never expose a ``_sum``/``_count`` pair
+        that disagrees with the bucket counts (the +Inf cumulative
+        bucket always equals ``_count`` within one sample)."""
         with self._lock:
-            counts = list(self.bucket_counts)
-            bounds = self.bounds + (float("inf"),)
-        for b, c in zip(bounds, counts):
+            return list(self.bucket_counts), self.count, self.sum
+
+    def _cumulative_from(self, counts):
+        out, acc = [], 0
+        for b, c in zip(self.bounds + (float("inf"),), counts):
             acc += c
             out.append((b, acc))
         return out
+
+    def cumulative(self):
+        """[(upper_bound, cumulative_count), ...] ending with +Inf."""
+        counts, _, _ = self.snapshot_state()
+        return self._cumulative_from(counts)
 
     def _zero(self):
         self.bucket_counts = [0] * (len(self.bounds) + 1)
@@ -242,13 +252,18 @@ class MetricsRegistry:
                          for n, v in zip(fam.labelnames, key)]
                 base = "{" + ",".join(pairs) + "}" if pairs else ""
                 if fam.kind == "histogram":
-                    for bound, acc in child.cumulative():
+                    # one consistent read per scrape: buckets, _sum and
+                    # _count come from the SAME locked snapshot (a
+                    # concurrent add() can otherwise land between the
+                    # bucket copy and the sum/count reads)
+                    counts, count, total = child.snapshot_state()
+                    for bound, acc in child._cumulative_from(counts):
                         le = "+Inf" if bound == float("inf") else _fmt(bound)
                         bpairs = pairs + [f'le="{le}"']
                         lines.append(
                             f"{fam.name}_bucket{{{','.join(bpairs)}}} {acc}")
-                    lines.append(f"{fam.name}_sum{base} {_fmt(child.sum)}")
-                    lines.append(f"{fam.name}_count{base} {child.count}")
+                    lines.append(f"{fam.name}_sum{base} {_fmt(total)}")
+                    lines.append(f"{fam.name}_count{base} {count}")
                 else:
                     lines.append(f"{fam.name}{base} {_fmt(child.value)}")
         return "\n".join(lines) + ("\n" if lines else "")
@@ -261,37 +276,47 @@ class MetricsRegistry:
             for key, child in sorted(fam.child_items()):
                 labels = dict(zip(fam.labelnames, key))
                 if fam.kind == "histogram":
+                    counts, count, total = child.snapshot_state()
                     samples.append(
-                        {"labels": labels, "count": child.count,
-                         "sum": child.sum,
+                        {"labels": labels, "count": count,
+                         "sum": total,
                          "buckets": [
                              ["+Inf" if b == float("inf") else b, c]
-                             for b, c in child.cumulative()]})
+                             for b, c in child._cumulative_from(counts)]})
                 else:
                     samples.append({"labels": labels, "value": child.value})
             metrics[fam.name] = {"type": fam.kind, "help": fam.help,
                                  "samples": samples}
         return {"ts": time.time(), "metrics": metrics}
 
-    def write_snapshot(self, directory: str, extra_registries=()):
-        """Write ``metrics.<pid>.prom`` (atomic replace — always a
-        complete, parseable exposition) and append one JSON line to
-        ``metrics.<pid>.jsonl``.  ``extra_registries`` are concatenated
-        into the same exposition (e.g. an optimizer's private phase-
-        timer registry)."""
+    def write_snapshot(self, directory: str, extra_registries=(),
+                       host_id: int = None):
+        """Write ``metrics.h<host>.<pid>.prom`` (atomic replace — always
+        a complete, parseable exposition) and append one JSON line to
+        ``metrics.h<host>.<pid>.jsonl``.  ``extra_registries`` are
+        concatenated into the same exposition (e.g. an optimizer's
+        private phase-timer registry).  The host rank in the stem keeps
+        N hosts writing one shared metrics volume collision-free."""
+        if host_id is None:
+            from bigdl_tpu.obs.trace import _default_host_id
+
+            host_id = _default_host_id()
         os.makedirs(directory, exist_ok=True)
         pid = os.getpid()
-        prom_path = os.path.join(directory, f"metrics.{pid}.prom")
+        stem = f"metrics.h{host_id}.{pid}"
+        prom_path = os.path.join(directory, stem + ".prom")
         text = self.to_prometheus() + "".join(
             r.to_prometheus() for r in extra_registries)
         tmp = prom_path + ".tmp"
         with open(tmp, "w", encoding="utf-8") as fh:
             fh.write(text)
         os.replace(tmp, prom_path)
-        jsonl_path = os.path.join(directory, f"metrics.{pid}.jsonl")
+        jsonl_path = os.path.join(directory, stem + ".jsonl")
         snap = self.snapshot()
         for r in extra_registries:
             snap["metrics"].update(r.snapshot()["metrics"])
+        snap["host"] = host_id
+        snap["pid"] = pid
         with open(jsonl_path, "a", encoding="utf-8") as fh:
             fh.write(json.dumps(snap, default=str) + "\n")
         return {"prom": prom_path, "jsonl": jsonl_path}

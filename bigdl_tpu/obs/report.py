@@ -1,0 +1,252 @@
+"""Run report — one readable answer from a run's trace/metrics dirs.
+
+``python -m bigdl_tpu.obs.report TRACE_DIR [--metrics-dir DIR]`` reads
+the per-host ``*.events.jsonl`` shards (and the ``metrics.*.jsonl``
+snapshots when present) and renders what a postmortem asks first:
+
+* per-host step-time percentiles (from the ``computing`` spans — the
+  dispatch→resolved-loss wall time the reservoirs also see);
+* compile events (count + wall seconds blocked);
+* collective wire bytes by op/dtype, per-step footprint and the
+  int8-vs-f32 savings ratio;
+* resilience events (retries, non-finite skips, checkpoint failures);
+* slow-step anomalies and the slowest spans per host.
+
+``--json`` emits the machine-readable report instead of text.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+from typing import List, Optional
+
+from bigdl_tpu.obs.aggregate import read_shards
+
+_PCTS = (0.5, 0.95, 0.99)
+
+
+def _nearest_rank(values: List[float], q: float) -> Optional[float]:
+    if not values:
+        return None
+    vs = sorted(values)
+    k = min(len(vs) - 1, max(0, math.ceil(q * len(vs)) - 1))
+    return vs[k]
+
+
+def load_metric_snapshots(metrics_dir: str) -> List[dict]:
+    """Latest JSONL snapshot per metrics shard (one per host/pid)."""
+    snaps = []
+    if not metrics_dir or not os.path.isdir(metrics_dir):
+        return snaps
+    for fn in sorted(os.listdir(metrics_dir)):
+        if not (fn.startswith("metrics.") and fn.endswith(".jsonl")):
+            continue
+        last = None
+        with open(os.path.join(metrics_dir, fn), encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if line:
+                    try:
+                        last = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+        if last:
+            last.setdefault("shard", fn)
+            snaps.append(last)
+    return snaps
+
+
+def _metric_samples(snaps: List[dict], name: str) -> list:
+    """[(labels, value_or_histdict, host), ...] across all snapshots."""
+    out = []
+    for snap in snaps:
+        fam = (snap.get("metrics") or {}).get(name)
+        if not fam:
+            continue
+        for s in fam.get("samples", []):
+            out.append((s.get("labels") or {}, s, snap.get("host", 0)))
+    return out
+
+
+def build_report(trace_dir: str, metrics_dir: Optional[str] = None) -> dict:
+    shards = read_shards(trace_dir)
+    snaps = load_metric_snapshots(metrics_dir or trace_dir)
+
+    hosts: dict = {}
+    resilience: dict = {}
+    slow_steps: list = []
+    compile_events: list = []
+    for sh in shards:
+        key = f"host{sh.host}/pid{sh.pid}"
+        h = hosts.setdefault(key, {
+            "host": sh.host, "pid": sh.pid, "records": 0,
+            "step_times": [], "spans": []})
+        h["records"] += len(sh.records)
+        for rec in sh.records:
+            name = rec.get("name", "")
+            if rec.get("kind") == "span":
+                dur = float(rec.get("dur_s", 0.0))
+                h["spans"].append((name, dur,
+                                   (rec.get("attrs") or {}).get("step")))
+                if name == "computing":
+                    h["step_times"].append(dur)
+                if name.endswith(".compile"):
+                    compile_events.append(
+                        {"host": sh.host, "name": name,
+                         "seconds": round(dur, 4)})
+            else:
+                if name.startswith("resilience."):
+                    resilience[name] = resilience.get(name, 0) + 1
+                elif name == "slow_step":
+                    a = dict(rec.get("attrs") or {})
+                    a["host"] = sh.host
+                    slow_steps.append(a)
+
+    per_host = {}
+    for key, h in hosts.items():
+        st = h["step_times"]
+        slowest = sorted(h["spans"], key=lambda t: -t[1])[:5]
+        per_host[key] = {
+            "records": h["records"],
+            "steps": len(st),
+            "step_time_s": {
+                "p50": _nearest_rank(st, 0.5),
+                "p95": _nearest_rank(st, 0.95),
+                "p99": _nearest_rank(st, 0.99),
+                "max": max(st) if st else None,
+            },
+            "slowest_spans": [
+                {"name": n, "dur_s": round(d, 6), "step": s}
+                for n, d, s in slowest],
+        }
+
+    # ---- collective bytes from the metric snapshots ------------------
+    coll_total: dict = {}
+    for labels, s, _host in _metric_samples(
+            snaps, "bigdl_collective_bytes_total"):
+        key = f"{labels.get('op', '?')}:{labels.get('dtype', '?')}"
+        coll_total[key] = coll_total.get(key, 0.0) + float(
+            s.get("value", 0.0))
+    coll_step: dict = {}
+    for labels, s, _host in _metric_samples(
+            snaps, "bigdl_collective_bytes_per_step"):
+        key = f"{labels.get('op', '?')}:{labels.get('dtype', '?')}"
+        coll_step[key] = float(s.get("value", 0.0))
+    savings = [float(s.get("value", 0.0)) for _l, s, _h in _metric_samples(
+        snaps, "bigdl_collective_wire_savings_ratio")]
+
+    compile_count = sum(
+        float(s.get("value", 0.0)) for _l, s, _h in _metric_samples(
+            snaps, "bigdl_jit_compile_count"))
+
+    return {
+        "trace_dir": trace_dir,
+        "metrics_dir": metrics_dir or trace_dir,
+        "hosts": per_host,
+        "n_hosts": len({h["host"] for h in hosts.values()}),
+        "compile": {
+            "events_in_trace": compile_events,
+            "count_from_metrics": compile_count or None,
+        },
+        "collective_bytes_total": coll_total,
+        "collective_bytes_per_step": coll_step,
+        "wire_savings_ratio": max(savings) if savings else None,
+        "resilience_events": resilience,
+        "slow_steps": slow_steps,
+    }
+
+
+def _fmt_bytes(b: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(b) < 1024 or unit == "TiB":
+            return f"{b:.1f}{unit}" if unit != "B" else f"{b:.0f}B"
+        b /= 1024.0
+    return f"{b:.1f}TiB"
+
+
+def render_text(rep: dict) -> str:
+    lines = ["== bigdl_tpu run report ==",
+             f"trace dir:   {rep['trace_dir']}",
+             f"metrics dir: {rep['metrics_dir']}",
+             f"hosts:       {rep['n_hosts']}", ""]
+    lines.append("-- step times (computing spans, per host) --")
+    for key, h in sorted(rep["hosts"].items()):
+        st = h["step_time_s"]
+
+        def f(v):
+            return "-" if v is None else f"{v * 1000:.2f}ms"
+
+        lines.append(
+            f"  {key}: n={h['steps']} p50={f(st['p50'])} "
+            f"p95={f(st['p95'])} p99={f(st['p99'])} max={f(st['max'])}")
+    lines.append("")
+    lines.append("-- compiles --")
+    cc = rep["compile"]["count_from_metrics"]
+    lines.append(f"  count (metrics): "
+                 f"{int(cc) if cc is not None else 'n/a'}")
+    for ev in rep["compile"]["events_in_trace"][:8]:
+        lines.append(f"  host{ev['host']} {ev['name']}: {ev['seconds']}s")
+    lines.append("")
+    lines.append("-- collective wire bytes (total across hosts) --")
+    if not rep["collective_bytes_total"]:
+        lines.append("  (none recorded)")
+    for key, b in sorted(rep["collective_bytes_total"].items()):
+        per = rep["collective_bytes_per_step"].get(key)
+        extra = f"  ({_fmt_bytes(per)}/step)" if per else ""
+        lines.append(f"  {key:28s} {_fmt_bytes(b):>12s}{extra}")
+    if rep["wire_savings_ratio"]:
+        lines.append(f"  wire savings vs f32 exchange: "
+                     f"{rep['wire_savings_ratio']:.2f}x")
+    lines.append("")
+    lines.append("-- resilience events --")
+    if not rep["resilience_events"]:
+        lines.append("  (clean run)")
+    for name, n in sorted(rep["resilience_events"].items()):
+        lines.append(f"  {name}: {n}")
+    lines.append("")
+    lines.append("-- slow steps --")
+    if not rep["slow_steps"]:
+        lines.append("  (none)")
+    for s in rep["slow_steps"][:8]:
+        lines.append(
+            f"  host{s.get('host')} step {s.get('step')}: "
+            f"{float(s.get('dur_s', 0)) * 1000:.1f}ms "
+            f"(median {float(s.get('median_s', 0)) * 1000:.1f}ms, "
+            f"breakdown {s.get('breakdown')})")
+    lines.append("")
+    lines.append("-- slowest spans per host --")
+    for key, h in sorted(rep["hosts"].items()):
+        for sp in h["slowest_spans"]:
+            step = "" if sp["step"] is None else f" step={sp['step']}"
+            lines.append(f"  {key} {sp['name']}: "
+                         f"{sp['dur_s'] * 1000:.2f}ms{step}")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m bigdl_tpu.obs.report",
+        description="Render a run report from trace/metrics JSONL dirs.")
+    ap.add_argument("trace_dir", help="BIGDL_TRACE_DIR of the run")
+    ap.add_argument("--metrics-dir", default=None,
+                    help="BIGDL_METRICS_DIR (default: trace_dir)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the machine-readable report")
+    args = ap.parse_args(argv)
+    rep = build_report(args.trace_dir, args.metrics_dir)
+    if not rep["hosts"]:
+        print(f"no trace shards under {args.trace_dir}", flush=True)
+        return 1
+    if args.json:
+        print(json.dumps(rep, default=str))
+    else:
+        print(render_text(rep), end="")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

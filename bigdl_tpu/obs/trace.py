@@ -21,6 +21,7 @@ context manager — no allocation, no clock reads, no device syncs.
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import contextvars
 import itertools
@@ -28,6 +29,18 @@ import json
 import os
 import threading
 import time
+
+
+def _default_host_id() -> int:
+    """This process's host rank: the launcher's BIGDL_PROCESS_ID via the
+    config object (0 in single-host runs).  The tag is what lets
+    :mod:`bigdl_tpu.obs.aggregate` attribute merged spans to hosts."""
+    try:
+        from bigdl_tpu.config import config
+
+        return int(config.process_id)
+    except Exception:  # noqa: BLE001 — tracing must never fail bring-up
+        return 0
 
 # the active span id for the current thread/task (None at top level)
 _CURRENT: contextvars.ContextVar = contextvars.ContextVar(
@@ -67,6 +80,9 @@ class NullTracer:
     def counter(self, name, **values):
         pass
 
+    def recent(self):
+        return []
+
     def flush(self):
         pass
 
@@ -88,10 +104,16 @@ class Tracer:
     enabled = True
     _FILE_SEQ = itertools.count()
 
-    def __init__(self, trace_dir: str, app_name: str = "bigdl_tpu"):
+    def __init__(self, trace_dir: str, app_name: str = "bigdl_tpu",
+                 host_id: int = None, ring_size: int = 512):
         os.makedirs(trace_dir, exist_ok=True)
         self.pid = os.getpid()
-        stem = f"{app_name}.{self.pid}.{next(Tracer._FILE_SEQ)}"
+        self.host_id = (_default_host_id() if host_id is None
+                        else int(host_id))
+        # host rank in the stem: N hosts share one trace_dir (a mounted
+        # volume) without shard-name collisions even at equal pids
+        stem = (f"{app_name}.h{self.host_id}.{self.pid}."
+                f"{next(Tracer._FILE_SEQ)}")
         self.trace_path = os.path.join(trace_dir, stem + ".trace.json")
         self.jsonl_path = os.path.join(trace_dir, stem + ".events.jsonl")
         self._lock = threading.Lock()
@@ -99,6 +121,11 @@ class Tracer:
         self._events: list = []
         self._tids: dict = {}
         self._closed = False
+        # flight recorder: the last `ring_size` structured records stay
+        # in memory for postmortem bundles (obs/regress.py) and the
+        # slow-step detector's child-span breakdown
+        self._recent: collections.deque = collections.deque(
+            maxlen=max(1, int(ring_size)))
         # one wall-clock anchor + perf_counter timeline: Chrome wants a
         # monotonic microsecond ts, the JSONL wants wall time
         self._epoch_wall = time.time()
@@ -106,7 +133,8 @@ class Tracer:
         self._jsonl = open(self.jsonl_path, "a", encoding="utf-8")
         self._events.append({"name": "process_name", "ph": "M",
                              "pid": self.pid, "tid": 0,
-                             "args": {"name": app_name}})
+                             "args": {"name":
+                                      f"{app_name} host{self.host_id}"}})
 
     # ------------------------------------------------------------- internals
     def _tid(self) -> int:
@@ -125,13 +153,24 @@ class Tracer:
     def _record(self, chrome_ev: dict, jsonl_rec: dict = None):
         line = None
         if jsonl_rec is not None:
+            # every structured record carries its origin: the aggregator
+            # groups shards and tags merged spans by (host, pid)
+            jsonl_rec["host"] = self.host_id
+            jsonl_rec["pid"] = self.pid
             line = json.dumps(jsonl_rec, default=str) + "\n"
         with self._lock:
             if self._closed:
                 return
             self._events.append(chrome_ev)
             if line is not None:
+                self._recent.append(jsonl_rec)
                 self._jsonl.write(line)
+
+    def recent(self) -> list:
+        """The flight-recorder ring: the newest records (oldest first),
+        bounded by ``ring_size``."""
+        with self._lock:
+            return list(self._recent)
 
     def _ts_us(self, perf_t: float) -> float:
         return round((perf_t - self._epoch_perf) * 1e6, 3)
@@ -158,30 +197,33 @@ class Tracer:
                  "dur": round(dur * 1e6, 3), "pid": self.pid, "tid": tid,
                  "args": attrs},
                 {"kind": "span", "name": name, "id": sid, "parent": parent,
-                 "wall_time": self._wall(t0), "dur_s": round(dur, 9),
-                 "attrs": attrs})
+                 "tid": tid, "wall_time": self._wall(t0),
+                 "dur_s": round(dur, 9), "attrs": attrs})
 
     def event(self, name: str, **attrs):
         """Instant (zero-duration) structured event."""
         t = time.perf_counter()
+        tid = self._tid()
         self._record(
             {"name": name, "ph": "i", "s": "t", "ts": self._ts_us(t),
-             "pid": self.pid, "tid": self._tid(), "args": attrs},
+             "pid": self.pid, "tid": tid, "args": attrs},
             {"kind": "event", "name": name, "id": next(self._ids),
-             "parent": _CURRENT.get(), "wall_time": self._wall(t),
-             "attrs": attrs})
+             "parent": _CURRENT.get(), "tid": tid,
+             "wall_time": self._wall(t), "attrs": attrs})
 
     def complete(self, name: str, start_perf: float, duration_s: float,
                  **attrs):
         """Retroactive span from a ``perf_counter()`` start + duration —
         for phases measured outside the contextvar flow (e.g. the
         pipelined loss readback that resolves one iteration late)."""
+        tid = self._tid()
         self._record(
             {"name": name, "ph": "X", "ts": self._ts_us(start_perf),
              "dur": round(duration_s * 1e6, 3), "pid": self.pid,
-             "tid": self._tid(), "args": attrs},
+             "tid": tid, "args": attrs},
             {"kind": "span", "name": name, "id": next(self._ids),
-             "parent": _CURRENT.get(), "wall_time": self._wall(start_perf),
+             "parent": _CURRENT.get(), "tid": tid,
+             "wall_time": self._wall(start_perf),
              "dur_s": round(duration_s, 9), "attrs": attrs})
 
     def counter(self, name: str, **values):
@@ -199,7 +241,7 @@ class Tracer:
             if not self._jsonl.closed:
                 self._jsonl.flush()
         doc = {"traceEvents": events, "displayTimeUnit": "ms",
-               "otherData": {"pid": self.pid,
+               "otherData": {"pid": self.pid, "host_id": self.host_id,
                              "wall_epoch": self._epoch_wall}}
         tmp = self.trace_path + ".tmp"
         with open(tmp, "w", encoding="utf-8") as fh:

@@ -152,6 +152,10 @@ class DistriOptimizer(LocalOptimizer):
 
         flat, unravel = ravel_pytree(self.model.params())
         self._unravel = unravel
+        # static shape metadata for the collective byte footprint —
+        # host-side ints, no device read
+        self._flat_elems = int(flat.size)
+        self._flat_dtype = str(flat.dtype)
         return flat
 
     def _params_tree(self, pvar):
@@ -214,6 +218,70 @@ class DistriOptimizer(LocalOptimizer):
             opt.state = sharded
         return opt.state
 
+    def _collective_byte_footprint(self):
+        """The static wire-byte budget of one standard train step —
+        every collective ``sharded_step`` programs, costed from shapes
+        the driver already holds (obs/collectives.py cost model; no
+        device reads, no extra syncs).  Publishes the per-step gauges +
+        the int8-vs-f32 savings-ratio gauge and returns the bound
+        footprint the driver loop commits per resolved step."""
+        import jax
+
+        from bigdl_tpu import obs
+        from bigdl_tpu.config import config
+        from bigdl_tpu.obs import collectives as C
+
+        n = self.n_shards
+        padded = self._flat_elems + self._pad
+        pdtype = self._flat_dtype
+        fp = C.StepFootprint()
+        # ---- putGradients + aggregate: the gradient exchange ---------
+        if self.wire_dtype == "int8":
+            ex = C.int8_blockwise_exchange_bytes(padded, n, self.int8_block)
+            fp.add("all_to_all", "int8", ex["int8"])
+            fp.add("all_to_all", "float32", ex["float32"])
+            exchange = ex["int8"] + ex["float32"]
+        else:
+            wire = {"bfloat16": "bfloat16", "float32": "float32"}.get(
+                self.wire_dtype, pdtype)  # "none" ships the grad dtype
+            exchange = C.reduce_scatter_bytes(padded, wire, n)
+            fp.add("psum_scatter", wire, exchange)
+        # global-norm psum on the sharded gradient (always computed)
+        fp.add("psum", "float32", C.all_reduce_bytes(1, "float32", n))
+        if config.nonfinite_guard:
+            fp.add("pmin", "float32", C.all_reduce_bytes(1, "float32", n))
+        # loss pmean/psum (scalar, f32 either way)
+        fp.add("pmean", "float32", C.all_reduce_bytes(1, "float32", n))
+        # sendWeight + getWeights: the full padded vector comes back
+        fp.add("all_gather", pdtype, C.all_gather_bytes(padded, pdtype, n))
+        # BN running stats pmean (floating model-state leaves)
+        import jax.numpy as jnp
+
+        for leaf in jax.tree.leaves(self.model.state()):
+            if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype,
+                                                         jnp.floating):
+                fp.add("pmean", str(leaf.dtype),
+                       C.all_reduce_bytes(int(leaf.size), leaf.dtype, n))
+        fp.bind(obs.get_registry())
+        # the EQuARX argument as a gauge: f32 exchange bytes over what
+        # the configured wire actually ships
+        f32_exchange = C.reduce_scatter_bytes(padded, "float32", n)
+        ratio = f32_exchange / exchange if exchange else 1.0
+        obs.get_registry().gauge(
+            "bigdl_collective_wire_savings_ratio",
+            "f32 gradient-exchange bytes over the configured wire's "
+            "bytes (psum_scatter vs bf16/int8 blockwise)").set(ratio)
+        tracer = obs.get_tracer()
+        if tracer.enabled:
+            tracer.event("collective.footprint",
+                         wire_dtype=self.wire_dtype, n_shards=n,
+                         padded_elems=padded,
+                         bytes_per_step=round(fp.total(), 1),
+                         savings_ratio=round(ratio, 4),
+                         breakdown={k: round(v, 1)
+                                    for k, v in fp.by_op().items()})
+        return fp
+
     def _build_train_step(self):
         """Returns a dispatcher: full batches run the plain compiled
         step; a padded final batch (``_prepare_batch`` set a mask) runs
@@ -224,6 +292,10 @@ class DistriOptimizer(LocalOptimizer):
         which see the pad copies — same as the reference's padding)."""
         self._plain_step = self._build_step_impl(masked=False)
         self._masked_step = None
+        # the masked final-batch variant adds only one scalar psum
+        # (valid count) on top of this; the standard step's budget is
+        # the per-step account
+        self._collective_footprint = self._collective_byte_footprint()
 
         def dispatch(pvar, opt_state, mod_state, rng, inp, tgt):
             mask = self._device_mask

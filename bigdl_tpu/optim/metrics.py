@@ -68,11 +68,15 @@ class Metrics:
 
     def snapshot(self) -> dict:
         """{phase: {count, total, mean}} — the registry-bridge form the
-        obs layer and tests consume."""
+        obs layer and tests consume.  Per phase, count/total come from
+        ONE locked histogram read, so a scrape racing a concurrent
+        ``add()`` (the background checkpoint thread counts too) never
+        shows a count without its total."""
         out = {}
         for (phase,), child in self._family.child_items():
-            out[phase] = {"count": child.count, "total": child.sum,
-                          "mean": child.mean}
+            _, count, total = child.snapshot_state()
+            out[phase] = {"count": count, "total": total,
+                          "mean": total / count if count else 0.0}
         return out
 
     def summary(self) -> str:

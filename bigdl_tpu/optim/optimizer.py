@@ -105,6 +105,10 @@ class BaseOptimizer:
 
         self._obs_tracer = NULL_TRACER
         self._obs_runtime = None
+        # static per-step collective byte footprint (obs/collectives.py)
+        # — DistriOptimizer builds it with the train step; the driver
+        # loop commits it once per resolved step
+        self._collective_footprint = None
         # mixed-precision compute policy: None = full f32; "bfloat16"
         # runs fwd/bwd in bf16 with f32 master params + f32 grads/update
         # (the TPU-native recipe: MXU at 2x, normalizations stay f32)
@@ -286,6 +290,48 @@ class BaseOptimizer:
         None to drop it.  DistriOptimizer overrides to enforce mesh
         divisibility."""
         return inp, tgt
+
+    def _detect_slow_step(self, n, dt, tracer, runtime):
+        """Slow-step anomaly detector: a step slower than
+        ``median * BIGDL_SLOW_STEP_FACTOR`` (default 3x) emits a
+        structured ``slow_step`` trace event carrying the step's
+        child-span breakdown (data_wait / batch_prep / device_put /
+        step_dispatch durations out of the tracer's flight-recorder
+        ring), so outliers self-diagnose instead of vanishing into the
+        p99.  Only runs when the runtime profile is live (obs on); the
+        median window is the step-time reservoir, which already holds
+        this step."""
+        from bigdl_tpu.config import config
+
+        factor = config.obs.slow_step_factor
+        if factor <= 0:
+            return
+        res = runtime.step_times
+        if res.count < 8:
+            return  # warmup: compiles dominate, the median is noise
+        med = res.percentiles((0.5,))[0.5]
+        if med is None or med <= 0 or dt <= med * factor:
+            return
+        breakdown = {}
+        for rec in tracer.recent():
+            if rec.get("kind") != "span" or rec.get("name") in (
+                    "iteration", "computing"):
+                continue
+            if (rec.get("attrs") or {}).get("step") == n:
+                breakdown[rec["name"]] = round(
+                    breakdown.get(rec["name"], 0.0)
+                    + float(rec.get("dur_s", 0.0)), 6)
+        log.warning(
+            "slow step %d: %.4fs vs median %.4fs (> %gx) — breakdown %s",
+            n, dt, med, factor, breakdown or "unavailable (tracing off)")
+        tracer.event("slow_step", step=n, dur_s=round(dt, 6),
+                     median_s=round(med, 6), factor=factor,
+                     breakdown=breakdown)
+        from bigdl_tpu import obs
+
+        obs.get_registry().counter(
+            "bigdl_slow_steps_total",
+            "Steps exceeding median * BIGDL_SLOW_STEP_FACTOR").inc()
 
     def _params_tree(self, pvar):
         """Device-resident training params -> the model's params pytree.
@@ -579,12 +625,19 @@ class LocalOptimizer(BaseOptimizer):
             # completion (~ device step time + one iteration's host work)
             dt = time.perf_counter() - t0
             self.metrics.add("computing time", dt)
+            fp = self._collective_footprint
+            if fp is not None:
+                # one executed step's static collective bytes -> the
+                # bigdl_collective_bytes_total counters (host dict math,
+                # children pre-bound at step build)
+                fp.commit()
             if runtime is not None:
                 # feeds the step-time p50/p95/p99 reservoir; the span is
                 # retroactive (complete) because under pipelining this
                 # resolves one iteration after its dispatch
                 runtime.record_step(dt)
                 tracer.complete("computing", t0, dt, step=n)
+                self._detect_slow_step(n, dt, tracer, runtime)
             self.state["loss"] = loss_val
             if self.train_summary is not None:
                 self.train_summary.add_scalar("Loss", loss_val, n)
@@ -658,8 +711,10 @@ class LocalOptimizer(BaseOptimizer):
                 # named_scope phases of the jitted step; tracer is the
                 # shared no-op object when observability is off
                 tracer.complete("data_wait", t_wait, dt_wait, step=n)
+                # child spans carry the step too: the slow-step detector
+                # and the merged cross-host timeline both key on it
                 with tracer.span("iteration", step=n):
-                    with tracer.span("batch_prep"):
+                    with tracer.span("batch_prep", step=n):
                         prepared = self._prepare_batch(inp, tgt)
                     if prepared is None:
                         continue  # dropped (e.g. sub-mesh partial batch)
@@ -674,10 +729,10 @@ class LocalOptimizer(BaseOptimizer):
                     profiler.step()
                     rng = jax.random.fold_in(base_key, n)
                     with self.metrics.timer("put batch time"), \
-                            tracer.span("device_put"):
+                            tracer.span("device_put", step=n):
                         inp_d, tgt_d = self._put_batch(inp, tgt)
                     t0 = time.perf_counter()
-                    with tracer.span("step_dispatch"):
+                    with tracer.span("step_dispatch", step=n):
                         pvar, opt_state, mod_state, loss, ok = train_step(
                             pvar, opt_state, mod_state, rng, inp_d, tgt_d
                         )

@@ -108,6 +108,21 @@ class MoE(AbstractModule):
         )))
         x = input.reshape(s, d)
 
+        if self.mesh is not None and self.expert_axis in getattr(
+                self.mesh, "shape", {}):
+            n_exp = int(self.mesh.shape[self.expert_axis])
+            if n_exp > 1:
+                from bigdl_tpu.obs import collectives as C
+
+                # static-shape accounting (trace time): with the expert
+                # dim sharded, XLA lowers the dispatch and combine
+                # contractions into an all_to_all pair over the f32
+                # (E, C, D) expert buffers
+                C.record("all_to_all", "float32",
+                         2 * C.all_to_all_bytes(e * cap * d, "float32",
+                                                n_exp),
+                         axis_size=n_exp)
+
         logits = x @ params["gate"]                     # (S, E)
         probs = jax.nn.softmax(logits, axis=-1)
 

@@ -85,6 +85,22 @@ def pipelined(stage_fn: Callable, mesh, axis_name: str = "pipe"):
         return pipeline_apply(stage_fn, params, x, axis_name)
 
     def run(stacked_params, x):
+        from bigdl_tpu.obs import collectives as C
+
+        n = int(mesh.shape[axis_name])
+        if n > 1:
+            # wire accounting from static shapes (trace time): every
+            # fori_loop step ppermutes one microbatch-sized activation
+            # to the next stage (m + n - 1 steps incl. fill/drain), and
+            # the final psum broadcasts the (M, mb, ...) output buffer
+            m = int(x.shape[0])
+            mb_elems = int(x.size) // max(1, m)
+            C.record("ppermute", x.dtype,
+                     C.ppermute_bytes(mb_elems, x.dtype, hops=m + n - 1),
+                     axis_size=n)
+            C.record("psum", x.dtype,
+                     C.all_reduce_bytes(int(x.size), x.dtype, n),
+                     axis_size=n)
         pspecs = jax.tree.map(lambda _: P(axis_name), stacked_params)
         return _shard_map(
             body, mesh, in_specs=(pspecs, P()), out_specs=P()

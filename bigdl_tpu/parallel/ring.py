@@ -117,8 +117,18 @@ def ring_attention_sharded(q, k, v, mesh, *, seq_axis: str = "seq",
 
     from jax.sharding import PartitionSpec as P
 
+    from bigdl_tpu.obs import collectives as C
     from bigdl_tpu.optim.distri_optimizer import _shard_map
 
+    n = int(mesh.shape[seq_axis])
+    if n > 1:
+        # wire accounting from the GLOBAL static shapes (trace time —
+        # once per compile under jit): K and V blocks each ride the
+        # ring for n-1 hops at 1/n of the global array per device
+        C.record("ppermute", k.dtype,
+                 C.ppermute_bytes(int(k.size) // n, k.dtype, hops=n - 1)
+                 + C.ppermute_bytes(int(v.size) // n, v.dtype, hops=n - 1),
+                 axis_size=n)
     spec = P(batch_axis, None, seq_axis, None)
     f = partial(ring_attention, axis_name=seq_axis, causal=causal,
                 scale=scale)

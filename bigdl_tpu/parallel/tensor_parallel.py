@@ -96,7 +96,20 @@ def shard_params(params, mesh, rules=TRANSFORMER_TP_RULES):
     import jax
     from jax.sharding import NamedSharding
 
+    from bigdl_tpu.obs import collectives as C
+
     specs = param_specs(params, mesh, rules)
+    # placement accounting (one-shot, static shapes): bytes of every
+    # leaf that actually splits over a mesh axis — the initial
+    # host->devices scatter the TP layout costs
+    moved: dict = {}
+    for (path, leaf), (_, spec) in zip(_walk(params), _walk(specs)):
+        if spec is not None and any(a is not None for a in spec):
+            name = str(leaf.dtype) if hasattr(leaf, "dtype") else "float32"
+            moved[name] = moved.get(name, 0.0) + (
+                int(leaf.size) * C.dtype_bytes(name))
+    for name, nbytes in moved.items():
+        C.record("tp_shard_params", name, nbytes)
     return jax.tree.map(
         lambda x, s: x if x is None else jax.device_put(
             x, NamedSharding(mesh, s)
@@ -109,10 +122,22 @@ def shard_params(params, mesh, rules=TRANSFORMER_TP_RULES):
 def constrain(x, mesh, *spec_axes):
     """`with_sharding_constraint` shorthand: constrain(x, mesh, 'data',
     None, 'model') pins activation layout where XLA's propagation needs
-    the hint (typically the residual stream under dp×tp)."""
+    the hint (typically the residual stream under dp×tp).
+
+    Each call also accounts the constrained activation's bytes
+    (``bigdl_collective_bytes_total{op="sharding_constraint"}``) — an
+    upper bound on the reshard traffic the hint can force, recorded at
+    trace time from the static shape (GSPMD may satisfy the hint with
+    zero movement; the counter is the budget, not a measurement)."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    axes = [a for a in spec_axes if a is not None]
+    if axes and any(int(mesh.shape[a]) > 1 for a in axes):
+        from bigdl_tpu.obs import collectives as C
+
+        C.record("sharding_constraint", x.dtype,
+                 int(x.size) * C.dtype_bytes(x.dtype))
     return jax.lax.with_sharding_constraint(
         x, NamedSharding(mesh, P(*spec_axes))
     )

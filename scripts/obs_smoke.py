@@ -1,0 +1,147 @@
+#!/usr/bin/env python
+"""--obs-report smoke: the distributed-observability loop, end to end.
+
+Driven by ``scripts/run-tests.sh --obs-report``.  Four stages, each a
+hard assert:
+
+1. two simulated hosts (separate OS processes, ``BIGDL_PROCESS_ID``
+   0/1, CPU backend) each run a 10-step traced DistriOptimizer job into
+   ONE shared trace/metrics volume;
+2. ``python -m bigdl_tpu.obs.aggregate`` merges the shards into a
+   single Perfetto-loadable timeline — both hosts tagged, barriers
+   clock-aligned;
+3. ``python -m bigdl_tpu.obs.report`` renders the run report (step
+   times, collective bytes, slowest spans) from the same dirs;
+4. ``python -m bigdl_tpu.obs.regress`` gates a synthetic 2x step-time
+   slowdown against a synthetic trajectory (must FAIL and dump a
+   flight-recorder bundle) and the unchanged result (must PASS).
+
+Exit 0 only when all four hold.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_WORKER = """
+import os, sys
+sys.path.insert(0, os.environ["BIGDL_REPO"])
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") \\
+    + " --xla_force_host_platform_device_count=8"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from bigdl_tpu import obs
+from bigdl_tpu.engine import Engine
+from bigdl_tpu.nn import (ClassNLLCriterion, Linear, LogSoftMax, ReLU,
+                          Sequential)
+from bigdl_tpu.optim import DistriOptimizer, SGD, Trigger
+
+Engine.init()
+rng = np.random.RandomState(0)
+w = rng.randn(16, 4)
+x = rng.randn(320, 16).astype(np.float32)
+y = (np.argmax(x @ w, axis=1) + 1).astype(np.float32)
+model = Sequential().add(Linear(16, 32)).add(ReLU()) \\
+    .add(Linear(32, 4)).add(LogSoftMax())
+opt = DistriOptimizer(model, (x, y), ClassNLLCriterion(), batch_size=32)
+opt.set_optim_method(SGD(learningrate=0.1))
+opt.set_end_when(Trigger.max_iteration(10))
+opt.optimize()
+assert opt.state["neval"] == 11, opt.state["neval"]
+"""
+
+
+def run(cmd, **env):
+    e = dict(os.environ)
+    e.update({k: str(v) for k, v in env.items()})
+    e["BIGDL_REPO"] = REPO
+    return subprocess.run(cmd, env=e, cwd=REPO, capture_output=True,
+                          text=True, timeout=300)
+
+
+def main() -> int:
+    tmp = tempfile.mkdtemp(prefix="bigdl_obs_smoke_")
+    trace_dir = os.path.join(tmp, "trace")
+    metrics_dir = os.path.join(tmp, "metrics")
+
+    # -- 1: two simulated hosts, one shared volume --------------------
+    for host in (0, 1):
+        p = run([sys.executable, "-c", _WORKER],
+                BIGDL_PROCESS_ID=host, BIGDL_TRACE_DIR=trace_dir,
+                BIGDL_METRICS_DIR=metrics_dir)
+        assert p.returncode == 0, f"host {host} worker failed:\n{p.stdout}\n{p.stderr}"
+        print(f"[obs-smoke] host {host}: 10-step traced run ok")
+
+    # -- 2: merge ------------------------------------------------------
+    merged = os.path.join(tmp, "merged.trace.json")
+    p = run([sys.executable, "-m", "bigdl_tpu.obs.aggregate", trace_dir,
+             "-o", merged])
+    assert p.returncode == 0, p.stdout + p.stderr
+    summary = json.loads(p.stdout.strip().splitlines()[-1])
+    assert summary["hosts"] == [0, 1], summary
+    assert not summary["unaligned"], summary
+    doc = json.load(open(merged))
+    evs = [e for e in doc["traceEvents"] if e.get("ph") != "M"]
+    assert evs and all(
+        evs[i]["ts"] <= evs[i + 1]["ts"] for i in range(len(evs) - 1)), \
+        "merged timeline not monotone"
+    assert {e["args"].get("host") for e in evs} == {0, 1}
+    print(f"[obs-smoke] merged {summary['shards']} shards, "
+          f"{len(evs)} events, offsets {summary['offsets_s']}")
+
+    # -- 3: report -----------------------------------------------------
+    p = run([sys.executable, "-m", "bigdl_tpu.obs.report", trace_dir,
+             "--metrics-dir", metrics_dir])
+    assert p.returncode == 0, p.stdout + p.stderr
+    for needle in ("step times", "collective wire bytes", "psum_scatter",
+                   "slowest spans"):
+        assert needle in p.stdout, f"report missing {needle!r}:\n{p.stdout}"
+    print("[obs-smoke] report renders (step times + collective bytes)")
+
+    # -- 4: regression gate -------------------------------------------
+    traj = os.path.join(tmp, "traj")
+    os.makedirs(traj)
+    base = {"metric": "m", "value": 100.0, "platform": "cpu",
+            "extras": {"step_time_s": 0.05,
+                       "obs_runtime": {"step_time_p50_s": 0.05}}}
+    with open(os.path.join(traj, "BENCH_r01.json"), "w") as fh:
+        json.dump({"parsed": base}, fh)
+    slow = json.loads(json.dumps(base))
+    slow["extras"]["obs_runtime"]["step_time_p50_s"] = 0.10  # 2x slower
+    slow["value"] = 50.0
+    fresh_slow = os.path.join(tmp, "fresh_slow.json")
+    with open(fresh_slow, "w") as fh:
+        json.dump(slow, fh)
+    flight = os.path.join(tmp, "flight")
+    p = run([sys.executable, "-m", "bigdl_tpu.obs.regress", "--fresh",
+             fresh_slow, "--trajectory", traj, "--flight-dir", flight,
+             "--trace-dir", trace_dir, "--metrics-dir", metrics_dir,
+             "--json"])
+    assert p.returncode == 1, f"2x slowdown not flagged: {p.stdout}"
+    verdict = json.loads(p.stdout.strip().splitlines()[-1])
+    assert verdict["status"] == "violation", verdict
+    bundle_path = verdict.get("flight_recorder")
+    assert bundle_path and os.path.exists(bundle_path), verdict
+    bundle = json.load(open(bundle_path))
+    assert bundle["spans"], "flight bundle has no spans"
+    assert "bigdl_collective_bytes_total" in bundle["metrics"]["metrics"]
+    print(f"[obs-smoke] gate flags 2x slowdown; bundle at {bundle_path}")
+
+    fresh_ok = os.path.join(tmp, "fresh_ok.json")
+    with open(fresh_ok, "w") as fh:
+        json.dump(base, fh)
+    p = run([sys.executable, "-m", "bigdl_tpu.obs.regress", "--fresh",
+             fresh_ok, "--trajectory", traj, "--json"])
+    assert p.returncode == 0, f"unchanged result flagged: {p.stdout}"
+    print("[obs-smoke] gate passes the unchanged result")
+    print("[obs-smoke] PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

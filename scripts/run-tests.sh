@@ -10,6 +10,13 @@
 #                                                # assert a well-formed Chrome
 #                                                # trace + Prometheus snapshot
 #                                                # (-m obs)
+#   scripts/run-tests.sh --obs-report            # distributed-obs smoke: a
+#                                                # 2-host traced 10-step
+#                                                # DistriOptimizer run, shard
+#                                                # merge, report render, and
+#                                                # the perf-regression gate
+#                                                # against a synthetic
+#                                                # trajectory (no pytest)
 # The chaos and obs specs are deterministic and part of the default
 # selection; the flags are the focused loops for hacking on those layers.
 set -euo pipefail
@@ -25,6 +32,9 @@ if [[ "${1:-}" == "--chaos" ]]; then
 elif [[ "${1:-}" == "--trace" ]]; then
   shift
   MARKER=(-m obs)
+elif [[ "${1:-}" == "--obs-report" ]]; then
+  shift
+  exec python scripts/obs_smoke.py "$@"
 fi
 
 exec python -m pytest tests/ -q "${MARKER[@]}" "$@"

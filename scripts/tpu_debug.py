@@ -8,6 +8,13 @@ says exactly where the tunnel/compiler breaks.
 
     python scripts/tpu_debug.py            # full ladder
     python scripts/tpu_debug.py --rung 4   # one rung, in-process
+
+This probes the COMPILE path.  For a run that completed (or died) with
+``BIGDL_TRACE_DIR`` set, the post-run analysis lives in the obs CLIs:
+``python -m bigdl_tpu.obs.report <trace_dir>`` (step-time percentiles,
+collective bytes, slowest spans per host) and ``python -m
+bigdl_tpu.obs.aggregate <trace_dir>`` (one Perfetto timeline from all
+host shards).
 """
 
 import argparse
