@@ -76,6 +76,18 @@ class ObsConfig:
     # trajectory's best by this factor (obs/regress.py)
     # [BIGDL_REGRESS_TOLERANCE]
     regress_tolerance: float = 1.5
+    # training-health telemetry (obs/health.py): fetch the per-layer
+    # grad/param/update-norm array from the device once every N steps;
+    # 0 disables — the train step then compiles WITHOUT the health
+    # output (identical signature to a pre-health build, zero extra
+    # per-step host transfers) [BIGDL_HEALTH_EVERY]
+    health_every: int = 0
+    # rolling window for the numerics anomaly detector (loss / global
+    # grad-norm spike vs rolling median) [BIGDL_HEALTH_WINDOW]
+    health_window: int = 64
+    # a loss or grad norm above median * this factor is an anomaly;
+    # <= 0 disables the detector [BIGDL_HEALTH_SPIKE_FACTOR]
+    health_spike_factor: float = 10.0
 
     @property
     def active(self) -> bool:
@@ -91,6 +103,10 @@ class ObsConfig:
             slow_step_factor=_env_float("BIGDL_SLOW_STEP_FACTOR", 3.0),
             flight_spans=_env_int("BIGDL_FLIGHT_SPANS", 512),
             regress_tolerance=_env_float("BIGDL_REGRESS_TOLERANCE", 1.5),
+            health_every=_env_int("BIGDL_HEALTH_EVERY", 0),
+            health_window=_env_int("BIGDL_HEALTH_WINDOW", 64),
+            health_spike_factor=_env_float("BIGDL_HEALTH_SPIKE_FACTOR",
+                                           10.0),
         )
 
 

@@ -17,7 +17,11 @@ stack (optimizers, engine, serializer, resilience, bench):
   shards into one clock-aligned Perfetto timeline (CLI);
 * :mod:`bigdl_tpu.obs.report` — run-report CLI over trace/metrics dirs;
 * :mod:`bigdl_tpu.obs.regress` — perf-regression gate against the
-  BENCH_r*.json trajectory + flight-recorder bundles.
+  BENCH_r*.json trajectory + flight-recorder bundles;
+* :mod:`bigdl_tpu.obs.health` — per-layer grad/param/update-ratio
+  telemetry computed inside the jitted train step, non-finite
+  localization, and the numerics anomaly detector
+  (``BIGDL_HEALTH_EVERY``).
 
 Everything is off by default with a no-op fast path: disabled, the
 train loop sees one shared null context manager per span site and adds
@@ -37,6 +41,7 @@ from bigdl_tpu.obs.runtime import (
     Reservoir,
     RuntimeStats,
     device_memory_stats,
+    hlo_cost_analysis,
     host_rss_bytes,
     instrument_jit,
 )
@@ -136,6 +141,20 @@ def publish_runtime(registry: MetricsRegistry = None,
         "bigdl_jit_compile_seconds_total",
         "Wall seconds spent blocked on jit trace+compile").set(
         snap["compile"]["total_s"])
+    # HLO-derived step FLOPs (compiled.cost_analysis(), normalized per
+    # train step) and, when the chip's peak is known, observed MFU
+    sf = snap.get("step_flops")
+    if sf:
+        registry.gauge(
+            "bigdl_step_flops",
+            "HLO cost-analysis FLOPs of one compiled train step").set(sf)
+        p50 = st["p50"]
+        if runtime.peak_flops and p50:
+            registry.gauge(
+                "bigdl_mfu",
+                "Model FLOPs utilization: HLO step FLOPs / (p50 step "
+                "time * peak chip FLOPs)").set(
+                sf / (p50 * runtime.peak_flops))
     rss = snap.get("host_rss_bytes")
     if rss:
         registry.gauge("bigdl_host_rss_bytes",

@@ -40,8 +40,14 @@ def _escape(v) -> str:
 
 
 def _fmt(v: float) -> str:
-    """Prometheus sample value: integers without a trailing .0 noise."""
+    """Prometheus sample value: integers without a trailing .0 noise.
+    Non-finite values render as the exposition-format spellings
+    (``NaN`` / ``+Inf`` / ``-Inf``) instead of crashing the scrape."""
     f = float(v)
+    if f != f:
+        return "NaN"
+    if f in (float("inf"), float("-inf")):
+        return "+Inf" if f > 0 else "-Inf"
     return repr(int(f)) if f == int(f) else repr(f)
 
 

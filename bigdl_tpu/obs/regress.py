@@ -203,7 +203,28 @@ def flight_bundle(reason: str = "", trace_dir: Optional[str] = None,
         # backend (the hung-tunnel failure mode this repo knows well)
         "runtime": obs.get_runtime().snapshot(memory=False),
         "host_rss_bytes": host_rss_bytes(),
+        # training-health columns (obs/health.py): the postmortem's
+        # first numerics questions — which layer's norms moved, which
+        # went non-finite, what anomalies fired — pre-extracted from
+        # the same metrics snapshot + span ring
+        "health": _health_columns(metrics, spans),
     }
+
+
+_HEALTH_FAMILIES = ("bigdl_grad_norm", "bigdl_param_norm",
+                    "bigdl_update_ratio", "bigdl_global_grad_norm",
+                    "bigdl_nonfinite_layers_total",
+                    "bigdl_numerics_anomalies_total", "bigdl_step_flops",
+                    "bigdl_mfu")
+
+
+def _health_columns(metrics: dict, spans: list) -> dict:
+    fams = (metrics or {}).get("metrics") or {}
+    out = {"metrics": {name: fams[name]["samples"]
+                       for name in _HEALTH_FAMILIES if name in fams}}
+    out["events"] = [r for r in (spans or [])
+                     if str(r.get("name", "")).startswith("health.")]
+    return out
 
 
 def dump_flight_recorder(out_dir: str, verdict: dict,

@@ -10,9 +10,14 @@ snapshots when present) and renders what a postmortem asks first:
 * collective wire bytes by op/dtype, per-step footprint and the
   int8-vs-f32 savings ratio;
 * resilience events (retries, non-finite skips, checkpoint failures);
-* slow-step anomalies and the slowest spans per host.
+* slow-step anomalies and the slowest spans per host;
+* training health (obs/health.py): per-layer grad norm / param norm /
+  update ratio gauges, non-finite layer attributions, numerics
+  anomalies.
 
-``--json`` emits the machine-readable report instead of text.
+``--json`` emits the machine-readable report instead of text — the
+same dict ``build_report`` returns, so CI and ``obs/regress.py``
+consume reports without scraping the rendered text.
 """
 
 from __future__ import annotations
@@ -78,6 +83,8 @@ def build_report(trace_dir: str, metrics_dir: Optional[str] = None) -> dict:
     resilience: dict = {}
     slow_steps: list = []
     compile_events: list = []
+    nonfinite_events: list = []
+    anomaly_events: list = []
     for sh in shards:
         key = f"host{sh.host}/pid{sh.pid}"
         h = hosts.setdefault(key, {
@@ -103,6 +110,14 @@ def build_report(trace_dir: str, metrics_dir: Optional[str] = None) -> dict:
                     a = dict(rec.get("attrs") or {})
                     a["host"] = sh.host
                     slow_steps.append(a)
+                elif name == "health.nonfinite_layers":
+                    a = dict(rec.get("attrs") or {})
+                    a["host"] = sh.host
+                    nonfinite_events.append(a)
+                elif name == "health.anomaly":
+                    a = dict(rec.get("attrs") or {})
+                    a["host"] = sh.host
+                    anomaly_events.append(a)
 
     per_host = {}
     for key, h in hosts.items():
@@ -141,6 +156,39 @@ def build_report(trace_dir: str, metrics_dir: Optional[str] = None) -> dict:
         float(s.get("value", 0.0)) for _l, s, _h in _metric_samples(
             snaps, "bigdl_jit_compile_count"))
 
+    # ---- training health (obs/health.py) -----------------------------
+    def _by_layer(metric):
+        out = {}
+        for labels, s, _host in _metric_samples(snaps, metric):
+            out[labels.get("layer", "?")] = float(s.get("value", 0.0))
+        return out
+
+    def _summed(metric, key):
+        out = {}
+        for labels, s, _host in _metric_samples(snaps, metric):
+            k = labels.get(key, "?")
+            out[k] = out.get(k, 0.0) + float(s.get("value", 0.0))
+        return out
+
+    step_flops = [float(s.get("value", 0.0))
+                  for _l, s, _h in _metric_samples(snaps,
+                                                   "bigdl_step_flops")]
+    mfu = [float(s.get("value", 0.0))
+           for _l, s, _h in _metric_samples(snaps, "bigdl_mfu")]
+    health = {
+        "grad_norm": _by_layer("bigdl_grad_norm"),
+        "param_norm": _by_layer("bigdl_param_norm"),
+        "update_ratio": _by_layer("bigdl_update_ratio"),
+        "nonfinite_layers_total": _summed(
+            "bigdl_nonfinite_layers_total", "layer"),
+        "anomalies_total": _summed(
+            "bigdl_numerics_anomalies_total", "kind"),
+        "nonfinite_events": nonfinite_events,
+        "anomaly_events": anomaly_events,
+        "step_flops": max(step_flops) if step_flops else None,
+        "mfu": max(mfu) if mfu else None,
+    }
+
     return {
         "trace_dir": trace_dir,
         "metrics_dir": metrics_dir or trace_dir,
@@ -155,6 +203,7 @@ def build_report(trace_dir: str, metrics_dir: Optional[str] = None) -> dict:
         "wire_savings_ratio": max(savings) if savings else None,
         "resilience_events": resilience,
         "slow_steps": slow_steps,
+        "health": health,
     }
 
 
@@ -215,6 +264,45 @@ def render_text(rep: dict) -> str:
             f"{float(s.get('dur_s', 0)) * 1000:.1f}ms "
             f"(median {float(s.get('median_s', 0)) * 1000:.1f}ms, "
             f"breakdown {s.get('breakdown')})")
+    lines.append("")
+    lines.append("-- training health --")
+    h = rep.get("health") or {}
+    if not (h.get("grad_norm") or h.get("nonfinite_layers_total")
+            or h.get("anomalies_total")):
+        lines.append("  (no health telemetry — set BIGDL_HEALTH_EVERY)")
+    else:
+        layers = sorted(set(h.get("grad_norm", {}))
+                        | set(h.get("update_ratio", {})))
+        for layer in layers[:12]:
+            g = h.get("grad_norm", {}).get(layer)
+            p = h.get("param_norm", {}).get(layer)
+            r = h.get("update_ratio", {}).get(layer)
+
+            def f(v):
+                return "-" if v is None else f"{v:.4g}"
+
+            lines.append(f"  {layer:24s} grad={f(g):>10s} "
+                         f"param={f(p):>10s} upd/w={f(r):>10s}")
+        if len(layers) > 12:
+            lines.append(f"  ... {len(layers) - 12} more layers "
+                         "(use --json for all)")
+        if h.get("step_flops"):
+            mfu = f" mfu={h['mfu']:.4f}" if h.get("mfu") else ""
+            lines.append(f"  HLO step FLOPs: {h['step_flops']:.4g}{mfu}")
+        for layer, n in sorted(h.get("nonfinite_layers_total",
+                                     {}).items()):
+            lines.append(f"  NON-FINITE {layer}: {int(n)} step(s)")
+        for ev in h.get("nonfinite_events", [])[:8]:
+            lines.append(
+                f"  host{ev.get('host')} step {ev.get('step')}: first "
+                f"offender {ev.get('first')} (all: {ev.get('layers')})")
+        for kind, n in sorted(h.get("anomalies_total", {}).items()):
+            lines.append(f"  ANOMALY {kind}: {int(n)}")
+        for ev in h.get("anomaly_events", [])[:8]:
+            lines.append(
+                f"  host{ev.get('host')} step {ev.get('step')}: "
+                f"{ev.get('kind')} {float(ev.get('value', 0)):.4g} vs "
+                f"median {float(ev.get('median', 0)):.4g}")
     lines.append("")
     lines.append("-- slowest spans per host --")
     for key, h in sorted(rep["hosts"].items()):

@@ -114,6 +114,14 @@ class RuntimeStats:
         self.compile_count = 0
         self.compile_seconds = 0.0
         self.compile_events: list = []  # first 64, [{name, seconds}]
+        # HLO-derived costs per instrumented fn ({name: {flops,
+        # bytes_accessed}}, from compiled.cost_analysis()); step_flops
+        # normalizes the newest one to a single train step and
+        # peak_flops (set by bench.py from the chip spec) turns it into
+        # an MFU gauge at publish time
+        self.costs: dict = {}
+        self.step_flops: Optional[float] = None
+        self.peak_flops: Optional[float] = None
         self._lock = threading.Lock()
 
     def record_step(self, seconds: float):
@@ -133,6 +141,18 @@ class RuntimeStats:
         del name  # one reservoir: dispatch cost is fn-agnostic
         self.dispatch_times.add(seconds)
 
+    def record_cost(self, name: str, cost: dict,
+                    steps_per_call: float = 1.0):
+        """HLO cost analysis of one compiled fn.  ``steps_per_call``
+        normalizes a scanned body (bench runs N steps per call) to
+        per-train-step FLOPs."""
+        with self._lock:
+            self.costs[name] = dict(cost)
+            flops = cost.get("flops")
+            if flops:
+                self.step_flops = float(flops) / max(1.0,
+                                                     float(steps_per_call))
+
     def snapshot(self, memory: bool = True) -> dict:
         out = {
             "step_time_s": self.step_times.summary(),
@@ -140,6 +160,8 @@ class RuntimeStats:
             "compile": {"count": self.compile_count,
                         "total_s": round(self.compile_seconds, 6),
                         "events": list(self.compile_events)},
+            "cost": {k: dict(v) for k, v in self.costs.items()},
+            "step_flops": self.step_flops,
         }
         if memory:
             out["host_rss_bytes"] = host_rss_bytes()
@@ -169,23 +191,79 @@ def tree_signature(tree) -> tuple:
     return tuple(sig)
 
 
+def abstract_args(args, kwargs):
+    """``ShapeDtypeStruct`` mirror of an arg tree — host-side metadata
+    only (shape/dtype reads never sync the device).  Captured BEFORE a
+    donating call so :func:`hlo_cost_analysis` can lower afterwards."""
+    try:
+        import jax
+
+        abstract = lambda a: (jax.ShapeDtypeStruct(a.shape, a.dtype)
+                              if hasattr(a, "shape") and hasattr(a, "dtype")
+                              else a)
+        return jax.tree.map(abstract, (args, kwargs))
+    except Exception:  # noqa: BLE001 — telemetry must never sink a step
+        return None
+
+
+def hlo_cost_analysis(fn, abstract) -> Optional[dict]:
+    """``compiled.cost_analysis()`` of a jitted callable for one arg
+    signature — the compiler's own FLOPs/bytes count for the program it
+    actually built, vs whatever analytic model the caller believes.
+
+    ``abstract`` is the :func:`abstract_args` capture.  Called right
+    after the first real call, ``lower().compile()`` reuses the cached
+    executable — the cost is one retrace, not a second XLA compile.
+    Best-effort: any failure (non-jit callable, backend without cost
+    analysis) returns None."""
+    lower = getattr(fn, "lower", None)
+    if lower is None or abstract is None:
+        return None
+    try:
+        a_args, a_kw = abstract
+        ca = lower(*a_args, **a_kw).compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else None
+        if not ca:
+            return None
+        out = {}
+        if ca.get("flops"):
+            out["flops"] = float(ca["flops"])
+        if ca.get("bytes accessed"):
+            out["bytes_accessed"] = float(ca["bytes accessed"])
+        return out or None
+    except Exception:  # noqa: BLE001 — telemetry must never sink a step
+        return None
+
+
 def instrument_jit(fn, name: str = "jit", stats: Optional[RuntimeStats] = None,
-                   tracer=None):
+                   tracer=None, steps_per_call: float = 1.0):
     """Wrap a jitted callable: a call on an unseen arg signature is a
     compile event (its wall time ≈ trace + compile, because jit blocks
     the first call), a seen one is a cached dispatch.  The signature is
-    computed BEFORE the call — donated buffers are deleted by it."""
+    computed BEFORE the call — donated buffers are deleted by it.  The
+    first compile also records the program's HLO-derived FLOPs/bytes
+    (``steps_per_call`` normalizes a scanned N-step body)."""
     seen = set()
 
     def wrapped(*args, **kwargs):
         sig = tree_signature((args, kwargs))
+        first = sig not in seen
+        # abstract arg metadata is captured before the call — the call
+        # deletes donated buffers, cost analysis lowers from the mirror
+        abstract = abstract_args(args, kwargs) \
+            if first and stats is not None else None
         t0 = time.perf_counter()
         out = fn(*args, **kwargs)
         dt = time.perf_counter() - t0
-        if sig not in seen:
+        if first:
             seen.add(sig)
             if stats is not None:
                 stats.record_compile(name, dt)
+                cost = hlo_cost_analysis(fn, abstract)
+                if cost is not None:
+                    stats.record_cost(name, cost,
+                                      steps_per_call=steps_per_call)
             if tracer is not None:
                 tracer.complete(f"{name}.compile", t0, dt,
                                 signatures=len(seen))

@@ -250,6 +250,11 @@ class DistriOptimizer(LocalOptimizer):
         fp.add("psum", "float32", C.all_reduce_bytes(1, "float32", n))
         if config.nonfinite_guard:
             fp.add("pmin", "float32", C.all_reduce_bytes(1, "float32", n))
+        if self._health_monitor is not None:
+            # the (L, 4) per-layer health-stats psum (obs/health.py)
+            n_layers = len(self._health_monitor.names)
+            fp.add("psum", "float32",
+                   C.all_reduce_bytes(n_layers * 4, "float32", n))
         # loss pmean/psum (scalar, f32 either way)
         fp.add("pmean", "float32", C.all_reduce_bytes(1, "float32", n))
         # sendWeight + getWeights: the full padded vector comes back
@@ -326,6 +331,17 @@ class DistriOptimizer(LocalOptimizer):
         wire = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
                 "none": None}.get(self.wire_dtype, None)
         global_batch = self.batch_size
+        # per-layer health telemetry on the ZeRO shard (obs/health.py):
+        # layer boundaries in the ravelled layout — each device
+        # segment-sums its shard's contribution and ONE (L, 4) psum
+        # makes every host's stats global
+        health_on = self._health_monitor is not None
+        boundaries = None
+        if health_on:
+            from bigdl_tpu.obs import health as H
+
+            boundaries = jnp.asarray(
+                np.cumsum(H.layer_sizes(self.model.params())), jnp.int32)
         # freeze support on the flat ZeRO vector.  VERDICT r4 weak #5:
         # do NOT embed a flat-param-sized f32 mask as a jit constant
         # (plus a second padded copy for the shard slice) — that doubles
@@ -410,6 +426,10 @@ class DistriOptimizer(LocalOptimizer):
                 # ParameterProcessors on the *sharded* gradient, with the
                 # global norm via psum — matching L2NormClippingProcessor
                 sq = jax.lax.psum(jnp.sum(gshard * gshard), axis)
+                # health stats see the batch-scaled, pre-clip gradient
+                # (clipping hides exactly the explosions the telemetry
+                # exists to show)
+                g_for_health = gshard if health_on else None
                 gshard = clipper(gshard, global_sq_norm=sq)
             if guard:
                 # non-finite step guard: every replica must agree to
@@ -458,6 +478,16 @@ class DistriOptimizer(LocalOptimizer):
                     mshard = _keep_mask(idx * shard_len, shard_len,
                                         wshard.dtype)
                     new_wshard = wshard + mshard * (new_wshard - wshard)
+                if health_on:
+                    from bigdl_tpu.obs import health as H
+
+                    # (L, 4) global per-layer stats: new_wshard is
+                    # post-guard/post-freeze, so a skipped step reports
+                    # a zero update; nonfinite counts come from the
+                    # summed pre-clip gradient
+                    health_stats = H.flat_shard_stats(
+                        g_for_health, wshard, new_wshard,
+                        idx * shard_len, boundaries, axis)
             with jax.named_scope("send_weights"):
                 # ---- sendWeightPartition + getWeights -------------------
                 new_flat = jax.lax.all_gather(new_wshard, axis, tiled=True)
@@ -483,6 +513,9 @@ class DistriOptimizer(LocalOptimizer):
                 loss = jax.lax.psum(loss_aux, axis) / valid
             else:
                 loss = jax.lax.pmean(loss_aux, axis)
+            if health_on:
+                return (new_flat, new_opt, new_mstate, loss, ok,
+                        health_stats)
             return new_flat, new_opt, new_mstate, loss, ok
 
         opt_state_specs = {k: P(axis) if v.ndim == 1 else P()
@@ -492,11 +525,14 @@ class DistriOptimizer(LocalOptimizer):
         in_specs = (P(), opt_state_specs, mstate_spec, P(), P(axis), P(axis))
         if masked:
             in_specs = in_specs + (P(axis),)
+        out_specs = (P(), opt_state_specs, mstate_spec, P(), P())
+        if health_on:
+            out_specs = out_specs + (P(),)  # psum'd -> replicated
         mapped = _shard_map(
             sharded_step,
             self.mesh,
             in_specs=in_specs,
-            out_specs=(P(), opt_state_specs, mstate_spec, P(), P()),
+            out_specs=out_specs,
         )
         # donate params/opt-state/model-state like LocalOptimizer: the
         # step updates in place on-device instead of holding two copies

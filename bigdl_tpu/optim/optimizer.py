@@ -105,6 +105,9 @@ class BaseOptimizer:
 
         self._obs_tracer = NULL_TRACER
         self._obs_runtime = None
+        # per-layer numerics telemetry (obs/health.py); optimize()
+        # builds it from the live config, None = disabled
+        self._health_monitor = None
         # static per-step collective byte footprint (obs/collectives.py)
         # — DistriOptimizer builds it with the train step; the driver
         # loop commits it once per resolved step
@@ -458,6 +461,10 @@ class LocalOptimizer(BaseOptimizer):
         clipper = self._clipper
         loss_fn = self._loss_fn()
         guard = config.nonfinite_guard
+        # per-layer health telemetry (obs/health.py): pure device math
+        # appended to the step ONLY when the monitor exists — disabled
+        # runs compile the exact pre-health signature
+        health_on = self._health_monitor is not None
         # freeze support (reference module.freeze): zero the gradients
         # of frozen subtrees — static at trace time, no cost unfrozen
         mask = self.model.grad_mask() if self.model.has_frozen() else None
@@ -473,6 +480,9 @@ class LocalOptimizer(BaseOptimizer):
                 # mask BEFORE the clipper so frozen gradients cannot
                 # inflate the global norm and over-shrink live ones
                 grad = jax.tree.map(lambda g, s: g * s, grad, mask)
+            # health stats see the pre-clip gradient (clipping hides
+            # exactly the explosions the telemetry exists to show)
+            grad_for_health = grad if health_on else None
             grad = clipper(grad)
             new_p, new_opt = opt.step(grad, p, opt_st)
             if mask is not None:
@@ -497,6 +507,15 @@ class LocalOptimizer(BaseOptimizer):
                 new_p = keep(new_p, p)
                 new_opt = keep(new_opt, opt_st)
                 new_mstate = keep(new_mstate, mstate)
+            if health_on:
+                from bigdl_tpu.obs import health as _health
+
+                # (L, 4) per-layer [grad_sq, param_sq, update_sq,
+                # nonfinite]; new_p is post-guard so a skipped step
+                # reports a zero update
+                stats = _health.tree_layer_stats(grad_for_health, p,
+                                                 new_p)
+                return new_p, new_opt, new_mstate, loss, ok, stats
             return new_p, new_opt, new_mstate, loss, ok
 
         return train_step
@@ -525,6 +544,14 @@ class LocalOptimizer(BaseOptimizer):
         # host-device synchronizations either way
         tracer = self._obs_tracer = obs.get_tracer()
         self._obs_runtime = obs.get_runtime() if obs.active() else None
+        # training-health telemetry: the monitor exists only when
+        # BIGDL_HEALTH_EVERY > 0; its absence makes the step build the
+        # exact health-less signature with zero extra host transfers
+        from bigdl_tpu.obs import health as _health_mod
+
+        self._health_monitor = _health_mod.monitor_from_config(
+            self.model.params(), tracer=tracer,
+            summary=self.train_summary)
 
         model = self.model
         model.training()
@@ -598,6 +625,7 @@ class LocalOptimizer(BaseOptimizer):
         # shared no-op when disabled, runtime None — zero hot-loop cost
         tracer = self._obs_tracer
         runtime = self._obs_runtime
+        monitor = self._health_monitor
 
         # Async-dispatch pipelining: the device loss is read back ONE
         # iteration behind, so the next step is dispatched before the
@@ -617,9 +645,10 @@ class LocalOptimizer(BaseOptimizer):
                       self.checkpoint_trigger, _param_trig)
             if t is not None
         )
-        pending = []  # [(n, loss_device, ok_device, batch_size, t_dispatch)]
+        pending = []  # [(n, loss_dev, ok_dev, batch_size, t_dispatch,
+        #                 health_dev_or_None)]
 
-        def resolve(n, loss_dev, ok_dev, bs, t0):
+        def resolve(n, loss_dev, ok_dev, bs, t0, health_dev=None):
             loss_val = float(loss_dev)
             # in pipelined steady state this spans dispatch -> observed
             # completion (~ device step time + one iteration's host work)
@@ -639,6 +668,13 @@ class LocalOptimizer(BaseOptimizer):
                 tracer.complete("computing", t0, dt, step=n)
                 self._detect_slow_step(n, dt, tracer, runtime)
             self.state["loss"] = loss_val
+            if monitor is not None:
+                # fetches the (L, 4) health array only every K steps —
+                # or unconditionally when the guard tripped, because
+                # localization IS the point of that fetch.  Runs before
+                # the skip-escalation below so a NonFiniteStepError
+                # never races the layer attribution out of the trace.
+                monitor.on_step(n, health_dev, bool(ok_dev), loss_val)
             if self.train_summary is not None:
                 self.train_summary.add_scalar("Loss", loss_val, n)
                 self.train_summary.add_scalar(
@@ -733,18 +769,23 @@ class LocalOptimizer(BaseOptimizer):
                         inp_d, tgt_d = self._put_batch(inp, tgt)
                     t0 = time.perf_counter()
                     with tracer.span("step_dispatch", step=n):
-                        pvar, opt_state, mod_state, loss, ok = train_step(
+                        out = train_step(
                             pvar, opt_state, mod_state, rng, inp_d, tgt_d
                         )
+                    # health-enabled steps carry one extra output (the
+                    # per-layer stats array); disabled steps keep the
+                    # seed 5-tuple signature
+                    pvar, opt_state, mod_state, loss, ok = out[:5]
+                    health_dev = out[5] if monitor is not None else None
                     bs = np.asarray(inp).shape[0]
                     records_total += bs
                     if sync_per_step:
-                        resolve(n, loss, ok, bs, t0)
+                        resolve(n, loss, ok, bs, t0, health_dev)
                     else:
                         # the step is dispatched; reading back the
                         # PREVIOUS loss now lets the device run two-deep
                         flush_pending()
-                        pending.append((n, loss, ok, bs, t0))
+                        pending.append((n, loss, ok, bs, t0, health_dev))
                     if self.train_summary is not None:
                         # histograms stay on the synchronous path: pvar
                         # here IS step n's output and neval is still n,

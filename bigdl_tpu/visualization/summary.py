@@ -234,6 +234,11 @@ class _Summary:
 RESILIENCE_TAGS = ("NonFiniteSkips", "RetryCount",
                    "CheckpointWriteFailures")
 
+# per-layer numerics telemetry (obs/health.py): each layer gets one
+# scalar stream per prefix, tagged "<prefix><layer-path>" (e.g.
+# "GradNorm/0/weight") — read back with read_scalar(tag)
+HEALTH_TAG_PREFIXES = ("GradNorm/", "ParamNorm/", "UpdateRatio/")
+
 
 class TrainSummary(_Summary):
     """«bigdl»/visualization/TrainSummary.scala — loss/throughput/LR per
@@ -251,6 +256,19 @@ class TrainSummary(_Summary):
                                checkpoint_write_failures)):
             if value is not None:
                 self.add_scalar(tag, float(value), step)
+        return self
+
+    def add_health(self, step: int, layers: dict):
+        """Per-layer numerics scalars from one health sample
+        (``{layer: {grad_norm, param_norm, update_ratio, ...}}`` as
+        produced by ``obs.health.summarize``) — one TensorBoard stream
+        per (prefix, layer) from :data:`HEALTH_TAG_PREFIXES`."""
+        keys = ("grad_norm", "param_norm", "update_ratio")
+        for layer, row in layers.items():
+            for prefix, key in zip(HEALTH_TAG_PREFIXES, keys):
+                v = row.get(key)
+                if v is not None and np.isfinite(v):
+                    self.add_scalar(prefix + layer, float(v), step)
         return self
 
     def set_summary_trigger(self, name: str, trigger):

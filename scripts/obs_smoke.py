@@ -5,13 +5,16 @@ Driven by ``scripts/run-tests.sh --obs-report``.  Four stages, each a
 hard assert:
 
 1. two simulated hosts (separate OS processes, ``BIGDL_PROCESS_ID``
-   0/1, CPU backend) each run a 10-step traced DistriOptimizer job into
-   ONE shared trace/metrics volume;
+   0/1, CPU backend) each run a 10-step traced DistriOptimizer job —
+   with health telemetry on (``BIGDL_HEALTH_EVERY=2``) — into ONE
+   shared trace/metrics volume;
 2. ``python -m bigdl_tpu.obs.aggregate`` merges the shards into a
    single Perfetto-loadable timeline — both hosts tagged, barriers
    clock-aligned;
 3. ``python -m bigdl_tpu.obs.report`` renders the run report (step
-   times, collective bytes, slowest spans) from the same dirs;
+   times, collective bytes, slowest spans, the training-health section
+   with per-layer grad norms) from the same dirs, and ``--json``
+   carries the same health dict machine-readably;
 4. ``python -m bigdl_tpu.obs.regress`` gates a synthetic 2x step-time
    slowdown against a synthetic trajectory (must FAIL and dump a
    flight-recorder bundle) and the unchanged result (must PASS).
@@ -73,7 +76,7 @@ def main() -> int:
     for host in (0, 1):
         p = run([sys.executable, "-c", _WORKER],
                 BIGDL_PROCESS_ID=host, BIGDL_TRACE_DIR=trace_dir,
-                BIGDL_METRICS_DIR=metrics_dir)
+                BIGDL_METRICS_DIR=metrics_dir, BIGDL_HEALTH_EVERY=2)
         assert p.returncode == 0, f"host {host} worker failed:\n{p.stdout}\n{p.stderr}"
         print(f"[obs-smoke] host {host}: 10-step traced run ok")
 
@@ -99,9 +102,20 @@ def main() -> int:
              "--metrics-dir", metrics_dir])
     assert p.returncode == 0, p.stdout + p.stderr
     for needle in ("step times", "collective wire bytes", "psum_scatter",
-                   "slowest spans"):
+                   "slowest spans", "training health", "grad=",
+                   "upd/w="):
         assert needle in p.stdout, f"report missing {needle!r}:\n{p.stdout}"
-    print("[obs-smoke] report renders (step times + collective bytes)")
+    print("[obs-smoke] report renders (step times + collective bytes "
+          "+ training health)")
+
+    # --json: the same report machine-readably, health section included
+    p = run([sys.executable, "-m", "bigdl_tpu.obs.report", trace_dir,
+             "--metrics-dir", metrics_dir, "--json"])
+    assert p.returncode == 0, p.stdout + p.stderr
+    rep = json.loads(p.stdout.strip().splitlines()[-1])
+    assert rep["health"]["grad_norm"], rep["health"]
+    assert rep["health"]["update_ratio"], rep["health"]
+    print("[obs-smoke] --json report carries the health section")
 
     # -- 4: regression gate -------------------------------------------
     traj = os.path.join(tmp, "traj")

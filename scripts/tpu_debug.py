@@ -12,9 +12,14 @@ says exactly where the tunnel/compiler breaks.
 This probes the COMPILE path.  For a run that completed (or died) with
 ``BIGDL_TRACE_DIR`` set, the post-run analysis lives in the obs CLIs:
 ``python -m bigdl_tpu.obs.report <trace_dir>`` (step-time percentiles,
-collective bytes, slowest spans per host) and ``python -m
-bigdl_tpu.obs.aggregate <trace_dir>`` (one Perfetto timeline from all
-host shards).
+collective bytes, slowest spans per host, and — when the run exported
+health telemetry via ``BIGDL_HEALTH_EVERY`` — the "training health"
+section: per-layer grad/param norms, update ratios, non-finite layer
+attributions, numerics anomalies; ``--json`` for machines) and
+``python -m bigdl_tpu.obs.aggregate <trace_dir>`` (one Perfetto
+timeline from all host shards).  A NaN'd run names its first offending
+layer in the report's health section — start there before blaming the
+compiler.
 """
 
 import argparse

@@ -100,11 +100,30 @@ _WORKER = textwrap.dedent(
 
 
 def _free_port():
-    s = socket.socket()
-    s.bind(("localhost", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+    """Coordinator port for this run's 2-process jax.distributed world.
+
+    Plain bind-ephemeral-then-release is racy under CONCURRENT pytest
+    runs: both runs can be handed the same just-released port in the
+    window before their workers bind it, and the second world's
+    coordinator then fails to start (the spurious failure CHANGES.md r3
+    flagged).  Deriving the search base from the PID gives concurrent
+    runs disjoint probe ranges; each candidate is still bind-checked so
+    a genuinely busy port is skipped, and the chosen port is released
+    immediately before the workers (which inherit it via
+    BIGDL_COORDINATOR_ADDRESS) bind it."""
+    base = 20000 + (os.getpid() * 41) % 20000
+    for offset in range(256):
+        port = 20000 + (base - 20000 + offset) % 20000
+        s = socket.socket()
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        try:
+            s.bind(("localhost", port))
+        except OSError:
+            continue
+        finally:
+            s.close()
+        return port
+    raise RuntimeError("no free coordinator port in the PID-derived range")
 
 
 @pytest.mark.slow
