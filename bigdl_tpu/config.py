@@ -161,6 +161,21 @@ class BigDLConfig:
     # checkpoint retention: keep the newest K checkpoint pairs, 0 =
     # unlimited [BIGDL_CHECKPOINT_KEEP_LAST]
     checkpoint_keep_last: int = 0
+    # --- elastic training (resilience/elastic.py) -----------------------
+    # Engine.init installs a SIGTERM/SIGINT handler: finish the in-flight
+    # step, emergency checkpoint, exit EXIT_PREEMPTED
+    # [BIGDL_PREEMPTION_HANDLER]
+    preemption_handler: bool = True
+    # heartbeat peer-liveness for multi-host runs: a shared directory
+    # every host touches a host-tagged file in; unset = off
+    # [BIGDL_HEARTBEAT_DIR]
+    heartbeat_dir: Optional[str] = None
+    # touch the heartbeat file every K training steps
+    # [BIGDL_HEARTBEAT_EVERY]
+    heartbeat_every: int = 1
+    # a peer silent past this many seconds raises PeerLostError instead
+    # of hanging the next collective [BIGDL_HEARTBEAT_TIMEOUT]
+    heartbeat_timeout: float = 60.0
 
     # --- observability (obs/ package) -----------------------------------
     # span tracer / metrics registry / runtime profiling switches
@@ -190,6 +205,10 @@ class BigDLConfig:
             nonfinite_guard=_env_bool("BIGDL_NONFINITE_GUARD", True),
             max_nonfinite_skips=_env_int("BIGDL_MAX_NONFINITE_SKIPS", 10),
             checkpoint_keep_last=_env_int("BIGDL_CHECKPOINT_KEEP_LAST", 0),
+            preemption_handler=_env_bool("BIGDL_PREEMPTION_HANDLER", True),
+            heartbeat_dir=_env_str("BIGDL_HEARTBEAT_DIR", None),
+            heartbeat_every=_env_int("BIGDL_HEARTBEAT_EVERY", 1),
+            heartbeat_timeout=_env_float("BIGDL_HEARTBEAT_TIMEOUT", 60.0),
             obs=ObsConfig.from_env(),
         )
 

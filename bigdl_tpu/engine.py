@@ -76,6 +76,16 @@ class Engine:
         from bigdl_tpu.resilience.faults import get_injector
 
         get_injector()
+        # elastic preemption: SIGTERM/SIGINT finish the in-flight step,
+        # write an emergency checkpoint, and exit EXIT_PREEMPTED so the
+        # supervisor restarts from it (resilience/elastic.py); installed
+        # here because init is the one choke point every launcher hits
+        if config.preemption_handler:
+            from bigdl_tpu.resilience.elastic import (
+                install_preemption_handler,
+            )
+
+            install_preemption_handler()
         if cls._state.initialized and config.check_singleton:
             # bigdl.check.singleton analogue
             raise RuntimeError(
@@ -146,10 +156,14 @@ class Engine:
     @classmethod
     def reset(cls):
         """Test hook: drop the singleton (no reference analogue) and the
-        fault injector's fire-once counters with it."""
+        fault injector's fire-once counters with it.  A pending
+        preemption request is dropped too (the signal handlers stay
+        installed — they are idempotent and process-global)."""
+        from bigdl_tpu.resilience.elastic import clear_preemption
         from bigdl_tpu.resilience.faults import reset_injector
 
         reset_injector()
+        clear_preemption()
         cls._state = _EngineState()
 
     # ------------------------------------------------------------------ mesh

@@ -102,6 +102,24 @@ def _atexit_close():
         pass
 
 
+def _atexit_flush():
+    """Last-chance shard flush: a run that dies on an unhandled
+    exception or a fatal-signal ``SystemExit`` (the elastic preemption
+    path) must still land its metrics snapshot and trace shard for the
+    post-mortem — previously only ``optimize()``'s finally flushed.
+    Registered at import; atexit is LIFO so the tracer's close hook
+    (registered later, at first tracer build) runs first — Tracer.flush
+    after close is explicitly safe.  No-op when observability is off."""
+    try:
+        if _obs_config().active:
+            flush()
+    except Exception:  # noqa: BLE001 — interpreter teardown
+        pass
+
+
+atexit.register(_atexit_flush)
+
+
 def get_registry() -> MetricsRegistry:
     """The process-global metrics registry (always real — counters are
     host-side dict math; only file output is gated on config)."""

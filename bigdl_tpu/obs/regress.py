@@ -67,10 +67,15 @@ def _entry_from_result(result: dict, source: str = "fresh",
     }
 
 
-def load_trajectory(path: str) -> List[dict]:
+def load_trajectory(path: Optional[str]) -> List[dict]:
     """Every ``BENCH_r*.json`` under ``path`` (a repo dir), oldest
     first.  Driver artifacts wrap the result under ``"parsed"``; bare
-    result files work too."""
+    result files work too.  An unset/empty/absent path is a valid
+    "no trajectory yet" state (fresh repo, unexported
+    ``BIGDL_REGRESS_TRAJECTORY``) and yields ``[]`` — the gate then
+    reports a clean ``no_baseline`` verdict instead of raising."""
+    if not path:
+        return []
     entries = []
     for fn in sorted(glob.glob(os.path.join(path, "BENCH_r*.json"))):
         m = _ROUND_RE.search(fn)
@@ -91,13 +96,15 @@ def load_trajectory(path: str) -> List[dict]:
     return entries
 
 
-def check(fresh, trajectory: List[dict],
+def check(fresh, trajectory: Optional[List[dict]],
           tolerance: Optional[float] = None) -> dict:
     """Compare a fresh bench result (dict or pre-normalised entry)
     against the trajectory.  Returns a verdict dict with ``status`` in
-    ``{"pass", "violation", "no_baseline"}``."""
+    ``{"pass", "violation", "no_baseline"}``.  ``trajectory=None`` or
+    ``[]`` (no baseline recorded yet) is a clean ``no_baseline``."""
     if tolerance is None:
         tolerance = _default_tolerance()
+    trajectory = trajectory or []
     cur = (fresh if fresh is not None and "source" in fresh
            else _entry_from_result(fresh or {}))
     verdict = {"status": "no_baseline", "tolerance": tolerance,
@@ -245,7 +252,8 @@ def dump_flight_recorder(out_dir: str, verdict: dict,
     return path
 
 
-def gate(fresh, trajectory_dir: str, tolerance: Optional[float] = None,
+def gate(fresh, trajectory_dir: Optional[str],
+         tolerance: Optional[float] = None,
          flight_dir: Optional[str] = None,
          trace_dir: Optional[str] = None,
          metrics_dir: Optional[str] = None) -> dict:

@@ -163,6 +163,17 @@ class DistriOptimizer(LocalOptimizer):
         # no host round-trip (the unravel closure is a pure jax fn)
         return self._unravel(pvar)
 
+    def _topology(self):
+        """Checkpoint topology tag: the flat ZeRO-1 layout plus the
+        world size and padding it was written under, so restore at a
+        different world knows exactly what to strip and re-pad
+        (resilience/elastic.py ensure_shard_layout)."""
+        return {"world_size": self.n_shards,
+                "shard_layout": "zero1_flat",
+                "step": self.state["neval"],
+                "flat_elems": getattr(self, "_flat_elems", None),
+                "pad": self._pad}
+
     def _write_back(self, pvar, mod_state):
         # unravel allocates fresh arrays; mod_state is copied so the model
         # never aliases buffers the donated step will delete
@@ -199,6 +210,17 @@ class DistriOptimizer(LocalOptimizer):
                         "parameters (LocalOptimizer); reset it (state=None) "
                         "before reusing the method with DistriOptimizer"
                     )
+            # topology-aware resume (resilience/elastic.py): state
+            # restored from a checkpoint written at a different world
+            # size carries the OLD padded length — strip the old
+            # alignment padding, re-pad for this mesh's quantum, and
+            # re-place P(axis); same-world resumes pass through
+            from bigdl_tpu.resilience import elastic
+
+            opt.state = elastic.ensure_shard_layout(
+                opt.state, flat_elems=int(flat.size), pad=self._pad,
+                n_shards=n, mesh=self.mesh, axis=self.axis,
+                topology=getattr(opt, "loaded_topology", None))
         if opt.state is None:
             # build state against a single shard-sized template, then
             # expand vector entries across the mesh
@@ -738,3 +760,11 @@ class DistriOptimizer(LocalOptimizer):
                     self.state["epoch"] = extra["epoch"]
                 if "neval" in extra:
                     self.state["neval"] = extra["neval"]
+                # a mid-epoch checkpoint (emergency / iteration trigger)
+                # resumes `neval - epoch_neval0` batches into the epoch:
+                # fast-forward the data iterator that far so the replay
+                # stays batch-aligned with the uninterrupted run
+                self.state["epoch_neval0"] = extra.get(
+                    "epoch_neval0", self.state["neval"])
+                self._pending_fast_forward = max(
+                    0, self.state["neval"] - self.state["epoch_neval0"])

@@ -116,9 +116,20 @@ class BaseOptimizer:
         # runs fwd/bwd in bf16 with f32 master params + f32 grads/update
         # (the TPU-native recipe: MXU at 2x, normalizations stay f32)
         self.compute_dtype = None
-        # reference: InternalOptimizerUtil state table
+        # elastic session (preemption polling + heartbeat liveness);
+        # optimize() builds it from the live config, None outside a run
+        self._elastic_session = None
+        # batches to skip at the next epoch start — set by the resume
+        # paths when the loaded checkpoint was written mid-epoch, so the
+        # replay sees the exact batch the saved neval expects
+        self._pending_fast_forward = 0
+        # reference: InternalOptimizerUtil state table.  epoch_neval0 =
+        # the neval of the current epoch's first batch, checkpointed so
+        # a mid-epoch resume can fast-forward the data iterator to the
+        # exact batch the saved neval expects (resilience/elastic.py)
         self.state = {"epoch": 1, "neval": 1, "loss": None, "score": None,
-                      "epoch_finished": 0, "nonfinite_skips": 0}
+                      "epoch_finished": 0, "nonfinite_skips": 0,
+                      "epoch_neval0": 1}
 
     # ---- fluent setters (camelCase parity aliases at the bottom) --------
     def set_optim_method(self, method):
@@ -233,7 +244,7 @@ class BaseOptimizer:
 
         tag = f"{self.state['epoch']}_{self.state['neval']}"
         prefix = os.path.join(self.checkpoint_path, f"checkpoint_{tag}")
-        extra = {"epoch": self.state["epoch"], "neval": self.state["neval"]}
+        extra = self._checkpoint_extra()
         keep = self.checkpoint_keep_last
         if getattr(self, "checkpoint_background", False):
             from concurrent.futures import ThreadPoolExecutor
@@ -287,6 +298,80 @@ class BaseOptimizer:
                 log.exception("background checkpoint write failed "
                               "(recorded; surfaces on the next "
                               "checkpoint/optimize call)")
+
+    def _topology(self):
+        """The checkpoint topology tag (resilience/elastic.py): how the
+        writer's optimizer state is laid out, so restore can tell a
+        same-world resume from a resize.  Local training keeps the
+        native params pytree — nothing to re-partition."""
+        return {"world_size": 1, "shard_layout": "tree",
+                "step": self.state["neval"]}
+
+    def _checkpoint_extra(self) -> dict:
+        """Everything a resume needs beyond the arrays: trigger/LR
+        counters, the epoch's starting neval (mid-epoch fast-forward),
+        and the writer topology."""
+        return {"epoch": self.state["epoch"],
+                "neval": self.state["neval"],
+                "epoch_neval0": self.state.get("epoch_neval0",
+                                               self.state["neval"]),
+                "topology": self._topology()}
+
+    def _elastic_shutdown(self, step, pvar, mod_state, opt_state):
+        """Graceful preemption (resilience/elastic.py): the in-flight
+        step already resolved — write back the live device state, write
+        a synchronous emergency checkpoint through the hardened
+        ``write_checkpoint`` path, and raise :class:`Preempted` (a
+        SystemExit carrying EXIT_PREEMPTED).  The optimize() finally
+        still flushes obs shards and any background checkpoint."""
+        from bigdl_tpu import obs
+        from bigdl_tpu.resilience import elastic
+
+        signum = elastic.preemption_signal()
+        # the request is being handled NOW: drop the flag so a later
+        # optimize() in this process (tests, a supervisor running
+        # in-process) doesn't re-preempt on the stale bit
+        elastic.clear_preemption()
+        log.warning(
+            "preemption requested (signal %s) at iter %d — emergency "
+            "checkpoint, then exit %d", signum, step,
+            elastic.EXIT_PREEMPTED)
+        self._write_back(pvar, mod_state)
+        self.optim_method.state = opt_state
+        tracer = obs.get_tracer()
+        prefix = None
+        if self.checkpoint_path:
+            # serialize against an in-flight background write of the
+            # same prefix (records, never raises: nothing may mask the
+            # preemption exit)
+            self._flush_checkpoints(raise_errors=False)
+            tag = f"{self.state['epoch']}_{self.state['neval']}"
+            prefix = os.path.join(self.checkpoint_path,
+                                  f"checkpoint_{tag}")
+            try:
+                from bigdl_tpu.utils.serializer import save_checkpoint
+
+                save_checkpoint(prefix, self.model, self.optim_method,
+                                extra=self._checkpoint_extra(),
+                                keep_last=self.checkpoint_keep_last)
+                log.info("emergency checkpoint written: %s", prefix)
+                tracer.event("elastic.emergency_checkpoint", step=step,
+                             prefix=os.path.basename(prefix))
+            except Exception as e:  # noqa: BLE001 — still exit preempted
+                log.exception("emergency checkpoint failed; exiting "
+                              "preempted without one")
+                tracer.event("elastic.emergency_checkpoint_failed",
+                             step=step, error=type(e).__name__)
+                prefix = None
+        obs.get_registry().counter(
+            "bigdl_preemptions_total",
+            "Graceful preemption shutdowns (SIGTERM/SIGINT)").inc()
+        tracer.event("elastic.preempted", step=step, signum=signum,
+                     checkpoint=prefix and os.path.basename(prefix))
+        raise elastic.Preempted(
+            f"preempted (signal {signum}) at iter {step}; emergency "
+            f"checkpoint: {prefix or 'none'}", step=step,
+            checkpoint=prefix)
 
     def _prepare_batch(self, inp, tgt):
         """Hook: adjust a host batch before device transfer, or return
@@ -552,6 +637,12 @@ class LocalOptimizer(BaseOptimizer):
         self._health_monitor = _health_mod.monitor_from_config(
             self.model.params(), tracer=tracer,
             summary=self.train_summary)
+        # elastic session: registers this loop as a preemption listener
+        # (SIGTERM now drains gracefully instead of exiting from the
+        # handler) and starts the heartbeat monitor on multi-host runs
+        from bigdl_tpu.resilience import elastic as _elastic
+
+        self._elastic_session = _elastic.ElasticSession.from_config()
 
         model = self.model
         model.training()
@@ -595,6 +686,11 @@ class LocalOptimizer(BaseOptimizer):
             # DistriOptimizer retry path would otherwise hit "profiler
             # already started" on its next attempt
             profiler.stop()
+            # unregister the preemption listener + stop the heartbeat
+            # thread (a retry attempt builds a fresh session)
+            if self._elastic_session is not None:
+                self._elastic_session.close()
+                self._elastic_session = None
             # a background checkpoint still writing must become durable
             # before optimize() returns or the retry path reads the
             # checkpoint dir; write errors are logged here (raising in
@@ -729,6 +825,22 @@ class LocalOptimizer(BaseOptimizer):
 
             batches = iter(PrefetchIterator(self.dataset.data(train=True)))
             batch_exhausted = False
+            # mid-epoch resume (emergency / iteration-trigger
+            # checkpoint): the saved neval is this many batches into the
+            # epoch — consume them so the replayed data order matches
+            # the uninterrupted run exactly (resilience/elastic.py)
+            skip, self._pending_fast_forward = \
+                self._pending_fast_forward, 0
+            if skip > 0:
+                log.info("mid-epoch resume: fast-forwarding %d batches "
+                         "to iter %d", skip, self.state["neval"])
+                tracer.event("elastic.fast_forward", batches=skip,
+                             neval=self.state["neval"])
+                for _ in range(skip):
+                    try:
+                        next(batches)
+                    except StopIteration:
+                        break
             while True:
                 # reference Metrics phases: the fused XLA step folds the
                 # collective phases ("put gradient"/"aggregate"/"send
@@ -743,6 +855,15 @@ class LocalOptimizer(BaseOptimizer):
                 dt_wait = time.perf_counter() - t_wait
                 self.metrics.add("data wait time", dt_wait)
                 n = self.state["neval"]
+                # elastic boundary: heartbeat + peer-liveness check (may
+                # raise the classified-fatal PeerLostError BEFORE the
+                # collective that would hang on a dead peer) and the
+                # preemption flag a SIGTERM set — the in-flight step is
+                # resolved, then emergency checkpoint + Preempted
+                es = self._elastic_session
+                if es is not None and es.on_iteration(n):
+                    flush_pending()
+                    self._elastic_shutdown(n, pvar, mod_state, opt_state)
                 # trace phases mirror the reference Metrics names + the
                 # named_scope phases of the jitted step; tracer is the
                 # shared no-op object when observability is off
@@ -822,6 +943,9 @@ class LocalOptimizer(BaseOptimizer):
                 # epoch finished
                 self.state["epoch_finished"] = epoch
                 self.state["epoch"] = epoch + 1
+                # the next epoch's first batch runs at the current neval
+                # (mid-epoch-resume bookkeeping, checkpointed in extra)
+                self.state["epoch_neval0"] = self.state["neval"]
                 # in place: opt.state must stay the SAME dict object so a
                 # Plateau lr_scale poke from the validation below is seen
                 # by the next epoch's train_step

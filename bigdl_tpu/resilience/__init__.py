@@ -8,6 +8,14 @@ executor loss, driver ``retryNum < maxRetry`` checkpoint reload
   (``BIGDL_FAULT_PLAN``) so every recovery path runs in CI on CPU
 * :mod:`~bigdl_tpu.resilience.retry` — transient/fatal error
   classification + exponential backoff with a sliding-window budget
+* :mod:`~bigdl_tpu.resilience.elastic` — preemption-safe shutdown
+  (SIGTERM → finish step → emergency checkpoint → exit
+  ``EXIT_PREEMPTED``), heartbeat peer-liveness for multi-host runs
+  (``PeerLostError`` instead of a hung psum), and topology-tagged
+  checkpoints whose ZeRO shards re-partition on a world resize
+* :mod:`~bigdl_tpu.resilience.supervisor` — ``python -m
+  bigdl_tpu.resilience.supervisor -- <train cmd>`` restart loop,
+  classifying exit codes against the retry budget
 * checkpoint integrity lives in ``bigdl_tpu/utils/serializer.py``
   (manifest checksums, verify-on-load, newest-intact fallback,
   keep-last-K rotation)
@@ -15,6 +23,22 @@ executor loss, driver ``retryNum < maxRetry`` checkpoint reload
   (``optim/optimizer.py`` / ``optim/distri_optimizer.py``)
 """
 
+from bigdl_tpu.resilience.elastic import (
+    EXIT_FATAL,
+    EXIT_PREEMPTED,
+    EXIT_TRANSIENT,
+    ElasticSession,
+    HeartbeatMonitor,
+    Preempted,
+    clear_preemption,
+    ensure_shard_layout,
+    install_preemption_handler,
+    preemption_requested,
+    record_resume,
+    request_preemption,
+    restore_latest,
+    run_main,
+)
 from bigdl_tpu.resilience.faults import (
     Fault,
     FaultInjector,
@@ -27,20 +51,36 @@ from bigdl_tpu.resilience.retry import (
     CheckpointWriteError,
     FATAL_TYPES,
     NonFiniteStepError,
+    PeerLostError,
     RetryPolicy,
     classify,
 )
 
 __all__ = [
     "CheckpointWriteError",
+    "EXIT_FATAL",
+    "EXIT_PREEMPTED",
+    "EXIT_TRANSIENT",
+    "ElasticSession",
     "FATAL_TYPES",
     "Fault",
     "FaultInjector",
     "FaultPlan",
+    "HeartbeatMonitor",
     "InjectedFault",
     "NonFiniteStepError",
+    "PeerLostError",
+    "Preempted",
     "RetryPolicy",
     "classify",
+    "clear_preemption",
+    "ensure_shard_layout",
     "get_injector",
+    "install_preemption_handler",
+    "preemption_requested",
+    "record_resume",
+    "request_preemption",
     "reset_injector",
+    "restore_latest",
+    "run_main",
 ]

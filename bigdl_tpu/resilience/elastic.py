@@ -1,0 +1,474 @@
+"""Elastic training — preemption, peer liveness, world-resize resume.
+
+The reference survives process loss because Spark reschedules the task
+and ``DistriOptimizer`` re-enters from the last checkpoint; nothing in
+that story covers the TPU operational reality this module owns:
+
+* **Preemption** — the scheduler's SIGTERM (or an operator's Ctrl-C)
+  must *finish the in-flight step*, write an emergency checkpoint
+  through the hardened ``write_checkpoint`` path, flush the obs shards,
+  and exit with the distinct :data:`EXIT_PREEMPTED` code so a
+  supervisor can tell "evicted, resume me" from "crashed".  The signal
+  handler (installed by ``Engine.init``) only sets a flag; both
+  optimizers poll it at iteration boundaries — no state is ever torn
+  mid-step.
+* **Peer liveness** — a hung host in a multi-host world stalls every
+  peer *forever* inside the next collective (psum has no timeout).
+  Each host touches a host-tagged heartbeat file every
+  ``BIGDL_HEARTBEAT_EVERY`` steps; a monitor thread (plus an explicit
+  per-iteration check) flags any peer silent past
+  ``BIGDL_HEARTBEAT_TIMEOUT`` seconds and the training loop raises a
+  classified-**fatal** :class:`PeerLostError` *before* entering the
+  collective that would deadlock.
+* **World resize** — checkpoints carry ``{world_size, shard_layout,
+  step}`` topology metadata, and :func:`ensure_shard_layout`
+  re-partitions the flat ZeRO-1 optimizer-state vectors written at N
+  shards for an M-shard mesh (strip the old alignment padding, re-pad
+  for the new quantum, re-place over the new mesh) — restore is
+  topology-independent, so a 2-host checkpoint resumes on 1 host and
+  vice versa.
+* **Supervision** — ``python -m bigdl_tpu.resilience.supervisor``
+  (resilience/supervisor.py) loops the training command, classifying
+  exit codes against the PR 1 :class:`~bigdl_tpu.resilience.retry.
+  RetryPolicy` budget.
+
+Everything here is driven deterministically by the PR 1 fault plans and
+plain POSIX signals, so every recovery path is a CPU unit test.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import signal
+import threading
+import time
+from typing import Optional
+
+from bigdl_tpu.resilience.retry import PeerLostError
+
+log = logging.getLogger("bigdl_tpu.resilience")
+
+# -------------------------------------------------------------- exit codes
+# The supervisor contract.  Distinct from shell/signal conventions
+# (126/127/128+n) and from sysexits so nothing else can alias them:
+# preempted = evicted mid-run with an emergency checkpoint on disk —
+# restart costs no retry budget; transient = EX_TEMPFAIL, restart under
+# the RetryPolicy budget; fatal = EX_CONFIG, restarting cannot help.
+EXIT_PREEMPTED = 170
+EXIT_TRANSIENT = 75
+EXIT_FATAL = 78
+
+
+class Preempted(SystemExit):
+    """Graceful preemption shutdown.  A ``SystemExit`` subclass so an
+    unhandled one exits the interpreter with :data:`EXIT_PREEMPTED`
+    (the supervisor's "resume me" signal) and so the classified retry
+    loop — which only catches ``Exception`` — never swallows it."""
+
+    def __init__(self, message: str = "preempted", step: Optional[int] = None,
+                 checkpoint: Optional[str] = None):
+        super().__init__(EXIT_PREEMPTED)
+        self.message = message
+        self.step = step
+        self.checkpoint = checkpoint
+
+    def __str__(self):
+        return self.message
+
+
+# ------------------------------------------------------- preemption flag
+# One process-wide flag: the signal handler SETS it (async-signal-thin:
+# flag + bookkeeping only), training loops POLL it at iteration
+# boundaries so the in-flight step always completes.
+_flag = threading.Event()
+_signum: Optional[int] = None
+_listeners = 0
+_listener_lock = threading.Lock()
+_installed: dict = {}  # signum -> previous handler
+
+
+def preemption_requested() -> bool:
+    return _flag.is_set()
+
+
+def preemption_signal() -> Optional[int]:
+    """The signal number that requested preemption (None if requested
+    programmatically or not at all)."""
+    return _signum
+
+
+def request_preemption(signum: Optional[int] = None):
+    """Programmatic preemption (tests / cooperative shutdown): the next
+    iteration boundary runs the same graceful path a SIGTERM would."""
+    global _signum
+    _signum = signum
+    _flag.set()
+
+
+def clear_preemption():
+    """Drop the flag (test hook / after a handled preemption)."""
+    global _signum
+    _signum = None
+    _flag.clear()
+
+
+def _add_listener():
+    global _listeners
+    with _listener_lock:
+        _listeners += 1
+
+
+def _remove_listener():
+    global _listeners
+    with _listener_lock:
+        _listeners = max(0, _listeners - 1)
+
+
+def _handler(signum, frame):
+    request_preemption(signum)
+    log.warning("elastic: received signal %d — finishing the in-flight "
+                "step, then emergency checkpoint + exit %d",
+                signum, EXIT_PREEMPTED)
+    try:
+        from bigdl_tpu import obs
+
+        obs.get_tracer().event("elastic.preempt_signal", signum=signum,
+                               listeners=_listeners)
+    except Exception:  # noqa: BLE001 — telemetry must not break shutdown
+        pass
+    if _listeners == 0:
+        # no training loop is polling: nothing will ever act on the
+        # flag, so exit from here (atexit still flushes obs shards).
+        # SIGINT outside training keeps its interactive meaning.
+        prev = _installed.get(signum)
+        if signum == getattr(signal, "SIGINT", None):
+            if callable(prev):
+                return prev(signum, frame)
+            raise KeyboardInterrupt
+        raise Preempted(f"signal {signum} with no active training loop")
+
+
+def install_preemption_handler(signals=None) -> bool:
+    """Install the SIGTERM/SIGINT preemption handler (idempotent;
+    called by ``Engine.init``).  Returns False when handlers cannot be
+    installed (non-main thread) — training then simply lacks graceful
+    preemption, it does not fail."""
+    if signals is None:
+        signals = (signal.SIGTERM, signal.SIGINT)
+    ok = True
+    for s in signals:
+        if s in _installed:
+            continue
+        try:
+            _installed[s] = signal.signal(s, _handler)
+        except (ValueError, OSError):  # not the main thread / exotic env
+            log.debug("elastic: cannot install handler for signal %s "
+                      "(not the main thread?)", s)
+            ok = False
+    return ok
+
+
+def uninstall_preemption_handler():
+    """Restore the pre-install handlers (test hook)."""
+    for s, prev in list(_installed.items()):
+        try:
+            signal.signal(s, prev)
+        except (ValueError, OSError):
+            pass
+        _installed.pop(s, None)
+
+
+# ---------------------------------------------------------- peer liveness
+class HeartbeatMonitor:
+    """Heartbeat-file peer liveness for multi-host runs.
+
+    Each host writes ``heartbeat.h<host>`` in a shared directory every
+    ``every_steps`` training steps (:meth:`beat`); :meth:`check` — run
+    at every iteration boundary, plus a daemon thread for telemetry
+    while the main thread is blocked on device work — compares every
+    peer file's mtime against ``timeout_s`` and raises
+    :class:`PeerLostError` (classified fatal) instead of letting the
+    next psum hang forever on a dead peer.  A peer that never wrote a
+    file at all counts from this monitor's start time, so a host that
+    dies during bring-up is caught too."""
+
+    def __init__(self, directory: str, host: int, n_hosts: int,
+                 timeout_s: float = 60.0, every_steps: int = 1,
+                 interval_s: Optional[float] = None, clock=time.time):
+        os.makedirs(directory, exist_ok=True)
+        self.directory = directory
+        self.host = int(host)
+        self.n_hosts = int(n_hosts)
+        self.timeout_s = float(timeout_s)
+        self.every_steps = max(1, int(every_steps))
+        self.interval_s = (interval_s if interval_s is not None
+                           else max(0.05, min(1.0, self.timeout_s / 4.0)))
+        self._clock = clock
+        self._started = clock()
+        self._last_beat_step: Optional[int] = None
+        self._lost: dict = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def path(self, host: int) -> str:
+        return os.path.join(self.directory, f"heartbeat.h{host}")
+
+    def beat(self, step: Optional[int] = None, force: bool = False):
+        """Touch this host's heartbeat file (every ``every_steps``
+        steps; ``force`` beats unconditionally, e.g. at session start)."""
+        if not force and step is not None and \
+                self._last_beat_step is not None and \
+                0 <= step - self._last_beat_step < self.every_steps:
+            # (a step that moved BACKWARDS — retry rewound neval —
+            # always beats rather than starving until it catches up)
+            return
+        self._last_beat_step = step
+        p = self.path(self.host)
+        tmp = p + ".tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump({"host": self.host, "step": step,
+                           "ts": self._clock()}, fh)
+            os.replace(tmp, p)
+        except OSError as e:  # a full/blipping shared FS must not kill
+            log.warning("heartbeat write failed: %s", e)  # the trainer
+
+    def peer_ages(self, now: Optional[float] = None) -> dict:
+        """Seconds since each peer's last beat (monitor start stands in
+        for a peer that never beat)."""
+        now = self._clock() if now is None else now
+        ages = {}
+        for h in range(self.n_hosts):
+            if h == self.host:
+                continue
+            try:
+                last = os.path.getmtime(self.path(h))
+            except OSError:
+                last = self._started
+            ages[h] = now - last
+        return ages
+
+    def scan(self, now: Optional[float] = None) -> dict:
+        """Flag peers silent past the timeout; returns {host: age}.
+        Each newly lost peer emits one ``elastic.peer_lost`` trace
+        event and one ``bigdl_peer_lost_total`` increment."""
+        for h, age in self.peer_ages(now).items():
+            if age > self.timeout_s and h not in self._lost:
+                self._lost[h] = age
+                log.error("elastic: peer host %d silent for %.1fs "
+                          "(timeout %.1fs)", h, age, self.timeout_s)
+                from bigdl_tpu import obs
+
+                obs.get_tracer().event(
+                    "elastic.peer_lost", peer=h, age_s=round(age, 3),
+                    timeout_s=self.timeout_s, host=self.host)
+                obs.get_registry().counter(
+                    "bigdl_peer_lost_total",
+                    "Peers flagged dead by the heartbeat monitor").inc()
+        return dict(self._lost)
+
+    def check(self):
+        """Raise :class:`PeerLostError` when any peer is lost — called
+        at iteration boundaries, BEFORE the step that would hang."""
+        lost = self.scan()
+        if lost:
+            detail = ", ".join(f"host {h} silent {age:.1f}s"
+                               for h, age in sorted(lost.items()))
+            raise PeerLostError(
+                f"peer(s) lost past BIGDL_HEARTBEAT_TIMEOUT="
+                f"{self.timeout_s:g}s: {detail}; refusing to enter the "
+                "next collective (it would hang forever)")
+
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="bigdl-heartbeat", daemon=True)
+            self._thread.start()
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.scan()
+            except Exception:  # noqa: BLE001 — monitor must never die
+                log.exception("heartbeat scan failed")
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+
+# ---------------------------------------------------------------- session
+class ElasticSession:
+    """Per-``optimize()`` elastic state: registers this loop as a
+    preemption listener and owns the optional heartbeat monitor."""
+
+    def __init__(self, monitor: Optional[HeartbeatMonitor] = None):
+        self.monitor = monitor
+        _add_listener()
+        if monitor is not None:
+            monitor.beat(force=True)
+            monitor.start()
+
+    @classmethod
+    def from_config(cls) -> "ElasticSession":
+        from bigdl_tpu.config import refresh_from_env
+
+        cfg = refresh_from_env()
+        monitor = None
+        if cfg.heartbeat_dir and cfg.num_processes > 1:
+            monitor = HeartbeatMonitor(
+                cfg.heartbeat_dir, cfg.process_id, cfg.num_processes,
+                timeout_s=cfg.heartbeat_timeout,
+                every_steps=cfg.heartbeat_every)
+        return cls(monitor)
+
+    def on_iteration(self, step: int) -> bool:
+        """Iteration-boundary poll: beat + peer check (may raise
+        :class:`PeerLostError`); returns True when a preemption is
+        pending and the caller must run its graceful shutdown."""
+        if self.monitor is not None:
+            self.monitor.beat(step)
+            self.monitor.check()
+        return _flag.is_set()
+
+    def close(self):
+        _remove_listener()
+        if self.monitor is not None:
+            self.monitor.stop()
+
+
+# -------------------------------------------------- topology-aware resume
+def ensure_shard_layout(state: dict, flat_elems: int, pad: int,
+                        n_shards: int, mesh, axis,
+                        topology: Optional[dict] = None) -> dict:
+    """Re-partition loaded ZeRO-1 optimizer state for the CURRENT mesh.
+
+    The flat shard layout makes resize mechanical: a state vector saved
+    at N shards is the padded flat-parameter layout (``flat_elems`` true
+    entries + the N-world alignment padding), element-aligned with the
+    ravelled weights.  Restoring at M shards = strip the old padding,
+    re-pad for the M-world quantum, and place ``P(axis)`` over the new
+    mesh.  Entries already matching the current layout (same-world
+    resume, the common case) pass through untouched; scalars always do.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import jax.numpy as jnp
+
+    padded = flat_elems + pad
+    stale = [k for k, v in state.items()
+             if getattr(v, "ndim", None) == 1
+             and v.shape[0] >= flat_elems and v.shape[0] != padded]
+    if not stale:
+        return state
+    old_len = state[stale[0]].shape[0]
+    for k in stale:
+        if state[k].shape[0] != old_len:
+            raise ValueError(
+                "inconsistent optimizer-state vector lengths "
+                f"{ {k: int(state[k].shape[0]) for k in stale} }; the "
+                "checkpoint does not look like one flat ZeRO layout")
+    old_world = (topology or {}).get("world_size")
+    new_state = dict(state)
+    for k in stale:
+        v = jnp.asarray(state[k])[:flat_elems]
+        if pad:
+            v = jnp.concatenate([v, jnp.zeros((pad,), v.dtype)])
+        new_state[k] = jax.device_put(v, NamedSharding(mesh, P(axis)))
+    log.info("elastic: re-partitioned optimizer state %s from a "
+             "%s-shard layout (%d elems) to %d shards (%d elems)",
+             sorted(stale), old_world or "?", old_len, n_shards, padded)
+    from bigdl_tpu import obs
+
+    obs.get_tracer().event(
+        "elastic.resize", old_world=old_world, new_world=n_shards,
+        old_elems=int(old_len), new_elems=int(padded),
+        keys=sorted(stale))
+    return new_state
+
+
+def record_resume(old_world: Optional[int], new_world: int,
+                  step: Optional[int] = None):
+    """Account one resume-from-checkpoint: ``bigdl_resumes_total``
+    labeled with the resize (``"2to1"``, ``"none"`` for same-world,
+    ``"unknown"`` for pre-topology checkpoints) + a trace event."""
+    if old_world is None:
+        resize = "unknown"
+    elif int(old_world) == int(new_world):
+        resize = "none"
+    else:
+        resize = f"{int(old_world)}to{int(new_world)}"
+    from bigdl_tpu import obs
+
+    obs.get_registry().counter(
+        "bigdl_resumes_total",
+        "Resumes from checkpoint, labeled by world resize",
+        labels=("resize",)).labels(resize=resize).inc()
+    obs.get_tracer().event("elastic.resume", resize=resize,
+                           old_world=old_world, new_world=new_world,
+                           step=step)
+    return resize
+
+
+def restore_latest(optimizer, directory: Optional[str] = None):
+    """Resume an optimizer from the newest intact checkpoint in
+    ``directory`` (default: its own checkpoint path): load weights +
+    optimizer state (re-partitioned lazily by the step build when the
+    world changed), rewind the epoch/neval/epoch-start counters so
+    triggers, LR schedule, RNG folding, and the mid-epoch fast-forward
+    all resume exactly, and account the resume.  Returns the
+    checkpoint's extra dict, or None when the directory holds no
+    checkpoint yet (a first boot is not an error)."""
+    from bigdl_tpu.utils.serializer import load_latest_checkpoint
+
+    d = directory or optimizer.checkpoint_path
+    if not d or not os.path.isdir(d):
+        return None
+    try:
+        extra = load_latest_checkpoint(d, optimizer.model,
+                                       optimizer.optim_method)
+    except FileNotFoundError:
+        return None
+    if "epoch" in extra:
+        optimizer.state["epoch"] = extra["epoch"]
+    if "neval" in extra:
+        optimizer.state["neval"] = extra["neval"]
+    optimizer.state["epoch_neval0"] = extra.get(
+        "epoch_neval0", optimizer.state["neval"])
+    # a mid-epoch checkpoint resumes N batches into its epoch: the
+    # driver loop skips that many so the replayed data order matches
+    optimizer._pending_fast_forward = max(
+        0, optimizer.state["neval"] - optimizer.state["epoch_neval0"])
+    topo = extra.get("topology") or {}
+    record_resume(topo.get("world_size"),
+                  getattr(optimizer, "n_shards", 1),
+                  step=optimizer.state.get("neval"))
+    return extra
+
+
+# ------------------------------------------------------------- entrypoint
+def run_main(fn) -> int:
+    """Entry-point wrapper mapping training outcomes onto the elastic
+    exit-code contract: 0 on success, :data:`EXIT_PREEMPTED` via the
+    :class:`Preempted` SystemExit, classified-fatal errors →
+    :data:`EXIT_FATAL`, everything transient → :data:`EXIT_TRANSIENT`.
+    Use as ``sys.exit(elastic.run_main(main))``."""
+    from bigdl_tpu.resilience.retry import classify
+
+    try:
+        fn()
+        return 0
+    except SystemExit:
+        raise  # incl. Preempted: the code is already the contract
+    except KeyboardInterrupt:
+        raise
+    except BaseException as e:  # noqa: BLE001 — the mapping IS the point
+        code = EXIT_FATAL if classify(e) == "fatal" else EXIT_TRANSIENT
+        log.exception("elastic.run_main: %s -> exit %d",
+                      type(e).__name__, code)
+        raise SystemExit(code)

@@ -43,6 +43,14 @@ class CheckpointWriteError(RuntimeError):
     silently reduced to a log line."""
 
 
+class PeerLostError(RuntimeError):
+    """A multi-host peer stopped heartbeating (resilience/elastic.py).
+    Classified FATAL in-process: retrying from a checkpoint at the same
+    world size would hang in the first collective all over again — the
+    process must exit so the supervisor/launcher can re-form the world
+    (possibly at a new size; checkpoints are topology-tagged)."""
+
+
 # config/programming errors: retrying cannot change the outcome
 FATAL_TYPES = (
     ValueError,
@@ -63,7 +71,7 @@ def classify(exc: BaseException) -> str:
         return "fatal"  # KeyboardInterrupt / SystemExit / GeneratorExit
     if isinstance(exc, (InjectedFault, NonFiniteStepError)):
         return "transient"
-    if isinstance(exc, CheckpointWriteError):
+    if isinstance(exc, (CheckpointWriteError, PeerLostError)):
         return "fatal"
     if isinstance(exc, FATAL_TYPES):
         return "fatal"

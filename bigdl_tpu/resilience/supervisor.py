@@ -1,0 +1,221 @@
+"""Restart supervisor — the process-level half of elastic training.
+
+The reference's driver survives because Spark restarts failed tasks and
+``DistriOptimizer`` re-enters from the last checkpoint; here the
+scheduler kills whole JAX processes (preemption) and nobody restarts
+them.  This module is that restarter::
+
+    python -m bigdl_tpu.resilience.supervisor [options] -- \
+        python train.py ...
+
+It loops the command, classifying each exit against the elastic
+exit-code contract (resilience/elastic.py):
+
+* ``0`` — done, exit 0.
+* :data:`~bigdl_tpu.resilience.elastic.EXIT_PREEMPTED` — the child shut
+  down gracefully with an emergency checkpoint on disk.  Restart
+  immediately; preemptions consume no retry budget (an eviction is not
+  a failure), bounded only by ``--max-preemptions``.
+* :data:`~bigdl_tpu.resilience.elastic.EXIT_FATAL` (and shell usage
+  errors) — restarting cannot help; exit with the child's code.
+* anything else — transient.  Back off and restart under the PR 1
+  :class:`~bigdl_tpu.resilience.retry.RetryPolicy` budget (attempt cap
+  + sliding window), then give up with the child's code.
+
+Each launch exports ``BIGDL_ELASTIC_ATTEMPT`` (0-based launch counter)
+and ``BIGDL_ELASTIC_PREEMPTIONS`` so the child can adapt — e.g. rebuild
+its mesh over however many hosts survived and resume via
+``elastic.restore_latest`` (checkpoints are topology-tagged, so a
+2-host snapshot restores on 1 host).  SIGTERM/SIGINT to the supervisor
+forwards to the child, waits for its graceful exit, and stops the loop
+(a preempted supervisor must not immediately respawn what the scheduler
+is evicting).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Callable, List, Optional, Sequence
+
+from bigdl_tpu.resilience.elastic import (
+    EXIT_FATAL,
+    EXIT_PREEMPTED,
+)
+from bigdl_tpu.resilience.retry import RetryPolicy
+
+log = logging.getLogger("bigdl_tpu.resilience")
+
+
+class Supervisor:
+    """Run ``cmd`` in a classify-and-restart loop.
+
+    ``runner(cmd, env) -> returncode`` is injectable so every branch of
+    the loop is a unit test with no subprocesses; the default runner
+    spawns the real child and forwards SIGTERM/SIGINT to it."""
+
+    def __init__(self, cmd: Sequence[str], max_retries: int = 5,
+                 max_preemptions: int = 1000,
+                 policy: Optional[RetryPolicy] = None,
+                 runner: Optional[Callable] = None,
+                 sleep: Callable[[float], None] = time.sleep,
+                 fatal_codes: Sequence[int] = (EXIT_FATAL, 2, 126, 127)):
+        if not cmd:
+            raise ValueError("supervisor needs a command to run")
+        self.cmd = list(cmd)
+        self.max_preemptions = int(max_preemptions)
+        self.policy = policy or RetryPolicy.from_config(
+            max_retries=max_retries)
+        self._runner = runner or self._spawn
+        self._sleep = sleep
+        self.fatal_codes = set(int(c) for c in fatal_codes)
+        self.attempt = 0          # 0-based launch counter (all launches)
+        self.preemptions = 0
+        self._child: Optional[subprocess.Popen] = None
+        self._terminated = False  # the supervisor itself was signalled
+
+    # ------------------------------------------------------------- child
+    def _spawn(self, cmd: List[str], env: dict) -> int:
+        self._child = subprocess.Popen(cmd, env=env)
+        try:
+            return self._child.wait()
+        finally:
+            self._child = None
+
+    def _forward_signal(self, signum, frame):
+        del frame
+        self._terminated = True
+        log.warning("supervisor: signal %d — forwarding to child and "
+                    "stopping the restart loop", signum)
+        child = self._child
+        if child is not None and child.poll() is None:
+            try:
+                child.send_signal(signum)
+            except OSError:
+                pass
+
+    def install_signal_forwarding(self):
+        """SIGTERM/SIGINT → forward to the child, then exit with its
+        code instead of restarting (main() installs this; tests with a
+        fake runner don't need it)."""
+        for s in (signal.SIGTERM, signal.SIGINT):
+            try:
+                signal.signal(s, self._forward_signal)
+            except (ValueError, OSError):
+                pass
+
+    # -------------------------------------------------------------- loop
+    def _event(self, name: str, **attrs):
+        from bigdl_tpu import obs
+
+        obs.get_tracer().event(name, **attrs)
+
+    def _count_restart(self, kind: str):
+        from bigdl_tpu import obs
+
+        obs.get_registry().counter(
+            "bigdl_supervisor_restarts_total",
+            "Child restarts, by exit classification",
+            labels=("kind",)).labels(kind=kind).inc()
+
+    def run(self) -> int:
+        self._event("elastic.supervisor_start", cmd=self.cmd)
+        while True:
+            env = dict(os.environ)
+            env["BIGDL_ELASTIC_ATTEMPT"] = str(self.attempt)
+            env["BIGDL_ELASTIC_PREEMPTIONS"] = str(self.preemptions)
+            log.info("supervisor: launch %d (preemptions so far: %d): %s",
+                     self.attempt, self.preemptions, " ".join(self.cmd))
+            rc = self._runner(self.cmd, env)
+            self.attempt += 1
+            if rc == 0:
+                log.info("supervisor: command completed cleanly")
+                self._event("elastic.supervisor_done", attempts=self.attempt)
+                return 0
+            if self._terminated:
+                # the supervisor itself is being evicted: the child's
+                # graceful exit code is the truth to report upward
+                log.warning("supervisor: stopping after its own signal; "
+                            "child exited %d", rc)
+                return rc
+            if rc == EXIT_PREEMPTED:
+                self.preemptions += 1
+                self._event("elastic.restart", kind="preempted", rc=rc,
+                            attempt=self.attempt,
+                            preemptions=self.preemptions)
+                self._count_restart("preempted")
+                if self.preemptions > self.max_preemptions:
+                    log.error("supervisor: %d preemptions exceeds "
+                              "--max-preemptions=%d; giving up",
+                              self.preemptions, self.max_preemptions)
+                    return rc
+                log.warning("supervisor: child preempted (rc %d) — "
+                            "resuming from the latest checkpoint "
+                            "(no retry budget consumed)", rc)
+                continue
+            if rc in self.fatal_codes:
+                log.error("supervisor: child exited %d (fatal — "
+                          "restarting cannot help)", rc)
+                self._event("elastic.supervisor_fatal", rc=rc,
+                            attempt=self.attempt)
+                return rc
+            delay = self.policy.record_failure()
+            self._event("elastic.restart", kind="transient", rc=rc,
+                        attempt=self.attempt,
+                        delay_s=None if delay is None else round(delay, 3))
+            self._count_restart("transient")
+            if delay is None:
+                log.error("supervisor: retry budget exhausted after %d "
+                          "transient failures; giving up with rc %d",
+                          self.policy.attempts, rc)
+                return rc
+            log.warning("supervisor: child exited %d (transient) — "
+                        "restart %d/%d in %.2fs", rc,
+                        self.policy.attempts, self.policy.max_retries,
+                        delay)
+            if delay > 0:
+                self._sleep(delay)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m bigdl_tpu.resilience.supervisor",
+        description="Run a training command in a classify-and-restart "
+                    "loop: preempted (rc %d) restarts free, transient "
+                    "restarts under the retry budget, fatal (rc %d) "
+                    "stops." % (EXIT_PREEMPTED, EXIT_FATAL))
+    ap.add_argument("--max-retries", type=int, default=5,
+                    help="transient-restart attempt cap (default 5)")
+    ap.add_argument("--max-preemptions", type=int, default=1000,
+                    help="preemption-restart cap (default 1000)")
+    ap.add_argument("cmd", nargs=argparse.REMAINDER,
+                    help="training command (prefix with --)")
+    args = ap.parse_args(argv)
+    cmd = list(args.cmd)
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        ap.error("no command given; usage: ... -- python train.py")
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    sup = Supervisor(cmd, max_retries=args.max_retries,
+                     max_preemptions=args.max_preemptions)
+    sup.install_signal_forwarding()
+    try:
+        return sup.run()
+    finally:
+        from bigdl_tpu import obs
+
+        if obs.active():
+            obs.flush()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

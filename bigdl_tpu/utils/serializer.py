@@ -290,12 +290,15 @@ def _sha256(path: str) -> str:
 _CKPT_SUFFIXES = (".model.npz", ".optim.npz")
 
 
-def write_manifest(path_prefix: str) -> str:
+def write_manifest(path_prefix: str, topology: dict = None) -> str:
     """Record size + sha256 of every file in the ``path_prefix``
     checkpoint pair so verify-on-load can tell torn/rotted checkpoints
-    from intact ones.  Written atomically AFTER the pair is durable —
-    a crash between pair and manifest degrades to the legacy
-    no-manifest check, never to a manifest blessing garbage."""
+    from intact ones, plus the writer's ``topology``
+    (``{world_size, shard_layout, step}`` — resilience/elastic.py) so a
+    resize-resume can inspect the source world without opening the npz.
+    Written atomically AFTER the pair is durable — a crash between pair
+    and manifest degrades to the legacy no-manifest check, never to a
+    manifest blessing garbage."""
     files = {}
     for suffix in _CKPT_SUFFIXES:
         p = path_prefix + suffix
@@ -305,14 +308,39 @@ def write_manifest(path_prefix: str) -> str:
                 "sha256": _sha256(p),
             }
     manifest_path = path_prefix + ".manifest.json"
+    doc = {"format": 1, "files": files}
+    if topology:
+        doc["topology"] = topology
     tmp = manifest_path + ".tmp"
     with open(tmp, "w", encoding="utf-8") as fh:
-        json.dump({"format": 1, "files": files}, fh)
+        json.dump(doc, fh)
         fh.flush()
         os.fsync(fh.fileno())
     os.replace(tmp, manifest_path)
     _fsync_dir(os.path.dirname(manifest_path))
     return manifest_path
+
+
+def read_checkpoint_topology(path_prefix: str) -> dict:
+    """The ``{world_size, shard_layout, step}`` metadata a checkpoint
+    was written under — from the manifest (no npz open), falling back
+    to the ``.optim`` meta for manifest-less pairs.  ``{}`` when the
+    checkpoint predates topology tagging."""
+    manifest_path = path_prefix + ".manifest.json"
+    try:
+        with open(manifest_path, "r", encoding="utf-8") as fh:
+            topo = json.load(fh).get("topology")
+            if topo:
+                return topo
+    except (OSError, ValueError):
+        pass
+    optim_path = path_prefix + ".optim.npz"
+    try:
+        with np.load(optim_path) as data:
+            meta = json.loads(bytes(data["__meta__"]).decode("utf-8"))
+        return (meta.get("extra") or {}).get("topology") or {}
+    except Exception:  # noqa: BLE001 — absent/torn pair = no metadata
+        return {}
 
 
 def verify_checkpoint(path_prefix: str):
@@ -362,6 +390,18 @@ def _verify_checkpoint_impl(path_prefix: str):
             if _sha256(p) != rec["sha256"]:
                 return False, f"{name}: checksum mismatch"
         return True, "ok"
+    # no manifest: either a legacy writer, or a kill between the pair
+    # landing and the manifest rename.  A leftover tmp file for THIS
+    # prefix is crash-window evidence (every completed stage removes its
+    # tmp via os.replace) — treat the pair as torn and fall back rather
+    # than resume without optimizer state / topology metadata.
+    for leftover in (path_prefix + ".model.npz.tmp.npz",
+                     path_prefix + ".optim.npz.tmp.npz",
+                     manifest_path + ".tmp"):
+        if os.path.exists(leftover):
+            return False, (f"no manifest + leftover "
+                           f"{os.path.basename(leftover)}: interrupted "
+                           "checkpoint write")
     try:
         with np.load(model_path) as data:
             data.files  # zip central directory read — catches truncation
@@ -393,7 +433,8 @@ def gc_checkpoints(directory: str, keep_last: int):
     removed = []
     for prefix in doomed:
         for f in os.listdir(directory):
-            if f == prefix + ".manifest.json" or (
+            if f in (prefix + ".manifest.json",
+                     prefix + ".manifest.json.tmp") or (
                     f.startswith(prefix + ".") and ".npz" in f):
                 try:
                     os.remove(os.path.join(directory, f))
@@ -420,7 +461,11 @@ def write_checkpoint(snap: dict, path_prefix: str, keep_last: int = 0):
                                prefix=os.path.basename(path_prefix)):
         arrays = _module_arrays(snap["spec"], snap["p_leaves"],
                                 snap["s_leaves"])
-        _atomic_savez(path_prefix + ".model", arrays)
+        # the .optim pair lands FIRST: discovery keys on .model.npz, so
+        # ordering optim -> model means any discoverable prefix already
+        # has its complete optimizer state — a kill anywhere in the
+        # write can leave a torn-but-listed checkpoint only inside the
+        # pair->manifest window, which verify flags via tmp leftovers
         if snap["optim"] is not None:
             opt_arrays = {k: np.asarray(v)
                           for k, v in snap["optim"]["arrays"].items()}
@@ -432,7 +477,11 @@ def write_checkpoint(snap: dict, path_prefix: str, keep_last: int = 0):
                 json.dumps(meta).encode("utf-8"), dtype=np.uint8
             )
             _atomic_savez(path_prefix + ".optim", opt_arrays)
-        write_manifest(path_prefix)
+        _atomic_savez(path_prefix + ".model", arrays)
+        topology = None
+        if snap["optim"] is not None:
+            topology = (snap["optim"]["extra"] or {}).get("topology")
+        write_manifest(path_prefix, topology=topology)
         # chaos hook: post-write corruption the verify-on-load must catch
         from bigdl_tpu.resilience.faults import get_injector
 
@@ -478,6 +527,10 @@ def _load_checkpoint_impl(path_prefix, model, optim_method):
         optim_method.load_state_arrays(
             {k: data[k] for k in data.files if k != "__meta__"}
         )
+        # the source topology rides with the method so the next step
+        # build can re-partition ZeRO state for a resized world
+        # (resilience/elastic.py ensure_shard_layout)
+        optim_method.loaded_topology = extra.get("topology")
     return extra
 
 

@@ -1,0 +1,199 @@
+#!/usr/bin/env python
+"""Supervisor chaos smoke — the elastic story end-to-end, for real.
+
+Driven by ``scripts/run-tests.sh --elastic``.  The parent runs the REAL
+restart supervisor (``bigdl_tpu.resilience.supervisor``) over a real
+training child:
+
+1. launch 0: a 2-"host" (2 forced CPU devices) DistriOptimizer run,
+   checkpointing every epoch, with a fault plan killing it at step 7
+   (``step:7:raise`` + ``max_retry=0`` — the in-process retry budget is
+   deliberately empty, so the process dies with the TRANSIENT exit
+   code after the epoch-1 checkpoint is on disk);
+2. the supervisor classifies the exit, burns one retry-budget slot,
+   and relaunches with ``BIGDL_ELASTIC_ATTEMPT=1``;
+3. launch 1: the child comes back at world size **1**, resumes via
+   ``elastic.restore_latest`` (the 2-shard checkpoint re-partitions for
+   the 1-shard mesh), and trains to completion;
+4. the parent then runs an uninterrupted 1-host baseline from the same
+   seeds and asserts the resumed loss trajectory matches step-for-step,
+   and that the resumed child's metrics shard recorded
+   ``bigdl_resumes_total{resize="2to1"} 1``.
+
+Everything is subprocesses — the parent never imports jax — so the
+smoke also exercises the exit-code contract exactly as a launcher
+would.
+"""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+KILL_STEP = 7
+EPOCHS = 4  # 128 samples / batch 32 = 4 steps per epoch -> 16 steps
+
+
+def child():
+    attempt = int(os.environ.get("BIGDL_ELASTIC_ATTEMPT", "0"))
+    world = 2 if attempt == 0 else 1
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + f" --xla_force_host_platform_device_count"
+                                 f"={world}")
+    if attempt == 0:
+        os.environ["BIGDL_FAULT_PLAN"] = f"step:{KILL_STEP}:raise"
+    else:
+        os.environ.pop("BIGDL_FAULT_PLAN", None)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from bigdl_tpu.common import RandomGenerator
+    from bigdl_tpu.dataset import ArrayDataSet
+    from bigdl_tpu.engine import Engine
+    from bigdl_tpu.nn import (
+        ClassNLLCriterion, Linear, LogSoftMax, ReLU, Sequential,
+    )
+    from bigdl_tpu.optim import DistriOptimizer, SGD, Trigger
+    from bigdl_tpu.resilience import elastic
+
+    smoke_dir = os.environ["BIGDL_SMOKE_DIR"]
+    Engine.init()
+    assert len(jax.devices()) == world, jax.devices()
+    RandomGenerator.RNG.set_seed(7)
+    model = Sequential().add(Linear(16, 32)).add(ReLU()) \
+        .add(Linear(32, 4)).add(LogSoftMax())
+    rng = np.random.RandomState(0)
+    w = rng.randn(16, 4)
+    x = rng.randn(128, 16).astype(np.float32)
+    y = (np.argmax(x @ w, axis=1) + 1).astype(np.float32)
+    opt = DistriOptimizer(model, ArrayDataSet(x, y, 32, shuffle=False),
+                          ClassNLLCriterion(), batch_size=32,
+                          wire_dtype="none")
+    opt.set_optim_method(SGD(learningrate=0.5, momentum=0.9))
+    opt.set_end_when(Trigger.max_epoch(EPOCHS))
+    opt.set_checkpoint(os.path.join(smoke_dir, "ckpt"),
+                       Trigger.every_epoch())
+    opt.max_retry = 0  # first transient failure kills the process
+
+    losses = {}
+
+    class Tape:
+        def add_scalar(self, tag, value, step):
+            if tag == "Loss":
+                losses[step] = float(value)
+
+        def add_histogram(self, *a, **k):
+            pass
+
+        def get_summary_trigger(self, name):
+            return None
+
+        def add_resilience(self, *a, **k):
+            pass
+
+    opt.set_train_summary(Tape())
+    extra = elastic.restore_latest(opt)
+    print(f"SMOKE_CHILD attempt={attempt} world={world} "
+          f"resumed={extra is not None} "
+          f"from_world={(extra or {}).get('topology', {}).get('world_size')}",
+          flush=True)
+
+    def train():
+        try:
+            opt.optimize()
+        finally:
+            out = os.path.join(smoke_dir, f"losses.attempt{attempt}.json")
+            with open(out, "w", encoding="utf-8") as fh:
+                json.dump(losses, fh)
+
+    sys.exit(elastic.run_main(train))
+
+
+def baseline(smoke_dir, env):
+    """Uninterrupted 1-host run from the same seeds (a fresh child with
+    attempt forced to 1 and an empty checkpoint dir)."""
+    bdir = os.path.join(smoke_dir, "baseline")
+    os.makedirs(bdir, exist_ok=True)
+    benv = dict(env)
+    benv["BIGDL_SMOKE_DIR"] = bdir
+    benv["BIGDL_ELASTIC_ATTEMPT"] = "1"
+    subprocess.run([sys.executable, os.path.abspath(__file__),
+                    "--child"], env=benv, check=True)
+    with open(os.path.join(bdir, "losses.attempt1.json"),
+              encoding="utf-8") as fh:
+        return {int(k): v for k, v in json.load(fh).items()}
+
+
+def main():
+    import tempfile
+
+    from bigdl_tpu.resilience.elastic import EXIT_TRANSIENT
+    from bigdl_tpu.resilience.supervisor import Supervisor
+
+    smoke_dir = tempfile.mkdtemp(prefix="bigdl_elastic_smoke_")
+    obs_dir = os.path.join(smoke_dir, "obs")
+    # instant restarts for the supervisor's own RetryPolicy too (it
+    # reads the live config of THIS process)
+    os.environ["BIGDL_RETRY_BACKOFF_BASE"] = "0"
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    env.update(BIGDL_SMOKE_DIR=smoke_dir, BIGDL_METRICS_DIR=obs_dir,
+               BIGDL_RETRY_BACKOFF_BASE="0", PYTHONPATH=REPO)
+
+    rcs = []
+
+    def runner(cmd, child_env):
+        rc = subprocess.call(cmd, env={**env, **{
+            k: child_env[k] for k in ("BIGDL_ELASTIC_ATTEMPT",
+                                      "BIGDL_ELASTIC_PREEMPTIONS")}})
+        rcs.append(rc)
+        return rc
+
+    sup = Supervisor(
+        [sys.executable, os.path.abspath(__file__), "--child"],
+        max_retries=3, runner=runner, sleep=lambda s: None)
+    rc = sup.run()
+    assert rc == 0, f"supervisor gave up with rc {rc} (children: {rcs})"
+    assert rcs == [EXIT_TRANSIENT, 0], \
+        f"expected one transient kill then success, got {rcs}"
+    print(f"SMOKE supervisor: launches={sup.attempt} child_rcs={rcs}")
+
+    # --- resumed trajectory must match an uninterrupted 1-host run ----
+    with open(os.path.join(smoke_dir, "losses.attempt1.json"),
+              encoding="utf-8") as fh:
+        resumed = {int(k): v for k, v in json.load(fh).items()}
+    base = baseline(smoke_dir, env)
+    assert resumed, "resumed child recorded no losses"
+    worst = 0.0
+    for step, val in sorted(resumed.items()):
+        assert step in base, f"resumed step {step} not in baseline"
+        rel = abs(val - base[step]) / max(1.0, abs(base[step]))
+        worst = max(worst, rel)
+        assert rel < 1e-3, \
+            f"loss diverged at step {step}: {val} vs {base[step]}"
+    print(f"SMOKE trajectory: {len(resumed)} resumed steps match the "
+          f"uninterrupted baseline (worst rel err {worst:.2e})")
+
+    # --- the resize was counted in the resumed child's metrics shard --
+    proms = glob.glob(os.path.join(obs_dir, "metrics.*.prom"))
+    assert proms, f"no metrics shards under {obs_dir}"
+    blob = "".join(open(p, encoding="utf-8").read() for p in proms)
+    needle = 'bigdl_resumes_total{resize="2to1"} 1'
+    assert needle in blob, \
+        f"{needle!r} not found in metrics shards:\n{blob[-2000:]}"
+    print(f"SMOKE metrics: found {needle!r}")
+    print("ELASTIC SMOKE PASS")
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        child()
+    else:
+        main()

@@ -17,6 +17,15 @@
 #                                                # the perf-regression gate
 #                                                # against a synthetic
 #                                                # trajectory (no pytest)
+#   scripts/run-tests.sh --elastic               # supervisor chaos smoke: a
+#                                                # 2-host run fault-killed at
+#                                                # step 7, restarted by the
+#                                                # real supervisor at world
+#                                                # size 1; asserts the resumed
+#                                                # loss trajectory + the
+#                                                # bigdl_resumes_total{
+#                                                # resize="2to1"} counter
+#                                                # (no pytest)
 # The chaos and obs specs are deterministic and part of the default
 # selection; the flags are the focused loops for hacking on those layers.
 set -euo pipefail
@@ -35,6 +44,9 @@ elif [[ "${1:-}" == "--trace" ]]; then
 elif [[ "${1:-}" == "--obs-report" ]]; then
   shift
   exec python scripts/obs_smoke.py "$@"
+elif [[ "${1:-}" == "--elastic" ]]; then
+  shift
+  exec python scripts/elastic_smoke.py "$@"
 fi
 
 exec python -m pytest tests/ -q "${MARKER[@]}" "$@"

@@ -20,6 +20,14 @@ attributions, numerics anomalies; ``--json`` for machines) and
 timeline from all host shards).  A NaN'd run names its first offending
 layer in the report's health section — start there before blaming the
 compiler.
+
+A run that keeps DYING (preemption, host loss) rather than failing to
+compile belongs under the restart supervisor instead: ``python -m
+bigdl_tpu.resilience.supervisor -- <train cmd>`` resumes preempted
+children from their emergency checkpoint (exit code 170) for free and
+transient crashes under the retry budget — see MIGRATION.md "Elastic
+training" for the exit-code/heartbeat/resize knobs, and
+``scripts/run-tests.sh --elastic`` for the end-to-end smoke.
 """
 
 import argparse

@@ -140,6 +140,112 @@ class TestRetryPolicy:
         assert q.record_failure(now=50.0) == 0.0
 
 
+# --------------------------------------- kill -9 inside write_checkpoint
+class TestKillDuringCheckpointWrite:
+    """ISSUE satellite: SIGKILL at every truncation point inside
+    ``write_checkpoint`` must leave ``load_latest_checkpoint`` a path
+    back to the newest INTACT pair.  The writer's sequence is
+    ``.optim`` (tmp+rename) → ``.model`` (tmp+rename) → manifest
+    (tmp+rename), each fsync'd; every state below reconstructs the
+    exact on-disk layout a kill at that point leaves behind."""
+
+    def _intact_old(self, tmp_path):
+        now = time.time()
+        old = _ckpt(tmp_path, "1_1", 1, 1, mtime=now - 60)
+        return old, now
+
+    def _load(self, tmp_path):
+        model = Linear(4, 2)
+        method = SGD(learningrate=0.1)
+        return load_latest_checkpoint(str(tmp_path), model, method)
+
+    def test_killed_mid_optim_tmp_write(self, tmp_path):
+        old, now = self._intact_old(tmp_path)
+        # .optim tmp half-written; nothing else of the new prefix exists
+        p = tmp_path / "checkpoint_2_9.optim.npz.tmp.npz"
+        p.write_bytes(b"PK\x03\x04garbage" * 10)
+        extra = self._load(tmp_path)
+        assert extra["neval"] == 1  # invisible prefix: fell back cleanly
+
+    def test_killed_mid_model_tmp_write(self, tmp_path):
+        old, now = self._intact_old(tmp_path)
+        new = _ckpt(tmp_path, "2_9", 2, 9, mtime=now)
+        # rewind: the model rename never happened, its tmp is torn
+        os.rename(new + ".model.npz", new + ".model.npz.tmp.npz")
+        os.truncate(new + ".model.npz.tmp.npz", 64)
+        os.remove(new + ".manifest.json")
+        extra = self._load(tmp_path)
+        assert extra["neval"] == 1
+
+    def test_killed_in_pair_to_manifest_window(self, tmp_path):
+        """Both renames landed, the kill hit before the manifest tmp
+        existed: the pair IS intact (renames are atomic, optim wrote
+        first) — the legacy no-manifest check may bless it."""
+        old, now = self._intact_old(tmp_path)
+        new = _ckpt(tmp_path, "2_9", 2, 9, mtime=now)
+        os.remove(new + ".manifest.json")
+        ok, reason = verify_checkpoint(new)
+        assert ok and "no manifest" in reason
+        extra = self._load(tmp_path)
+        assert extra["neval"] == 9
+
+    def test_killed_mid_manifest_tmp_write(self, tmp_path):
+        """A torn manifest tmp is crash-window evidence: the pair must
+        NOT be trusted without its checksums — fall back."""
+        old, now = self._intact_old(tmp_path)
+        new = _ckpt(tmp_path, "2_9", 2, 9, mtime=now)
+        os.remove(new + ".manifest.json")
+        (tmp_path / "checkpoint_2_9.manifest.json.tmp").write_text(
+            '{"format": 1, "files": {"checkpoint_2_9.mod')
+        ok, reason = verify_checkpoint(new)
+        assert not ok and "interrupted" in reason
+        extra = self._load(tmp_path)
+        assert extra["neval"] == 1
+
+    def test_killed_in_fsync_window_truncated_rename(self, tmp_path):
+        """The paranoid case a crashed *host* (not process) can leave
+        on a non-ordering filesystem: model file renamed but its data
+        lost (zero-length) and no manifest.  The leftover optim tmp of
+        the interrupted NEXT stage plus the unreadable npz both
+        independently fail verification."""
+        old, now = self._intact_old(tmp_path)
+        new = os.path.join(str(tmp_path), "checkpoint_2_9")
+        (tmp_path / "checkpoint_2_9.optim.npz").write_bytes(b"")
+        (tmp_path / "checkpoint_2_9.model.npz").write_bytes(b"")
+        ok, reason = verify_checkpoint(new)
+        assert not ok
+        extra = self._load(tmp_path)
+        assert extra["neval"] == 1
+
+    def test_optim_written_before_model(self, tmp_path, monkeypatch):
+        """Pin the write ORDER the recovery story depends on: discovery
+        keys on .model.npz, so .optim must hit disk first — any
+        discoverable prefix then already has its optimizer state."""
+        from bigdl_tpu.utils import serializer
+
+        order = []
+        real = serializer._atomic_savez
+
+        def spy(path, arrays):
+            order.append(os.path.basename(path))
+            return real(path, arrays)
+
+        monkeypatch.setattr(serializer, "_atomic_savez", spy)
+        save_checkpoint(os.path.join(str(tmp_path), "checkpoint_1_1"),
+                        Linear(4, 2), SGD(learningrate=0.1),
+                        extra={"epoch": 1, "neval": 1})
+        assert order == ["checkpoint_1_1.optim", "checkpoint_1_1.model"]
+
+    def test_gc_removes_manifest_tmp_leftovers(self, tmp_path):
+        now = time.time()
+        for i in range(3):
+            _ckpt(tmp_path, f"1_{i}", 1, i, mtime=now - 30 + 10 * i)
+        (tmp_path / "checkpoint_1_0.manifest.json.tmp").write_text("{")
+        gc_checkpoints(str(tmp_path), keep_last=2)
+        left = [f for f in os.listdir(tmp_path) if "1_0" in f]
+        assert left == []
+
+
 # ----------------------------------------------------- checkpoint integrity
 def _ckpt(tmp_path, tag, epoch, neval, mtime=None):
     prefix = os.path.join(str(tmp_path), f"checkpoint_{tag}")
