@@ -88,6 +88,18 @@ class ObsConfig:
     # a loss or grad norm above median * this factor is an anomaly;
     # <= 0 disables the detector [BIGDL_HEALTH_SPIKE_FACTOR]
     health_spike_factor: float = 10.0
+    # goodput ledger (obs/goodput.py): the bottleneck classifier runs
+    # once every N productive steps; <= 0 disables the windowed
+    # classifier (the ledger still records) [BIGDL_GOODPUT_WINDOW]
+    goodput_window: int = 32
+    # assumed interconnect bandwidth in GB/s for the comm-seconds
+    # estimate (static wire bytes / bandwidth); 0 = unknown, the
+    # classifier then never reports comm_bound [BIGDL_WIRE_GBPS]
+    wire_gbps: float = 0.0
+    # cross-host straggler detection (obs/aggregate.py): a host whose
+    # step-time p50 exceeds the cross-host median by this factor is
+    # flagged; <= 1 disables [BIGDL_STRAGGLER_FACTOR]
+    straggler_factor: float = 1.5
 
     @property
     def active(self) -> bool:
@@ -107,6 +119,9 @@ class ObsConfig:
             health_window=_env_int("BIGDL_HEALTH_WINDOW", 64),
             health_spike_factor=_env_float("BIGDL_HEALTH_SPIKE_FACTOR",
                                            10.0),
+            goodput_window=_env_int("BIGDL_GOODPUT_WINDOW", 32),
+            wire_gbps=_env_float("BIGDL_WIRE_GBPS", 0.0),
+            straggler_factor=_env_float("BIGDL_STRAGGLER_FACTOR", 1.5),
         )
 
 

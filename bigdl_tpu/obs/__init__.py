@@ -21,7 +21,12 @@ stack (optimizers, engine, serializer, resilience, bench):
 * :mod:`bigdl_tpu.obs.health` — per-layer grad/param/update-ratio
   telemetry computed inside the jitted train step, non-finite
   localization, and the numerics anomaly detector
-  (``BIGDL_HEALTH_EVERY``).
+  (``BIGDL_HEALTH_EVERY``);
+* :mod:`bigdl_tpu.obs.goodput` — wall-clock goodput ledger: productive
+  step time vs. badput causes (compile, checkpoints, data waits,
+  startup, supervisor backoff, restart rework), per-attempt JSONL
+  shards aggregated across restarts/hosts, and the per-window
+  input/compute/comm/host bottleneck classifier.
 
 Everything is off by default with a no-op fast path: disabled, the
 train loop sees one shared null context manager per span site and adds
@@ -40,6 +45,7 @@ from bigdl_tpu.obs.metrics import DEFAULT_BUCKETS, MetricsRegistry
 from bigdl_tpu.obs.runtime import (
     Reservoir,
     RuntimeStats,
+    all_device_memory_stats,
     device_memory_stats,
     hlo_cost_analysis,
     host_rss_bytes,
@@ -50,8 +56,9 @@ from bigdl_tpu.obs.trace import NULL_TRACER, NullTracer, Tracer
 __all__ = [
     "DEFAULT_BUCKETS", "MetricsRegistry", "Reservoir", "RuntimeStats",
     "NullTracer", "Tracer", "NULL_TRACER",
-    "active", "get_tracer", "get_registry", "get_runtime",
+    "active", "get_tracer", "get_registry", "get_runtime", "get_ledger",
     "instrument_jit", "host_rss_bytes", "device_memory_stats",
+    "all_device_memory_stats",
     "flush", "reset",
 ]
 
@@ -136,6 +143,14 @@ def get_runtime() -> RuntimeStats:
         return _runtime
 
 
+def get_ledger():
+    """The process goodput ledger (obs/goodput.py) — recording when
+    observability is active, the shared no-op otherwise."""
+    from bigdl_tpu.obs import goodput
+
+    return goodput.get_ledger()
+
+
 def publish_runtime(registry: MetricsRegistry = None,
                     runtime: RuntimeStats = None) -> dict:
     """Mirror the runtime snapshot into registry gauges (step-time
@@ -183,6 +198,17 @@ def publish_runtime(registry: MetricsRegistry = None,
                             "Device 0 memory stats", labels=("stat",))
         for k, v in dm.items():
             dg.labels(stat=k).set(v)
+    dma = snap.get("device_memory_all")
+    if dma:
+        hg = registry.gauge(
+            "bigdl_hbm_peak_bytes",
+            "Peak HBM bytes in use, per local device",
+            labels=("device",))
+        for i, stats in dma.items():
+            peak = stats.get("peak_bytes_in_use",
+                             stats.get("bytes_in_use"))
+            if peak is not None:
+                hg.labels(device=i).set(peak)
     return snap
 
 
@@ -195,6 +221,11 @@ def flush(extra_registries=()) -> dict:
     if not cfg.active:
         return {}
     publish_runtime()
+    # the goodput ledger publishes its attempt-local classification
+    # (bigdl_goodput_ratio / bigdl_badput_seconds_total) BEFORE the
+    # snapshot is written so the shard carries the final numbers
+    ledger = get_ledger()
+    ledger.publish(_registry)
     paths = {}
     out_dir = cfg.metrics_dir or cfg.trace_dir
     if out_dir:
@@ -205,6 +236,9 @@ def flush(extra_registries=()) -> dict:
     if tracer is not NULL_TRACER:
         paths["trace"] = tracer.trace_path
         paths["events"] = tracer.jsonl_path
+    gp = ledger.flush()
+    if gp:
+        paths["goodput"] = gp
     return paths
 
 
@@ -222,3 +256,6 @@ def reset():
         _tracer_dir = None
         _registry = MetricsRegistry()
         _runtime = None
+    from bigdl_tpu.obs import goodput
+
+    goodput.reset_ledger()

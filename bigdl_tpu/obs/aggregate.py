@@ -33,11 +33,16 @@ the DistriOptimizer pod-slice runs in MULTICHIP_r*.json.
 from __future__ import annotations
 
 import json
+import math
 import os
 from typing import List, Optional
 
 # the alignment anchor Engine.init emits after multi-host bring-up
 BARRIER_EVENT = "engine.init_barrier"
+
+# the per-host step span the straggler detector keys on (the
+# dispatch -> resolved-loss wall time both optimizers emit)
+STEP_SPAN = "computing"
 
 
 class Shard:
@@ -106,6 +111,90 @@ def align_shards(shards: List[Shard],
     return shards
 
 
+def _p50(values: List[float]) -> Optional[float]:
+    if not values:
+        return None
+    vs = sorted(values)
+    return vs[min(len(vs) - 1, max(0, math.ceil(0.5 * len(vs)) - 1))]
+
+
+def detect_stragglers(shards: List[Shard],
+                      factor: Optional[float] = None) -> dict:
+    """Cross-host straggler detection over the merged timeline.
+
+    Two signals from the per-host ``computing`` (step) spans:
+
+    * **host-level skew** — a host whose step-time p50 exceeds the
+      cross-host median of p50s by ``factor`` is flagged (the chronic
+      straggler that drags every synchronous collective);
+    * **per-step skew** — for every step present on >= 2 hosts, a host
+      slower than that step's cross-host median by ``factor`` counts
+      one ``bigdl_straggler_steps_total{host}`` increment (the
+      intermittent straggler a p50 hides).
+
+    ``factor`` defaults to ``BIGDL_STRAGGLER_FACTOR`` (1.5); <= 1
+    disables.  Works on wall durations only — clock *offsets* (which
+    the barrier alignment removes) cannot fake a slow duration.
+    Returns ``{factor, hosts: {host: {p50, steps, straggler_steps}},
+    median_p50, stragglers: [host, ...]}``."""
+    if factor is None:
+        from bigdl_tpu.config import refresh_from_env
+
+        factor = refresh_from_env().obs.straggler_factor
+    factor = float(factor)
+    # host -> {step -> [durs]} and host -> [durs]
+    by_host: dict = {}
+    by_host_step: dict = {}
+    for s in shards:
+        for rec in s.records:
+            if rec.get("kind") != "span" or rec.get("name") != STEP_SPAN:
+                continue
+            dur = float(rec.get("dur_s", 0.0))
+            by_host.setdefault(s.host, []).append(dur)
+            step = (rec.get("attrs") or {}).get("step")
+            if step is not None:
+                by_host_step.setdefault(int(step), {}).setdefault(
+                    s.host, []).append(dur)
+    hosts = {h: {"p50": _p50(durs), "steps": len(durs),
+                 "straggler_steps": 0}
+             for h, durs in by_host.items()}
+    out = {"factor": factor, "hosts": hosts, "median_p50": None,
+           "stragglers": []}
+    if factor <= 1.0 or len(hosts) < 2:
+        return out
+    median = _p50([v["p50"] for v in hosts.values()
+                   if v["p50"] is not None])
+    out["median_p50"] = median
+    if median:
+        out["stragglers"] = sorted(
+            h for h, v in hosts.items()
+            if v["p50"] is not None and v["p50"] > median * factor)
+    for step, per_host in by_host_step.items():
+        if len(per_host) < 2:
+            continue
+        step_durs = {h: _p50(d) for h, d in per_host.items()}
+        step_median = _p50(list(step_durs.values()))
+        if not step_median:
+            continue
+        for h, d in step_durs.items():
+            if d > step_median * factor:
+                hosts[h]["straggler_steps"] += 1
+    # surface the counts as the labeled counter so in-process callers
+    # (tests, a supervisor aggregating between launches) can scrape them
+    if any(v["straggler_steps"] for v in hosts.values()) \
+            or out["stragglers"]:
+        from bigdl_tpu import obs
+
+        counter = obs.get_registry().counter(
+            "bigdl_straggler_steps_total",
+            "Steps on which a host exceeded the cross-host median step "
+            "time by BIGDL_STRAGGLER_FACTOR", labels=("host",))
+        for h, v in hosts.items():
+            if v["straggler_steps"]:
+                counter.labels(host=h).inc(v["straggler_steps"])
+    return out
+
+
 def merge_shards(shards: List[Shard], barrier: str = BARRIER_EVENT) -> dict:
     """Merge aligned shards into one Chrome ``trace_event`` document.
 
@@ -141,6 +230,24 @@ def merge_shards(shards: List[Shard], barrier: str = BARRIER_EVENT) -> dict:
                 ev["ph"] = "i"
                 ev["s"] = "t"
             events.append(ev)
+    # cross-host straggler detection rides the merge: each flagged host
+    # gets one `straggler` instant event at the end of the timeline so
+    # the skew is ON the Perfetto view, not only in the summary
+    stragglers = detect_stragglers(shards)
+    host_cpid = {}
+    for i, s in enumerate(sorted(shards, key=lambda s: (s.host, s.pid,
+                                                        s.path))):
+        host_cpid.setdefault(s.host, i + 1)
+    end_ts = events[-1]["ts"] if events else 0.0
+    for h in stragglers["stragglers"]:
+        info = stragglers["hosts"].get(h, {})
+        events.append({
+            "name": "straggler", "ph": "i", "s": "g", "ts": end_ts,
+            "pid": host_cpid.get(h, 1), "tid": 0,
+            "args": {"host": h, "p50_s": info.get("p50"),
+                     "median_p50_s": stragglers["median_p50"],
+                     "factor": stragglers["factor"],
+                     "straggler_steps": info.get("straggler_steps")}})
     # a monotone timeline: Perfetto tolerates disorder, humans and the
     # monotonicity tests do not
     events.sort(key=lambda e: e["ts"])
@@ -156,6 +263,7 @@ def merge_shards(shards: List[Shard], barrier: str = BARRIER_EVENT) -> dict:
                 for s in shards},
             "unaligned": [f"host{s.host}/pid{s.pid}"
                           for s in shards if not s.aligned],
+            "stragglers": stragglers,
         },
     }
 
@@ -178,6 +286,7 @@ def merge_trace_dir(trace_dir: str, out_path: Optional[str] = None,
         "events": sum(len(s.records) for s in shards),
         "offsets_s": doc["otherData"]["offsets_s"],
         "unaligned": doc["otherData"]["unaligned"],
+        "stragglers": doc["otherData"]["stragglers"]["stragglers"],
         "out": out_path,
     }
 

@@ -13,7 +13,12 @@ snapshots when present) and renders what a postmortem asks first:
 * slow-step anomalies and the slowest spans per host;
 * training health (obs/health.py): per-layer grad norm / param norm /
   update ratio gauges, non-finite layer attributions, numerics
-  anomalies.
+  anomalies;
+* goodput (obs/goodput.py): the cross-attempt, cross-host wall-clock
+  ledger — goodput ratio, badput seconds by cause (compile,
+  checkpoints, data waits, startup, supervisor backoff, restart
+  rework), the window bottleneck classification, and cross-host
+  straggler flags.
 
 ``--json`` emits the machine-readable report instead of text — the
 same dict ``build_report`` returns, so CI and ``obs/regress.py``
@@ -175,6 +180,34 @@ def build_report(trace_dir: str, metrics_dir: Optional[str] = None) -> dict:
                                                    "bigdl_step_flops")]
     mfu = [float(s.get("value", 0.0))
            for _l, s, _h in _metric_samples(snaps, "bigdl_mfu")]
+
+    # ---- goodput ledger (obs/goodput.py) -----------------------------
+    from bigdl_tpu.obs import goodput as G
+    from bigdl_tpu.obs.aggregate import detect_stragglers
+
+    gp = G.aggregate_goodput(metrics_dir or trace_dir)
+    if gp is not None:
+        # bottleneck: prefer the run's own windowed gauge (it saw live
+        # comm/host fractions); fall back to re-deriving the input
+        # share from the ledger when no window ever ticked
+        label, source = None, None
+        for labels, s, _host in _metric_samples(snaps, "bigdl_bottleneck"):
+            if float(s.get("value", 0.0)) >= 1.0:
+                label, source = labels.get("class"), "gauge"
+        derived = G.classify_bottleneck(
+            gp["productive_s"] + gp["badput_s"].get("rework", 0.0),
+            gp["badput_s"].get("data_wait", 0.0))
+        if label is None:
+            label, source = derived["label"], "ledger"
+        gp["bottleneck"] = {"label": label, "source": source,
+                            "input_fraction": derived["input_fraction"]}
+    stragglers = detect_stragglers(shards)
+
+    # per-device HBM peaks (bigdl_hbm_peak_bytes, max across snapshots)
+    hbm: dict = {}
+    for labels, s, _host in _metric_samples(snaps, "bigdl_hbm_peak_bytes"):
+        d = labels.get("device", "?")
+        hbm[d] = max(hbm.get(d, 0.0), float(s.get("value", 0.0)))
     health = {
         "grad_norm": _by_layer("bigdl_grad_norm"),
         "param_norm": _by_layer("bigdl_param_norm"),
@@ -204,6 +237,9 @@ def build_report(trace_dir: str, metrics_dir: Optional[str] = None) -> dict:
         "resilience_events": resilience,
         "slow_steps": slow_steps,
         "health": health,
+        "goodput": gp,
+        "stragglers": stragglers,
+        "hbm_peak_bytes": hbm,
     }
 
 
@@ -237,6 +273,10 @@ def render_text(rep: dict) -> str:
                  f"{int(cc) if cc is not None else 'n/a'}")
     for ev in rep["compile"]["events_in_trace"][:8]:
         lines.append(f"  host{ev['host']} {ev['name']}: {ev['seconds']}s")
+    hbm = rep.get("hbm_peak_bytes") or {}
+    if hbm:
+        lines.append("  hbm peak: " + ", ".join(
+            f"d{d} {_fmt_bytes(b)}" for d, b in sorted(hbm.items())))
     lines.append("")
     lines.append("-- collective wire bytes (total across hosts) --")
     if not rep["collective_bytes_total"]:
@@ -264,6 +304,47 @@ def render_text(rep: dict) -> str:
             f"{float(s.get('dur_s', 0)) * 1000:.1f}ms "
             f"(median {float(s.get('median_s', 0)) * 1000:.1f}ms, "
             f"breakdown {s.get('breakdown')})")
+    lines.append("")
+    lines.append("-- goodput --")
+    gp = rep.get("goodput")
+    if not gp:
+        lines.append("  (no goodput ledger — set BIGDL_METRICS_DIR)")
+    else:
+        hosts = ",".join(str(h) for h in gp["hosts"])
+        lines.append(f"  attempts: {gp['attempts']} (hosts {hosts}), "
+                     f"{gp['steps']} productive steps")
+        ratio = gp["goodput_ratio"]
+        lines.append(
+            f"  wall {gp['total_s']:.2f}s | productive "
+            f"{gp['productive_s']:.2f}s | goodput ratio "
+            + (f"{ratio:.3f}" if ratio is not None else "n/a"))
+        if gp["badput_s"]:
+            lines.append("  badput: " + "; ".join(
+                f"{cause} {secs:.2f}s"
+                for cause, secs in sorted(gp["badput_s"].items())))
+        if gp["unknown_s"]:
+            lines.append(f"  unknown gaps: {gp['unknown_s']:.2f}s")
+        if gp["rework_steps"]:
+            lines.append(f"  rework: {gp['rework_steps']} replayed "
+                         "step(s) after restart")
+        bn = gp.get("bottleneck")
+        if bn:
+            lines.append(
+                f"  bottleneck: {bn['label']} (input share "
+                f"{bn['input_fraction'] * 100:.0f}%, via {bn['source']})")
+    strag = rep.get("stragglers") or {}
+    if strag.get("stragglers"):
+        med = strag.get("median_p50") or 0.0
+        for h in strag["stragglers"]:
+            info = strag["hosts"].get(h) or strag["hosts"].get(str(h), {})
+            p50 = info.get("p50") or 0.0
+            lines.append(
+                f"  STRAGGLER host{h}: p50 {p50 * 1000:.1f}ms vs "
+                f"cross-host median {med * 1000:.1f}ms "
+                f"(factor {strag['factor']:g}, "
+                f"{info.get('straggler_steps', 0)} flagged steps)")
+    elif len(rep["hosts"]) > 1:
+        lines.append("  stragglers: none flagged")
     lines.append("")
     lines.append("-- training health --")
     h = rep.get("health") or {}

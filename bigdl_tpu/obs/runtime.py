@@ -105,6 +105,32 @@ def device_memory_stats(device=None) -> Optional[dict]:
         return None
 
 
+_HBM_KEYS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
+
+
+def all_device_memory_stats() -> Optional[dict]:
+    """HBM stats for EVERY local device — ``{device_index: {bytes_in_use,
+    peak_bytes_in_use, bytes_limit}}``.  Device 0 alone hides exactly
+    the failure a sharded trainer cares about (one chip's allocator
+    running hot while its peers idle); host-side allocator reads, no
+    device sync.  None when no device reports stats (CPU)."""
+    try:
+        import jax
+
+        devices = jax.local_devices()
+    except Exception:  # noqa: BLE001 — absent backend / no jax yet
+        return None
+    out = {}
+    for i, dev in enumerate(devices):
+        try:
+            stats = dev.memory_stats()
+        except Exception:  # noqa: BLE001 — backend without stats
+            stats = None
+        if stats:
+            out[i] = {k: stats[k] for k in _HBM_KEYS if k in stats}
+    return out or None
+
+
 class RuntimeStats:
     """Aggregated runtime profile for one process."""
 
@@ -168,8 +194,10 @@ class RuntimeStats:
             dm = device_memory_stats()
             if dm is not None:
                 out["device_memory"] = {
-                    k: dm[k] for k in ("bytes_in_use", "peak_bytes_in_use",
-                                       "bytes_limit") if k in dm}
+                    k: dm[k] for k in _HBM_KEYS if k in dm}
+            dma = all_device_memory_stats()
+            if dma is not None:
+                out["device_memory_all"] = dma
         return out
 
     def reset(self):
@@ -237,13 +265,14 @@ def hlo_cost_analysis(fn, abstract) -> Optional[dict]:
 
 
 def instrument_jit(fn, name: str = "jit", stats: Optional[RuntimeStats] = None,
-                   tracer=None, steps_per_call: float = 1.0):
+                   tracer=None, steps_per_call: float = 1.0, ledger=None):
     """Wrap a jitted callable: a call on an unseen arg signature is a
     compile event (its wall time ≈ trace + compile, because jit blocks
     the first call), a seen one is a cached dispatch.  The signature is
     computed BEFORE the call — donated buffers are deleted by it.  The
     first compile also records the program's HLO-derived FLOPs/bytes
-    (``steps_per_call`` normalizes a scanned N-step body)."""
+    (``steps_per_call`` normalizes a scanned N-step body) and stamps a
+    ``compile`` badput interval into the goodput ``ledger``."""
     seen = set()
 
     def wrapped(*args, **kwargs):
@@ -267,6 +296,8 @@ def instrument_jit(fn, name: str = "jit", stats: Optional[RuntimeStats] = None,
             if tracer is not None:
                 tracer.complete(f"{name}.compile", t0, dt,
                                 signatures=len(seen))
+            if ledger is not None:
+                ledger.record("compile", t0, dt)
         elif stats is not None:
             stats.record_dispatch(name, dt)
         return out

@@ -70,6 +70,25 @@ def _note_fallback(reason, x_shape, w_shape, stride, pad):
     }
     FALLBACK_LOG.append(rec)
     _log.warning("conv_bn_stats fell back to XLA: %s", rec)
+    # production visibility (round-5 ADVICE): a fused model silently
+    # mixing Pallas and XLA dispatch — e.g. the three kxk stride-2
+    # ResNet stage transitions — must show up in the metrics scrape and
+    # the trace, not only in the in-process test-harness list.  Fires
+    # at trace time (shapes are static), so once per compile, and is
+    # guarded: telemetry must never sink a kernel dispatch.
+    try:
+        from bigdl_tpu import obs
+
+        k = rec["w_shape"][2] if len(rec["w_shape"]) > 2 else 1
+        site = f"conv_bn_k{k}s{rec['stride']}"
+        obs.get_registry().counter(
+            "bigdl_kernel_fallbacks_total",
+            "Fused-kernel call sites that fell back to the XLA "
+            "reference path, by site (trace-time, once per compile)",
+            labels=("site",)).labels(site=site).inc()
+        obs.get_tracer().event("kernel.fallback", site=site, **rec)
+    except Exception:  # noqa: BLE001 — never break the dispatch
+        pass
 
 
 def _conv_ref(x, w, stride, pad):

@@ -290,6 +290,9 @@ class DistriOptimizer(LocalOptimizer):
                 fp.add("pmean", str(leaf.dtype),
                        C.all_reduce_bytes(int(leaf.size), leaf.dtype, n))
         fp.bind(obs.get_registry())
+        # the goodput window classifier estimates comm seconds from the
+        # same static budget (obs/goodput.py, BIGDL_WIRE_GBPS)
+        self._obs_ledger.set_comm_bytes_per_step(fp.total())
         # the EQuARX argument as a gauge: f32 exchange bytes over what
         # the configured wire actually ships
         f32_exchange = C.reduce_scatter_bytes(padded, "float32", n)
@@ -768,3 +771,7 @@ class DistriOptimizer(LocalOptimizer):
                     "epoch_neval0", self.state["neval"])
                 self._pending_fast_forward = max(
                     0, self.state["neval"] - self.state["epoch_neval0"])
+                # goodput: the in-process retry replays every step
+                # between the checkpoint and the crash — stamp this
+                # attempt's own max step as the rework high-water mark
+                obs.get_ledger().stamp_resume(self.state["neval"])

@@ -100,11 +100,14 @@ class BaseOptimizer:
         self._nonfinite_consec = 0
         self._fault_injector = None
         # observability session handles; optimize() rebinds them from
-        # the live config (NULL tracer / None reservoir = disabled)
+        # the live config (NULL tracer / None reservoir / NULL ledger
+        # = disabled)
+        from bigdl_tpu.obs.goodput import NULL_LEDGER
         from bigdl_tpu.obs.trace import NULL_TRACER
 
         self._obs_tracer = NULL_TRACER
         self._obs_runtime = None
+        self._obs_ledger = NULL_LEDGER
         # per-layer numerics telemetry (obs/health.py); optimize()
         # builds it from the live config, None = disabled
         self._health_monitor = None
@@ -629,6 +632,11 @@ class LocalOptimizer(BaseOptimizer):
         # host-device synchronizations either way
         tracer = self._obs_tracer = obs.get_tracer()
         self._obs_runtime = obs.get_runtime() if obs.active() else None
+        # goodput ledger (obs/goodput.py): interval stamps ride the
+        # span boundaries below — the shared no-op object when obs is
+        # off, so the hot loop pays method-call noise at most and never
+        # a device read either way
+        self._obs_ledger = obs.get_ledger()
         # training-health telemetry: the monitor exists only when
         # BIGDL_HEALTH_EVERY > 0; its absence makes the step build the
         # exact health-less signature with zero extra host transfers
@@ -667,7 +675,7 @@ class LocalOptimizer(BaseOptimizer):
         if self._obs_runtime is not None:
             train_step = obs.instrument_jit(
                 train_step, "train_step", stats=self._obs_runtime,
-                tracer=tracer)
+                tracer=tracer, ledger=self._obs_ledger)
 
         base_key = jax.random.key(1234)
         wall_start = time.time()
@@ -722,6 +730,7 @@ class LocalOptimizer(BaseOptimizer):
         tracer = self._obs_tracer
         runtime = self._obs_runtime
         monitor = self._health_monitor
+        ledger = self._obs_ledger
 
         # Async-dispatch pipelining: the device loss is read back ONE
         # iteration behind, so the next step is dispatched before the
@@ -763,6 +772,9 @@ class LocalOptimizer(BaseOptimizer):
                 runtime.record_step(dt)
                 tracer.complete("computing", t0, dt, step=n)
                 self._detect_slow_step(n, dt, tracer, runtime)
+            # goodput: one productive-step interval (re-tagged rework
+            # by the ledger when n is under the resume high-water mark)
+            ledger.record("step", t0, dt, step=n)
             self.state["loss"] = loss_val
             if monitor is not None:
                 # fetches the (L, 4) health array only every K steps —
@@ -868,6 +880,7 @@ class LocalOptimizer(BaseOptimizer):
                 # named_scope phases of the jitted step; tracer is the
                 # shared no-op object when observability is off
                 tracer.complete("data_wait", t_wait, dt_wait, step=n)
+                ledger.record("data_wait", t_wait, dt_wait, step=n)
                 # child spans carry the step too: the slow-step detector
                 # and the merged cross-host timeline both key on it
                 with tracer.span("iteration", step=n):
@@ -889,6 +902,11 @@ class LocalOptimizer(BaseOptimizer):
                             tracer.span("device_put", step=n):
                         inp_d, tgt_d = self._put_batch(inp, tgt)
                     t0 = time.perf_counter()
+                    # driver-side prep (batch_prep + device_put + rng
+                    # fold) feeds the host_bound share of the window
+                    # classifier; in pipelined steady state it overlaps
+                    # device compute, so it is a share — not a cause
+                    ledger.note_host_seconds(t0 - t_wait - dt_wait)
                     with tracer.span("step_dispatch", step=n):
                         out = train_step(
                             pvar, opt_state, mod_state, rng, inp_d, tgt_d
@@ -924,8 +942,12 @@ class LocalOptimizer(BaseOptimizer):
                         flush_pending()
                         # device-resident params: no host weight copy per
                         # validation trigger (VERDICT r2 #3)
+                        t_eval = time.perf_counter()
                         with tracer.span("validation", step=n):
                             self._run_validation(pvar, mod_state)
+                        ledger.record("eval", t_eval,
+                                      time.perf_counter() - t_eval,
+                                      step=n)
                         model.training()
                     if self.checkpoint_trigger is not None and \
                             self.checkpoint_trigger(self.state):
@@ -960,8 +982,11 @@ class LocalOptimizer(BaseOptimizer):
                 if self.validation_trigger is not None and self.validation_trigger(
                     self.state
                 ):
+                    t_eval = time.perf_counter()
                     with tracer.span("validation", epoch=epoch):
                         self._run_validation(pvar, mod_state)
+                    ledger.record("eval", t_eval,
+                                  time.perf_counter() - t_eval)
                     model.training()
                 if self.checkpoint_trigger is not None and self.checkpoint_trigger(
                     self.state

@@ -448,6 +448,14 @@ def restore_latest(optimizer, directory: Optional[str] = None):
     record_resume(topo.get("world_size"),
                   getattr(optimizer, "n_shards", 1),
                   step=optimizer.state.get("neval"))
+    # goodput (obs/goodput.py): stamp the prior attempt's max step —
+    # read from the earlier attempts' ledger shards — as the rework
+    # high-water mark, so the replayed steps between the restored step
+    # and the pre-crash front are accounted as rework badput, not
+    # productive time
+    from bigdl_tpu import obs
+
+    obs.get_ledger().stamp_resume(optimizer.state.get("neval"))
     return extra
 
 

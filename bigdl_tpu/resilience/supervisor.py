@@ -178,7 +178,16 @@ class Supervisor:
                         self.policy.attempts, self.policy.max_retries,
                         delay)
             if delay > 0:
+                # backoff is badput the children never see — the
+                # supervisor's own goodput shard carries it so the
+                # aggregated cross-attempt ratio includes the wait
+                from bigdl_tpu import obs
+
+                t0 = time.perf_counter()
                 self._sleep(delay)
+                obs.get_ledger().record(
+                    "supervisor_backoff", t0,
+                    time.perf_counter() - t0, rc=rc)
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:

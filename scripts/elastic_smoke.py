@@ -18,7 +18,13 @@ training child:
 4. the parent then runs an uninterrupted 1-host baseline from the same
    seeds and asserts the resumed loss trajectory matches step-for-step,
    and that the resumed child's metrics shard recorded
-   ``bigdl_resumes_total{resize="2to1"} 1``.
+   ``bigdl_resumes_total{resize="2to1"} 1``;
+5. the goodput ledger (obs/goodput.py) aggregated ACROSS the two
+   attempts via ``python -m bigdl_tpu.obs.report --json`` shows a
+   cross-attempt goodput ratio in (0, 1) with nonzero ``rework``
+   (the replayed steps between the restored step and the crashed
+   attempt's high-water mark) and nonzero ``checkpoint_restore``
+   badput.
 
 Everything is subprocesses — the parent never imports jax — so the
 smoke also exercises the exit-code contract exactly as a launcher
@@ -123,6 +129,10 @@ def baseline(smoke_dir, env):
     benv = dict(env)
     benv["BIGDL_SMOKE_DIR"] = bdir
     benv["BIGDL_ELASTIC_ATTEMPT"] = "1"
+    # the baseline's obs shards must not pollute the supervised run's
+    # cross-attempt goodput aggregation
+    benv["BIGDL_METRICS_DIR"] = bdir
+    benv["BIGDL_TRACE_DIR"] = bdir
     subprocess.run([sys.executable, os.path.abspath(__file__),
                     "--child"], env=benv, check=True)
     with open(os.path.join(bdir, "losses.attempt1.json"),
@@ -145,6 +155,7 @@ def main():
     env.pop("JAX_PLATFORMS", None)
     env.pop("XLA_FLAGS", None)
     env.update(BIGDL_SMOKE_DIR=smoke_dir, BIGDL_METRICS_DIR=obs_dir,
+               BIGDL_TRACE_DIR=obs_dir,
                BIGDL_RETRY_BACKOFF_BASE="0", PYTHONPATH=REPO)
 
     rcs = []
@@ -189,6 +200,32 @@ def main():
     assert needle in blob, \
         f"{needle!r} not found in metrics shards:\n{blob[-2000:]}"
     print(f"SMOKE metrics: found {needle!r}")
+
+    # --- cross-attempt goodput: the ledger shards of BOTH attempts
+    # aggregate into one ratio, with the restart's cost visible -------
+    # (the report CLI imports the bigdl_tpu package, which imports jax:
+    # pin the CPU platform so it never probes for a TPU — the training
+    # children pin it themselves, which is why env dropped it above)
+    p = subprocess.run(
+        [sys.executable, "-m", "bigdl_tpu.obs.report", obs_dir,
+         "--json"], env={**env, "JAX_PLATFORMS": "cpu"},
+        capture_output=True, text=True, timeout=120)
+    assert p.returncode == 0, p.stdout + p.stderr
+    rep = json.loads(p.stdout.strip().splitlines()[-1])
+    gp = rep["goodput"]
+    assert gp, f"report has no goodput section: {rep.keys()}"
+    assert gp["attempts"] >= 2, gp
+    ratio = gp["goodput_ratio"]
+    assert ratio is not None and 0 < ratio < 1, gp
+    assert gp["badput_s"].get("checkpoint_restore", 0) > 0, \
+        f"no checkpoint_restore badput: {gp['badput_s']}"
+    assert gp["badput_s"].get("rework", 0) > 0, \
+        f"no rework badput (replayed steps not re-tagged): {gp}"
+    assert gp["rework_steps"] > 0, gp
+    print(f"SMOKE goodput: ratio {ratio:.3f} across {gp['attempts']} "
+          f"attempts, rework {gp['badput_s']['rework'] * 1000:.1f}ms "
+          f"({gp['rework_steps']} steps), restore "
+          f"{gp['badput_s']['checkpoint_restore'] * 1000:.1f}ms")
     print("ELASTIC SMOKE PASS")
 
 

@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""--goodput smoke: the goodput ledger + bottleneck attribution loop,
+end to end.
+
+Driven by ``scripts/run-tests.sh --goodput``.  Four stages, each a hard
+assert:
+
+1. two simulated hosts (separate OS processes, ``BIGDL_PROCESS_ID``
+   0/1, CPU backend) each run a 10-step traced DistriOptimizer job into
+   ONE shared trace/metrics volume — with the input pipeline
+   **synthetically starved** (every batch sleeps before delivery), so
+   the run is input-bound by construction, and a 4-step
+   ``BIGDL_GOODPUT_WINDOW`` so the windowed classifier ticks;
+2. ``python -m bigdl_tpu.obs.aggregate`` merges the shards (the merge
+   now carries straggler detection — two healthy hosts must flag
+   nothing);
+3. ``python -m bigdl_tpu.obs.report`` renders the goodput section in
+   text — ratio, badput causes, the bottleneck verdict;
+4. ``--json`` carries the same numbers machine-readably and the
+   bottleneck label must be ``input_bound`` — the classifier agreeing
+   with how the run was sabotaged is the acceptance.
+
+Exit 0 only when all four hold.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_WORKER = """
+import os, sys, time
+sys.path.insert(0, os.environ["BIGDL_REPO"])
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") \\
+    + " --xla_force_host_platform_device_count=8"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import bigdl_tpu.native as native
+from bigdl_tpu.engine import Engine
+from bigdl_tpu.nn import (ClassNLLCriterion, Linear, LogSoftMax, ReLU,
+                          Sequential)
+from bigdl_tpu.optim import DistriOptimizer, SGD, Trigger
+
+# synthetic input starvation: every batch arrives late, so the driver's
+# data_wait dwarfs the (tiny CPU) step time -> input_bound by design
+_P = native.PrefetchIterator
+
+class Starved:
+    def __init__(self, iterable, depth=2):
+        self._it = iter(_P(iterable, depth))
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        time.sleep(float(os.environ.get("SMOKE_BATCH_DELAY", "0.03")))
+        return next(self._it)
+
+native.PrefetchIterator = Starved
+
+Engine.init()
+rng = np.random.RandomState(0)
+w = rng.randn(16, 4)
+x = rng.randn(320, 16).astype(np.float32)
+y = (np.argmax(x @ w, axis=1) + 1).astype(np.float32)
+model = Sequential().add(Linear(16, 32)).add(ReLU()) \\
+    .add(Linear(32, 4)).add(LogSoftMax())
+opt = DistriOptimizer(model, (x, y), ClassNLLCriterion(), batch_size=32)
+opt.set_optim_method(SGD(learningrate=0.1))
+opt.set_end_when(Trigger.max_iteration(10))
+opt.optimize()
+assert opt.state["neval"] == 11, opt.state["neval"]
+"""
+
+
+def run(cmd, **env):
+    e = dict(os.environ)
+    e.update({k: str(v) for k, v in env.items()})
+    e["BIGDL_REPO"] = REPO
+    return subprocess.run(cmd, env=e, cwd=REPO, capture_output=True,
+                          text=True, timeout=300)
+
+
+def main() -> int:
+    tmp = tempfile.mkdtemp(prefix="bigdl_goodput_smoke_")
+    trace_dir = os.path.join(tmp, "trace")
+    metrics_dir = os.path.join(tmp, "metrics")
+
+    # -- 1: two input-starved hosts, one shared volume ----------------
+    for host in (0, 1):
+        p = run([sys.executable, "-c", _WORKER],
+                BIGDL_PROCESS_ID=host, BIGDL_TRACE_DIR=trace_dir,
+                BIGDL_METRICS_DIR=metrics_dir, BIGDL_GOODPUT_WINDOW=4)
+        assert p.returncode == 0, \
+            f"host {host} worker failed:\n{p.stdout}\n{p.stderr}"
+        print(f"[goodput-smoke] host {host}: starved 10-step run ok")
+
+    # -- 2: merge (straggler detection rides along) -------------------
+    merged = os.path.join(tmp, "merged.trace.json")
+    p = run([sys.executable, "-m", "bigdl_tpu.obs.aggregate", trace_dir,
+             "-o", merged])
+    assert p.returncode == 0, p.stdout + p.stderr
+    summary = json.loads(p.stdout.strip().splitlines()[-1])
+    assert summary["hosts"] == [0, 1], summary
+    assert summary["stragglers"] == [], \
+        f"two equally-starved hosts flagged: {summary}"
+    doc = json.load(open(merged))
+    assert "stragglers" in doc["otherData"], doc["otherData"].keys()
+    print(f"[goodput-smoke] merged {summary['shards']} shards, "
+          f"stragglers={summary['stragglers']}")
+
+    # -- 3: the goodput section renders in text -----------------------
+    p = run([sys.executable, "-m", "bigdl_tpu.obs.report", trace_dir,
+             "--metrics-dir", metrics_dir])
+    assert p.returncode == 0, p.stdout + p.stderr
+    for needle in ("-- goodput --", "goodput ratio", "badput:",
+                   "data_wait", "bottleneck: input_bound"):
+        assert needle in p.stdout, \
+            f"report missing {needle!r}:\n{p.stdout}"
+    print("[goodput-smoke] text report renders the goodput section "
+          "with the input_bound verdict")
+
+    # -- 4: --json carries the same, machine-readably -----------------
+    p = run([sys.executable, "-m", "bigdl_tpu.obs.report", trace_dir,
+             "--metrics-dir", metrics_dir, "--json"])
+    assert p.returncode == 0, p.stdout + p.stderr
+    rep = json.loads(p.stdout.strip().splitlines()[-1])
+    gp = rep["goodput"]
+    assert gp, "no goodput section in --json report"
+    ratio = gp["goodput_ratio"]
+    assert ratio is not None and 0 < ratio < 1, gp
+    assert gp["badput_s"].get("data_wait", 0) > 0, gp["badput_s"]
+    assert gp["bottleneck"]["label"] == "input_bound", gp["bottleneck"]
+    assert gp["hosts"] == [0, 1], gp
+    # the starved run's input share must clear the classifier threshold
+    assert gp["bottleneck"]["input_fraction"] >= 0.3, gp["bottleneck"]
+    print(f"[goodput-smoke] --json: ratio {ratio:.3f}, data_wait "
+          f"{gp['badput_s']['data_wait']:.2f}s vs productive "
+          f"{gp['productive_s']:.2f}s, bottleneck "
+          f"{gp['bottleneck']['label']} (via {gp['bottleneck']['source']})")
+    print("[goodput-smoke] PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

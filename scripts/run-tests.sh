@@ -22,9 +22,20 @@
 #                                                # step 7, restarted by the
 #                                                # real supervisor at world
 #                                                # size 1; asserts the resumed
-#                                                # loss trajectory + the
+#                                                # loss trajectory, the
 #                                                # bigdl_resumes_total{
-#                                                # resize="2to1"} counter
+#                                                # resize="2to1"} counter, and
+#                                                # a cross-attempt goodput
+#                                                # ratio with nonzero rework
+#                                                # badput (no pytest)
+#   scripts/run-tests.sh --goodput               # goodput smoke: a 2-host
+#                                                # traced run with a
+#                                                # synthetically starved input
+#                                                # pipeline -> aggregate ->
+#                                                # report; asserts the goodput
+#                                                # section renders (text +
+#                                                # --json) and the bottleneck
+#                                                # classifier says input_bound
 #                                                # (no pytest)
 # The chaos and obs specs are deterministic and part of the default
 # selection; the flags are the focused loops for hacking on those layers.
@@ -47,6 +58,9 @@ elif [[ "${1:-}" == "--obs-report" ]]; then
 elif [[ "${1:-}" == "--elastic" ]]; then
   shift
   exec python scripts/elastic_smoke.py "$@"
+elif [[ "${1:-}" == "--goodput" ]]; then
+  shift
+  exec python scripts/goodput_smoke.py "$@"
 fi
 
 exec python -m pytest tests/ -q "${MARKER[@]}" "$@"

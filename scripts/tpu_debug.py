@@ -17,9 +17,16 @@ health telemetry via ``BIGDL_HEALTH_EVERY`` — the "training health"
 section: per-layer grad/param norms, update ratios, non-finite layer
 attributions, numerics anomalies; ``--json`` for machines) and
 ``python -m bigdl_tpu.obs.aggregate <trace_dir>`` (one Perfetto
-timeline from all host shards).  A NaN'd run names its first offending
-layer in the report's health section — start there before blaming the
-compiler.
+timeline from all host shards, with cross-host straggler flags).  A
+NaN'd run names its first offending layer in the report's health
+section — start there before blaming the compiler.  A run that is
+merely SLOW (or restarts a lot) starts at the report's "goodput"
+section instead: the wall-clock ledger says how much time went to
+compiles, checkpoints, input waits, supervisor backoff, and
+restart rework vs. productive steps, and the bottleneck line says
+whether the run was input/compute/comm/host bound — see MIGRATION.md
+"Goodput & bottleneck attribution" for the knobs and
+``scripts/run-tests.sh --goodput`` for the end-to-end smoke.
 
 A run that keeps DYING (preemption, host loss) rather than failing to
 compile belongs under the restart supervisor instead: ``python -m

@@ -1,0 +1,536 @@
+"""Goodput-ledger specs (ISSUE 6): wall-clock interval classification
+(overlap/nesting/unknown gaps/crashed shards), rework accounting across
+restarts via the high-water mark, the per-window bottleneck classifier,
+cross-host straggler detection over the merged timeline, and the
+disabled-is-noop contract.
+
+The cross-process acceptance (supervisor chaos run reporting a
+cross-attempt goodput ratio with nonzero rework) lives in
+``scripts/elastic_smoke.py``; the report/CLI rendering smoke in
+``scripts/goodput_smoke.py`` (``run-tests.sh --goodput``).
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from bigdl_tpu import obs
+from bigdl_tpu.nn import ClassNLLCriterion, Linear, LogSoftMax, ReLU, Sequential
+from bigdl_tpu.obs import aggregate, goodput as G
+from bigdl_tpu.obs.aggregate import Shard, detect_stragglers, merge_shards
+from bigdl_tpu.optim import LocalOptimizer, SGD, Trigger
+from bigdl_tpu.resilience import reset_injector
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs(monkeypatch):
+    for var in ("BIGDL_OBS", "BIGDL_TRACE_DIR", "BIGDL_METRICS_DIR",
+                "BIGDL_FAULT_PLAN", "BIGDL_PROCESS_ID",
+                "BIGDL_GOODPUT_WINDOW", "BIGDL_WIRE_GBPS",
+                "BIGDL_STRAGGLER_FACTOR", "BIGDL_ELASTIC_ATTEMPT"):
+        monkeypatch.delenv(var, raising=False)
+    reset_injector()
+    obs.reset()
+    yield
+    obs.reset()
+    reset_injector()
+
+
+def _iv(kind, wall, dur, step=None, host=0, attempt=0):
+    rec = {"kind": kind, "wall": wall, "dur_s": dur,
+           "host": host, "pid": 1, "attempt": attempt}
+    if step is not None:
+        rec["step"] = step
+    return rec
+
+
+# --------------------------------------------------------- classification
+class TestClassifier:
+    def test_empty_records(self):
+        s = G.classify_records([])
+        assert s["total_s"] == 0.0
+        assert s["goodput_ratio"] is None
+
+    def test_plain_steps_and_gap(self):
+        recs = [_iv("step", 0.0, 1.0, step=1),
+                _iv("step", 1.5, 1.0, step=2)]  # 0.5s unaccounted
+        s = G.classify_records(recs)
+        assert s["productive_s"] == pytest.approx(2.0)
+        assert s["unknown_s"] == pytest.approx(0.5)
+        assert s["total_s"] == pytest.approx(2.5)
+        assert s["goodput_ratio"] == pytest.approx(0.8)
+
+    def test_overlap_badput_wins_over_step(self):
+        # the first step's observed time CONTAINS its compile — the
+        # overlap must be charged to compile exactly once
+        recs = [_iv("step", 0.0, 2.0, step=1),
+                _iv("compile", 0.0, 1.5)]
+        s = G.classify_records(recs)
+        assert s["seconds"]["compile"] == pytest.approx(1.5)
+        assert s["productive_s"] == pytest.approx(0.5)
+        assert s["total_s"] == pytest.approx(2.0)
+
+    def test_nesting_most_specific_wins(self):
+        # restore nested inside the startup window: the inner 1s is
+        # checkpoint_restore, the remaining 2s stays startup
+        recs = [_iv("startup", 0.0, 3.0),
+                _iv("checkpoint_restore", 1.0, 1.0)]
+        s = G.classify_records(recs)
+        assert s["seconds"]["checkpoint_restore"] == pytest.approx(1.0)
+        assert s["seconds"]["startup"] == pytest.approx(2.0)
+
+    def test_rework_counts_as_badput_not_productive(self):
+        recs = [_iv("rework", 0.0, 1.0, step=5),
+                _iv("rework", 1.0, 1.0, step=6),
+                _iv("step", 2.0, 1.0, step=7)]
+        s = G.classify_records(recs)
+        assert s["productive_s"] == pytest.approx(1.0)
+        assert s["badput_s"]["rework"] == pytest.approx(2.0)
+        assert s["rework_steps"] == 2
+        assert s["goodput_ratio"] == pytest.approx(1 / 3)
+
+    def test_markers_extend_span_without_duration(self):
+        recs = [{"kind": "attempt_start", "wall": 0.0},
+                _iv("step", 4.0, 1.0, step=1)]
+        s = G.classify_records(recs)
+        assert s["total_s"] == pytest.approx(5.0)
+        assert s["unknown_s"] == pytest.approx(4.0)
+
+
+class TestBottleneckClassification:
+    def test_labels(self):
+        assert G.classify_bottleneck(1.0, 0.6)["label"] == "input_bound"
+        assert G.classify_bottleneck(1.0, 0.0, comm_s=0.5)["label"] \
+            == "comm_bound"
+        assert G.classify_bottleneck(1.0, 0.0, host_s=0.5)["label"] \
+            == "host_bound"
+        assert G.classify_bottleneck(1.0, 0.05)["label"] == "compute_bound"
+        assert G.classify_bottleneck(0.0, 0.0)["label"] == "compute_bound"
+
+    def test_input_beats_comm(self):
+        # precedence mirrors the fix order: a starved pipeline masks
+        # the wire share
+        v = G.classify_bottleneck(1.0, 1.0, comm_s=0.9)
+        assert v["label"] == "input_bound"
+
+    def test_window_tick_publishes_gauge_and_event(self, tmp_path,
+                                                   monkeypatch):
+        monkeypatch.setenv("BIGDL_METRICS_DIR", str(tmp_path))
+        monkeypatch.setenv("BIGDL_TRACE_DIR", str(tmp_path))
+        monkeypatch.setenv("BIGDL_GOODPUT_WINDOW", "4")
+        obs.reset()
+        led = obs.get_ledger()
+        assert led.enabled
+        t = time.perf_counter()
+        for n in range(1, 5):
+            led.record("data_wait", t, 0.09, step=n)
+            led.record("step", t + 0.09, 0.01, step=n)
+            t += 0.1
+        gauge = obs.get_registry().gauge("bigdl_bottleneck",
+                                         labels=("class",))
+        assert gauge.labels(**{"class": "input_bound"}).value == 1.0
+        assert gauge.labels(**{"class": "compute_bound"}).value == 0.0
+        events = [r for r in obs.get_tracer().recent()
+                  if r.get("name") == "goodput.bottleneck"]
+        assert events and events[-1]["attrs"]["label"] == "input_bound"
+
+    def test_window_tick_comm_bound_via_wire_gbps(self, tmp_path,
+                                                  monkeypatch):
+        monkeypatch.setenv("BIGDL_METRICS_DIR", str(tmp_path))
+        monkeypatch.setenv("BIGDL_GOODPUT_WINDOW", "4")
+        # 1 GB/s assumed wire, 10 MB/step -> 10ms comm out of 20ms steps
+        monkeypatch.setenv("BIGDL_WIRE_GBPS", "1")
+        obs.reset()
+        led = obs.get_ledger()
+        led.set_comm_bytes_per_step(10e6)
+        t = time.perf_counter()
+        for n in range(1, 5):
+            led.record("step", t, 0.02, step=n)
+            t += 0.02
+        gauge = obs.get_registry().gauge("bigdl_bottleneck",
+                                         labels=("class",))
+        assert gauge.labels(**{"class": "comm_bound"}).value == 1.0
+
+
+# ------------------------------------------------------------- the ledger
+class TestLedger:
+    def test_disabled_returns_shared_null(self):
+        led = obs.get_ledger()
+        assert led is G.NULL_LEDGER
+        assert not led.enabled
+        # every surface is a no-op — nothing raises, nothing records
+        led.record("step", 0.0, 1.0, step=1)
+        led.note_host_seconds(0.1)
+        led.set_comm_bytes_per_step(10)
+        assert led.stamp_resume(3) == 0
+        assert led.flush() is None
+        assert led.records() == []
+
+    def test_first_step_emits_startup(self):
+        led = G.GoodputLedger(None)
+        t = time.perf_counter()
+        led.record("step", t, 0.01, step=1)
+        kinds = [r["kind"] for r in led.records()]
+        assert kinds == ["attempt_start", "startup", "step"]
+
+    def test_high_water_retags_rework(self):
+        led = G.GoodputLedger(None)
+        led.set_high_water(6)
+        t = time.perf_counter()
+        for n in (5, 6, 7):
+            led.record("step", t, 0.01, step=n)
+        kinds = [(r["kind"], r.get("step")) for r in led.records()
+                 if r["kind"] in ("step", "rework")]
+        assert kinds == [("rework", 5), ("rework", 6), ("step", 7)]
+
+    def test_flush_appends_and_reader_roundtrips(self, tmp_path):
+        led = G.GoodputLedger(str(tmp_path), host_id=2, attempt=1)
+        t = time.perf_counter()
+        led.record("step", t, 0.01, step=1)
+        path = led.flush()
+        assert os.path.basename(path).startswith("goodput.h2.")
+        assert path.endswith(".a1.jsonl")
+        n_lines = len(open(path).read().splitlines())
+        led.record("step", t, 0.01, step=2)
+        led.flush()
+        # append-only: the second flush writes ONLY the new records
+        assert len(open(path).read().splitlines()) == n_lines + 1
+        shards = G.read_ledger_shards(str(tmp_path))
+        assert len(shards) == 1
+        assert shards[0]["host"] == 2 and shards[0]["attempt"] == 1
+
+    def test_torn_tail_line_is_skipped(self, tmp_path):
+        # a crashed writer loses at most its torn last line
+        p = tmp_path / "goodput.h0.123.a0.jsonl"
+        good = json.dumps(_iv("step", 0.0, 1.0, step=3))
+        p.write_text(good + "\n" + '{"kind": "step", "wall": 1.0, "du')
+        shards = G.read_ledger_shards(str(tmp_path))
+        assert len(shards) == 1
+        assert len(shards[0]["records"]) == 1
+        assert G.prior_high_water(str(tmp_path)) == 3
+
+    def test_stamp_resume_scans_prior_attempt_shards(self, tmp_path):
+        # attempt 0 crashed at step 9 — its shard holds the high water
+        prev = G.GoodputLedger(str(tmp_path), attempt=0)
+        t = time.perf_counter()
+        for n in range(1, 10):
+            prev.record("step", t, 0.001, step=n)
+        prev.flush()
+        cur = G.GoodputLedger(str(tmp_path), attempt=1)
+        hw = cur.stamp_resume(restored_step=5)
+        assert hw == 9
+        for n in range(5, 12):
+            cur.record("step", t, 0.001, step=n)
+        kinds = {}
+        for r in cur.records():
+            if r["kind"] in ("step", "rework"):
+                kinds[r["step"]] = r["kind"]
+        assert all(kinds[n] == "rework" for n in range(5, 10))
+        assert kinds[10] == "step" and kinds[11] == "step"
+
+    def test_stamp_resume_uses_in_memory_max_for_inprocess_retry(self):
+        led = G.GoodputLedger(None)
+        t = time.perf_counter()
+        for n in range(1, 8):
+            led.record("step", t, 0.001, step=n)
+        assert led.stamp_resume(restored_step=4) == 7
+
+    def test_publish_sets_ratio_and_badput_deltas(self):
+        led = G.GoodputLedger(None)
+        led._epoch_wall = 0.0  # deterministic span
+        led._records[0]["wall"] = 0.0
+        led._append(_iv("compile", 0.0, 1.0))
+        led._append(_iv("step", 1.0, 3.0, step=1))
+        led._saw_step = True
+        reg = obs.get_registry()
+        led.publish(reg)
+        assert reg.gauge("bigdl_goodput_ratio").labels().value \
+            == pytest.approx(0.75)
+        badput = reg.counter("bigdl_badput_seconds_total",
+                             labels=("cause",))
+        assert badput.labels(cause="compile").value == pytest.approx(1.0)
+        # a second publish must not double-count (monotonic counter,
+        # delta semantics)
+        led.publish(reg)
+        assert badput.labels(cause="compile").value == pytest.approx(1.0)
+
+    def test_aggregate_across_attempts_and_hosts(self, tmp_path):
+        for host, attempt, steps in ((0, 0, range(1, 5)),
+                                     (0, 1, range(3, 9)),
+                                     (1, 1, range(3, 9))):
+            led = G.GoodputLedger(str(tmp_path), host_id=host,
+                                  attempt=attempt)
+            t = time.perf_counter()
+            if attempt == 1:
+                led.record("checkpoint_restore", t, 0.2)
+                t += 0.2  # restore finished before the first replay
+                led.set_high_water(4)
+            for n in steps:
+                led.record("step", t, 0.1, step=n)
+                t += 0.1
+            led.flush()
+        agg = G.aggregate_goodput(str(tmp_path))
+        assert agg["attempts"] == 3
+        assert agg["hosts"] == [0, 1]
+        assert agg["badput_s"]["checkpoint_restore"] > 0
+        assert agg["badput_s"]["rework"] > 0
+        assert agg["rework_steps"] == 4  # steps 3,4 on both hosts
+        assert 0 < agg["goodput_ratio"] < 1
+
+    def test_aggregate_empty_dir_is_none(self, tmp_path):
+        assert G.aggregate_goodput(str(tmp_path)) is None
+        assert G.aggregate_goodput(str(tmp_path / "absent")) is None
+
+    def test_unknown_cause_raises(self):
+        led = G.GoodputLedger(None)
+        with pytest.raises(ValueError):
+            led.record("coffee_break", 0.0, 1.0)
+
+
+# -------------------------------------------------- straggler detection
+def _host_shard(host, skew_s, slow=1.0, steps=10, pid=None):
+    pid = 100 + host if pid is None else pid
+    recs = [{"kind": "event", "name": "engine.init_barrier",
+             "wall_time": 1000.0 + skew_s, "host": host, "pid": pid,
+             "attrs": {}}]
+    t = 1000.5 + skew_s
+    for n in range(1, steps + 1):
+        recs.append({"kind": "span", "name": "computing",
+                     "wall_time": t, "dur_s": 0.02 * slow,
+                     "host": host, "pid": pid, "attrs": {"step": n}})
+        t += 0.05
+    return Shard(f"goodput_test.h{host}.events.jsonl", recs)
+
+
+class TestStragglerDetection:
+    def test_four_hosts_skewed_clocks_flag_the_slow_host(self):
+        # hosts 0-2 healthy, host 3 artificially 4x slower, with wall
+        # clocks skewed by up to 42s — skew shifts offsets, never
+        # durations, so only the genuinely slow host is flagged
+        skews = {0: 0.0, 1: 7.5, 2: -3.25, 3: 42.0}
+        shards = [_host_shard(h, s, slow=(4.0 if h == 3 else 1.0))
+                  for h, s in skews.items()]
+        res = detect_stragglers(shards, factor=1.5)
+        assert res["stragglers"] == [3]
+        assert res["hosts"][3]["p50"] == pytest.approx(0.08)
+        assert res["median_p50"] == pytest.approx(0.02)
+        # every one of host 3's steps exceeded the per-step median
+        assert res["hosts"][3]["straggler_steps"] == 10
+        assert res["hosts"][0]["straggler_steps"] == 0
+        # the labeled counter carries the per-host count
+        counter = obs.get_registry().counter(
+            "bigdl_straggler_steps_total", labels=("host",))
+        assert counter.labels(host=3).value == 10
+
+    def test_merge_carries_straggler_events_and_summary(self):
+        shards = [_host_shard(h, 0.0, slow=(3.0 if h == 1 else 1.0))
+                  for h in range(4)]
+        doc = merge_shards(shards)
+        assert doc["otherData"]["stragglers"]["stragglers"] == [1]
+        ev = [e for e in doc["traceEvents"] if e["name"] == "straggler"]
+        assert len(ev) == 1 and ev[0]["args"]["host"] == 1
+
+    def test_uniform_hosts_flag_nothing(self):
+        shards = [_host_shard(h, 0.0) for h in range(4)]
+        res = detect_stragglers(shards, factor=1.5)
+        assert res["stragglers"] == []
+        assert all(v["straggler_steps"] == 0
+                   for v in res["hosts"].values())
+
+    def test_factor_below_one_disables(self):
+        shards = [_host_shard(h, 0.0, slow=(9.0 if h == 1 else 1.0))
+                  for h in range(2)]
+        res = detect_stragglers(shards, factor=0.0)
+        assert res["stragglers"] == []
+
+    def test_single_host_never_flags(self):
+        res = detect_stragglers([_host_shard(0, 0.0, slow=5.0)],
+                                factor=1.5)
+        assert res["stragglers"] == []
+
+    def test_factor_env_knob(self, monkeypatch):
+        monkeypatch.setenv("BIGDL_STRAGGLER_FACTOR", "10.0")
+        shards = [_host_shard(h, 0.0, slow=(4.0 if h == 1 else 1.0))
+                  for h in range(4)]
+        res = detect_stragglers(shards)  # factor from config
+        assert res["factor"] == 10.0
+        assert res["stragglers"] == []
+
+
+# ------------------------------------------------- training integration
+def _toy(n=128, d=16, k=4, seed=0):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(d, k)
+    x = rng.randn(n, d).astype(np.float32)
+    y = (np.argmax(x @ w, axis=1) + 1).astype(np.float32)
+    return x, y
+
+
+def _model(d=16, k=4):
+    return Sequential().add(Linear(d, 32)).add(ReLU()) \
+        .add(Linear(32, k)).add(LogSoftMax())
+
+
+class TestTrainingIntegration:
+    def test_local_run_lands_ledger_shard_with_all_phases(
+            self, tmp_path, monkeypatch):
+        metrics_dir = tmp_path / "metrics"
+        monkeypatch.setenv("BIGDL_METRICS_DIR", str(metrics_dir))
+        obs.reset()
+        x, y = _toy()
+        opt = LocalOptimizer(_model(), (x, y), ClassNLLCriterion(),
+                             batch_size=32)
+        opt.set_optim_method(SGD(learningrate=0.1))
+        opt.set_end_when(Trigger.max_iteration(6))
+        opt.set_checkpoint(str(tmp_path / "ckpt"),
+                           Trigger.every_epoch())
+        opt.optimize()
+        agg = G.aggregate_goodput(str(metrics_dir))
+        assert agg is not None
+        assert agg["steps"] == 6
+        kinds = set()
+        for shard in G.read_ledger_shards(str(metrics_dir)):
+            kinds |= {r["kind"] for r in shard["records"]}
+        assert {"step", "data_wait", "compile", "checkpoint_save",
+                "startup"} <= kinds
+        assert 0 < agg["goodput_ratio"] <= 1
+        # the attempt-local metrics made it into the prom shard too
+        proms = [f for f in os.listdir(metrics_dir)
+                 if f.endswith(".prom")]
+        blob = "".join(open(metrics_dir / f).read() for f in proms)
+        assert "bigdl_goodput_ratio" in blob
+        assert 'bigdl_badput_seconds_total{cause="compile"}' in blob
+
+    def test_restore_records_checkpoint_restore_badput(
+            self, tmp_path, monkeypatch):
+        metrics_dir = tmp_path / "metrics"
+        monkeypatch.setenv("BIGDL_METRICS_DIR", str(metrics_dir))
+        obs.reset()
+        from bigdl_tpu.utils.serializer import (
+            load_latest_checkpoint,
+            save_checkpoint,
+        )
+
+        model = _model()
+        method = SGD(learningrate=0.1)
+        save_checkpoint(str(tmp_path / "checkpoint_1_1"), model, method,
+                        extra={"epoch": 1, "neval": 1})
+        load_latest_checkpoint(str(tmp_path), model, method)
+        kinds = [r["kind"] for r in obs.get_ledger().records()]
+        assert "checkpoint_save" in kinds
+        assert "checkpoint_restore" in kinds
+
+    def test_disabled_run_keeps_null_ledger_and_writes_nothing(
+            self, tmp_path):
+        x, y = _toy()
+        opt = LocalOptimizer(_model(), (x, y), ClassNLLCriterion(),
+                             batch_size=32)
+        opt.set_optim_method(SGD(learningrate=0.1))
+        opt.set_end_when(Trigger.max_iteration(3))
+        opt.optimize()
+        # the no-op contract: the loop bound the SHARED null ledger and
+        # no goodput shard (or any other obs artifact) hit the disk
+        assert opt._obs_ledger is G.NULL_LEDGER
+        assert obs.get_ledger() is G.NULL_LEDGER
+        assert not any(f.startswith("goodput.")
+                       for f in os.listdir(tmp_path))
+
+    def test_instrument_jit_records_compile_interval(self):
+        import jax
+
+        led = G.GoodputLedger(None)
+        led._saw_step = True  # no startup noise
+        from bigdl_tpu.obs.runtime import instrument_jit
+
+        f = instrument_jit(jax.jit(lambda a: a * 2), "f", ledger=led)
+        xs = np.ones((4,), np.float32)
+        f(xs)
+        f(xs)  # cached dispatch: no second compile interval
+        compiles = [r for r in led.records() if r["kind"] == "compile"]
+        assert len(compiles) == 1
+
+    def test_supervisor_backoff_is_recorded(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("BIGDL_METRICS_DIR", str(tmp_path))
+        obs.reset()
+        from bigdl_tpu.resilience.supervisor import Supervisor
+
+        rcs = iter([1, 0])  # one transient failure, then success
+
+        def runner(cmd, env):
+            return next(rcs)
+
+        sup = Supervisor(["true"], max_retries=3, runner=runner,
+                         sleep=lambda s: None)
+        assert sup.run() == 0
+        kinds = [r["kind"] for r in obs.get_ledger().records()]
+        assert "supervisor_backoff" in kinds
+
+
+# --------------------------------------------- kernel-fallback counter
+class TestKernelFallbackCounter:
+    def test_kxk_stride2_fallback_counts_site(self):
+        import jax.numpy as jnp
+
+        from bigdl_tpu.ops import conv_bn
+
+        conv_bn.FALLBACK_LOG.clear()
+        x = jnp.ones((1, 4, 8, 8), jnp.float32)
+        w = jnp.ones((8, 4, 3, 3), jnp.float32)
+        shift = jnp.zeros((8,), jnp.float32)
+        conv_bn.conv_bn_stats(x, w, shift, stride=2, pad=1)
+        assert conv_bn.FALLBACK_LOG, "stride-2 bail not in FALLBACK_LOG"
+        counter = obs.get_registry().counter(
+            "bigdl_kernel_fallbacks_total", labels=("site",))
+        assert counter.labels(site="conv_bn_k3s2").value >= 1
+
+
+# ------------------------------------------------------- report surface
+class TestReportGoodputSection:
+    def _run_and_report(self, tmp_path, monkeypatch):
+        trace_dir = tmp_path / "trace"
+        metrics_dir = tmp_path / "metrics"
+        monkeypatch.setenv("BIGDL_TRACE_DIR", str(trace_dir))
+        monkeypatch.setenv("BIGDL_METRICS_DIR", str(metrics_dir))
+        obs.reset()
+        x, y = _toy()
+        opt = LocalOptimizer(_model(), (x, y), ClassNLLCriterion(),
+                             batch_size=32)
+        opt.set_optim_method(SGD(learningrate=0.1))
+        opt.set_end_when(Trigger.max_iteration(6))
+        opt.optimize()
+        from bigdl_tpu.obs import report
+
+        rep = report.build_report(str(trace_dir), str(metrics_dir))
+        return rep, report.render_text(rep)
+
+    def test_report_carries_goodput_json_and_text(self, tmp_path,
+                                                  monkeypatch):
+        rep, text = self._run_and_report(tmp_path, monkeypatch)
+        gp = rep["goodput"]
+        assert gp is not None
+        assert 0 < gp["goodput_ratio"] <= 1
+        assert gp["steps"] == 6
+        assert gp["bottleneck"]["label"] in G.BOTTLENECKS
+        assert "-- goodput --" in text
+        assert "goodput ratio" in text
+        assert "bottleneck:" in text
+        # the report dict stays JSON-able for --json
+        json.dumps(rep, default=str)
+
+    def test_report_without_ledger_says_so(self, tmp_path):
+        trace_dir = tmp_path / "trace"
+        trace_dir.mkdir()
+        (trace_dir / "x.events.jsonl").write_text(json.dumps(
+            {"kind": "span", "name": "computing", "wall_time": 1.0,
+             "dur_s": 0.01, "host": 0, "pid": 1,
+             "attrs": {"step": 1}}) + "\n")
+        from bigdl_tpu.obs import report
+
+        rep = report.build_report(str(trace_dir))
+        assert rep["goodput"] is None
+        assert "(no goodput ledger" in report.render_text(rep)

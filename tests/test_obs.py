@@ -509,7 +509,7 @@ class TestTrainingInstrumentation:
         assert snap["compile"]["count"] >= 1
         # JSONL metric snapshot parses too
         jsonl = [f for f in os.listdir(metrics_dir)
-                 if f.endswith(".jsonl")]
+                 if f.startswith("metrics.") and f.endswith(".jsonl")]
         assert jsonl
         rec = json.loads(open(
             os.path.join(metrics_dir, jsonl[0])).readline())
