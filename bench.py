@@ -558,6 +558,22 @@ def _obs_runtime_extras():
         return None
 
 
+def _tuner_extras():
+    """Auto-tuner evidence for the BENCH JSON (ops/autotune.py): the
+    cache stats and every decision with its static baseline, measured
+    candidate times and never-lose gate verdict — how the A/B
+    comparisons (attn_ab/bn_ab "tuned" rows) are banked across
+    chip-unavailable rounds.  None when the tuner is off."""
+    try:
+        from bigdl_tpu.ops import autotune
+
+        if not autotune.enabled():
+            return None
+        return autotune.summary()
+    except Exception:
+        return None
+
+
 def _child_platform_setup(platform: str):
     """Pin jax to the requested platform and return the device (may
     raise / hang — the parent's probe + deadline own that risk)."""
@@ -888,6 +904,9 @@ def _run_child(platform: str):
 
     result["partial"] = False
     ex["obs_runtime"] = _obs_runtime_extras()
+    tuner = _tuner_extras()
+    if tuner is not None:
+        ex["tuner"] = tuner
     print(PARTIAL_MARK + json.dumps(result), flush=True)
 
 

@@ -126,6 +126,41 @@ class ObsConfig:
 
 
 @dataclasses.dataclass
+class TunerConfig:
+    """Fusion-aware kernel auto-tuner (``bigdl_tpu/ops/autotune.py``).
+
+    Off by default: dispatch then follows the hand-measured static
+    policies in ``ops/attention.py`` / ``ops/conv_bn.py`` exactly.
+    Enabled, every tunable call site (flash attention fwd/bwd, 1x1 and
+    kxk conv+BN) resolves its impl and block sizes from the cached
+    cost-model search instead.
+    """
+
+    # master switch [BIGDL_TUNER]
+    enabled: bool = False
+    # JSON decision store, keyed on (site, shape, dtype, platform);
+    # unset = in-memory only (decisions die with the process)
+    # [BIGDL_TUNER_CACHE]
+    cache_path: Optional[str] = None
+    # allow one-shot wall-clock measurement of candidates when inputs
+    # are concrete (never inside a jit trace — there the cost model
+    # decides); measured times are cached like any decision
+    # [BIGDL_TUNER_MEASURE]
+    measure: bool = False
+    # timed iterations per measured candidate [BIGDL_TUNER_MEASURE_ITERS]
+    measure_iters: int = 3
+
+    @classmethod
+    def from_env(cls) -> "TunerConfig":
+        return cls(
+            enabled=_env_bool("BIGDL_TUNER", False),
+            cache_path=_env_str("BIGDL_TUNER_CACHE", None),
+            measure=_env_bool("BIGDL_TUNER_MEASURE", False),
+            measure_iters=_env_int("BIGDL_TUNER_MEASURE_ITERS", 3),
+        )
+
+
+@dataclasses.dataclass
 class BigDLConfig:
     """Process-global framework configuration.
 
@@ -198,6 +233,11 @@ class BigDLConfig:
     #  BIGDL_OBS_RESERVOIR]
     obs: ObsConfig = dataclasses.field(default_factory=ObsConfig)
 
+    # --- kernel auto-tuner (ops/autotune.py) ----------------------------
+    # [BIGDL_TUNER / BIGDL_TUNER_CACHE / BIGDL_TUNER_MEASURE /
+    #  BIGDL_TUNER_MEASURE_ITERS]
+    tuner: TunerConfig = dataclasses.field(default_factory=TunerConfig)
+
     # --- benchmarking [BENCH_* kept for bench.py compat] ----------------
 
     @classmethod
@@ -225,6 +265,7 @@ class BigDLConfig:
             heartbeat_every=_env_int("BIGDL_HEARTBEAT_EVERY", 1),
             heartbeat_timeout=_env_float("BIGDL_HEARTBEAT_TIMEOUT", 60.0),
             obs=ObsConfig.from_env(),
+            tuner=TunerConfig.from_env(),
         )
 
     def describe(self) -> str:

@@ -1194,6 +1194,23 @@ class BatchNormalization(AbstractModule):
     updated as (1-momentum)*running + momentum*batch, running variance
     stored unbiased, batch normalisation uses biased variance; training
     mode uses batch stats, evaluate mode uses running stats.
+
+    Momentum-warmup caveat (single-pass shifted statistics): training
+    stats are computed in one pass shifted by the RUNNING mean, and
+    the r05 A/B hunt removed every in-step rescue for a stale shift
+    (each was measured slower on chip — see the ``apply`` comment and
+    scripts/bn_ab.py).  So for roughly the first 1/momentum training
+    steps (~10 at the default 0.1), while ``running_mean`` is still
+    cold (zeros) on heavily un-normalized input, the batch variance
+    ``m2 - d^2`` cancels digits and the normalized output can be
+    mis-scaled.  The running mean converges geometrically at the
+    momentum rate and the variance self-heals within
+    ``~log(d^2/var)/(2*momentum)`` steps; the batch MEAN is exact at
+    any shift, so only the scale (not the centering) wobbles during
+    warmup.  If the input distribution is pathological (|E[x]| more
+    than ~64 batch-stds from 0), normalize the data or warm the
+    running stats instead of expecting the first steps' outputs to be
+    unit-variance.
     """
 
     param_names = ("weight", "bias")

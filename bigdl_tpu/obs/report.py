@@ -18,7 +18,11 @@ snapshots when present) and renders what a postmortem asks first:
   ledger — goodput ratio, badput seconds by cause (compile,
   checkpoints, data waits, startup, supervisor backoff, restart
   rework), the window bottleneck classification, and cross-host
-  straggler flags.
+  straggler flags;
+* kernel auto-tuner (ops/autotune.py): dispatch decisions by site and
+  chosen impl, cache hit/miss/measurement traffic, and the recent
+  ``tuner.decision`` events with their provenance (cache / model /
+  measured / corrupt_cache).
 
 ``--json`` emits the machine-readable report instead of text — the
 same dict ``build_report`` returns, so CI and ``obs/regress.py``
@@ -90,6 +94,7 @@ def build_report(trace_dir: str, metrics_dir: Optional[str] = None) -> dict:
     compile_events: list = []
     nonfinite_events: list = []
     anomaly_events: list = []
+    tuner_events: list = []
     for sh in shards:
         key = f"host{sh.host}/pid{sh.pid}"
         h = hosts.setdefault(key, {
@@ -123,6 +128,10 @@ def build_report(trace_dir: str, metrics_dir: Optional[str] = None) -> dict:
                     a = dict(rec.get("attrs") or {})
                     a["host"] = sh.host
                     anomaly_events.append(a)
+                elif name == "tuner.decision":
+                    a = dict(rec.get("attrs") or {})
+                    a["host"] = sh.host
+                    tuner_events.append(a)
 
     per_host = {}
     for key, h in hosts.items():
@@ -203,6 +212,26 @@ def build_report(trace_dir: str, metrics_dir: Optional[str] = None) -> dict:
                             "input_fraction": derived["input_fraction"]}
     stragglers = detect_stragglers(shards)
 
+    # ---- kernel auto-tuner (ops/autotune.py) -------------------------
+    tuner_decisions: dict = {}
+    for labels, s, _host in _metric_samples(
+            snaps, "bigdl_tuner_decisions_total"):
+        key = f"{labels.get('site', '?')}:{labels.get('impl', '?')}"
+        tuner_decisions[key] = tuner_decisions.get(key, 0.0) + float(
+            s.get("value", 0.0))
+
+    def _tuner_count(metric):
+        return sum(float(s.get("value", 0.0))
+                   for _l, s, _h in _metric_samples(snaps, metric))
+
+    tuner = {
+        "decisions_total": tuner_decisions,
+        "cache_hits": _tuner_count("bigdl_tuner_cache_hits_total"),
+        "cache_misses": _tuner_count("bigdl_tuner_cache_misses_total"),
+        "measurements": _tuner_count("bigdl_tuner_measurements_total"),
+        "events": tuner_events,
+    }
+
     # per-device HBM peaks (bigdl_hbm_peak_bytes, max across snapshots)
     hbm: dict = {}
     for labels, s, _host in _metric_samples(snaps, "bigdl_hbm_peak_bytes"):
@@ -240,6 +269,7 @@ def build_report(trace_dir: str, metrics_dir: Optional[str] = None) -> dict:
         "goodput": gp,
         "stragglers": stragglers,
         "hbm_peak_bytes": hbm,
+        "tuner": tuner,
     }
 
 
@@ -384,6 +414,23 @@ def render_text(rep: dict) -> str:
                 f"  host{ev.get('host')} step {ev.get('step')}: "
                 f"{ev.get('kind')} {float(ev.get('value', 0)):.4g} vs "
                 f"median {float(ev.get('median', 0)):.4g}")
+    lines.append("")
+    lines.append("-- kernel auto-tuner --")
+    tn = rep.get("tuner") or {}
+    if not (tn.get("decisions_total") or tn.get("events")):
+        lines.append("  (no tuner activity — set BIGDL_TUNER=1)")
+    else:
+        for key, n in sorted(tn.get("decisions_total", {}).items()):
+            lines.append(f"  {key:28s} {int(n)} decision(s)")
+        lines.append(
+            f"  cache: {int(tn.get('cache_hits', 0))} hit(s), "
+            f"{int(tn.get('cache_misses', 0))} miss(es), "
+            f"{int(tn.get('measurements', 0))} wall-clock probe(s)")
+        for ev in tn.get("events", [])[:8]:
+            lines.append(
+                f"  host{ev.get('host')} {ev.get('site')}: "
+                f"{ev.get('label')} via {ev.get('source')} "
+                f"(static {ev.get('static')}) [{ev.get('key')}]")
     lines.append("")
     lines.append("-- slowest spans per host --")
     for key, h in sorted(rep["hosts"].items()):

@@ -13,10 +13,12 @@ on TPU backends.  Numerics of (a) and (b) are locked together by tests
 play for its native kernels (SURVEY.md §4.3).
 """
 
+from bigdl_tpu.ops import autotune
 from bigdl_tpu.ops.attention import dot_product_attention, flash_attention
 from bigdl_tpu.ops.quantized_matmul import int8_matmul, quantize_per_channel
 
 __all__ = [
+    "autotune",
     "dot_product_attention",
     "flash_attention",
     "int8_matmul",

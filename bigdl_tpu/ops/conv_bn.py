@@ -25,9 +25,15 @@ Two kernels:
   feeding one deep (block_o, k*k*C) @ (k*k*C, Ho*Wp) MXU dot; pad
   lanes are masked from the stats and sliced off by the caller.
   Pure-2-D because the 2026-07 Mosaic rejects 3-D vector shape casts
-  (the r04 kernel's reshape died in infer-vector-layout); the same
-  constraint removes the stride-2 reshape-parity trick, so stride-2
-  sites take the XLA reference path.
+  (the r04 kernel's reshape died in infer-vector-layout).  Stride-2
+  sites (the three ResNet stage-transition 3x3s) reach the SAME
+  kernel through a space-to-depth rewrite outside the kernel
+  (:func:`_s2d_rewrite`): the padded image's 2x2 phase blocks become
+  4C channels and the kxk stride-2 conv becomes an equivalent
+  (k//2+1)x(k//2+1) stride-1 conv with zero-scattered weights — plain
+  XLA reshapes/transposes feeding the lane-shift kernel, no lane
+  gathers (which this Mosaic has no layout for).  Strides > 2 still
+  take the XLA reference path.
 
 Backward is analytic (jax.custom_vjp): with cotangents (gy, gs1, gs2),
   dy_eff = gy + gs1[c] + 2 (y - shift) gs2[c]
@@ -71,8 +77,8 @@ def _note_fallback(reason, x_shape, w_shape, stride, pad):
     FALLBACK_LOG.append(rec)
     _log.warning("conv_bn_stats fell back to XLA: %s", rec)
     # production visibility (round-5 ADVICE): a fused model silently
-    # mixing Pallas and XLA dispatch — e.g. the three kxk stride-2
-    # ResNet stage transitions — must show up in the metrics scrape and
+    # mixing Pallas and XLA dispatch — e.g. a VMEM-infeasible megapixel
+    # site, or a stride-3 conv — must show up in the metrics scrape and
     # the trace, not only in the in-process test-harness list.  Fires
     # at trace time (shapes are static), so once per compile, and is
     # guarded: telemetry must never sink a kernel dispatch.
@@ -155,10 +161,13 @@ def _fwd_kernel_1x1(x_ref, w_ref, shift_ref, y_ref, s1_ref, s2_ref, *,
     y_ref[0] = y.astype(y_ref.dtype)
 
 
-def _tiles_1x1(o: int, c: int, hw: int, xbytes: int):
+def _tiles_1x1(o: int, c: int, hw: int, xbytes: int,
+               block_o_hint: int = 0):
     """Pick (block_o, block_hw) fitting the VMEM budget.  block_o is a
-    multiple of 8 (sublane), block_hw of 128 (lane)."""
-    block_o = min(256, _round_up(o, 8))
+    multiple of 8 (sublane), block_hw of 128 (lane).
+    ``block_o_hint`` caps the O-tile (the auto-tuner's knob)."""
+    block_o = min(block_o_hint or 256, _round_up(o, 8))
+    block_o = max(8, block_o - block_o % 8)
     block_hw = _round_up(hw, 128)
     while True:
         # 2x input tiles (double buffering) + f32 compute tile + output
@@ -174,7 +183,7 @@ def _tiles_1x1(o: int, c: int, hw: int, xbytes: int):
             return block_o, block_hw  # smallest tile; let it ride
 
 
-def _fwd_1x1(x, w, shift, interpret):
+def _fwd_1x1(x, w, shift, interpret, block_o_hint: int = 0):
     """x (N, C, H, W), w (O, C), shift (O,) f32 ->
     (y (N, O, H, W), s1 (O,) f32, s2 (O,) f32)."""
     from jax.experimental import pallas as pl
@@ -182,7 +191,8 @@ def _fwd_1x1(x, w, shift, interpret):
     n, c, h, wd = x.shape
     o = w.shape[0]
     hw = h * wd
-    block_o, block_hw = _tiles_1x1(o, c, hw, x.dtype.itemsize)
+    block_o, block_hw = _tiles_1x1(o, c, hw, x.dtype.itemsize,
+                                   block_o_hint)
     o_pad = _round_up(o, block_o)
     hw_pad = _round_up(hw, block_hw)
     x2 = x.reshape(n, c, hw)
@@ -284,24 +294,37 @@ def _fwd_kernel_kxk(x_ref, w_ref, shift_ref, y_ref, s1_ref, s2_ref,
 
 
 def _kxk_plan(c: int, h: int, wd: int, o: int, k: int, stride: int,
-              pad: int, xbytes: int):
+              pad: int, xbytes: int, block_o_hint: int = 0):
     """Static kxk feasibility + tile plan.  Returns
     (block_o, ho, wo, reason) — ``reason`` is None when the Pallas
     kernel applies, else a human-readable bail cause (the kernel then
-    uses the XLA reference path)."""
+    uses the XLA reference path).  ``block_o_hint`` caps the O-tile
+    search (the auto-tuner's knob; 0 = budget-derived)."""
     hp, wp_ = h + 2 * pad, wd + 2 * pad
     ho = (hp - k) // stride + 1
     wo = (wp_ - k) // stride + 1
 
+    if stride == 2:
+        # space-to-depth rewrite (_s2d_rewrite): the stride-2 conv is
+        # exactly a (k//2+1)x(k//2+1) stride-1 conv over the 4C-channel
+        # phase image, so feasibility is the REWRITTEN problem's.  The
+        # rewritten output extent equals the original's (ho, wo).
+        kb = k // 2 + 1
+        hb, wb = ho + kb - 1, wo + kb - 1
+        block_o, _, _, reason = _kxk_plan(4 * c, hb, wb, o, kb, 1, 0,
+                                          xbytes, block_o_hint)
+        if reason is not None:
+            reason = f"s2d: {reason}"
+        return block_o, ho, wo, reason
     # the pure-2-D kernel maps tap (dy, dx) to a lane-shifted slice of
-    # the flattened padded image, which only exists for stride 1; the
-    # r04 stride-2 reshape-parity trick used 3-D shape casts the
-    # 2026-07 Mosaic rejects ("infer-vector-layout: unsupported shape
-    # cast"), so stride != 1 now takes the XLA reference
+    # the flattened padded image, which only exists for stride 1
+    # (stride 2 is rewritten to stride 1 above; higher strides would
+    # need lane gathers the 2026-07 Mosaic has no layout for)
     if stride != 1:
         return None, ho, wo, f"stride {stride} != 1 (lane-shift kernel)"
 
-    block_o = min(256, _round_up(o, 8))
+    block_o = min(block_o_hint or 256, _round_up(o, 8))
+    block_o = max(8, block_o - block_o % 8)
     while block_o > 8:
         # flat padded image block (grid-varying: double-buffered) +
         # tap-concat im2col at padded width + weights + f32 acc/output
@@ -318,7 +341,39 @@ def _kxk_plan(c: int, h: int, wd: int, o: int, k: int, stride: int,
     return block_o, ho, wo, None
 
 
-def _fwd_kxk(x, w, shift, stride, pad, interpret):
+def _s2d_rewrite(x, w, pad):
+    """Space-to-depth rewrite of a kxk STRIDE-2 conv as an exactly
+    equivalent stride-1 conv the lane-shift kernel can run.
+
+    The padded image's 2x2 phase blocks become 4C channels
+    (channel order ``(py*2 + px) * C + c``) and tap (dy, dx) of the
+    original kernel lands at block offset (dy//2, dx//2), phase
+    (dy%2, dx%2) of a (k//2+1)^2 block-space kernel — every other
+    entry of the scattered weight is zero.  Output (r, j) of the
+    rewritten conv reads padded pixels (2r+dy, 2j+dx): the stride-2
+    conv, value for value, BN statistics included.  All plain XLA
+    reshapes/transposes outside the kernel; the backward never sees
+    any of it (the custom vjp differentiates the original conv)."""
+    n, c, h, wd = x.shape
+    o, _, k, _ = w.shape
+    kb = k // 2 + 1
+    ho = (h + 2 * pad - k) // 2 + 1
+    wo = (wd + 2 * pad - k) // 2 + 1
+    hb, wb = ho + kb - 1, wo + kb - 1
+    # pad to the exact 2*hb x 2*wb block footprint the rewrite reads
+    xp = jnp.pad(x, ((0, 0), (0, 0), (pad, 2 * hb - h - pad),
+                     (pad, 2 * wb - wd - pad)))
+    xs = xp.reshape(n, c, hb, 2, wb, 2).transpose(0, 3, 5, 1, 2, 4) \
+        .reshape(n, 4 * c, hb, wb)
+    w2 = jnp.zeros((o, 2, 2, c, kb, kb), w.dtype)
+    for dy in range(k):
+        for dx in range(k):
+            w2 = w2.at[:, dy % 2, dx % 2, :, dy // 2, dx // 2] \
+                .set(w[:, :, dy, dx])
+    return xs, w2.reshape(o, 4 * c, kb, kb)
+
+
+def _fwd_kxk(x, w, shift, stride, pad, interpret, block_o_hint: int = 0):
     """x (N,C,H,W), w (O,C,k,k), shift (O,) f32 ->
     (y (N,O,Ho,Wo), s1, s2).  Torch-style symmetric padding."""
     from jax.experimental import pallas as pl
@@ -329,10 +384,13 @@ def _fwd_kxk(x, w, shift, stride, pad, interpret):
     hp, wp_ = h + 2 * pad, wd + 2 * pad
 
     block_o, ho, wo, reason = _kxk_plan(c, h, wd, o, k, stride, pad,
-                                        x.dtype.itemsize)
+                                        x.dtype.itemsize, block_o_hint)
     if reason is not None:
         _note_fallback(reason, x.shape, w.shape, stride, pad)
         return _reference(x, w, shift, stride, pad)
+    if stride == 2:
+        xs, w2 = _s2d_rewrite(x, w, pad)
+        return _fwd_kxk(xs, w2, shift, 1, 0, interpret, block_o_hint)
     o_pad = _round_up(o, block_o)
 
     # flattened spatially-padded image, plus k-1 trailing lanes so the
@@ -382,22 +440,28 @@ def _fwd_kxk(x, w, shift, stride, pad, interpret):
 # --------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _conv_bn_stats_vjp(x, w, shift, stride, pad, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _conv_bn_stats_vjp(x, w, shift, stride, pad, interpret, impl,
+                       block_o):
+    # impl "xla" is a TUNER decision (measured/modelled cheaper for
+    # this shape), not a feasibility bail — no fallback note
+    if impl == "xla":
+        return _reference(x, w, shift, stride, pad)
     if w.shape[2] == 1 and w.shape[3] == 1 and pad == 0:
         if stride != 1:
             x = x[:, :, ::stride, ::stride]
-        return _fwd_1x1(x, w[:, :, 0, 0], shift, interpret)
-    return _fwd_kxk(x, w, shift, stride, pad, interpret)
+        return _fwd_1x1(x, w[:, :, 0, 0], shift, interpret, block_o)
+    return _fwd_kxk(x, w, shift, stride, pad, interpret, block_o)
 
 
-def _fwd_rule(x, w, shift, stride, pad, interpret):
-    out = _conv_bn_stats_vjp(x, w, shift, stride, pad, interpret)
+def _fwd_rule(x, w, shift, stride, pad, interpret, impl, block_o):
+    out = _conv_bn_stats_vjp(x, w, shift, stride, pad, interpret, impl,
+                             block_o)
     y, s1, _ = out
     return out, (x, w, y, shift, s1)
 
 
-def _bwd_rule(stride, pad, interpret, res, cts):
+def _bwd_rule(stride, pad, interpret, impl, block_o, res, cts):
     x, w, y, shift, s1 = res
     gy, gs1, gs2 = cts
     yc = y.astype(jnp.float32) - shift[None, :, None, None]
@@ -429,14 +493,22 @@ _conv_bn_stats_vjp.defvjp(_fwd_rule, _bwd_rule)
 
 
 def conv_bn_stats(x, w, shift, *, stride: int = 1, pad: int = 0,
-                  interpret: bool = False):
+                  interpret: bool = False, impl: str = "auto",
+                  block_o: int = 0):
     """Fused conv + centered BN statistics.
 
     x (N, C, H, W); w (O, C, kh, kw) or (O, C) for 1x1; shift (O,) f32
     — typically the BN running mean.  Returns (y, s1, s2) with
     s1 = sum(y - shift) and s2 = sum((y - shift)^2) per channel in f32.
     Supports k=1 (stride subsampling outside the kernel) and odd k with
-    symmetric torch-style padding at stride 1 or 2.
+    symmetric torch-style padding at stride 1 or 2 (stride 2 via the
+    space-to-depth rewrite).
+
+    ``impl``: "auto" (Pallas when feasible; when the auto-tuner is on
+    — ``BIGDL_TUNER=1``, ops/autotune.py — the cached per-shape search
+    decides instead), "pallas" (static dispatch, no tuner), or "xla"
+    (reference).  ``block_o`` caps the O-tile (0 = budget-derived) —
+    the tuner's knob.
     """
     if w.ndim == 2:
         w = w[:, :, None, None]
@@ -446,7 +518,19 @@ def conv_bn_stats(x, w, shift, *, stride: int = 1, pad: int = 0,
     # whose parallel grid would race the s1/s2 accumulation) runs the
     # interpreter
     interpret = interpret or jax.default_backend() != "tpu"
-    return _conv_bn_stats_vjp(x, w, shift, stride, pad, interpret)
+    if impl == "auto":
+        impl = "pallas"
+        from bigdl_tpu.ops import autotune
+
+        if autotune.enabled():
+            decision = autotune.decide_conv_bn(
+                x.shape, w.shape, x.dtype, stride=stride, pad=pad,
+                arrays=(x, w, shift), interpret=interpret)
+            if decision is not None:
+                impl = decision["impl"]
+                block_o = block_o or int(decision.get("block_o") or 0)
+    return _conv_bn_stats_vjp(x, w, shift, stride, pad, interpret,
+                              impl, int(block_o))
 
 
 def conv1x1_bn_stats(x, w, shift, *, stride: int = 1,
@@ -461,13 +545,17 @@ def kernel_path(x_shape, w_shape, *, stride: int = 1, pad: int = 0,
     """Which path ``conv_bn_stats`` takes for these STATIC shapes —
     ``"pallas_1x1"``, ``"pallas_kxk"``, or ``"xla:<reason>"``.
 
-    Mirrors the exact dispatch in ``_conv_bn_stats_vjp`` / ``_kxk_plan``
-    without tracing anything, so tests can pin every production call
-    site to the Pallas path (VERDICT r4 item 3).  ``itemsize`` is the
-    activation dtype's byte width (2 = bf16, the training compute
-    dtype).  Decisions are batch-independent: the kxk grid iterates
-    samples and the 1x1 kernel tiles (O, HW), so a shape proven at one
-    batch holds at any batch.
+    Mirrors the exact STATIC dispatch in ``_conv_bn_stats_vjp`` /
+    ``_kxk_plan`` (stride-2 kxk sites route through the space-to-depth
+    rewrite and report ``pallas_kxk`` when the rewritten problem fits
+    VMEM) without tracing anything, so tests can pin every production
+    call site to the Pallas path (VERDICT r4 item 3).  A
+    tuner-enabled run may override per shape — this reports the
+    tuner-OFF dispatch.  ``itemsize`` is the activation dtype's byte
+    width (2 = bf16, the training compute dtype).  Decisions are
+    batch-independent: the kxk grid iterates samples and the 1x1
+    kernel tiles (O, HW), so a shape proven at one batch holds at any
+    batch.
     """
     n, c, h, wd = (int(s) for s in x_shape)
     w_shape = tuple(int(s) for s in w_shape)

@@ -7,6 +7,16 @@ This script reproduces those numbers — and re-evaluates them now that
 the flash path has a true blockwise backward — one subprocess per
 (T, impl) so a hung remote compile costs only that cell.
 
+``tuned`` is the auto-tuner row (ops/autotune.py): the child enables
+``BIGDL_TUNER``, pre-warms the cell's attention shape with concrete
+arrays (so candidates are wall-clock measured, fwd+bwd), and runs the
+model with ``attn_impl="auto"`` — dispatch then comes from the cached
+decision.  All cells share one cache file, and the tuner's
+never-lose gate means the tuned row can only match or beat the best
+static row; the decisions ride the output line (and bench.py's
+``extras.tuner``) so the evidence is banked across
+chip-unavailable rounds.
+
 Usage: python scripts/attn_ab.py [impl ...]   (default: pallas lax)
 Cells: (T=512,B=16) (T=1024,B=8) (T=2048,B=4) (T=4096,B=2).
 """
@@ -22,7 +32,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 CELLS = [(512, 16), (1024, 8), (2048, 4), (4096, 2)]
 IMPLS = sys.argv[1:] or ["pallas", "lax"]
-_VALID = {"auto", "lax", "pallas", "pallas_interpret"}
+_VALID = {"auto", "lax", "pallas", "pallas_interpret", "tuned"}
 _bad = [i for i in IMPLS if i not in _VALID]
 if _bad:
     # dot_product_attention silently routes unknown impl strings to the
@@ -40,8 +50,26 @@ def _run_cell(t: int, b: int, impl: str):
     jax.config.update("jax_platforms", "axon")
     from bigdl_tpu.models.transformer import build_transformer_lm
 
+    attn_impl = impl
+    tuner_info = None
+    if impl == "tuned":
+        os.environ.setdefault("BIGDL_TUNER", "1")
+        os.environ.setdefault("BIGDL_TUNER_MEASURE", "1")
+        os.environ.setdefault(
+            "BIGDL_TUNER_CACHE",
+            os.environ.get("ATTN_AB_TUNER_CACHE",
+                           "/tmp/bigdl_attn_ab_tuner.json"))
+        from bigdl_tpu.ops import autotune
+
+        # pre-warm the cell's shape with concrete arrays so candidates
+        # are wall-clock measured; the in-model trace then hits the
+        # cache (measurement never runs inside a jit trace)
+        autotune.prewarm_attention(b, 8, t, t, 64, causal=True)
+        attn_impl = "auto"
+        tuner_info = [f"{d['label']}<-{d['source']}"
+                      for d in autotune.summary()["decisions"]]
     model = build_transformer_lm(8192, dim=512, n_head=8, n_layer=8,
-                                 max_len=t, attn_impl=impl)
+                                 max_len=t, attn_impl=attn_impl)
     rs = np.random.RandomState(0)
     x = jnp.asarray(rs.randint(0, 8192, (b, t)).astype(np.float32))
     params, state = model.params(), model.state()
@@ -71,11 +99,14 @@ def _run_cell(t: int, b: int, impl: str):
     t0 = time.perf_counter()
     float(run(params, x))
     dt = time.perf_counter() - t0
-    print(json.dumps({
+    rec = {
         "T": t, "batch": b, "impl": impl,
         "tokens_per_sec": round(b * t * 10 / dt, 1),
         "step_ms": round(dt / 10 * 1e3, 2),
-    }), flush=True)
+    }
+    if tuner_info is not None:
+        rec["tuner"] = tuner_info
+    print(json.dumps(rec), flush=True)
 
 
 def main():
@@ -84,6 +115,10 @@ def main():
         t, b, impl = child.split(",")
         _run_cell(int(t), int(b), impl)
         return
+    if "tuned" in IMPLS and "ATTN_AB_TUNER_CACHE" not in os.environ:
+        # one shared decision store across all tuned cells of this run
+        os.environ["ATTN_AB_TUNER_CACHE"] = \
+            f"/tmp/bigdl_attn_ab_tuner.{os.getpid()}.json"
     for t, b in CELLS:
         for impl in IMPLS:
             t0 = time.time()

@@ -18,6 +18,12 @@ costs only that variant:
             (data-derived shift, stop_gradient)
   pix     — single-pass shifted by one pixel per channel (x[0,:,0,0])
   twopass — naive two-pass f32 stats (the baseline's formulation)
+  fused   — fused conv+BN Pallas kernels (nn/fused.py), static dispatch
+  tuned   — fused conv+BN with the kernel auto-tuner on
+            (ops/autotune.py, BIGDL_TUNER=1): per-site impl/block-o
+            from the cached cost-model search; the fused-vs-tuned pair
+            is the tuner's A/B, and the never-lose gate means tuned
+            can only match or beat fused per shape
 
 Measured 2026-07-31 on the relay's TPU v5 lite, b128 ms/step: nocond
 50.1-53.5, pix 53.4, twopass 57.8, s0 64.2-64.5, where 85.5, cond OOM
@@ -180,7 +186,16 @@ def _run_one(variant: str):
     import jax
 
     jax.config.update("jax_platforms", "axon")
-    _patch_bn(variant)
+    fuse = variant in ("fused", "tuned")
+    tuner_info = None
+    if variant == "tuned":
+        os.environ.setdefault("BIGDL_TUNER", "1")
+        os.environ.setdefault(
+            "BIGDL_TUNER_CACHE",
+            os.environ.get("BN_AB_TUNER_CACHE",
+                           "/tmp/bigdl_bn_ab_tuner.json"))
+    if not fuse:
+        _patch_bn(variant)
     import bench as B
 
     rs = np.random.RandomState(0)
@@ -188,13 +203,22 @@ def _run_one(variant: str):
     y = (rs.randint(0, 1000, BATCH) + 1).astype(np.float32)
     t0 = time.time()
     ips, step_s = B._bench_framework(x, y, BATCH, ITERS,
-                                     compute_dtype="bfloat16")
-    print(json.dumps({
+                                     compute_dtype="bfloat16",
+                                     fuse=fuse)
+    if variant == "tuned":
+        from bigdl_tpu.ops import autotune
+
+        tuner_info = [f"{d['site']}:{d['label']}<-{d['source']}"
+                      for d in autotune.summary()["decisions"]]
+    rec = {
         "variant": variant, "batch": BATCH,
         "images_per_sec": round(ips, 1),
         "step_ms": round(step_s * 1e3, 2),
         "wall_s": round(time.time() - t0, 1),
-    }), flush=True)
+    }
+    if tuner_info is not None:
+        rec["tuner"] = tuner_info
+    print(json.dumps(rec), flush=True)
 
 
 def main():

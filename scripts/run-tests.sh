@@ -28,6 +28,16 @@
 #                                                # a cross-attempt goodput
 #                                                # ratio with nonzero rework
 #                                                # badput (no pytest)
+#   scripts/run-tests.sh --tune                  # auto-tuner smoke: tunes one
+#                                                # attention and one conv+BN
+#                                                # shape on CPU (interpret
+#                                                # mode, measured candidates),
+#                                                # asserts a persisted JSON
+#                                                # cache, re-runs with zero
+#                                                # re-measurements, and checks
+#                                                # the report's kernel
+#                                                # auto-tuner section
+#                                                # (no pytest)
 #   scripts/run-tests.sh --goodput               # goodput smoke: a 2-host
 #                                                # traced run with a
 #                                                # synthetically starved input
@@ -61,6 +71,9 @@ elif [[ "${1:-}" == "--elastic" ]]; then
 elif [[ "${1:-}" == "--goodput" ]]; then
   shift
   exec python scripts/goodput_smoke.py "$@"
+elif [[ "${1:-}" == "--tune" ]]; then
+  shift
+  exec python scripts/tune_smoke.py "$@"
 fi
 
 exec python -m pytest tests/ -q "${MARKER[@]}" "$@"

@@ -28,6 +28,15 @@ whether the run was input/compute/comm/host bound — see MIGRATION.md
 "Goodput & bottleneck attribution" for the knobs and
 ``scripts/run-tests.sh --goodput`` for the end-to-end smoke.
 
+A run that compiles and is healthy but SLOWER than expected on its hot
+kernels (attention, fused conv+BN) is a dispatch question before a
+compiler one: enable the auto-tuner (`BIGDL_TUNER=1
+BIGDL_TUNER_CACHE=/path/tuner.json`, add `BIGDL_TUNER_MEASURE=1` on a
+real chip) and read the report's "kernel auto-tuner" section — which
+impl/blocks each site chose, from cache or measurement, and how far
+the static policy was off — see MIGRATION.md "Kernel auto-tuning" and
+``scripts/run-tests.sh --tune`` for the end-to-end smoke.
+
 A run that keeps DYING (preemption, host loss) rather than failing to
 compile belongs under the restart supervisor instead: ``python -m
 bigdl_tpu.resilience.supervisor -- <train cmd>`` resumes preempted

@@ -6,9 +6,10 @@ invisible in the headline benchmark.  These tests
 
 * capture the REAL call sites by tracing the fused ResNet-50 forward at
   the bench operating point (batch 128, 224px, bf16) and assert
-  ``kernel_path`` routes every one of them (36 x 1x1 + 16 x 3x3; the
-  7x7 stem deliberately stays on XLA, see nn/fused.py) to a Pallas
-  kernel, and
+  ``kernel_path`` routes every one of them (36 x 1x1 + 16 x 3x3,
+  INCLUDING the three stride-2 stage transitions via the
+  space-to-depth rewrite; the 7x7 stem deliberately stays on XLA, see
+  nn/fused.py) to a Pallas kernel, and
 * prove every bail is recorded in ``FALLBACK_LOG`` with its shape and
   cause, so a regression is observable, not silent.
 """
@@ -70,18 +71,15 @@ def test_all_resnet50_fused_sites_take_pallas(monkeypatch):
         path = conv_bn.kernel_path(xs, ws, stride=stride, pad=pad,
                                    itemsize=itemsize)
         if stride == 2 and len(ws) == 4 and ws[2] == 3:
-            # the 3 stage-transition 3x3s: the pure-2-D lane-shift
-            # kernel is stride-1 only (2026-07 Mosaic rejects the old
-            # reshape-parity trick), so these take XLA BY DESIGN — the
-            # assertion documents the known, bounded exception
+            # the 3 stage-transition 3x3s now reach the lane-shift
+            # kernel through the space-to-depth rewrite — the r05
+            # "stride-2 takes XLA by design" exception is CLOSED
             stride2.append(path)
-            continue
         if not path.startswith("pallas"):
             bad.append((xs, ws, stride, pad, path))
     assert not bad, f"fused call sites silently on XLA: {bad}"
     assert len(stride2) == 3
-    assert all(p == "xla:stride 2 != 1 (lane-shift kernel)"
-               for p in stride2), stride2
+    assert all(p == "pallas_kxk" for p in stride2), stride2
 
 
 def test_kernel_path_matches_runtime_dispatch():
@@ -108,8 +106,15 @@ def test_kernel_path_matches_runtime_dispatch():
 def test_kernel_path_rejects_unsupported_stride():
     assert conv_bn.kernel_path((2, 8, 16, 16), (8, 8, 3, 3), stride=3,
                                pad=1) == "xla:stride 3 != 1 (lane-shift kernel)"
+    # stride 2 is no longer a bail: the space-to-depth rewrite feeds
+    # the same lane-shift kernel
     assert conv_bn.kernel_path((2, 8, 16, 16), (8, 8, 3, 3), stride=2,
-                               pad=1) == "xla:stride 2 != 1 (lane-shift kernel)"
+                               pad=1) == "pallas_kxk"
+    # ... unless even the rewritten problem blows VMEM — then the bail
+    # names the rewrite
+    big = conv_bn.kernel_path((1, 256, 512, 512), (256, 256, 3, 3),
+                              stride=2, pad=1)
+    assert big.startswith("xla:s2d: "), big
 
 
 def test_feasible_shape_stays_pallas_and_logs_nothing():

@@ -473,20 +473,38 @@ class TestTrainingIntegration:
 
 # --------------------------------------------- kernel-fallback counter
 class TestKernelFallbackCounter:
-    def test_kxk_stride2_fallback_counts_site(self):
+    def test_kxk_stride3_fallback_counts_site(self):
         import jax.numpy as jnp
 
         from bigdl_tpu.ops import conv_bn
 
         conv_bn.FALLBACK_LOG.clear()
+        x = jnp.ones((1, 4, 9, 9), jnp.float32)
+        w = jnp.ones((8, 4, 3, 3), jnp.float32)
+        shift = jnp.zeros((8,), jnp.float32)
+        conv_bn.conv_bn_stats(x, w, shift, stride=3, pad=1)
+        assert conv_bn.FALLBACK_LOG, "stride-3 bail not in FALLBACK_LOG"
+        counter = obs.get_registry().counter(
+            "bigdl_kernel_fallbacks_total", labels=("site",))
+        assert counter.labels(site="conv_bn_k3s3").value >= 1
+
+    def test_kxk_stride2_no_longer_falls_back(self):
+        # the r06 regression site (conv_bn_k3s2): the space-to-depth
+        # rewrite closed it — the counter must STOP incrementing
+        import jax.numpy as jnp
+
+        from bigdl_tpu.ops import conv_bn
+
+        conv_bn.FALLBACK_LOG.clear()
+        counter = obs.get_registry().counter(
+            "bigdl_kernel_fallbacks_total", labels=("site",))
+        before = counter.labels(site="conv_bn_k3s2").value
         x = jnp.ones((1, 4, 8, 8), jnp.float32)
         w = jnp.ones((8, 4, 3, 3), jnp.float32)
         shift = jnp.zeros((8,), jnp.float32)
         conv_bn.conv_bn_stats(x, w, shift, stride=2, pad=1)
-        assert conv_bn.FALLBACK_LOG, "stride-2 bail not in FALLBACK_LOG"
-        counter = obs.get_registry().counter(
-            "bigdl_kernel_fallbacks_total", labels=("site",))
-        assert counter.labels(site="conv_bn_k3s2").value >= 1
+        assert not conv_bn.FALLBACK_LOG, conv_bn.FALLBACK_LOG
+        assert counter.labels(site="conv_bn_k3s2").value == before
 
 
 # ------------------------------------------------------- report surface
