@@ -35,6 +35,11 @@ def _env_int(name: str, default: int) -> int:
     return default if v is None else int(v)
 
 
+def _env_opt_int(name: str, default=None):
+    v = os.environ.get(name)
+    return default if v in (None, "") else int(v)
+
+
 def _env_float(name: str, default: float) -> float:
     v = os.environ.get(name)
     return default if v is None else float(v)
@@ -100,10 +105,32 @@ class ObsConfig:
     # step-time p50 exceeds the cross-host median by this factor is
     # flagged; <= 1 disables [BIGDL_STRAGGLER_FACTOR]
     straggler_factor: float = 1.5
+    # live telemetry plane (obs/server.py): per-host HTTP endpoint
+    # serving /metrics (Prometheus exposition), /healthz (JSON
+    # liveness) and /trace?last=K (flight-recorder tail) on a daemon
+    # thread.  0 = ephemeral port (tests), unset = off — no thread, no
+    # socket, zero overhead [BIGDL_OBS_PORT]
+    obs_port: Optional[int] = None
+    # the server writes its actually-bound port here (atomic replace)
+    # so a supervisor can find an ephemeral (port-0) child endpoint
+    # [BIGDL_OBS_PORT_FILE]
+    obs_port_file: Optional[str] = None
+    # comma-separated host:port peer endpoints scraped into one live
+    # fleet snapshot (obs/aggregate.FleetAggregator, report --watch)
+    # [BIGDL_OBS_PEERS]
+    obs_peers: Optional[str] = None
+    # alert rule pack (obs/alerts.py): inline JSON list or a path to a
+    # JSON file; unset = the default rule pack [BIGDL_ALERT_RULES]
+    alert_rules: Optional[str] = None
+    # alert sink: firing/resolved transitions append to this JSONL
+    # file, or POST to it when it is an http(s):// webhook
+    # [BIGDL_ALERT_SINK]
+    alert_sink: Optional[str] = None
 
     @property
     def active(self) -> bool:
-        return bool(self.enabled or self.trace_dir or self.metrics_dir)
+        return bool(self.enabled or self.trace_dir or self.metrics_dir
+                    or self.obs_port is not None)
 
     @classmethod
     def from_env(cls) -> "ObsConfig":
@@ -122,6 +149,11 @@ class ObsConfig:
             goodput_window=_env_int("BIGDL_GOODPUT_WINDOW", 32),
             wire_gbps=_env_float("BIGDL_WIRE_GBPS", 0.0),
             straggler_factor=_env_float("BIGDL_STRAGGLER_FACTOR", 1.5),
+            obs_port=_env_opt_int("BIGDL_OBS_PORT", None),
+            obs_port_file=_env_str("BIGDL_OBS_PORT_FILE", None),
+            obs_peers=_env_str("BIGDL_OBS_PEERS", None),
+            alert_rules=_env_str("BIGDL_ALERT_RULES", None),
+            alert_sink=_env_str("BIGDL_ALERT_SINK", None),
         )
 
 
@@ -226,6 +258,12 @@ class BigDLConfig:
     # a peer silent past this many seconds raises PeerLostError instead
     # of hanging the next collective [BIGDL_HEARTBEAT_TIMEOUT]
     heartbeat_timeout: float = 60.0
+    # supervisor hang watchdog (resilience/supervisor.py): a child
+    # whose /healthz step stamp stops advancing for this many seconds
+    # is killed and restarted as a transient failure — the hang class
+    # heartbeats and exit codes cannot catch; <= 0 disables
+    # [BIGDL_HANG_TIMEOUT]
+    hang_timeout: float = 0.0
 
     # --- observability (obs/ package) -----------------------------------
     # span tracer / metrics registry / runtime profiling switches
@@ -264,6 +302,7 @@ class BigDLConfig:
             heartbeat_dir=_env_str("BIGDL_HEARTBEAT_DIR", None),
             heartbeat_every=_env_int("BIGDL_HEARTBEAT_EVERY", 1),
             heartbeat_timeout=_env_float("BIGDL_HEARTBEAT_TIMEOUT", 60.0),
+            hang_timeout=_env_float("BIGDL_HANG_TIMEOUT", 0.0),
             obs=ObsConfig.from_env(),
             tuner=TunerConfig.from_env(),
         )

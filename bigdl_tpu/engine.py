@@ -132,6 +132,14 @@ class Engine:
             processes=config.num_processes, devices=n)
         obs.get_registry().counter(
             "bigdl_engine_inits_total", "Engine.init calls").inc()
+        # live telemetry plane: bring the per-host /metrics + /healthz
+        # endpoint up with the engine when BIGDL_OBS_PORT is set (unset:
+        # one config read, no thread, no socket).  init is the choke
+        # point every launcher hits, so the endpoint exists before the
+        # first step — a supervisor can watch bring-up, not only steps
+        from bigdl_tpu.obs import server as _obs_server
+
+        _obs_server.ensure_server()
         return cls
 
     # singleton-ish accessors -------------------------------------------------
@@ -159,11 +167,15 @@ class Engine:
         fault injector's fire-once counters with it.  A pending
         preemption request is dropped too (the signal handlers stay
         installed — they are idempotent and process-global)."""
+        from bigdl_tpu.obs import server as obs_server
         from bigdl_tpu.resilience.elastic import clear_preemption
         from bigdl_tpu.resilience.faults import reset_injector
 
         reset_injector()
         clear_preemption()
+        # release the live-telemetry socket with the engine (tests
+        # re-init with different ports; a later init rebuilds it)
+        obs_server.stop_server()
         cls._state = _EngineState()
 
     # ------------------------------------------------------------------ mesh

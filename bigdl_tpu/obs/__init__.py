@@ -26,7 +26,15 @@ stack (optimizers, engine, serializer, resilience, bench):
   step time vs. badput causes (compile, checkpoints, data waits,
   startup, supervisor backoff, restart rework), per-attempt JSONL
   shards aggregated across restarts/hosts, and the per-window
-  input/compute/comm/host bottleneck classifier.
+  input/compute/comm/host bottleneck classifier;
+* :mod:`bigdl_tpu.obs.server` — the live telemetry plane: per-host
+  ``/metrics`` + ``/healthz`` + ``/trace`` HTTP endpoints on a daemon
+  thread (``BIGDL_OBS_PORT``; port 0 = ephemeral; unset = no thread,
+  no socket);
+* :mod:`bigdl_tpu.obs.alerts` — declarative alert/SLO rules
+  (threshold / absence / rate / burn-rate) evaluated on the goodput
+  window tick, with a firing/resolved lifecycle, trace events,
+  ``bigdl_alerts_total`` counters and an optional file/webhook sink.
 
 Everything is off by default with a no-op fast path: disabled, the
 train loop sees one shared null context manager per span site and adds
@@ -78,7 +86,7 @@ def _obs_config():
 
 def active() -> bool:
     """Is any observability output enabled (BIGDL_OBS / BIGDL_TRACE_DIR
-    / BIGDL_METRICS_DIR)?"""
+    / BIGDL_METRICS_DIR / BIGDL_OBS_PORT)?"""
     return _obs_config().active
 
 
@@ -244,7 +252,9 @@ def flush(extra_registries=()) -> dict:
 
 def reset():
     """Test hook: close the tracer, drop the registry and runtime
-    singletons.  The next accessor rebuilds from the current config."""
+    singletons, tear down the live telemetry server, and reset the
+    alert engine + step stamp.  The next accessor rebuilds from the
+    current config."""
     global _tracer, _tracer_dir, _runtime, _registry
     with _lock:
         if _tracer is not NULL_TRACER:
@@ -256,6 +266,9 @@ def reset():
         _tracer_dir = None
         _registry = MetricsRegistry()
         _runtime = None
-    from bigdl_tpu.obs import goodput
+    from bigdl_tpu.obs import alerts, goodput, server
 
     goodput.reset_ledger()
+    server.stop_server()
+    server.clear_step()
+    alerts.reset_engine()

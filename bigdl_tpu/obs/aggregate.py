@@ -291,6 +291,178 @@ def merge_trace_dir(trace_dir: str, out_path: Optional[str] = None,
     }
 
 
+# ------------------------------------------------------------ live fleet
+class ShardTailer:
+    """Incremental ``metrics.*.jsonl`` tailing — the *live* reader for
+    the shards the registry snapshots append to.
+
+    A full re-read per refresh is O(run length); a dashboard refreshing
+    every second needs O(new lines).  Each poll seeks every shard to
+    its stored offset, consumes only complete new lines (a torn tail
+    line stays unconsumed until its newline lands — the same
+    torn-write tolerance the offline readers have), and keeps the
+    newest parsed snapshot per shard.  A shard that shrank (truncated
+    or replaced between runs) is re-read from zero."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        self._offsets: dict = {}
+        self.latest: dict = {}   # shard filename -> newest snapshot
+
+    def poll(self) -> dict:
+        """Consume new lines from every shard; returns ``latest``."""
+        if not self.directory or not os.path.isdir(self.directory):
+            return self.latest
+        for fn in sorted(os.listdir(self.directory)):
+            if not (fn.startswith("metrics.") and fn.endswith(".jsonl")):
+                continue
+            path = os.path.join(self.directory, fn)
+            try:
+                size = os.path.getsize(path)
+                offset = self._offsets.get(fn, 0)
+                if size < offset:
+                    offset = 0  # truncated/replaced: start over
+                if size == offset:
+                    continue
+                with open(path, "rb") as fh:
+                    fh.seek(offset)
+                    chunk = fh.read(size - offset)
+            except OSError:
+                continue
+            # only complete lines advance the offset
+            consumed = chunk.rfind(b"\n") + 1
+            if consumed <= 0:
+                continue
+            self._offsets[fn] = offset + consumed
+            for line in chunk[:consumed].splitlines():
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    snap = json.loads(line.decode("utf-8"))
+                except (json.JSONDecodeError, UnicodeDecodeError):
+                    continue
+                if isinstance(snap, dict) and "metrics" in snap:
+                    snap.setdefault("shard", fn)
+                    self.latest[fn] = snap
+        return self.latest
+
+
+class FleetAggregator:
+    """One in-memory fleet snapshot from N hosts, while they run.
+
+    Two sources, same output shape:
+
+    * **peer scraping** — ``BIGDL_OBS_PEERS="h0:8080,h1:8080"`` (or a
+      peers list): each refresh GETs every peer's ``/healthz`` and
+      ``/metrics`` (parsed by :func:`~bigdl_tpu.obs.metrics.
+      parse_prometheus`), so the snapshot is as fresh as the scrape;
+    * **shard tailing** — no peers: incrementally tail the
+      ``metrics.*.jsonl`` shards under ``metrics_dir`` (each host's
+      snapshot writer appends there), as stale as the hosts' last
+      flush but needing only a shared filesystem.
+
+    ``snapshot()`` returns ``{mode, hosts: {host: {status, step,
+    step_age_s, goodput_ratio, alerts, source}}, alerts: [...],
+    metrics: {name: [{labels, value, source}]}, errors: {source:
+    reason}}`` — what ``report --watch`` renders and the ROADMAP's
+    autoscaling policy loop will read.  ``fetch`` is injectable for
+    tests (no sockets)."""
+
+    def __init__(self, peers=None, metrics_dir: Optional[str] = None,
+                 fetch=None, timeout_s: float = 2.0):
+        if isinstance(peers, str):
+            peers = [p.strip() for p in peers.split(",") if p.strip()]
+        self.peers = list(peers or [])
+        self.metrics_dir = metrics_dir
+        self.timeout_s = float(timeout_s)
+        self._fetch = fetch or self._http_fetch
+        self._tailer = (ShardTailer(metrics_dir)
+                        if metrics_dir and not self.peers else None)
+
+    @classmethod
+    def from_config(cls) -> "FleetAggregator":
+        from bigdl_tpu.config import refresh_from_env
+
+        cfg = refresh_from_env().obs
+        return cls(peers=cfg.obs_peers,
+                   metrics_dir=cfg.metrics_dir or cfg.trace_dir)
+
+    def _http_fetch(self, url: str) -> str:
+        import urllib.request
+
+        with urllib.request.urlopen(url, timeout=self.timeout_s) as r:
+            return r.read().decode("utf-8")
+
+    # ------------------------------------------------------ peer scrape
+    def scrape_peer(self, addr: str) -> dict:
+        """One peer's ``/healthz`` + ``/metrics`` (metrics parse errors
+        are loud per the parse_prometheus contract; transport errors
+        mark the peer down, they never raise)."""
+        base = addr if addr.startswith("http") else f"http://{addr}"
+        out = {"addr": addr, "ok": False, "health": None, "metrics": None}
+        try:
+            out["health"] = json.loads(self._fetch(base + "/healthz"))
+            from bigdl_tpu.obs.metrics import parse_prometheus
+
+            out["metrics"] = parse_prometheus(self._fetch(base + "/metrics"))
+            out["ok"] = True
+        except Exception as e:  # noqa: BLE001 — a dead peer is data
+            out["error"] = f"{type(e).__name__}: {e}"
+        return out
+
+    # --------------------------------------------------------- snapshot
+    def snapshot(self) -> dict:
+        fleet = {"mode": "peers" if self.peers else "shards",
+                 "hosts": {}, "alerts": [], "metrics": {}, "errors": {}}
+        if self.peers:
+            for addr in self.peers:
+                scraped = self.scrape_peer(addr)
+                if not scraped["ok"]:
+                    fleet["errors"][addr] = scraped.get("error", "down")
+                    continue
+                h = scraped["health"] or {}
+                host = h.get("host", addr)
+                fleet["hosts"][str(host)] = {
+                    "status": h.get("status"), "step": h.get("step"),
+                    "step_age_s": h.get("step_age_s"),
+                    "goodput_ratio": h.get("goodput_ratio"),
+                    "alerts": h.get("alerts") or [],
+                    "heartbeat": h.get("heartbeat"), "source": addr}
+                for a in h.get("alerts") or []:
+                    fleet["alerts"].append(dict(a, host=host))
+                for s in scraped["metrics"]["samples"]:
+                    fleet["metrics"].setdefault(s["name"], []).append(
+                        {"labels": s["labels"], "value": s["value"],
+                         "source": addr})
+        elif self._tailer is not None:
+            for fn, snap in sorted(self._tailer.poll().items()):
+                host = snap.get("host", fn)
+                entry = fleet["hosts"].setdefault(str(host), {
+                    "status": "shard", "step": None, "step_age_s": None,
+                    "goodput_ratio": None, "alerts": [], "source": fn})
+                for name, fam in (snap.get("metrics") or {}).items():
+                    for s in fam.get("samples", []):
+                        value = s.get("value", s.get("count"))
+                        fleet["metrics"].setdefault(name, []).append(
+                            {"labels": s.get("labels") or {},
+                             "value": value, "source": fn})
+                        if name == "bigdl_goodput_ratio":
+                            entry["goodput_ratio"] = value
+                        elif name == "bigdl_alert_active" and value:
+                            rule = (s.get("labels") or {}).get("rule")
+                            entry["alerts"].append({"rule": rule})
+                            fleet["alerts"].append(
+                                {"rule": rule, "host": host})
+        return fleet
+
+
+def fleet_snapshot() -> dict:
+    """One live fleet snapshot from the ambient config (peers when
+    ``BIGDL_OBS_PEERS`` is set, shard tailing otherwise)."""
+    return FleetAggregator.from_config().snapshot()
+
+
 def main(argv=None) -> int:
     import argparse
 

@@ -1,0 +1,379 @@
+"""Declarative alert / SLO engine over the live metrics registry.
+
+The obs stack *measures* everything (goodput, numerics, stragglers,
+checkpoint failures); nothing *decides* anything.  This module is the
+decision half: a rule pack evaluated on the goodput window tick — the
+host-side hook both optimizers already pay for, so alerting adds zero
+new device syncs — with a firing/resolved lifecycle:
+
+=============  =========================================================
+rule type      fires when
+=============  =========================================================
+``threshold``  the metric's worst sample ``op`` value (e.g. goodput
+               ratio below target, a peer heartbeat age past budget)
+``absence``    the metric has no sample at all (a signal that should
+               exist, doesn't)
+``rate``       the counter moved by more than ``value`` since the last
+               evaluation (non-finite spike, straggler flagged,
+               checkpoint write failure)
+``burn_rate``  the SLO error budget burns faster than ``threshold``×
+               sustainable: ``(1 - ratio) / (1 - slo) >= threshold``
+=============  =========================================================
+
+Every rule carries ``for`` (consecutive breached evaluations before
+firing — one flaky window is not a page) and ``severity``.  On a
+fire/resolve transition the engine emits ``alert.firing`` /
+``alert.resolved`` trace events, increments ``bigdl_alerts_total
+{rule,severity}`` / ``bigdl_alerts_resolved_total{rule}``, mirrors
+``bigdl_alert_active{rule}`` gauges (what ``/healthz`` and the fleet
+aggregator read), and appends the transition to the optional
+``BIGDL_ALERT_SINK`` (JSONL file, or an http(s):// webhook POST).
+
+Rules come from ``BIGDL_ALERT_RULES`` — an inline JSON list or a path
+to one — replacing the default pack below; everything is plain host
+arithmetic over the registry, unit-testable with a synthetic clock.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import operator
+import threading
+import time
+from typing import Callable, List, Optional
+
+log = logging.getLogger("bigdl_tpu.obs")
+
+RULE_TYPES = ("threshold", "absence", "rate", "burn_rate")
+OPS = {"<": operator.lt, "<=": operator.le, ">": operator.gt,
+       ">=": operator.ge, "==": operator.eq, "!=": operator.ne}
+
+_FIRED_META = ("bigdl_alerts_total",
+               "Alert firing transitions, by rule and severity")
+_RESOLVED_META = ("bigdl_alerts_resolved_total",
+                  "Alert resolved transitions, by rule")
+_ACTIVE_META = ("bigdl_alert_active",
+                "1 while the rule is firing, 0 otherwise")
+
+
+def burn_rate(ratio: Optional[float], slo: float) -> float:
+    """SLO error-budget burn multiple.
+
+    With an SLO of ``slo`` (e.g. goodput ratio >= 0.9) the error budget
+    is ``1 - slo``; a window observing ``ratio`` burns it at
+    ``(1 - ratio) / (1 - slo)`` times the sustainable rate — burn 1.0
+    exactly exhausts the budget at the SLO boundary, 2.0 halves the
+    time to exhaustion.  ``slo >= 1`` means zero budget: any shortfall
+    is infinite burn."""
+    if ratio is None:
+        return 0.0
+    bad = max(0.0, 1.0 - float(ratio))
+    budget = 1.0 - float(slo)
+    if budget <= 0.0:
+        return float("inf") if bad > 0 else 0.0
+    return bad / budget
+
+
+def default_rules(heartbeat_timeout: float = 60.0) -> List[dict]:
+    """The default pack: one rule per failure mode the earlier PRs can
+    already *measure* but nothing *watched*."""
+    return [
+        {"name": "goodput_below_target", "type": "threshold",
+         "metric": "bigdl_goodput_ratio", "op": "<", "value": 0.5,
+         "for": 2, "severity": "warning"},
+        {"name": "goodput_slo_burn", "type": "burn_rate",
+         "metric": "bigdl_goodput_window_ratio", "slo": 0.5,
+         "threshold": 1.5, "for": 2, "severity": "warning"},
+        {"name": "nonfinite_spike", "type": "rate",
+         "metric": "bigdl_nonfinite_skips_total", "op": ">", "value": 0,
+         "for": 1, "severity": "critical"},
+        {"name": "straggler_flagged", "type": "rate",
+         "metric": "bigdl_straggler_steps_total", "op": ">", "value": 0,
+         "for": 1, "severity": "warning"},
+        {"name": "checkpoint_write_failure", "type": "rate",
+         "metric": "bigdl_checkpoint_write_failures_total", "op": ">",
+         "value": 0, "for": 1, "severity": "critical"},
+        {"name": "stale_peer_heartbeat", "type": "threshold",
+         "metric": "bigdl_heartbeat_age_seconds", "op": ">",
+         "value": max(1.0, float(heartbeat_timeout)) * 0.5,
+         "for": 1, "severity": "warning"},
+    ]
+
+
+def load_rules(spec: Optional[str],
+               heartbeat_timeout: float = 60.0) -> List[dict]:
+    """Resolve ``BIGDL_ALERT_RULES``: inline JSON (starts with ``[``)
+    or a file path; None/empty = the default pack.  Every rule is
+    validated loudly — a typo'd pack must fail at build, not silently
+    never fire."""
+    if not spec:
+        rules = default_rules(heartbeat_timeout)
+    else:
+        text = spec if spec.lstrip()[:1] in ("[", "{") else \
+            open(spec, encoding="utf-8").read()
+        rules = json.loads(text)
+    if not isinstance(rules, list):
+        raise ValueError(f"alert rules must be a JSON list, got "
+                         f"{type(rules).__name__}")
+    for r in rules:
+        kind = r.get("type", "threshold")
+        if kind not in RULE_TYPES:
+            raise ValueError(f"rule {r.get('name')!r}: unknown type "
+                             f"{kind!r}; one of {RULE_TYPES}")
+        if not r.get("name"):
+            raise ValueError(f"alert rule missing a name: {r}")
+        if not r.get("metric"):
+            raise ValueError(f"rule {r['name']!r}: missing metric")
+        if kind in ("threshold", "rate"):
+            if r.get("op", ">") not in OPS:
+                raise ValueError(f"rule {r['name']!r}: op {r.get('op')!r}"
+                                 f" not in {sorted(OPS)}")
+            if "value" not in r:
+                raise ValueError(f"rule {r['name']!r}: missing value")
+        if kind == "burn_rate" and "slo" not in r:
+            raise ValueError(f"rule {r['name']!r}: burn_rate needs slo")
+        r.setdefault("type", kind)
+        r.setdefault("for", 1)
+        r.setdefault("severity", "warning")
+    return rules
+
+
+# ------------------------------------------------------------- engine
+class AlertEngine:
+    """Evaluate a rule pack against a registry; track lifecycle."""
+
+    def __init__(self, rules: List[dict], registry=None,
+                 sink: Optional[str] = None,
+                 clock: Callable[[], float] = time.time):
+        self.rules = list(rules)
+        self._registry = registry
+        self.sink = sink
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = {r["name"]: {"breaches": 0, "firing": False,
+                                   "since": None, "value": None,
+                                   "labels": None}
+                       for r in self.rules}
+        # rate baselines are primed at engine build: counts that exist
+        # NOW are history (an engine rebuilt mid-run must not re-page
+        # old increments), while a counter that first *appears* later —
+        # families register lazily on first increment — is a genuine
+        # spike measured from zero, not swallowed as history
+        self._prev_rate: dict = {}
+        for r in self.rules:
+            if r.get("type") == "rate":
+                samples = self._samples(r["metric"], r.get("labels"))
+                self._prev_rate[r["name"]] = sum(v for v, _ in samples)
+
+    def registry(self):
+        if self._registry is not None:
+            return self._registry
+        from bigdl_tpu import obs
+
+        return obs.get_registry()
+
+    # ------------------------------------------------------ resolution
+    def _samples(self, metric: str, want_labels: Optional[dict]):
+        """[(value, labels)] for every child of ``metric`` whose labels
+        contain ``want_labels`` (histograms contribute their count)."""
+        out = []
+        for fam in self.registry().families():
+            if fam.name != metric:
+                continue
+            for key, child in fam.child_items():
+                labels = dict(zip(fam.labelnames, key))
+                if want_labels and any(labels.get(k) != str(v)
+                                       for k, v in want_labels.items()):
+                    continue
+                value = (child.count if fam.kind == "histogram"
+                         else child.value)
+                out.append((float(value), labels))
+        return out
+
+    def _worst(self, metric, want_labels, op_name: str):
+        """The sample most likely to breach: max for ``>``-ish ops, min
+        for ``<``-ish."""
+        samples = self._samples(metric, want_labels)
+        if not samples:
+            return None, None
+        pick = min if op_name in ("<", "<=") else max
+        return pick(samples, key=lambda s: s[0])
+
+    # ------------------------------------------------------ evaluation
+    def _breach(self, rule: dict):
+        """-> (breached, value, labels) for one rule, one evaluation."""
+        kind = rule["type"]
+        metric = rule["metric"]
+        want = rule.get("labels")
+        if kind == "absence":
+            samples = self._samples(metric, want)
+            return (not samples), None, want
+        if kind == "burn_rate":
+            value, labels = self._worst(metric, want, "<")
+            if value is None:
+                return False, None, None
+            burn = burn_rate(value, rule["slo"])
+            return burn >= float(rule.get("threshold", 1.0)), \
+                round(burn, 4), labels
+        op = OPS[rule.get("op", ">")]
+        if kind == "rate":
+            samples = self._samples(metric, want)
+            if not samples:
+                return False, None, None
+            total = sum(v for v, _ in samples)
+            prev = self._prev_rate.get(rule["name"], 0.0)
+            self._prev_rate[rule["name"]] = total
+            delta = total - prev
+            return op(delta, float(rule["value"])), delta, None
+        value, labels = self._worst(metric, want, rule.get("op", ">"))
+        if value is None:
+            return False, None, None
+        return op(value, float(rule["value"])), value, labels
+
+    def evaluate(self, now: Optional[float] = None) -> List[dict]:
+        """One evaluation pass; returns the transition records (one per
+        rule that fired or resolved this pass)."""
+        now = self._clock() if now is None else now
+        transitions = []
+        with self._lock:
+            for rule in self.rules:
+                st = self._state[rule["name"]]
+                try:
+                    breached, value, labels = self._breach(rule)
+                except Exception:  # noqa: BLE001 — one bad rule must not
+                    log.exception("alert rule %r evaluation failed",
+                                  rule["name"])  # kill the pack
+                    continue
+                st["value"], st["labels"] = value, labels
+                if breached:
+                    st["breaches"] += 1
+                    if not st["firing"] and \
+                            st["breaches"] >= int(rule.get("for", 1)):
+                        st["firing"] = True
+                        st["since"] = now
+                        transitions.append(self._transition(
+                            "firing", rule, st, now))
+                else:
+                    st["breaches"] = 0
+                    if st["firing"]:
+                        st["firing"] = False
+                        transitions.append(self._transition(
+                            "resolved", rule, st, now))
+                        st["since"] = None
+        for t in transitions:
+            self._emit(t)
+        return transitions
+
+    def _transition(self, state: str, rule: dict, st: dict,
+                    now: float) -> dict:
+        return {"state": state, "rule": rule["name"],
+                "severity": rule["severity"], "type": rule["type"],
+                "metric": rule["metric"], "value": st["value"],
+                "labels": st["labels"], "ts": now,
+                "since": st["since"]}
+
+    def _emit(self, t: dict):
+        from bigdl_tpu import obs
+
+        reg = self.registry()
+        if t["state"] == "firing":
+            reg.counter(*_FIRED_META,
+                        labels=("rule", "severity")).labels(
+                rule=t["rule"], severity=t["severity"]).inc()
+            reg.gauge(*_ACTIVE_META, labels=("rule",)).labels(
+                rule=t["rule"]).set(1.0)
+            log.warning("ALERT firing: %s [%s] %s=%r %s", t["rule"],
+                        t["severity"], t["metric"], t["value"],
+                        t["labels"] or "")
+        else:
+            reg.counter(*_RESOLVED_META, labels=("rule",)).labels(
+                rule=t["rule"]).inc()
+            reg.gauge(*_ACTIVE_META, labels=("rule",)).labels(
+                rule=t["rule"]).set(0.0)
+            log.info("alert resolved: %s (%s=%r)", t["rule"],
+                     t["metric"], t["value"])
+        obs.get_tracer().event(f"alert.{t['state']}", rule=t["rule"],
+                               severity=t["severity"],
+                               metric=t["metric"], value=t["value"],
+                               labels=t["labels"])
+        if self.sink:
+            _sink_write(self.sink, t)
+
+    def active(self) -> List[dict]:
+        """The currently-firing alerts (what ``/healthz`` reports)."""
+        with self._lock:
+            out = []
+            for rule in self.rules:
+                st = self._state[rule["name"]]
+                if st["firing"]:
+                    out.append({"rule": rule["name"],
+                                "severity": rule["severity"],
+                                "metric": rule["metric"],
+                                "value": st["value"],
+                                "labels": st["labels"],
+                                "since": st["since"]})
+            return out
+
+
+def _sink_write(sink: str, record: dict):
+    """Deliver one transition to the sink — JSONL append, or webhook
+    POST for http(s):// targets.  Best-effort: a full disk or a dead
+    receiver must never take the trainer down."""
+    try:
+        payload = json.dumps(record, default=str)
+        if sink.startswith(("http://", "https://")):
+            import urllib.request
+
+            req = urllib.request.Request(
+                sink, data=payload.encode("utf-8"),
+                headers={"Content-Type": "application/json"})
+            urllib.request.urlopen(req, timeout=2.0).close()
+        else:
+            with open(sink, "a", encoding="utf-8") as fh:
+                fh.write(payload + "\n")
+    except Exception as e:  # noqa: BLE001
+        log.warning("alert sink %s failed: %s", sink, e)
+
+
+# ---------------------------------------------------------- singleton
+_lock = threading.Lock()
+_engine: Optional[AlertEngine] = None
+_engine_key = None
+
+
+def get_engine() -> AlertEngine:
+    """The process alert engine, built from the live config and rebuilt
+    when the rule pack / sink changes."""
+    global _engine, _engine_key
+    from bigdl_tpu.config import refresh_from_env
+
+    cfg = refresh_from_env()
+    key = (cfg.obs.alert_rules, cfg.obs.alert_sink,
+           cfg.heartbeat_timeout)
+    with _lock:
+        if _engine is None or key != _engine_key:
+            _engine_key = key
+            _engine = AlertEngine(
+                load_rules(cfg.obs.alert_rules, cfg.heartbeat_timeout),
+                sink=cfg.obs.alert_sink)
+        return _engine
+
+
+def maybe_evaluate() -> List[dict]:
+    """Best-effort evaluation tick — rides the goodput window tick
+    inside the training loop, so it must never raise."""
+    try:
+        return get_engine().evaluate()
+    except Exception:  # noqa: BLE001 — alerting must not break training
+        log.exception("alert evaluation failed")
+        return []
+
+
+def reset_engine():
+    """Test hook: drop the singleton; the next :func:`get_engine`
+    rebuilds from the live config."""
+    global _engine, _engine_key
+    with _lock:
+        _engine = None
+        _engine_key = None

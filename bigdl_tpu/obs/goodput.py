@@ -94,6 +94,12 @@ _REWORK_META = (
     "Steps re-executed after a restart (restored step -> pre-crash "
     "high-water mark)",
 )
+_WINDOW_RATIO_META = (
+    "bigdl_goodput_window_ratio",
+    "Good share of the last classifier window's wall clock "
+    "(1 - badput/wall; badput = input waits, compiles, checkpoints) "
+    "— the live SLO burn-rate signal",
+)
 
 
 def _default_host_id() -> int:
@@ -134,6 +140,9 @@ class NullLedger:
 
     def stamp_resume(self, restored_step=None):
         return 0
+
+    def live_ratio(self):
+        return None
 
     def publish(self, registry=None):
         pass
@@ -181,10 +190,17 @@ class GoodputLedger:
         self._max_step_seen = 0
         self._saw_step = False
         self.comm_bytes_per_step = 0.0
+        # running productive/badput seconds — the O(1) live goodput
+        # ratio the /healthz endpoint and the alert engine read between
+        # full classifications (rework excluded: replay is badput)
+        self._productive_s = 0.0
+        self._badput_s = 0.0
         # windowed bottleneck classifier accumulators
         self._win_step_s = 0.0
         self._win_wait_s = 0.0
         self._win_host_s = 0.0
+        self._win_badput_s = 0.0
+        self._win_wall0 = self._epoch_perf
         self._win_steps = 0
         self._win_first_step = None
         self._published_badput: Dict[str, float] = {}
@@ -219,10 +235,12 @@ class GoodputLedger:
                 # intervals — compile, restore — the classifier carves
                 # out of the window)
                 self._saw_step = True
+                startup_s = max(0.0, self._wall(start_perf)
+                                - self._epoch_wall)
                 self._append({"kind": "startup", "wall": self._epoch_wall,
-                              "dur_s": round(
-                                  max(0.0, self._wall(start_perf)
-                                      - self._epoch_wall), 9)})
+                              "dur_s": round(startup_s, 9)})
+                self._badput_s += startup_s
+                self._win_badput_s += startup_s
             if step is not None and step <= self.high_water:
                 kind = "rework"
             if step is not None:
@@ -234,6 +252,12 @@ class GoodputLedger:
         if attrs:
             rec["attrs"] = attrs
         self._append(rec)
+        if kind == "step":
+            self._productive_s += float(dur_s)
+        else:
+            # every non-step cause — waits, compiles, checkpoints,
+            # eval, backoff, rework replay — burns the live budget
+            self._badput_s += float(dur_s)
         if kind in ("step", "rework"):
             self._win_step_s += float(dur_s)
             self._win_steps += 1
@@ -242,6 +266,9 @@ class GoodputLedger:
             self._maybe_window_tick(step)
         elif kind == "data_wait":
             self._win_wait_s += float(dur_s)
+            self._win_badput_s += float(dur_s)
+        else:
+            self._win_badput_s += float(dur_s)
 
     def note_host_seconds(self, seconds: float):
         """Driver-side per-step overhead (batch prep + device_put +
@@ -283,6 +310,22 @@ class GoodputLedger:
                      "rework badput", restored_step, self.high_water)
         return self.high_water
 
+    def live_ratio(self) -> Optional[float]:
+        """Cheap running goodput ratio for ``/healthz`` and the alert
+        engine — O(1), no boundary sweep.  Two live bounds exist:
+        ``productive/elapsed`` over-counts under async pipelining (a
+        dispatch→resolve step span absorbs the next batch's input
+        wait), while ``1 - badput/elapsed`` over-counts unattributed
+        gaps — so the tighter of the two is served.  The exact
+        boundary-sweep classification still happens at publish/flush
+        time (too expensive per scrape)."""
+        elapsed = time.time() - self._epoch_wall
+        if elapsed <= 0:
+            return None
+        bound_productive = self._productive_s / elapsed
+        bound_badput = 1.0 - self._badput_s / elapsed
+        return max(0.0, min(1.0, bound_productive, bound_badput))
+
     def records(self) -> List[dict]:
         with self._lock:
             return list(self._records)
@@ -296,8 +339,13 @@ class GoodputLedger:
             return
         step_s, wait_s = self._win_step_s, self._win_wait_s
         host_s, n = self._win_host_s, self._win_steps
+        badput_s = self._win_badput_s
         first = self._win_first_step
+        now_perf = time.perf_counter()
+        win_wall = now_perf - self._win_wall0
+        self._win_wall0 = now_perf
         self._win_step_s = self._win_wait_s = self._win_host_s = 0.0
+        self._win_badput_s = 0.0
         self._win_steps = 0
         self._win_first_step = None
         comm_s = 0.0
@@ -307,11 +355,24 @@ class GoodputLedger:
         verdict = classify_bottleneck(step_s, wait_s, comm_s, host_s)
         from bigdl_tpu import obs
 
-        gauge = obs.get_registry().gauge(*_BOTTLENECK_META,
-                                         labels=("class",))
+        registry = obs.get_registry()
+        gauge = registry.gauge(*_BOTTLENECK_META, labels=("class",))
         for label in BOTTLENECKS:
             gauge.labels(**{"class": label}).set(
                 1.0 if label == verdict["label"] else 0.0)
+        # live SLO signals for the alert engine and /healthz: the
+        # window's own good share of wall clock (recovers the moment a
+        # starved window ends) and the cheap cumulative ratio.  NOT
+        # step/(step+wait): under async pipelining the dispatch→resolve
+        # step span absorbs the next batch's wait, so that quotient
+        # floors near 0.5 in a fully starved run — 1 - badput/wall
+        # measures what actually burned the window
+        if win_wall > 0:
+            registry.gauge(*_WINDOW_RATIO_META).set(
+                round(max(0.0, min(1.0, 1.0 - badput_s / win_wall)), 6))
+        lr = self.live_ratio()
+        if lr is not None:
+            registry.gauge(*_RATIO_META).set(round(lr, 6))
         tracer = obs.get_tracer()
         if tracer.enabled:
             tracer.event("goodput.bottleneck", window=n,
@@ -325,6 +386,11 @@ class GoodputLedger:
                 tracer.counter("hbm_peak_bytes", **{
                     f"d{i}": s.get("peak_bytes_in_use", 0)
                     for i, s in hbm.items()})
+        # the alert engine rides the same tick: pure host arithmetic
+        # over the registry, zero new device syncs (obs/alerts.py)
+        from bigdl_tpu.obs import alerts
+
+        alerts.maybe_evaluate()
 
     # -------------------------------------------------------------- export
     def publish(self, registry=None):

@@ -24,6 +24,7 @@ from __future__ import annotations
 import bisect
 import json
 import os
+import re
 import threading
 import time
 from typing import Dict, Optional, Sequence, Tuple
@@ -37,6 +38,12 @@ DEFAULT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
 def _escape(v) -> str:
     return (str(v).replace("\\", r"\\").replace("\n", r"\n")
             .replace('"', r'\"'))
+
+
+def _escape_help(v: str) -> str:
+    """HELP-line escaping (exposition format: backslash and newline
+    only — quotes stay literal on comment lines)."""
+    return str(v).replace("\\", r"\\").replace("\n", r"\n")
 
 
 def _fmt(v: float) -> str:
@@ -247,11 +254,17 @@ class MetricsRegistry:
 
     # -------------------------------------------------------------- export
     def to_prometheus(self) -> str:
-        """Prometheus text exposition format 0.0.4."""
+        """Prometheus text exposition format 0.0.4.
+
+        Every family gets BOTH a ``# HELP`` and a ``# TYPE`` line (a
+        help-less registration falls back to its own name): real
+        scrapers reject or mislabel families exposed bare, and the
+        parity tests hold the reader (:func:`parse_prometheus`) and
+        this writer to the same contract."""
         lines = []
         for fam in sorted(self.families(), key=lambda f: f.name):
-            if fam.help:
-                lines.append(f"# HELP {fam.name} {fam.help}")
+            lines.append(
+                f"# HELP {fam.name} {_escape_help(fam.help or fam.name)}")
             lines.append(f"# TYPE {fam.name} {fam.kind}")
             for key, child in sorted(fam.child_items()):
                 pairs = [f'{n}="{_escape(v)}"'
@@ -331,3 +344,77 @@ class MetricsRegistry:
         """Drop every family (test hook)."""
         with self._lock:
             self._families.clear()
+
+
+# ------------------------------------------------------------- reader
+# one exposition sample line: name, optional {labels}, value
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)$')
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+_UNESCAPE = {r"\\": "\\", r"\"": '"', r"\n": "\n"}
+
+
+def _unescape_label(v: str) -> str:
+    return re.sub(r'\\(.)', lambda m: _UNESCAPE.get(m.group(0),
+                                                    m.group(1)), v)
+
+
+def _parse_value(v: str) -> float:
+    low = v.lower()
+    if low in ("nan",):
+        return float("nan")
+    if low in ("+inf", "inf"):
+        return float("inf")
+    if low == "-inf":
+        return float("-inf")
+    return float(v)
+
+
+def parse_prometheus(text: str) -> dict:
+    """Parse text exposition back into
+    ``{"families": {name: {"type", "help"}}, "samples": [{"name",
+    "labels", "value"}]}`` — the reader half of :meth:`to_prometheus`.
+
+    This is what the fleet aggregator uses on a peer's ``/metrics``
+    body and what the parity tests round-trip through; histogram
+    ``_bucket``/``_sum``/``_count`` lines appear as their literal
+    sample names.  Malformed lines raise — a scrape that parses must
+    parse *completely* (silently-dropped samples are how dashboards
+    lie)."""
+    families: Dict[str, dict] = {}
+    samples = []
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            rest = line[len("# HELP "):].split(" ", 1)
+            families.setdefault(rest[0], {})["help"] = \
+                rest[1] if len(rest) > 1 else ""
+            continue
+        if line.startswith("# TYPE "):
+            rest = line[len("# TYPE "):].split(" ", 1)
+            families.setdefault(rest[0], {})["type"] = \
+                rest[1] if len(rest) > 1 else ""
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            raise ValueError(f"bad exposition line: {line!r}")
+        name, labelbody, value = m.groups()
+        labels = {k: _unescape_label(v)
+                  for k, v in _LABEL_RE.findall(labelbody or "")}
+        samples.append({"name": name, "labels": labels,
+                        "value": _parse_value(value)})
+    return {"families": families, "samples": samples}
+
+
+def sample_value(parsed: dict, name: str, **labels) -> Optional[float]:
+    """First sample named ``name`` whose labels contain ``labels`` (a
+    convenience over :func:`parse_prometheus` output)."""
+    for s in parsed["samples"]:
+        if s["name"] == name and all(
+                s["labels"].get(k) == str(v) for k, v in labels.items()):
+            return s["value"]
+    return None

@@ -24,9 +24,20 @@ snapshots when present) and renders what a postmortem asks first:
   ``tuner.decision`` events with their provenance (cache / model /
   measured / corrupt_cache).
 
+* alerts (obs/alerts.py): fired/resolved transition counts per rule,
+  currently-firing rules, and the recent ``alert.firing`` /
+  ``alert.resolved`` events.
+
 ``--json`` emits the machine-readable report instead of text — the
 same dict ``build_report`` returns, so CI and ``obs/regress.py``
 consume reports without scraping the rendered text.
+
+``--watch`` turns the report into a refreshing terminal view topped by
+a live fleet header: with ``BIGDL_OBS_PEERS`` (or ``--peers``) set it
+scrapes each host's live ``/healthz`` + ``/metrics`` endpoint
+(obs/server.py); otherwise it incrementally tails the metrics shards.
+``--once`` renders a single frame (CI), ``--interval`` sets the
+refresh period.
 """
 
 from __future__ import annotations
@@ -95,6 +106,7 @@ def build_report(trace_dir: str, metrics_dir: Optional[str] = None) -> dict:
     nonfinite_events: list = []
     anomaly_events: list = []
     tuner_events: list = []
+    alert_events: list = []
     for sh in shards:
         key = f"host{sh.host}/pid{sh.pid}"
         h = hosts.setdefault(key, {
@@ -132,6 +144,12 @@ def build_report(trace_dir: str, metrics_dir: Optional[str] = None) -> dict:
                     a = dict(rec.get("attrs") or {})
                     a["host"] = sh.host
                     tuner_events.append(a)
+                elif name in ("alert.firing", "alert.resolved"):
+                    a = dict(rec.get("attrs") or {})
+                    a["host"] = sh.host
+                    a["state"] = name.split(".", 1)[1]
+                    a["wall_time"] = rec.get("wall_time")
+                    alert_events.append(a)
 
     per_host = {}
     for key, h in hosts.items():
@@ -232,6 +250,30 @@ def build_report(trace_dir: str, metrics_dir: Optional[str] = None) -> dict:
         "events": tuner_events,
     }
 
+    # ---- alerts (obs/alerts.py) --------------------------------------
+    fired: dict = {}
+    for labels, s, _host in _metric_samples(snaps, "bigdl_alerts_total"):
+        key = f"{labels.get('rule', '?')}[{labels.get('severity', '?')}]"
+        fired[key] = fired.get(key, 0.0) + float(s.get("value", 0.0))
+    resolved: dict = {}
+    for labels, s, _host in _metric_samples(
+            snaps, "bigdl_alerts_resolved_total"):
+        rule = labels.get("rule", "?")
+        resolved[rule] = resolved.get(rule, 0.0) + float(
+            s.get("value", 0.0))
+    active: dict = {}
+    for labels, s, _host in _metric_samples(snaps, "bigdl_alert_active"):
+        rule = labels.get("rule", "?")
+        active[rule] = max(active.get(rule, 0.0),
+                           float(s.get("value", 0.0)))
+    alert_events.sort(key=lambda a: a.get("wall_time") or 0.0)
+    alerts = {
+        "fired_total": fired,
+        "resolved_total": resolved,
+        "active": sorted(r for r, v in active.items() if v >= 1.0),
+        "events": alert_events,
+    }
+
     # per-device HBM peaks (bigdl_hbm_peak_bytes, max across snapshots)
     hbm: dict = {}
     for labels, s, _host in _metric_samples(snaps, "bigdl_hbm_peak_bytes"):
@@ -265,6 +307,7 @@ def build_report(trace_dir: str, metrics_dir: Optional[str] = None) -> dict:
         "wire_savings_ratio": max(savings) if savings else None,
         "resilience_events": resilience,
         "slow_steps": slow_steps,
+        "alerts": alerts,
         "health": health,
         "goodput": gp,
         "stragglers": stragglers,
@@ -334,6 +377,24 @@ def render_text(rep: dict) -> str:
             f"{float(s.get('dur_s', 0)) * 1000:.1f}ms "
             f"(median {float(s.get('median_s', 0)) * 1000:.1f}ms, "
             f"breakdown {s.get('breakdown')})")
+    lines.append("")
+    lines.append("-- alerts --")
+    al = rep.get("alerts") or {}
+    if not (al.get("fired_total") or al.get("events")):
+        lines.append("  (none fired)")
+    else:
+        for rule in al.get("active", []):
+            lines.append(f"  FIRING {rule}")
+        for key, n in sorted(al.get("fired_total", {}).items()):
+            rule = key.split("[", 1)[0]
+            res = al.get("resolved_total", {}).get(rule, 0)
+            lines.append(f"  {key:40s} fired {int(n)}x, "
+                         f"resolved {int(res)}x")
+        for ev in al.get("events", [])[-8:]:
+            lines.append(
+                f"  host{ev.get('host')} {ev.get('state'):>8s} "
+                f"{ev.get('rule')} [{ev.get('severity')}] "
+                f"{ev.get('metric')}={ev.get('value')}")
     lines.append("")
     lines.append("-- goodput --")
     gp = rep.get("goodput")
@@ -441,18 +502,86 @@ def render_text(rep: dict) -> str:
     return "\n".join(lines) + "\n"
 
 
+def render_fleet(fleet: dict) -> str:
+    """The live-fleet header ``--watch`` puts above the report body."""
+    lines = [f"-- live fleet ({fleet.get('mode')}) --"]
+    hosts = fleet.get("hosts") or {}
+    if not hosts:
+        lines.append("  (no hosts visible yet)")
+    for host, h in sorted(hosts.items()):
+        gr = h.get("goodput_ratio")
+        age = h.get("step_age_s")
+        lines.append(
+            f"  host{host}: status={h.get('status')} "
+            f"step={h.get('step')}"
+            + (f" age={age:.1f}s" if age is not None else "")
+            + (f" goodput={gr:.3f}" if gr is not None else "")
+            + f"  [{h.get('source')}]")
+        for a in h.get("alerts") or []:
+            lines.append(f"    FIRING {a.get('rule')}"
+                         + (f" [{a.get('severity')}]"
+                            if a.get("severity") else ""))
+    for src, err in sorted((fleet.get("errors") or {}).items()):
+        lines.append(f"  DOWN {src}: {err}")
+    return "\n".join(lines) + "\n"
+
+
 def main(argv=None) -> int:
     import argparse
+    import time as _time
 
     ap = argparse.ArgumentParser(
         prog="python -m bigdl_tpu.obs.report",
-        description="Render a run report from trace/metrics JSONL dirs.")
+        description="Render a run report from trace/metrics JSONL dirs "
+                    "(--watch: a refreshing live view fed by peer "
+                    "/metrics endpoints or shard tailing).")
     ap.add_argument("trace_dir", help="BIGDL_TRACE_DIR of the run")
     ap.add_argument("--metrics-dir", default=None,
                     help="BIGDL_METRICS_DIR (default: trace_dir)")
     ap.add_argument("--json", action="store_true",
                     help="emit the machine-readable report")
+    ap.add_argument("--watch", action="store_true",
+                    help="refreshing terminal view with a live fleet "
+                         "header (BIGDL_OBS_PEERS or --peers scrapes "
+                         "live endpoints; otherwise tails the metrics "
+                         "shards)")
+    ap.add_argument("--peers", default=None,
+                    help="comma-separated host:port live endpoints "
+                         "(default BIGDL_OBS_PEERS)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="--watch refresh period in seconds (default 2)")
+    ap.add_argument("--once", action="store_true",
+                    help="render a single --watch frame and exit "
+                         "(CI/testing)")
     args = ap.parse_args(argv)
+
+    if args.watch:
+        from bigdl_tpu.obs.aggregate import FleetAggregator
+
+        peers = args.peers if args.peers is not None else \
+            os.environ.get("BIGDL_OBS_PEERS")
+        agg = FleetAggregator(
+            peers=peers,
+            metrics_dir=args.metrics_dir or args.trace_dir)
+        while True:
+            fleet = agg.snapshot()
+            rep = build_report(args.trace_dir, args.metrics_dir)
+            rep["fleet"] = fleet
+            if args.json:
+                print(json.dumps(rep, default=str), flush=True)
+            else:
+                frame = render_fleet(fleet) + "\n" + render_text(rep)
+                if not args.once:
+                    # ANSI clear+home: a refreshing view, not a scroll
+                    print("\x1b[2J\x1b[H", end="")
+                print(frame, end="", flush=True)
+            if args.once:
+                return 0
+            try:
+                _time.sleep(args.interval)
+            except KeyboardInterrupt:
+                return 0
+
     rep = build_report(args.trace_dir, args.metrics_dir)
     if not rep["hosts"]:
         print(f"no trace shards under {args.trace_dir}", flush=True)

@@ -1,0 +1,302 @@
+"""Live telemetry plane — per-host /metrics, /healthz, /trace endpoints.
+
+Everything the obs stack built in PRs 2–6 lands as JSONL shards read
+*after* the run; a production job must be watchable *while it runs*
+(the TensorFlow system paper's stance: supervision decisions are driven
+by continuously exported runtime signals, not offline log analysis).
+This module is that live surface: one stdlib HTTP server per host on a
+daemon thread, enabled via ``BIGDL_OBS_PORT`` and serving
+
+* ``GET /metrics`` — Prometheus text exposition of the live process
+  registry (plus any extra registries the optimizers register, e.g.
+  the driver-phase timers), straight from the same one-lock
+  ``snapshot_state()`` reads the file snapshots use — a scrape racing
+  a training step can never see a torn histogram;
+* ``GET /healthz`` — JSON liveness: the last resolved step + its age
+  (the stamp the supervisor's hang watchdog keys on), live goodput
+  ratio, active alerts (obs/alerts.py), and the heartbeat peer census;
+* ``GET /trace?last=K`` — the newest K records of the PR 3
+  flight-recorder ring (``[]`` when tracing is off).
+
+Lifecycle contract (the PR 4 coordinator-port bug class, closed for
+good): the serving thread and every per-request thread are daemons, the
+server is torn down by atexit / ``Engine.reset`` / ``obs.reset``, and
+``BIGDL_OBS_PORT=0`` binds an ephemeral port (the actually-bound port
+is exposed as ``server.port`` and, when ``BIGDL_OBS_PORT_FILE`` is set,
+written there atomically — how a supervisor finds an ephemeral child
+endpoint).  Unset, this module holds no thread and no socket: the
+disabled path is one ``None`` check.
+"""
+
+from __future__ import annotations
+
+import atexit
+import http.server
+import json
+import logging
+import os
+import threading
+import time
+import urllib.parse
+import weakref
+from typing import List, Optional
+
+log = logging.getLogger("bigdl_tpu.obs")
+
+_lock = threading.Lock()
+_server: Optional["ObsServer"] = None
+_server_key = None
+_atexit_registered = False
+# extra registries (weakrefs) concatenated into /metrics — the
+# optimizers register their private phase-timer registries here
+_extras: List = []
+
+# the step-advance stamp: (step, wall_time) written by both optimizers'
+# resolve path — ONE tuple rebind (atomic under the GIL), no lock, no
+# device read.  /healthz derives step age from it; the supervisor's
+# hang watchdog classifies a stale stamp as a hung child.
+_step_stamp = (None, None)
+
+
+def note_step(step: int):
+    """Stamp one resolved training step (both optimizers call this per
+    step; the elastic retry path re-stamps the restored step so a
+    rewound counter never looks like a stall)."""
+    global _step_stamp
+    _step_stamp = (int(step), time.time())
+
+
+def last_step():
+    """``(step, wall_time)`` of the newest stamp (``(None, None)``
+    before the first resolved step)."""
+    return _step_stamp
+
+
+def clear_step():
+    """Test hook: drop the stamp."""
+    global _step_stamp
+    _step_stamp = (None, None)
+
+
+def register_registry(registry):
+    """Expose an extra :class:`MetricsRegistry` on ``/metrics`` (held
+    by weakref — a dead optimizer never pins its registry here)."""
+    with _lock:
+        _extras[:] = [r for r in _extras if r() is not None]
+        if not any(r() is registry for r in _extras):
+            _extras.append(weakref.ref(registry))
+
+
+def _extra_registries():
+    with _lock:
+        return [r() for r in _extras if r() is not None]
+
+
+# ----------------------------------------------------------- payloads
+def metrics_text() -> str:
+    """The full Prometheus exposition ``/metrics`` serves (process
+    registry + registered extras)."""
+    from bigdl_tpu import obs
+
+    return obs.get_registry().to_prometheus() + "".join(
+        r.to_prometheus() for r in _extra_registries())
+
+
+def trace_tail(last: int = 64) -> list:
+    """The newest ``last`` flight-recorder records (``[]`` when tracing
+    is off)."""
+    from bigdl_tpu import obs
+
+    recent = obs.get_tracer().recent()
+    return recent[-max(1, int(last)):] if recent else []
+
+
+def _heartbeat_census() -> Optional[dict]:
+    """Per-peer heartbeat ages out of the ``bigdl_heartbeat_age_seconds``
+    gauges the monitor publishes (None when no heartbeat monitor ever
+    ran in this process)."""
+    from bigdl_tpu import obs
+
+    for fam in obs.get_registry().families():
+        if fam.name == "bigdl_heartbeat_age_seconds":
+            census = {}
+            for key, child in fam.child_items():
+                labels = dict(zip(fam.labelnames, key))
+                census[labels.get("host", "?")] = round(child.value, 3)
+            return census or None
+    return None
+
+
+def health_payload() -> dict:
+    """The ``/healthz`` JSON body (also directly callable — the unit
+    tests and an in-process supervisor skip the HTTP hop)."""
+    from bigdl_tpu import obs
+    from bigdl_tpu.config import config
+
+    now = time.time()
+    step, stamped = _step_stamp
+    ledger = obs.get_ledger()
+    ratio = ledger.live_ratio() if ledger.enabled else None
+    from bigdl_tpu.obs import alerts
+
+    active_alerts = alerts.get_engine().active()
+    step_age = None if stamped is None else round(now - stamped, 3)
+    status = "idle" if step is None else "ok"
+    if step_age is not None and config.hang_timeout > 0 \
+            and step_age > config.hang_timeout:
+        status = "stalled"
+    srv = _server
+    return {
+        "status": status,
+        "host": int(config.process_id),
+        "pid": os.getpid(),
+        "attempt": int(os.environ.get("BIGDL_ELASTIC_ATTEMPT", "0") or 0),
+        "time": now,
+        "port": srv.port if srv is not None else None,
+        "uptime_s": (round(now - srv.started, 3)
+                     if srv is not None else None),
+        "step": step,
+        "step_age_s": step_age,
+        "goodput_ratio": (None if ratio is None
+                          else round(min(1.0, ratio), 6)),
+        "alerts": active_alerts,
+        "heartbeat": _heartbeat_census(),
+    }
+
+
+# ------------------------------------------------------------- server
+class _Handler(http.server.BaseHTTPRequestHandler):
+    server_version = "bigdl-obs/1"
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # noqa: A003 — stdlib signature
+        log.debug("obs.server: " + fmt, *args)
+
+    def _send(self, code: int, body: bytes, content_type: str):
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, obj, code: int = 200):
+        self._send(code, json.dumps(obj, default=str).encode("utf-8"),
+                   "application/json")
+
+    def do_GET(self):  # noqa: N802 — stdlib spelling
+        try:
+            url = urllib.parse.urlsplit(self.path)
+            if url.path == "/metrics":
+                self._send(200, metrics_text().encode("utf-8"),
+                           "text/plain; version=0.0.4; charset=utf-8")
+            elif url.path == "/healthz":
+                self._send_json(health_payload())
+            elif url.path == "/trace":
+                q = urllib.parse.parse_qs(url.query)
+                last = int(q.get("last", ["64"])[0])
+                self._send_json(trace_tail(last))
+            elif url.path == "/":
+                self._send_json(
+                    {"endpoints": ["/metrics", "/healthz",
+                                   "/trace?last=K"]})
+            else:
+                self._send_json({"error": f"no route {url.path}"}, 404)
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # scraper went away mid-response
+        except Exception as e:  # noqa: BLE001 — a scrape must not die ugly
+            log.exception("obs.server: %s failed", self.path)
+            try:
+                self._send_json({"error": f"{type(e).__name__}: {e}"},
+                                500)
+            except OSError:
+                pass
+
+
+class ObsServer:
+    """One per-host endpoint: a ``ThreadingHTTPServer`` with daemon
+    request threads, served from a daemon thread."""
+
+    def __init__(self, port: int, host: str = "0.0.0.0",
+                 port_file: Optional[str] = None):
+        self.httpd = http.server.ThreadingHTTPServer((host, int(port)),
+                                                     _Handler)
+        self.httpd.daemon_threads = True
+        self.port = int(self.httpd.server_address[1])
+        self.port_file = port_file
+        self.started = time.time()
+        if port_file:
+            # atomic replace: a watching supervisor never reads a torn
+            # port number
+            tmp = port_file + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as fh:
+                fh.write(str(self.port))
+            os.replace(tmp, port_file)
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever,
+            kwargs={"poll_interval": 0.25},
+            name="bigdl-obs-server", daemon=True)
+        self._thread.start()
+        log.info("obs.server: live telemetry on port %d "
+                 "(/metrics /healthz /trace)", self.port)
+
+    def url(self, path: str = "/healthz") -> str:
+        return f"http://127.0.0.1:{self.port}{path}"
+
+    def close(self):
+        """Stop serving and release the socket (idempotent)."""
+        try:
+            self.httpd.shutdown()
+            self.httpd.server_close()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
+        if self._thread.is_alive():
+            self._thread.join(timeout=2.0)
+
+
+# ---------------------------------------------------------- singleton
+def ensure_server() -> Optional[ObsServer]:
+    """The process endpoint — built when ``BIGDL_OBS_PORT`` is set,
+    ``None`` otherwise (no thread, no socket: the disabled path is this
+    one config read).  Rebuilt when the port config changes; a bind
+    failure logs and disables rather than killing training."""
+    global _server, _server_key, _atexit_registered
+    from bigdl_tpu.config import refresh_from_env
+
+    cfg = refresh_from_env().obs
+    key = (cfg.obs_port, cfg.obs_port_file)
+    with _lock:
+        if key == _server_key:
+            return _server
+        if _server is not None:
+            _server.close()
+            _server = None
+        _server_key = key
+        if cfg.obs_port is not None:
+            try:
+                _server = ObsServer(cfg.obs_port,
+                                    port_file=cfg.obs_port_file)
+            except OSError as e:
+                log.warning("obs.server: cannot bind port %s (%s) — "
+                            "live telemetry disabled for this process",
+                            cfg.obs_port, e)
+                _server = None
+            if _server is not None and not _atexit_registered:
+                atexit.register(stop_server)
+                _atexit_registered = True
+        return _server
+
+
+def get_server() -> Optional[ObsServer]:
+    """The running server, if any (never builds one)."""
+    return _server
+
+
+def stop_server():
+    """Tear the endpoint down (atexit / Engine.reset / obs.reset
+    hook); the next :func:`ensure_server` rebuilds from live config."""
+    global _server, _server_key
+    with _lock:
+        if _server is not None:
+            _server.close()
+            _server = None
+        _server_key = None

@@ -775,3 +775,10 @@ class DistriOptimizer(LocalOptimizer):
                 # between the checkpoint and the crash — stamp this
                 # attempt's own max step as the rework high-water mark
                 obs.get_ledger().stamp_resume(self.state["neval"])
+                # re-stamp /healthz with the restored step so the hang
+                # watchdog's stall clock restarts at the rewind instead
+                # of reading the pre-crash stamp's age
+                from bigdl_tpu.obs import server as _obs_server
+
+                if _obs_server.get_server() is not None:
+                    _obs_server.note_step(self.state["neval"])

@@ -637,6 +637,17 @@ class LocalOptimizer(BaseOptimizer):
         # off, so the hot loop pays method-call noise at most and never
         # a device read either way
         self._obs_ledger = obs.get_ledger()
+        # live telemetry plane (obs/server.py): the /metrics + /healthz
+        # endpoint exists only when BIGDL_OBS_PORT is set; unset, this
+        # is one config read, no thread, no socket — and the loop below
+        # skips the per-step stamp entirely
+        from bigdl_tpu.obs import server as _obs_server
+
+        self._obs_server = _obs_server.ensure_server()
+        if self._obs_server is not None:
+            # the reference Metrics phase timers live in a private
+            # registry; expose them on /metrics next to the process one
+            _obs_server.register_registry(self.metrics.registry)
         # training-health telemetry: the monitor exists only when
         # BIGDL_HEALTH_EVERY > 0; its absence makes the step build the
         # exact health-less signature with zero extra host transfers
@@ -731,6 +742,14 @@ class LocalOptimizer(BaseOptimizer):
         runtime = self._obs_runtime
         monitor = self._health_monitor
         ledger = self._obs_ledger
+        # step-advance stamp for /healthz + the supervisor hang
+        # watchdog: one tuple rebind per resolved step, and only when
+        # the live endpoint exists — the disabled path stays a None
+        # check (the exact off-path the noop pin asserts)
+        if getattr(self, "_obs_server", None) is not None:
+            from bigdl_tpu.obs.server import note_step
+        else:
+            note_step = None
 
         # Async-dispatch pipelining: the device loss is read back ONE
         # iteration behind, so the next step is dispatched before the
@@ -775,6 +794,8 @@ class LocalOptimizer(BaseOptimizer):
             # goodput: one productive-step interval (re-tagged rework
             # by the ledger when n is under the resume high-water mark)
             ledger.record("step", t0, dt, step=n)
+            if note_step is not None:
+                note_step(n)
             self.state["loss"] = loss_val
             if monitor is not None:
                 # fetches the (L, 4) health array only every K steps —

@@ -253,8 +253,22 @@ class HeartbeatMonitor:
     def scan(self, now: Optional[float] = None) -> dict:
         """Flag peers silent past the timeout; returns {host: age}.
         Each newly lost peer emits one ``elastic.peer_lost`` trace
-        event and one ``bigdl_peer_lost_total`` increment."""
-        for h, age in self.peer_ages(now).items():
+        event and one ``bigdl_peer_lost_total`` increment.  Every scan
+        also mirrors the per-peer ages into
+        ``bigdl_heartbeat_age_seconds{host}`` gauges — staleness as
+        *data* the alert engine and ``/healthz`` can watch degrade,
+        not only the terminal :class:`PeerLostError`."""
+        ages = self.peer_ages(now)
+        if ages:
+            from bigdl_tpu import obs
+
+            gauge = obs.get_registry().gauge(
+                "bigdl_heartbeat_age_seconds",
+                "Seconds since each peer host's last heartbeat file "
+                "write", labels=("host",))
+            for h, age in ages.items():
+                gauge.labels(host=h).set(round(max(0.0, age), 3))
+        for h, age in ages.items():
             if age > self.timeout_s and h not in self._lost:
                 self._lost[h] = age
                 log.error("elastic: peer host %d silent for %.1fs "
@@ -454,8 +468,14 @@ def restore_latest(optimizer, directory: Optional[str] = None):
     # and the pre-crash front are accounted as rework badput, not
     # productive time
     from bigdl_tpu import obs
+    from bigdl_tpu.obs import server as _obs_server
 
     obs.get_ledger().stamp_resume(optimizer.state.get("neval"))
+    # re-stamp /healthz with the restored step: a resume that rewinds
+    # neval must restart the hang watchdog's stall clock, not inherit
+    # the dead attempt's stamp age
+    if _obs_server.get_server() is not None:
+        _obs_server.note_step(optimizer.state.get("neval") or 0)
     return extra
 
 

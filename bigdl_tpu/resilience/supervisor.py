@@ -34,11 +34,13 @@ is evicting).
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import signal
 import subprocess
 import sys
+import tempfile
 import time
 from typing import Callable, List, Optional, Sequence
 
@@ -49,6 +51,77 @@ from bigdl_tpu.resilience.elastic import (
 from bigdl_tpu.resilience.retry import RetryPolicy
 
 log = logging.getLogger("bigdl_tpu.resilience")
+
+
+class HangWatchdog:
+    """Classify a silent child as *hung* via its live ``/healthz``.
+
+    Heartbeats catch a dead *host* and exit codes catch a dead
+    *process*, but a child stuck inside a collective (or a wedged data
+    loader) is alive by both measures while making zero progress.  The
+    live telemetry plane closes that gap: both optimizers stamp every
+    resolved step (``obs/server.note_step``), ``/healthz`` serves the
+    stamp's age, and this watchdog polls it — a child whose
+    ``step_age_s`` exceeds ``BIGDL_HANG_TIMEOUT`` is killed and
+    restarted as a transient failure under the retry budget.
+
+    The child's endpoint is found via ``BIGDL_OBS_PORT`` (>0), or —
+    for ephemeral port 0 — via the ``BIGDL_OBS_PORT_FILE`` the child
+    writes its bound port into (the supervisor injects a temp path
+    when the launcher didn't).  Conservative by construction: any
+    fetch failure, a missing port, or a child that has not resolved
+    its *first* step yet (startup/compile can legitimately take longer
+    than the hang budget) reads as "cannot tell", never as "hung".
+    ``fetch`` is injectable so every branch unit-tests without HTTP."""
+
+    def __init__(self, timeout_s: float, port: Optional[int] = None,
+                 port_file: Optional[str] = None,
+                 fetch: Optional[Callable[[str], Optional[dict]]] = None):
+        self.timeout_s = float(timeout_s)
+        self.port = int(port) if port else None
+        self.port_file = port_file
+        self._fetch = fetch or self._http_fetch
+        self.last_payload: Optional[dict] = None
+
+    @staticmethod
+    def _http_fetch(url: str) -> Optional[dict]:
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(url, timeout=1.0) as resp:
+                return json.loads(resp.read().decode("utf-8"))
+        except Exception:  # noqa: BLE001 — unreachable != hung
+            return None
+
+    def _resolve_port(self) -> Optional[int]:
+        if self.port:
+            return self.port
+        if self.port_file and os.path.isfile(self.port_file):
+            try:
+                with open(self.port_file, encoding="utf-8") as fh:
+                    self.port = int(fh.read().strip() or 0) or None
+            except (OSError, ValueError):
+                self.port = None
+        return self.port
+
+    def health(self) -> Optional[dict]:
+        """One ``/healthz`` poll (None when unreachable/unknown)."""
+        port = self._resolve_port()
+        if not port:
+            return None
+        payload = self._fetch(f"http://127.0.0.1:{port}/healthz")
+        if payload is not None:
+            self.last_payload = payload
+        return payload
+
+    def stalled(self) -> bool:
+        """True only on positive evidence: the child answered and its
+        newest step stamp is older than the hang budget."""
+        payload = self.health()
+        if not payload:
+            return False
+        age = payload.get("step_age_s")
+        return age is not None and float(age) > self.timeout_s
 
 
 class Supervisor:
@@ -63,7 +136,8 @@ class Supervisor:
                  policy: Optional[RetryPolicy] = None,
                  runner: Optional[Callable] = None,
                  sleep: Callable[[float], None] = time.sleep,
-                 fatal_codes: Sequence[int] = (EXIT_FATAL, 2, 126, 127)):
+                 fatal_codes: Sequence[int] = (EXIT_FATAL, 2, 126, 127),
+                 hang_timeout: Optional[float] = None):
         if not cmd:
             raise ValueError("supervisor needs a command to run")
         self.cmd = list(cmd)
@@ -73,16 +147,71 @@ class Supervisor:
         self._runner = runner or self._spawn
         self._sleep = sleep
         self.fatal_codes = set(int(c) for c in fatal_codes)
+        if hang_timeout is None:
+            from bigdl_tpu.config import refresh_from_env
+
+            hang_timeout = refresh_from_env().hang_timeout
+        self.hang_timeout = float(hang_timeout or 0.0)
         self.attempt = 0          # 0-based launch counter (all launches)
         self.preemptions = 0
+        self.hangs = 0
         self._child: Optional[subprocess.Popen] = None
         self._terminated = False  # the supervisor itself was signalled
+        self._hang_detected = False
 
     # ------------------------------------------------------------- child
+    def _make_watchdog(self, env: dict) -> Optional[HangWatchdog]:
+        """A watchdog for this launch, or None when disabled.  Needs
+        BIGDL_HANG_TIMEOUT > 0 and a child live endpoint to poll
+        (BIGDL_OBS_PORT; port 0 resolves through the port file the
+        launch env carries — injected by :meth:`run` when absent)."""
+        if self.hang_timeout <= 0:
+            return None
+        port_spec = env.get("BIGDL_OBS_PORT")
+        if port_spec in (None, ""):
+            log.warning("supervisor: BIGDL_HANG_TIMEOUT=%g set but "
+                        "BIGDL_OBS_PORT is not — the hang watchdog "
+                        "needs the child's /healthz; disabled",
+                        self.hang_timeout)
+            return None
+        try:
+            port = int(port_spec)
+        except ValueError:
+            return None
+        return HangWatchdog(self.hang_timeout,
+                            port=port if port > 0 else None,
+                            port_file=env.get("BIGDL_OBS_PORT_FILE"))
+
     def _spawn(self, cmd: List[str], env: dict) -> int:
         self._child = subprocess.Popen(cmd, env=env)
+        watchdog = self._make_watchdog(env)
         try:
-            return self._child.wait()
+            if watchdog is None:
+                return self._child.wait()
+            # poll a few times per hang budget: fine-grained enough to
+            # catch a stall promptly, coarse enough that the scrape
+            # cost on the child is noise
+            poll = max(0.1, min(2.0, self.hang_timeout / 4.0))
+            while True:
+                try:
+                    return self._child.wait(timeout=poll)
+                except subprocess.TimeoutExpired:
+                    pass
+                if self._terminated or not watchdog.stalled():
+                    continue
+                payload = watchdog.last_payload or {}
+                log.error(
+                    "supervisor: child step stamp stale for %.1fs "
+                    "(step %s, budget %.1fs) — killing the hung child",
+                    payload.get("step_age_s", -1.0), payload.get("step"),
+                    self.hang_timeout)
+                self._hang_detected = True
+                self._child.terminate()
+                try:
+                    self._child.wait(timeout=5.0)
+                except subprocess.TimeoutExpired:
+                    self._child.kill()
+                return self._child.wait()
         finally:
             self._child = None
 
@@ -128,9 +257,26 @@ class Supervisor:
             env = dict(os.environ)
             env["BIGDL_ELASTIC_ATTEMPT"] = str(self.attempt)
             env["BIGDL_ELASTIC_PREEMPTIONS"] = str(self.preemptions)
+            # hang watchdog on an ephemeral child port: the child must
+            # tell the supervisor where it bound, so inject a per-launch
+            # port file when the launcher didn't provide one
+            if self.hang_timeout > 0 \
+                    and env.get("BIGDL_OBS_PORT") == "0" \
+                    and not env.get("BIGDL_OBS_PORT_FILE"):
+                env["BIGDL_OBS_PORT_FILE"] = os.path.join(
+                    tempfile.gettempdir(),
+                    f"bigdl-obs-port.{os.getpid()}.a{self.attempt}")
+            pf = env.get("BIGDL_OBS_PORT_FILE")
+            if pf:
+                try:  # a stale file from a dead launch must not
+                    os.unlink(pf)  # point the watchdog at a ghost port
+                except OSError:
+                    pass
             log.info("supervisor: launch %d (preemptions so far: %d): %s",
                      self.attempt, self.preemptions, " ".join(self.cmd))
+            self._hang_detected = False
             rc = self._runner(self.cmd, env)
+            hung = self._hang_detected
             self.attempt += 1
             if rc == 0:
                 log.info("supervisor: command completed cleanly")
@@ -142,7 +288,7 @@ class Supervisor:
                 log.warning("supervisor: stopping after its own signal; "
                             "child exited %d", rc)
                 return rc
-            if rc == EXIT_PREEMPTED:
+            if rc == EXIT_PREEMPTED and not hung:
                 self.preemptions += 1
                 self._event("elastic.restart", kind="preempted", rc=rc,
                             attempt=self.attempt,
@@ -157,24 +303,30 @@ class Supervisor:
                             "resuming from the latest checkpoint "
                             "(no retry budget consumed)", rc)
                 continue
-            if rc in self.fatal_codes:
+            if rc in self.fatal_codes and not hung:
                 log.error("supervisor: child exited %d (fatal — "
                           "restarting cannot help)", rc)
                 self._event("elastic.supervisor_fatal", rc=rc,
                             attempt=self.attempt)
                 return rc
+            # a hang-killed child is transient BY CLASSIFICATION — the
+            # watchdog produced the exit code, so the code itself says
+            # nothing; it restarts under the same retry budget
+            kind = "hang" if hung else "transient"
+            if hung:
+                self.hangs += 1
             delay = self.policy.record_failure()
-            self._event("elastic.restart", kind="transient", rc=rc,
+            self._event("elastic.restart", kind=kind, rc=rc,
                         attempt=self.attempt,
                         delay_s=None if delay is None else round(delay, 3))
-            self._count_restart("transient")
+            self._count_restart(kind)
             if delay is None:
                 log.error("supervisor: retry budget exhausted after %d "
-                          "transient failures; giving up with rc %d",
-                          self.policy.attempts, rc)
+                          "%s failures; giving up with rc %d",
+                          self.policy.attempts, kind, rc)
                 return rc
-            log.warning("supervisor: child exited %d (transient) — "
-                        "restart %d/%d in %.2fs", rc,
+            log.warning("supervisor: child exited %d (%s) — "
+                        "restart %d/%d in %.2fs", rc, kind,
                         self.policy.attempts, self.policy.max_retries,
                         delay)
             if delay > 0:
@@ -203,6 +355,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     help="transient-restart attempt cap (default 5)")
     ap.add_argument("--max-preemptions", type=int, default=1000,
                     help="preemption-restart cap (default 1000)")
+    ap.add_argument("--hang-timeout", type=float, default=None,
+                    help="kill+restart a child whose /healthz step "
+                         "stamp stops advancing for this many seconds "
+                         "(default BIGDL_HANG_TIMEOUT; needs "
+                         "BIGDL_OBS_PORT on the child)")
     ap.add_argument("cmd", nargs=argparse.REMAINDER,
                     help="training command (prefix with --)")
     args = ap.parse_args(argv)
@@ -215,7 +372,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         level=logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s")
     sup = Supervisor(cmd, max_retries=args.max_retries,
-                     max_preemptions=args.max_preemptions)
+                     max_preemptions=args.max_preemptions,
+                     hang_timeout=args.hang_timeout)
     sup.install_signal_forwarding()
     try:
         return sup.run()

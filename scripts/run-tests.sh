@@ -47,6 +47,20 @@
 #                                                # --json) and the bottleneck
 #                                                # classifier says input_bound
 #                                                # (no pytest)
+#   scripts/run-tests.sh --live                  # live-telemetry smoke: a
+#                                                # 2-host run with /metrics +
+#                                                # /healthz servers on
+#                                                # ephemeral ports, scraped
+#                                                # mid-run; fleet snapshot
+#                                                # merged from both; a goodput
+#                                                # SLO alert fires during a
+#                                                # starved window and resolves
+#                                                # after; report --watch
+#                                                # --once renders the alerts
+#                                                # section; the supervisor
+#                                                # hang watchdog restarts a
+#                                                # deliberately wedged child
+#                                                # (no pytest)
 # The chaos and obs specs are deterministic and part of the default
 # selection; the flags are the focused loops for hacking on those layers.
 set -euo pipefail
@@ -74,6 +88,9 @@ elif [[ "${1:-}" == "--goodput" ]]; then
 elif [[ "${1:-}" == "--tune" ]]; then
   shift
   exec python scripts/tune_smoke.py "$@"
+elif [[ "${1:-}" == "--live" ]]; then
+  shift
+  exec python scripts/live_smoke.py "$@"
 fi
 
 exec python -m pytest tests/ -q "${MARKER[@]}" "$@"

@@ -44,6 +44,20 @@ children from their emergency checkpoint (exit code 170) for free and
 transient crashes under the retry budget — see MIGRATION.md "Elastic
 training" for the exit-code/heartbeat/resize knobs, and
 ``scripts/run-tests.sh --elastic`` for the end-to-end smoke.
+
+A run you need to watch RIGHT NOW (not post-mortem) has the live
+telemetry plane: export ``BIGDL_OBS_PORT`` and curl the host's
+``/healthz`` (status / last-step age / live goodput / firing alerts)
+and ``/metrics`` (Prometheus, scrapeable), or point ``python -m
+bigdl_tpu.obs.report <dir> --watch`` at the fleet
+(``BIGDL_OBS_PEERS=h0:P,h1:P`` for live scraping, shard tailing
+otherwise).  A run that silently WEDGES — alive, no step progress —
+is exactly what ``BIGDL_HANG_TIMEOUT`` + the supervisor's /healthz
+hang watchdog restarts; the declarative alert pack
+(``BIGDL_ALERT_RULES``/``BIGDL_ALERT_SINK``) pages on goodput SLO
+burn, non-finite spikes, stragglers, checkpoint failures and stale
+heartbeats — see MIGRATION.md "Live telemetry & alerting" and
+``scripts/run-tests.sh --live`` for the end-to-end smoke.
 """
 
 import argparse

@@ -56,37 +56,32 @@ def _model(d=16, k=4):
         .add(LogSoftMax())
 
 
-# one sample line of Prometheus text exposition format 0.0.4
-_PROM_SAMPLE = re.compile(
-    r'^[a-zA-Z_:][a-zA-Z0-9_:]*'                      # metric name
-    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"'              # first label
-    r'(,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\})?'         # more labels
-    r' [-+0-9.eE]+(inf|nan)?$')
-
-
+# parity contract: the reader half (obs.metrics.parse_prometheus) must
+# consume everything the writer (to_prometheus) emits — including the
+# HELP/TYPE family headers real scrapers require on EVERY family
 def _assert_prometheus_parses(text):
+    from bigdl_tpu.obs.metrics import parse_prometheus
+
     assert text.strip(), "empty exposition"
-    for line in text.splitlines():
-        if not line or line.startswith("#"):
-            continue
-        assert _PROM_SAMPLE.match(line), f"bad exposition line: {line!r}"
+    parsed = parse_prometheus(text)  # raises on any malformed line
+    assert parsed["samples"], "exposition with no samples"
+    # every sample's family must carry both # HELP and # TYPE lines
+    # (histogram _bucket/_sum/_count samples belong to the base family)
+    fams = parsed["families"]
+    for s in parsed["samples"]:
+        base = re.sub(r"_(bucket|sum|count)$", "", s["name"])
+        fam = fams.get(s["name"]) or fams.get(base)
+        assert fam is not None, f"sample {s['name']} has no family header"
+        assert "help" in fam, f"family of {s['name']} missing # HELP"
+        assert "type" in fam, f"family of {s['name']} missing # TYPE"
+    return parsed
 
 
 def _prom_value(text, name, **labels):
     """Value of the sample `name{labels}` in an exposition text."""
-    for line in text.splitlines():
-        if not line.startswith(name):
-            continue
-        rest = line[len(name):]
-        if rest.startswith("{"):
-            body, value = rest[1:].split("}", 1)
-            got = dict(p.split("=", 1) for p in body.split(",") if p)
-            got = {k: v.strip('"') for k, v in got.items()}
-        else:
-            got, value = {}, rest
-        if all(got.get(k) == str(v) for k, v in labels.items()):
-            return float(value)
-    return None
+    from bigdl_tpu.obs.metrics import parse_prometheus, sample_value
+
+    return sample_value(parse_prometheus(text), name, **labels)
 
 
 # ------------------------------------------------------------- registry

@@ -1,0 +1,823 @@
+"""Live telemetry plane specs (ISSUE 8) — per-host /metrics + /healthz
++ /trace endpoints, the declarative alert/SLO engine, live fleet
+aggregation, and the supervisor hang watchdog.
+
+The acceptance pins live here: a LocalOptimizer run with
+``BIGDL_OBS_PORT=0`` serves a scrapeable Prometheus exposition (with
+the HELP/TYPE family headers real scrapers require) and a /healthz
+whose step stamp tracks the loop; a synthetic nan_grad fault drives an
+alert through its full firing→resolved lifecycle; with the port unset
+the process holds no server thread and no socket; and a deliberately
+stalled child is killed and restarted by the supervisor's hang
+watchdog — the failure class heartbeats and exit codes cannot see.
+"""
+
+import json
+import math
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from bigdl_tpu import obs
+from bigdl_tpu.nn import ClassNLLCriterion, Linear, LogSoftMax, ReLU, Sequential
+from bigdl_tpu.obs import alerts, server
+from bigdl_tpu.obs.aggregate import FleetAggregator, ShardTailer
+from bigdl_tpu.obs.metrics import (
+    MetricsRegistry,
+    parse_prometheus,
+    sample_value,
+)
+from bigdl_tpu.optim import SGD, LocalOptimizer, Trigger
+from bigdl_tpu.resilience import reset_injector
+from bigdl_tpu.resilience.supervisor import HangWatchdog, Supervisor
+
+pytestmark = pytest.mark.obs
+
+_LIVE_VARS = (
+    "BIGDL_OBS", "BIGDL_TRACE_DIR", "BIGDL_METRICS_DIR",
+    "BIGDL_FAULT_PLAN", "BIGDL_OBS_PORT", "BIGDL_OBS_PORT_FILE",
+    "BIGDL_OBS_PEERS", "BIGDL_ALERT_RULES", "BIGDL_ALERT_SINK",
+    "BIGDL_HANG_TIMEOUT", "BIGDL_GOODPUT_WINDOW",
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs(monkeypatch):
+    for var in _LIVE_VARS:
+        monkeypatch.delenv(var, raising=False)
+    reset_injector()
+    obs.reset()
+    yield
+    obs.reset()
+    reset_injector()
+
+
+def _toy(n=128, d=16, k=4, seed=0):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(d, k)
+    x = rng.randn(n, d).astype(np.float32)
+    y = (np.argmax(x @ w, axis=1) + 1).astype(np.float32)
+    return x, y
+
+
+def _model(d=16, k=4):
+    return Sequential().add(Linear(d, 32)).add(ReLU()).add(Linear(32, k)) \
+        .add(LogSoftMax())
+
+
+def _get(url, timeout=5.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.read().decode("utf-8")
+
+
+def _obs_threads():
+    return [t for t in threading.enumerate()
+            if t.name == "bigdl-obs-server"]
+
+
+# ================================================ exposition reader
+class TestParsePrometheus:
+    def test_roundtrip_families_and_samples(self):
+        reg = MetricsRegistry()
+        reg.counter("a_total", "counts a", labels=("k",)).labels(
+            k='va"l\\ue\n').inc(3)
+        reg.gauge("g", "a gauge").set(-2.5)
+        reg.histogram("h_seconds", "lat", buckets=(0.5, 1.0)).observe(0.7)
+        parsed = parse_prometheus(reg.to_prometheus())
+        # every family carries BOTH headers (the satellite contract)
+        for fam in ("a_total", "g", "h_seconds"):
+            assert parsed["families"][fam]["type"]
+            assert parsed["families"][fam]["help"]
+        # label escaping round-trips exactly
+        assert sample_value(parsed, "a_total", k='va"l\\ue\n') == 3
+        assert sample_value(parsed, "g") == -2.5
+        assert sample_value(parsed, "h_seconds_bucket", le="1") == 1
+        assert sample_value(parsed, "h_seconds_count") == 1
+        assert sample_value(parsed, "h_seconds_sum") == 0.7
+
+    def test_nonfinite_values(self):
+        reg = MetricsRegistry()
+        reg.gauge("nan_g", "x").set(float("nan"))
+        reg.gauge("inf_g", "x").set(float("inf"))
+        parsed = parse_prometheus(reg.to_prometheus())
+        assert math.isnan(sample_value(parsed, "nan_g"))
+        assert sample_value(parsed, "inf_g") == float("inf")
+
+    def test_malformed_line_is_loud(self):
+        with pytest.raises(ValueError, match="bad exposition line"):
+            parse_prometheus("ok_metric 1\nthis is not exposition\n")
+
+    def test_missing_sample_is_none(self):
+        assert sample_value(parse_prometheus("x 1"), "y") is None
+        assert sample_value(parse_prometheus('x{a="1"} 1'), "x", a=2) is None
+
+
+# ==================================================== burn-rate math
+class TestBurnRate:
+    def test_units(self):
+        # SLO 0.5 leaves a 0.5 error budget: observing 0.25 burns
+        # 0.75/0.5 = 1.5x sustainable
+        assert alerts.burn_rate(0.25, 0.5) == pytest.approx(1.5)
+        # exactly at the SLO boundary burns exactly 1.0
+        assert alerts.burn_rate(0.9, 0.9) == pytest.approx(1.0)
+        # perfect goodput burns nothing
+        assert alerts.burn_rate(1.0, 0.9) == 0.0
+        # zero budget (slo >= 1): any shortfall is infinite burn
+        assert alerts.burn_rate(0.99, 1.0) == float("inf")
+        assert alerts.burn_rate(1.0, 1.0) == 0.0
+        # no signal yet: no burn (absence is its own rule type)
+        assert alerts.burn_rate(None, 0.9) == 0.0
+
+    def test_burn_rate_rule_fires_and_resolves(self):
+        reg = MetricsRegistry()
+        g = reg.gauge("bigdl_goodput_window_ratio", "w")
+        eng = alerts.AlertEngine(
+            [{"name": "burn", "type": "burn_rate",
+              "metric": "bigdl_goodput_window_ratio", "slo": 0.5,
+              "threshold": 1.5, "for": 1, "severity": "warning"}],
+            registry=reg, clock=lambda: 100.0)
+        g.set(0.25)  # burn 1.5 >= 1.5 -> breach
+        t = eng.evaluate()
+        assert [x["state"] for x in t] == ["firing"]
+        assert t[0]["value"] == pytest.approx(1.5)
+        g.set(0.9)   # burn 0.2 -> resolve
+        t = eng.evaluate()
+        assert [x["state"] for x in t] == ["resolved"]
+
+
+# =================================================== rule validation
+class TestAlertRules:
+    def test_default_pack_validates(self):
+        rules = alerts.load_rules(None, heartbeat_timeout=60.0)
+        names = {r["name"] for r in rules}
+        assert {"goodput_below_target", "nonfinite_spike",
+                "straggler_flagged", "checkpoint_write_failure",
+                "stale_peer_heartbeat", "goodput_slo_burn"} <= names
+        assert all(r["type"] in alerts.RULE_TYPES for r in rules)
+
+    def test_inline_json_and_file(self, tmp_path):
+        spec = '[{"name": "x", "metric": "m", "op": ">", "value": 1}]'
+        rules = alerts.load_rules(spec)
+        assert rules[0]["type"] == "threshold"  # defaulted
+        assert rules[0]["for"] == 1
+        p = tmp_path / "rules.json"
+        p.write_text(spec)
+        assert alerts.load_rules(str(p)) == rules
+
+    @pytest.mark.parametrize("bad,msg", [
+        ('[{"name": "x", "metric": "m", "type": "nope"}]', "unknown type"),
+        ('[{"metric": "m"}]', "missing a name"),
+        ('[{"name": "x"}]', "missing metric"),
+        ('[{"name": "x", "metric": "m", "op": "~", "value": 1}]', "op"),
+        ('[{"name": "x", "metric": "m"}]', "missing value"),
+        ('[{"name": "x", "metric": "m", "type": "burn_rate"}]',
+         "needs slo"),
+        ('{"name": "x"}', "JSON list"),
+    ])
+    def test_typod_pack_fails_at_build(self, bad, msg):
+        with pytest.raises(ValueError, match=msg):
+            alerts.load_rules(bad)
+
+
+# ===================================================== alert engine
+class TestAlertEngine:
+    def _engine(self, rules, reg, clock=None):
+        return alerts.AlertEngine(alerts.load_rules(json.dumps(rules)),
+                                  registry=reg,
+                                  clock=clock or (lambda: 1.0))
+
+    def test_threshold_for_debounce_and_lifecycle(self):
+        reg = MetricsRegistry()
+        g = reg.gauge("bigdl_goodput_ratio", "r")
+        eng = self._engine(
+            [{"name": "low", "metric": "bigdl_goodput_ratio",
+              "op": "<", "value": 0.5, "for": 2,
+              "severity": "warning"}], reg)
+        g.set(0.2)
+        assert eng.evaluate() == []          # 1st breach: debounced
+        t = eng.evaluate()                   # 2nd consecutive: fires
+        assert [x["state"] for x in t] == ["firing"]
+        assert eng.active()[0]["rule"] == "low"
+        # lifecycle metrics on fire
+        text = reg.to_prometheus()
+        parsed = parse_prometheus(text)
+        assert sample_value(parsed, "bigdl_alerts_total", rule="low",
+                            severity="warning") == 1
+        assert sample_value(parsed, "bigdl_alert_active", rule="low") == 1
+        g.set(0.9)
+        t = eng.evaluate()
+        assert [x["state"] for x in t] == ["resolved"]
+        assert eng.active() == []
+        parsed = parse_prometheus(reg.to_prometheus())
+        assert sample_value(parsed, "bigdl_alerts_resolved_total",
+                            rule="low") == 1
+        assert sample_value(parsed, "bigdl_alert_active", rule="low") == 0
+        # one flaky breach does not re-fire (for=2 resets)
+        g.set(0.2)
+        assert eng.evaluate() == []
+
+    def test_threshold_picks_worst_labeled_sample(self):
+        reg = MetricsRegistry()
+        g = reg.gauge("bigdl_heartbeat_age_seconds", "ages",
+                      labels=("host",))
+        g.labels(host=1).set(2.0)
+        g.labels(host=2).set(45.0)
+        eng = self._engine(
+            [{"name": "stale", "metric": "bigdl_heartbeat_age_seconds",
+              "op": ">", "value": 30.0}], reg)
+        t = eng.evaluate()
+        assert t[0]["state"] == "firing"
+        assert t[0]["value"] == 45.0
+        assert t[0]["labels"] == {"host": "2"}
+
+    def test_absence_rule(self):
+        reg = MetricsRegistry()
+        eng = self._engine(
+            [{"name": "no_signal", "type": "absence",
+              "metric": "bigdl_goodput_ratio"}], reg)
+        assert [x["state"] for x in eng.evaluate()] == ["firing"]
+        reg.gauge("bigdl_goodput_ratio", "r").set(0.5)
+        assert [x["state"] for x in eng.evaluate()] == ["resolved"]
+
+    def test_rate_rule_baselines_existing_counts_at_build(self):
+        reg = MetricsRegistry()
+        c = reg.counter("bigdl_nonfinite_skips_total", "skips")
+        c.inc(10)  # history from before this engine existed
+        eng = self._engine(
+            [{"name": "spike", "type": "rate",
+              "metric": "bigdl_nonfinite_skips_total",
+              "op": ">", "value": 0}], reg)
+        assert eng.evaluate() == []   # primed: 10 is history
+        c.inc(2)
+        t = eng.evaluate()
+        assert t[0]["state"] == "firing"
+        assert t[0]["value"] == 2.0   # the delta, not the total
+        t = eng.evaluate()            # no further movement: resolves
+        assert t[0]["state"] == "resolved"
+
+    def test_rate_rule_counter_appearing_later_is_a_spike(self):
+        """A family registered lazily on its first increment (the
+        nonfinite counter) must fire — not be swallowed as history."""
+        reg = MetricsRegistry()
+        eng = self._engine(
+            [{"name": "spike", "type": "rate",
+              "metric": "bigdl_nonfinite_skips_total",
+              "op": ">", "value": 0}], reg)
+        assert eng.evaluate() == []
+        reg.counter("bigdl_nonfinite_skips_total", "skips").inc()
+        t = eng.evaluate()
+        assert [x["state"] for x in t] == ["firing"]
+        assert t[0]["value"] == 1.0
+
+    def test_one_bad_rule_does_not_kill_the_pack(self):
+        reg = MetricsRegistry()
+        reg.gauge("ok_metric", "x").set(99.0)
+        eng = alerts.AlertEngine(
+            [{"name": "broken", "type": "threshold", "metric": "m",
+              "op": ">", "value": "not-a-number", "for": 1,
+              "severity": "warning"},
+             {"name": "works", "type": "threshold",
+              "metric": "ok_metric", "op": ">", "value": 1,
+              "for": 1, "severity": "warning"}], registry=reg)
+        reg.gauge("m", "x").set(5.0)  # would crash float("not-a-number")
+        t = eng.evaluate()
+        assert [x["rule"] for x in t] == ["works"]
+
+    def test_file_sink_appends_transitions(self, tmp_path):
+        sink = tmp_path / "alerts.jsonl"
+        reg = MetricsRegistry()
+        g = reg.gauge("m", "x")
+        eng = alerts.AlertEngine(
+            alerts.load_rules(
+                '[{"name": "s", "metric": "m", "op": ">", "value": 1}]'),
+            registry=reg, sink=str(sink))
+        g.set(5)
+        eng.evaluate()
+        g.set(0)
+        eng.evaluate()
+        recs = [json.loads(ln) for ln in
+                sink.read_text().strip().splitlines()]
+        assert [r["state"] for r in recs] == ["firing", "resolved"]
+        assert recs[0]["rule"] == "s"
+
+    def test_engine_singleton_rebuilds_on_rule_change(self, monkeypatch):
+        alerts.reset_engine()
+        e1 = alerts.get_engine()
+        assert alerts.get_engine() is e1
+        monkeypatch.setenv(
+            "BIGDL_ALERT_RULES",
+            '[{"name": "z", "metric": "m", "op": ">", "value": 1}]')
+        e2 = alerts.get_engine()
+        assert e2 is not e1
+        assert [r["name"] for r in e2.rules] == ["z"]
+
+
+# ======================================================= obs server
+class TestObsServer:
+    def test_disabled_is_noop_no_thread_no_socket(self):
+        assert server.ensure_server() is None
+        assert server.get_server() is None
+        assert _obs_threads() == []
+        assert server.last_step() == (None, None)
+
+    def test_ephemeral_port_serves_all_routes(self, monkeypatch,
+                                              tmp_path):
+        monkeypatch.setenv("BIGDL_OBS_PORT", "0")
+        monkeypatch.setenv("BIGDL_TRACE_DIR", str(tmp_path))
+        s = server.ensure_server()
+        assert s is not None and s.port > 0
+        assert server.ensure_server() is s  # same config: same server
+        obs.get_registry().counter("bigdl_live_total", "live").inc(4)
+        obs.get_tracer().event("live.ping", k=1)
+        server.note_step(12)
+        code, text = _get(s.url("/metrics"))
+        assert code == 200
+        parsed = parse_prometheus(text)  # loud on malformed lines
+        assert sample_value(parsed, "bigdl_live_total") == 4
+        assert "# TYPE bigdl_live_total counter" in text
+        assert "# HELP bigdl_live_total live" in text
+        code, body = _get(s.url("/healthz"))
+        h = json.loads(body)
+        assert h["status"] == "ok"
+        assert h["step"] == 12
+        assert h["step_age_s"] is not None and h["step_age_s"] >= 0
+        assert h["port"] == s.port
+        code, body = _get(s.url("/trace?last=8"))
+        tail = json.loads(body)
+        assert any(r.get("name") == "live.ping" for r in tail)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(s.url("/nope"))
+        assert ei.value.code == 404
+
+    def test_port_file_carries_bound_port(self, monkeypatch, tmp_path):
+        pf = tmp_path / "port"
+        monkeypatch.setenv("BIGDL_OBS_PORT", "0")
+        monkeypatch.setenv("BIGDL_OBS_PORT_FILE", str(pf))
+        s = server.ensure_server()
+        assert int(pf.read_text()) == s.port
+
+    def test_rebuild_on_port_change_and_idempotent_stop(self,
+                                                        monkeypatch):
+        monkeypatch.setenv("BIGDL_OBS_PORT", "0")
+        s1 = server.ensure_server()
+        monkeypatch.delenv("BIGDL_OBS_PORT")
+        assert server.ensure_server() is None  # config off: torn down
+        monkeypatch.setenv("BIGDL_OBS_PORT", "0")
+        s2 = server.ensure_server()
+        assert s2 is not s1
+        server.stop_server()
+        server.stop_server()  # idempotent
+        assert _obs_threads() == []
+
+    def test_bind_failure_disables_instead_of_raising(self, monkeypatch):
+        blocker = socket.socket()
+        blocker.bind(("0.0.0.0", 0))
+        blocker.listen(1)
+        try:
+            monkeypatch.setenv("BIGDL_OBS_PORT",
+                               str(blocker.getsockname()[1]))
+            assert server.ensure_server() is None  # logged, not raised
+        finally:
+            blocker.close()
+
+    def test_extra_registry_weakref(self, monkeypatch):
+        monkeypatch.setenv("BIGDL_OBS_PORT", "0")
+        server.ensure_server()
+        extra = MetricsRegistry()
+        extra.gauge("bigdl_phase_smoke", "x").set(1.5)
+        server.register_registry(extra)
+        server.register_registry(extra)  # dedup
+        text = server.metrics_text()
+        assert sample_value(parse_prometheus(text),
+                            "bigdl_phase_smoke") == 1.5
+        del extra
+        import gc
+
+        gc.collect()
+        assert "bigdl_phase_smoke" not in server.metrics_text()
+
+    def test_healthz_stalled_status_and_heartbeat_census(
+            self, monkeypatch):
+        monkeypatch.setenv("BIGDL_OBS_PORT", "0")
+        monkeypatch.setenv("BIGDL_HANG_TIMEOUT", "0.05")
+        server.ensure_server()
+        server.note_step(3)
+        time.sleep(0.1)
+        obs.get_registry().gauge(
+            "bigdl_heartbeat_age_seconds", "ages",
+            labels=("host",)).labels(host=1).set(4.2)
+        h = server.health_payload()
+        assert h["status"] == "stalled"  # stamp older than the budget
+        assert h["heartbeat"] == {"1": 4.2}
+
+
+# ================================= live LocalOptimizer acceptance gate
+class TestLiveOptimizerScrape:
+    def test_scrape_metrics_healthz_trace_of_live_run(
+            self, monkeypatch, tmp_path):
+        monkeypatch.setenv("BIGDL_OBS_PORT", "0")
+        monkeypatch.setenv("BIGDL_TRACE_DIR", str(tmp_path))
+        monkeypatch.setenv("BIGDL_METRICS_DIR", str(tmp_path))
+        obs.reset()
+        x, y = _toy(n=128)
+        opt = LocalOptimizer(_model(), (x, y), ClassNLLCriterion(),
+                             batch_size=16)
+        opt.set_optim_method(SGD(learningrate=0.1))
+        opt.set_end_when(Trigger.max_epoch(1))
+        opt.optimize()
+        s = server.get_server()
+        assert s is not None  # brought up by the optimizer, still live
+        _, text = _get(s.url("/metrics"))
+        parsed = parse_prometheus(text)
+        # the run's own registry, served live with family headers
+        assert sample_value(parsed, "bigdl_goodput_ratio") is not None
+        assert "# TYPE bigdl_goodput_ratio gauge" in text
+        # the optimizer's private phase registry rides the same scrape
+        assert any(su["name"] == "bigdl_phase_seconds_count"
+                   for su in parsed["samples"])
+        _, body = _get(s.url("/healthz"))
+        h = json.loads(body)
+        assert h["step"] == 8  # 128/16 batches resolved
+        assert h["status"] == "ok"
+        assert 0.0 < h["goodput_ratio"] <= 1.0
+        _, body = _get(s.url("/trace?last=32"))
+        assert len(json.loads(body)) > 0
+
+    def test_alert_firing_resolved_on_nan_grad_fault(
+            self, monkeypatch, tmp_path):
+        """The full lifecycle, end to end: a synthetic nan_grad fault
+        bumps bigdl_nonfinite_skips_total, the alert engine rides the
+        goodput window tick, the nonfinite_spike rate rule fires, and
+        the next quiet window resolves it — with matching counters and
+        alert.firing/alert.resolved trace events."""
+        monkeypatch.setenv("BIGDL_OBS_PORT", "0")
+        monkeypatch.setenv("BIGDL_TRACE_DIR", str(tmp_path))
+        monkeypatch.setenv("BIGDL_METRICS_DIR", str(tmp_path))
+        monkeypatch.setenv("BIGDL_GOODPUT_WINDOW", "2")
+        monkeypatch.setenv("BIGDL_FAULT_PLAN", "step:2:nan_grad")
+        obs.reset()
+        reset_injector()
+        x, y = _toy(n=128)
+        opt = LocalOptimizer(_model(), (x, y), ClassNLLCriterion(),
+                             batch_size=16)
+        opt.set_optim_method(SGD(learningrate=0.1))
+        opt.set_end_when(Trigger.max_epoch(1))
+        opt.optimize()
+        assert opt.state["nonfinite_skips"] == 1
+        parsed = parse_prometheus(obs.get_registry().to_prometheus())
+        fired = sample_value(parsed, "bigdl_alerts_total",
+                             rule="nonfinite_spike", severity="critical")
+        resolved = sample_value(parsed, "bigdl_alerts_resolved_total",
+                                rule="nonfinite_spike")
+        assert fired == 1
+        assert resolved == 1  # matching lifecycle counts
+        assert sample_value(parsed, "bigdl_alert_active",
+                            rule="nonfinite_spike") == 0
+        # both transitions are on the trace, and the report renders them
+        from bigdl_tpu.obs.report import build_report, render_text
+
+        rep = build_report(str(tmp_path))
+        states = [e["state"] for e in rep["alerts"]["events"]
+                  if e.get("rule") == "nonfinite_spike"]
+        assert states == ["firing", "resolved"]
+        text = render_text(rep)
+        assert "-- alerts --" in text
+        assert "nonfinite_spike[critical]" in text
+        assert "fired 1x, resolved 1x" in text
+
+    def test_disabled_run_binds_nothing_and_stamps_nothing(self):
+        """The off-path pin: BIGDL_OBS_PORT unset -> no server object,
+        no daemon thread, no socket, no step stamp — the loop's only
+        cost is one None check."""
+        x, y = _toy(n=64)
+        opt = LocalOptimizer(_model(), (x, y), ClassNLLCriterion(),
+                             batch_size=16)
+        opt.set_optim_method(SGD(learningrate=0.1))
+        opt.set_end_when(Trigger.max_epoch(1))
+        opt.optimize()
+        assert opt._obs_server is None
+        assert server.get_server() is None
+        assert _obs_threads() == []
+        assert server.last_step() == (None, None)
+
+
+# ==================================================== hang watchdog
+class TestHangWatchdog:
+    def test_unreachable_or_preStep_child_is_never_hung(self):
+        wd = HangWatchdog(1.0, port=1, fetch=lambda url: None)
+        assert not wd.stalled()  # cannot tell != hung
+        wd = HangWatchdog(1.0, port=1,
+                          fetch=lambda url: {"step": None,
+                                             "step_age_s": None})
+        assert not wd.stalled()  # still compiling: no first stamp yet
+
+    def test_stale_stamp_is_hung_fresh_is_not(self):
+        wd = HangWatchdog(1.0, port=1,
+                          fetch=lambda url: {"step": 5,
+                                             "step_age_s": 3.0})
+        assert wd.stalled()
+        assert wd.last_payload["step"] == 5
+        wd = HangWatchdog(1.0, port=1,
+                          fetch=lambda url: {"step": 5,
+                                             "step_age_s": 0.2})
+        assert not wd.stalled()
+
+    def test_port_file_resolution(self, tmp_path):
+        pf = tmp_path / "port"
+        seen = []
+        wd = HangWatchdog(1.0, port_file=str(pf),
+                          fetch=lambda url: seen.append(url) or None)
+        assert wd.health() is None      # no file yet: no port, no fetch
+        assert seen == []
+        pf.write_text("45123")
+        wd.health()
+        assert seen == ["http://127.0.0.1:45123/healthz"]
+        assert wd.port == 45123         # cached after first resolve
+
+    def test_supervisor_counts_hang_restarts_under_budget(
+            self, monkeypatch):
+        monkeypatch.setenv("BIGDL_RETRY_BACKOFF_BASE", "0")
+        calls = []
+
+        def runner(cmd, env):
+            calls.append(env["BIGDL_ELASTIC_ATTEMPT"])
+            if len(calls) == 1:
+                sup._hang_detected = True  # what _spawn's kill path sets
+                return -15
+            return 0
+
+        sup = Supervisor(["x"], max_retries=2, runner=runner,
+                         sleep=lambda s: None, hang_timeout=1.0)
+        assert sup.run() == 0
+        assert calls == ["0", "1"]
+        assert sup.hangs == 1
+        parsed = parse_prometheus(obs.get_registry().to_prometheus())
+        assert sample_value(parsed, "bigdl_supervisor_restarts_total",
+                            kind="hang") == 1
+
+    def test_watchdog_disabled_without_port(self, monkeypatch):
+        sup = Supervisor(["x"], runner=lambda c, e: 0,
+                         hang_timeout=5.0)
+        assert sup._make_watchdog({}) is None          # no BIGDL_OBS_PORT
+        assert sup._make_watchdog({"BIGDL_OBS_PORT": "0"}) is not None
+        sup2 = Supervisor(["x"], runner=lambda c, e: 0, hang_timeout=0)
+        assert sup2._make_watchdog({"BIGDL_OBS_PORT": "0"}) is None
+
+    def test_stalled_child_killed_and_restarted(self, monkeypatch,
+                                                tmp_path):
+        """Acceptance: a real child that stamps one step then wedges is
+        killed by the watchdog and restarted; the restarted attempt
+        completes.  This is the hang class exit codes cannot catch (the
+        child never exits) and heartbeats cannot catch (its heartbeat
+        thread would keep beating)."""
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        script = tmp_path / "stall.py"
+        script.write_text(textwrap.dedent(f"""
+            import os, sys, time
+            sys.path.insert(0, {repo!r})
+            from bigdl_tpu.obs import server
+            s = server.ensure_server()
+            assert s is not None, "child server must bind"
+            if int(os.environ.get("BIGDL_ELASTIC_ATTEMPT", "0")) >= 1:
+                sys.exit(0)            # the restarted attempt completes
+            server.note_step(1)
+            time.sleep(120)            # wedged: alive but never advances
+        """))
+        monkeypatch.setenv("BIGDL_OBS_PORT", "0")
+        monkeypatch.setenv("BIGDL_RETRY_BACKOFF_BASE", "0")
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        sup = Supervisor([sys.executable, str(script)], max_retries=2,
+                         hang_timeout=1.5)
+        t0 = time.time()
+        assert sup.run() == 0
+        assert time.time() - t0 < 60, "watchdog should kill in seconds"
+        assert sup.hangs == 1
+        assert sup.attempt == 2
+
+
+# ================================================== fleet aggregation
+def _peer_payload(host, step, ratio, alerts_list=()):
+    health = json.dumps({
+        "status": "ok", "host": host, "step": step, "step_age_s": 0.1,
+        "goodput_ratio": ratio, "alerts": list(alerts_list),
+        "heartbeat": None})
+    reg = MetricsRegistry()
+    reg.gauge("bigdl_goodput_ratio", "r").set(ratio)
+    reg.counter("bigdl_steps_smoke_total", "s").inc(step)
+    return {"/healthz": health, "/metrics": reg.to_prometheus()}
+
+
+class TestFleetAggregator:
+    def test_peer_scrape_merges_hosts_alerts_and_metrics(self):
+        peers = {
+            "h0:9100": _peer_payload(0, 40, 0.9),
+            "h1:9100": _peer_payload(
+                1, 38, 0.3,
+                [{"rule": "goodput_below_target",
+                  "severity": "warning"}]),
+        }
+
+        def fetch(url):
+            for addr, routes in peers.items():
+                if addr in url:
+                    return routes[url.split(addr, 1)[1]]
+            raise OSError("unknown peer")
+
+        agg = FleetAggregator(peers="h0:9100, h1:9100", fetch=fetch)
+        fleet = agg.snapshot()
+        assert fleet["mode"] == "peers"
+        assert set(fleet["hosts"]) == {"0", "1"}
+        assert fleet["hosts"]["1"]["goodput_ratio"] == 0.3
+        assert fleet["hosts"]["0"]["step"] == 40
+        assert [a["rule"] for a in fleet["alerts"]] == [
+            "goodput_below_target"]
+        assert fleet["alerts"][0]["host"] == 1
+        ratios = {s["source"]: s["value"]
+                  for s in fleet["metrics"]["bigdl_goodput_ratio"]}
+        assert ratios == {"h0:9100": 0.9, "h1:9100": 0.3}
+
+    def test_dead_peer_is_data_not_an_exception(self):
+        def fetch(url):
+            raise OSError("connection refused")
+
+        fleet = FleetAggregator(peers=["h9:1"], fetch=fetch).snapshot()
+        assert fleet["hosts"] == {}
+        assert "h9:1" in fleet["errors"]
+
+    def test_shard_tailing_is_incremental(self, tmp_path):
+        def snap_line(host, ratio, active=0):
+            return json.dumps({"ts": 1.0, "host": host, "metrics": {
+                "bigdl_goodput_ratio": {"kind": "gauge", "samples": [
+                    {"labels": {}, "value": ratio}]},
+                "bigdl_alert_active": {"kind": "gauge", "samples": [
+                    {"labels": {"rule": "goodput_below_target"},
+                     "value": active}]},
+            }}) + "\n"
+
+        shard = tmp_path / "metrics.h0.111.jsonl"
+        shard.write_text(snap_line(0, 0.8) + snap_line(0, 0.6, active=1))
+        (tmp_path / "metrics.h1.222.jsonl").write_text(snap_line(1, 0.9))
+        agg = FleetAggregator(metrics_dir=str(tmp_path))
+        fleet = agg.snapshot()
+        assert fleet["mode"] == "shards"
+        assert set(fleet["hosts"]) == {"0", "1"}
+        # newest snapshot per shard wins
+        assert fleet["hosts"]["0"]["goodput_ratio"] == 0.6
+        assert [a["rule"] for a in fleet["alerts"]] == [
+            "goodput_below_target"]
+        # a torn tail line (no newline yet) is not consumed ...
+        torn = snap_line(0, 0.99).rstrip("\n")[:25]
+        with open(shard, "a") as fh:
+            fh.write(torn)
+        assert agg.snapshot()["hosts"]["0"]["goodput_ratio"] == 0.6
+        # ... and a replaced (shrunk) shard is re-read from zero
+        shard.write_text(snap_line(0, 0.99))
+        assert agg.snapshot()["hosts"]["0"]["goodput_ratio"] == 0.99
+
+    def test_tailer_offsets_only_advance_on_complete_lines(self,
+                                                           tmp_path):
+        t = ShardTailer(str(tmp_path))
+        p = tmp_path / "metrics.h0.1.jsonl"
+        p.write_text('{"host": 0, "metrics": {}}\n{"host": 0, "met')
+        t.poll()
+        assert t._offsets[p.name] == len('{"host": 0, "metrics": {}}\n')
+        with open(p, "a") as fh:
+            fh.write('rics": {"g": {"samples": []}}}\n')
+        t.poll()
+        assert t.latest[p.name]["metrics"] == {"g": {"samples": []}}
+
+
+# ================================================== report --watch
+class TestReportWatch:
+    def _seed_dirs(self, tmp_path):
+        """A minimal trace shard + metrics shard a report can read."""
+        (tmp_path / "app.h0.1.0.events.jsonl").write_text("\n".join([
+            json.dumps({"kind": "span", "name": "computing",
+                        "wall_time": 1.0, "dur_s": 0.01, "host": 0,
+                        "pid": 1, "tid": 1, "attrs": {"step": 1}}),
+            json.dumps({"kind": "event", "name": "alert.firing",
+                        "wall_time": 1.1, "host": 0, "pid": 1, "tid": 1,
+                        "attrs": {"rule": "goodput_below_target",
+                                  "severity": "warning",
+                                  "metric": "bigdl_goodput_ratio",
+                                  "value": 0.2}}),
+        ]) + "\n")
+        (tmp_path / "metrics.h0.1.jsonl").write_text(json.dumps({
+            "ts": 1.0, "host": 0, "metrics": {
+                "bigdl_alerts_total": {"kind": "counter", "samples": [
+                    {"labels": {"rule": "goodput_below_target",
+                                "severity": "warning"}, "value": 1}]},
+                "bigdl_alert_active": {"kind": "gauge", "samples": [
+                    {"labels": {"rule": "goodput_below_target"},
+                     "value": 1}]},
+            }}) + "\n")
+
+    def test_watch_once_text_renders_fleet_and_alerts(self, tmp_path,
+                                                      capsys):
+        from bigdl_tpu.obs import report
+
+        self._seed_dirs(tmp_path)
+        rc = report.main([str(tmp_path), "--watch", "--once"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "-- live fleet (shards) --" in out
+        assert "host0" in out
+        assert "-- alerts --" in out
+        assert "FIRING goodput_below_target" in out
+        assert "\x1b[2J" not in out  # --once never clears the screen
+
+    def test_watch_once_json_carries_fleet_and_alerts(self, tmp_path,
+                                                      capsys):
+        from bigdl_tpu.obs import report
+
+        self._seed_dirs(tmp_path)
+        rc = report.main([str(tmp_path), "--watch", "--once", "--json"])
+        assert rc == 0
+        rep = json.loads(capsys.readouterr().out)
+        assert rep["fleet"]["mode"] == "shards"
+        assert "0" in rep["fleet"]["hosts"]
+        assert rep["alerts"]["active"] == ["goodput_below_target"]
+        assert rep["alerts"]["fired_total"] == {
+            "goodput_below_target[warning]": 1}
+        assert rep["alerts"]["events"][0]["state"] == "firing"
+
+
+# ========================================== live goodput SLO signal
+class TestLiveGoodputSignal:
+    def test_window_ratio_sees_through_pipelined_waits(self,
+                                                       monkeypatch,
+                                                       tmp_path):
+        """Under async pipelining a dispatch→resolve step span absorbs
+        the next batch's input wait, so step/(step+wait) floors near
+        0.5 in a fully starved run.  The live window gauge must use
+        1 - badput/wall instead — a starved window reads starved."""
+        from bigdl_tpu.obs.goodput import GoodputLedger
+
+        monkeypatch.setenv("BIGDL_METRICS_DIR", str(tmp_path))
+        monkeypatch.setenv("BIGDL_GOODPUT_WINDOW", "4")
+        obs.reset()
+        from bigdl_tpu.config import refresh_from_env
+
+        refresh_from_env()
+        led = GoodputLedger(str(tmp_path))
+        t0 = time.perf_counter()
+        # 4 pipelined iterations: each waits 30ms on input, and each
+        # resolve span (50ms) OVERLAPS the following wait — the old
+        # quotient would read 50/(50+30) = 0.62 "healthy"
+        for n in range(1, 5):
+            led.record("data_wait", t0, 0.030, step=n)
+            led.record("step", t0, 0.050, step=n)
+            time.sleep(0.02)  # real wall passes so win_wall > badput
+        parsed = parse_prometheus(obs.get_registry().to_prometheus())
+        ratio = sample_value(parsed, "bigdl_goodput_window_ratio")
+        assert ratio is not None
+        wall = time.perf_counter() - t0
+        expect = max(0.0, 1.0 - 0.120 / wall)
+        assert ratio == pytest.approx(expect, abs=0.05)
+        assert ratio < 0.62, "window ratio blind to pipelined waits"
+        led.close()
+
+    def test_live_ratio_takes_the_tighter_bound(self, tmp_path):
+        from bigdl_tpu.obs.goodput import GoodputLedger
+
+        led = GoodputLedger(str(tmp_path))
+        led._epoch_wall = time.time() - 10.0  # 10s elapsed
+        t0 = time.perf_counter()
+        led.record("step", t0, 8.0, step=1)      # absorbed waits inside
+        led.record("data_wait", t0, 6.0, step=1)
+        # productive bound: 8/10 = 0.8; badput bound: 1 - 6/10 = 0.4
+        assert led.live_ratio() == pytest.approx(0.4, abs=0.15)
+        led.close()
+
+
+# ============================================ heartbeat-age satellite
+class TestHeartbeatAgeGauge:
+    def test_scan_publishes_age_gauges_before_peer_lost(self, tmp_path):
+        from bigdl_tpu.resilience.elastic import HeartbeatMonitor
+
+        clk = [100.0]
+        mon = HeartbeatMonitor(str(tmp_path), host=0, n_hosts=3,
+                               timeout_s=60.0, clock=lambda: clk[0])
+        mon.beat(force=True)
+        (tmp_path / "heartbeat.h1").write_text("{}")
+        os.utime(tmp_path / "heartbeat.h1", (95.0, 95.0))
+        clk[0] = 110.0
+        mon.scan()
+        parsed = parse_prometheus(obs.get_registry().to_prometheus())
+        # host1 beat 15s ago; host2 never beat (counts from start)
+        assert sample_value(parsed, "bigdl_heartbeat_age_seconds",
+                            host=1) == pytest.approx(15.0)
+        assert sample_value(parsed, "bigdl_heartbeat_age_seconds",
+                            host=2) == pytest.approx(10.0)
+        # staleness is data BEFORE any PeerLostError fires
+        mon.check()  # under timeout: no raise
+        # and the healthz census reads the same gauges
+        assert server._heartbeat_census() == {"1": 15.0, "2": 10.0}
