@@ -558,6 +558,42 @@ def _obs_runtime_extras():
         return None
 
 
+def _wire_extras():
+    """Quantized-collective evidence for the BENCH JSON: the static
+    byte model of the wire this run is configured for (config.wire),
+    plus the newest ``WIRE_SMOKE.json`` A/B results when the smoke has
+    been run (scripts/wire_smoke.py — savings ratios and trajectory
+    agreement per wire dtype).  None when nothing is banked and the
+    configured wire is the default."""
+    try:
+        from bigdl_tpu.config import config
+        from bigdl_tpu.obs import collectives as C
+
+        out = {}
+        smoke = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "WIRE_SMOKE.json")
+        if os.path.exists(smoke):
+            with open(smoke, "r", encoding="utf-8") as fh:
+                out["smoke"] = json.load(fh)
+        w = config.wire
+        if w.dtype not in ("bfloat16",) or out:
+            from bigdl_tpu.parallel.wire import WIRE_DTYPES
+
+            model = {"dtype": w.dtype, "block": w.block,
+                     "error_feedback": w.error_feedback}
+            if w.dtype in WIRE_DTYPES:
+                # a reference point: 1 MiB of gradient over 8 shards
+                name = WIRE_DTYPES[w.dtype][0]
+                ex = C.staged_ring_exchange_bytes(1 << 20, 8, w.block,
+                                                  name)
+                f32 = C.reduce_scatter_bytes(1 << 20, "float32", 8)
+                model["model_savings_1mib_8way"] = f32 / sum(ex.values())
+            out["configured"] = model
+        return out or None
+    except Exception:
+        return None
+
+
 def _tuner_extras():
     """Auto-tuner evidence for the BENCH JSON (ops/autotune.py): the
     cache stats and every decision with its static baseline, measured
@@ -907,6 +943,9 @@ def _run_child(platform: str):
     tuner = _tuner_extras()
     if tuner is not None:
         ex["tuner"] = tuner
+    wire = _wire_extras()
+    if wire is not None:
+        ex["wire"] = wire
     print(PARTIAL_MARK + json.dumps(result), flush=True)
 
 

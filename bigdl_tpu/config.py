@@ -193,6 +193,38 @@ class TunerConfig:
 
 
 @dataclasses.dataclass
+class WireConfig:
+    """Compressed-collective wire defaults (``bigdl_tpu/parallel/wire``).
+
+    The process-wide answer to "what leaves the chip": DistriOptimizer
+    resolves its gradient wire from here when the constructor leaves
+    ``wire_dtype``/``wire_block``/``wire_ef`` unset, and every opt-in
+    path (TP psum, MoE all_to_all, ring K/V rotation) passed a bare
+    dtype string fills block/EF from here too.
+    """
+
+    # gradient-exchange wire dtype: "bfloat16" (cast, TPU-native),
+    # "int8" / "fp8_e4m3" / "fp8_e5m2" (blockwise-scaled staged ring),
+    # "float32"/"none" (uncompressed) [BIGDL_WIRE_DTYPE]
+    dtype: str = "bfloat16"
+    # elements per quantization scale for the scaled dtypes
+    # [BIGDL_WIRE_BLOCK]
+    block: int = 512
+    # error feedback: carry each device's quantization residual across
+    # steps so compression error dithers instead of biasing long runs
+    # [BIGDL_WIRE_EF]
+    error_feedback: bool = False
+
+    @classmethod
+    def from_env(cls) -> "WireConfig":
+        return cls(
+            dtype=_env_str("BIGDL_WIRE_DTYPE", "bfloat16"),
+            block=_env_int("BIGDL_WIRE_BLOCK", 512),
+            error_feedback=_env_bool("BIGDL_WIRE_EF", False),
+        )
+
+
+@dataclasses.dataclass
 class BigDLConfig:
     """Process-global framework configuration.
 
@@ -276,6 +308,10 @@ class BigDLConfig:
     #  BIGDL_TUNER_MEASURE_ITERS]
     tuner: TunerConfig = dataclasses.field(default_factory=TunerConfig)
 
+    # --- compressed collective wire (parallel/wire.py) ------------------
+    # [BIGDL_WIRE_DTYPE / BIGDL_WIRE_BLOCK / BIGDL_WIRE_EF]
+    wire: WireConfig = dataclasses.field(default_factory=WireConfig)
+
     # --- benchmarking [BENCH_* kept for bench.py compat] ----------------
 
     @classmethod
@@ -305,6 +341,7 @@ class BigDLConfig:
             hang_timeout=_env_float("BIGDL_HANG_TIMEOUT", 0.0),
             obs=ObsConfig.from_env(),
             tuner=TunerConfig.from_env(),
+            wire=WireConfig.from_env(),
         )
 
     def describe(self) -> str:

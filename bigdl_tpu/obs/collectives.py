@@ -112,16 +112,77 @@ def ppermute_bytes(n_elems: int, dtype, hops: int = 1) -> float:
 
 def int8_blockwise_exchange_bytes(padded_elems: int, axis_size: int,
                                   block: int) -> dict:
-    """Wire bytes of ``int8_blockwise_reduce_scatter`` (one all_to_all
+    """Wire bytes of the round-5 quantize-once exchange (one all_to_all
     pair): int8 payload + f32 per-block scales.  ``padded_elems`` must
     be divisible by ``axis_size * block`` (the optimizer pads to that
-    quantum)."""
+    quantum).  Kept as the historical a2a-shaped model; the staged ring
+    the optimizer now runs moves the same totals
+    (:func:`staged_ring_exchange_bytes`)."""
     n_blocks = padded_elems // axis_size // block
     return {
         "int8": all_to_all_bytes(padded_elems, "int8", axis_size),
         "float32": all_to_all_bytes(axis_size * n_blocks, "float32",
                                     axis_size),
     }
+
+
+def fp8_blockwise_exchange_bytes(padded_elems: int, axis_size: int,
+                                 block: int,
+                                 dtype: str = "float8_e4m3fn") -> dict:
+    """fp8 analogue of :func:`int8_blockwise_exchange_bytes`: 1-byte
+    payload + f32 per-block scales through one all_to_all pair."""
+    n_blocks = padded_elems // axis_size // block
+    return {
+        dtype: all_to_all_bytes(padded_elems, dtype, axis_size),
+        "float32": all_to_all_bytes(axis_size * n_blocks, "float32",
+                                    axis_size),
+    }
+
+
+def staged_ring_exchange_bytes(padded_elems: int, axis_size: int,
+                               block: int, dtype: str) -> dict:
+    """Per-device wire bytes of the in-reduce staged ring
+    (``parallel/wire.reduce_scatter``): the partial for every chunk
+    rides ``n-1`` hops, each hop shipping one ``padded/n``-element
+    payload in the wire dtype plus (for the scaled dtypes) its
+    ``padded/(n*block)`` f32 scales — the per-hop scale overhead is the
+    price of re-quantizing inside the reduction.  Totals equal the
+    quantize-once all_to_all model: in-reduce staging costs no extra
+    bytes, it moves the SAME bytes through every reduction stage."""
+    n = int(axis_size)
+    if n <= 1:
+        return {dtype: 0.0}
+    chunk = padded_elems // n
+    hops = n - 1
+    out = {dtype: float(hops * chunk) * dtype_bytes(dtype)}
+    if dtype not in ("bfloat16", "float16", "float32"):
+        out["float32"] = float(hops * (chunk // block)) * 4.0
+    return out
+
+
+_SAVINGS_META = (
+    "bigdl_collective_wire_savings_ratio",
+    "Uncompressed exchange bytes over what the configured wire "
+    "actually ships, per exchange path (grad = DistriOptimizer's "
+    "ZeRO-1 exchange, tp/moe/ring = the opt-in compressed wires)",
+)
+
+
+def record_savings(path: str, baseline_bytes: float, wire_bytes: float,
+                   registry=None) -> float:
+    """Publish the EQuARX headline gauge for one exchange path:
+    ``baseline_bytes`` (what the uncompressed exchange would ship) over
+    ``wire_bytes`` (what the configured wire ships).  Returns the
+    ratio (1.0 when nothing is compressed or nothing moves)."""
+    ratio = (float(baseline_bytes) / float(wire_bytes)
+             if wire_bytes else 1.0)
+    if registry is None:
+        from bigdl_tpu import obs
+
+        registry = obs.get_registry()
+    registry.gauge(*_SAVINGS_META, labels=("path",)).labels(
+        path=path).set(ratio)
+    return ratio
 
 
 # --------------------------------------------------------------- recording

@@ -183,6 +183,11 @@ def build_report(trace_dir: str, metrics_dir: Optional[str] = None) -> dict:
         coll_step[key] = float(s.get("value", 0.0))
     savings = [float(s.get("value", 0.0)) for _l, s, _h in _metric_samples(
         snaps, "bigdl_collective_wire_savings_ratio")]
+    savings_by_path: dict = {}
+    for labels, s, _host in _metric_samples(
+            snaps, "bigdl_collective_wire_savings_ratio"):
+        savings_by_path[labels.get("path", "grad")] = float(
+            s.get("value", 0.0))
 
     compile_count = sum(
         float(s.get("value", 0.0)) for _l, s, _h in _metric_samples(
@@ -305,6 +310,7 @@ def build_report(trace_dir: str, metrics_dir: Optional[str] = None) -> dict:
         "collective_bytes_total": coll_total,
         "collective_bytes_per_step": coll_step,
         "wire_savings_ratio": max(savings) if savings else None,
+        "wire_savings_by_path": savings_by_path,
         "resilience_events": resilience,
         "slow_steps": slow_steps,
         "alerts": alerts,
@@ -358,7 +364,11 @@ def render_text(rep: dict) -> str:
         per = rep["collective_bytes_per_step"].get(key)
         extra = f"  ({_fmt_bytes(per)}/step)" if per else ""
         lines.append(f"  {key:28s} {_fmt_bytes(b):>12s}{extra}")
-    if rep["wire_savings_ratio"]:
+    if rep.get("wire_savings_by_path"):
+        by = ", ".join(f"{p} {r:.2f}x" for p, r in
+                       sorted(rep["wire_savings_by_path"].items()))
+        lines.append(f"  wire savings vs uncompressed exchange: {by}")
+    elif rep["wire_savings_ratio"]:
         lines.append(f"  wire savings vs f32 exchange: "
                      f"{rep['wire_savings_ratio']:.2f}x")
     lines.append("")

@@ -67,38 +67,32 @@ def _shard_map(f, mesh, in_specs, out_specs):
 
 def int8_blockwise_reduce_scatter(g, axis, n, block):
     """Quantized reduce-scatter (inside shard_map): ``g`` is the local
-    flat gradient, length divisible by ``n * block``.  Each device
-    quantizes per-destination-chunk, per-block to int8 (symmetric,
-    scale = max|g|/127), ships payload + f32 scales through ONE
-    all_to_all pair, and the owner dequantizes and accumulates in f32.
+    flat gradient, length divisible by ``n * block``.
 
-    This is the int8 analogue of the reference's FP16CompressedTensor
-    wire («bigdl»/parameters/FP16CompressedTensor.scala) at a quarter
-    of the f32 bytes (+4/block for scales); EQuARX-style blockwise
-    scaling bounds the element error by its block's max/254.
-    """
-    import jax
+    Round 5 shipped this as a quantize-once / all_to_all / dequantize
+    exchange; it is now the int8 face of the staged ring in
+    ``parallel/wire.py`` — the partial sum for each chunk rides the
+    ring ``n-1`` hops, re-quantized per hop (payload + f32 scales on
+    the wire) with f32 accumulation, so the compression applies inside
+    the reduction stages themselves (EQuARX, arXiv:2506.17615).  Same
+    wire bytes as the a2a shape; the blockwise scale still bounds each
+    hop's element error by its block's max/254."""
+    from bigdl_tpu.parallel import wire
 
-    jnp = _jnp()
-    nb = g.size // n // block
-    gq = g.astype(jnp.float32).reshape(n, nb, block)
-    amax = jnp.max(jnp.abs(gq), axis=2)
-    scale = jnp.maximum(amax / 127.0, jnp.float32(1e-30))
-    q = jnp.clip(jnp.round(gq / scale[..., None]), -127, 127).astype(
-        jnp.int8)
-    q = jax.lax.all_to_all(q, axis, 0, 0, tiled=True)
-    scale = jax.lax.all_to_all(scale, axis, 0, 0, tiled=True)
-    return jnp.sum(q.astype(jnp.float32) * scale[..., None],
-                   axis=0).reshape(-1)
+    out, _ = wire.reduce_scatter(
+        g, axis, n, wire.WireSpec("int8", block=block))
+    return out
 
 
 class DistriOptimizer(LocalOptimizer):
     """Synchronous data-parallel trainer with ZeRO-1 sharded updates."""
 
     def __init__(self, model, dataset, criterion, batch_size=32, mesh=None,
-                 wire_dtype="bfloat16", data_axes=None, int8_block=512):
+                 wire_dtype=None, data_axes=None, int8_block=None,
+                 wire_block=None, wire_ef=None):
         super().__init__(model, dataset, criterion, batch_size)
         from bigdl_tpu.engine import Engine
+        from bigdl_tpu.parallel import wire as W
 
         if mesh is None:
             if not Engine.is_initialized():
@@ -120,24 +114,50 @@ class DistriOptimizer(LocalOptimizer):
         for a in self.axes:
             self.n_shards *= mesh.shape[a]
         # reference: FP16CompressedTensor on-the-wire compression for
-        # gradient blocks; bf16 is the TPU-native equivalent, int8 the
-        # blockwise-quantized EQuARX-style option (half the bf16 bytes)
-        if wire_dtype not in ("bfloat16", "float32", "none", "int8"):
+        # gradient blocks; bf16 is the TPU-native equivalent, int8 /
+        # fp8 the blockwise-quantized EQuARX-style staged-ring options
+        # (parallel/wire.py).  Unset knobs fall back to config
+        # (BIGDL_WIRE_DTYPE / BIGDL_WIRE_BLOCK / BIGDL_WIRE_EF).
+        from bigdl_tpu.config import config
+
+        if wire_dtype is None:
+            wire_dtype = config.wire.dtype
+        if wire_dtype not in W.WIRE_DTYPES and \
+                wire_dtype not in W.UNCOMPRESSED:
             # an unknown spelling must not silently train uncompressed
             raise ValueError(
                 f"wire_dtype {wire_dtype!r} not supported; choose "
-                "'bfloat16', 'int8', 'float32' or 'none'")
+                "'bfloat16', 'int8', 'fp8_e4m3', 'fp8_e5m2', 'float32' "
+                "or 'none'")
         self.wire_dtype = wire_dtype
-        self.int8_block = int(int8_block)
-        if wire_dtype == "int8":
-            if self.int8_block < 1:
+        block = wire_block if wire_block is not None else int8_block
+        if block is not None and int(block) < 1:
+            raise ValueError(
+                f"wire_block/int8_block must be positive, got {block}")
+        if wire_dtype in W.WIRE_DTYPES:
+            spec = W.WireSpec.from_config(
+                dtype=wire_dtype, block=block, error_feedback=wire_ef)
+        else:
+            if wire_ef:
                 raise ValueError(
-                    f"int8_block must be positive, got {int8_block}")
-            if len(self.axes) > 1:
-                raise NotImplementedError(
-                    "int8 wire compression over hierarchical data axes "
-                    "is not supported; use a single data axis or "
-                    "bfloat16")
+                    "error feedback needs a compressed wire dtype "
+                    f"(got {wire_dtype!r})")
+            spec = None
+        self.wire = spec
+        # legacy spelling: the int8 wire's block knob names the block
+        # for every scaled dtype
+        self.int8_block = spec.block if spec is not None else \
+            int(block) if block is not None else config.wire.block
+        # the staged ring (scaled dtypes, or any EF wire) runs over ONE
+        # ring; plain bf16 keeps the native psum_scatter, which XLA
+        # lowers hierarchically
+        self._staged_ring = spec is not None and (spec.scaled
+                                                  or spec.error_feedback)
+        if self._staged_ring and len(self.axes) > 1:
+            raise NotImplementedError(
+                f"the {wire_dtype!r} staged-ring wire over hierarchical "
+                "data axes is not supported; use a single data axis or "
+                "bfloat16")
         self._pad = 0
         self._warned_batch_sizes = set()
         self._host_mask = None
@@ -172,7 +192,14 @@ class DistriOptimizer(LocalOptimizer):
                 "shard_layout": "zero1_flat",
                 "step": self.state["neval"],
                 "flat_elems": getattr(self, "_flat_elems", None),
-                "pad": self._pad}
+                "pad": self._pad,
+                # the wire the run trained under — a resize-resume can
+                # see whether an EF residual rides the optimizer state
+                # without opening the npz
+                "wire": {"dtype": self.wire_dtype,
+                         "block": self.int8_block,
+                         "ef": bool(self.wire is not None
+                                    and self.wire.error_feedback)}}
 
     def _write_back(self, pvar, mod_state):
         # unravel allocates fresh arrays; mod_state is copied so the model
@@ -194,8 +221,10 @@ class DistriOptimizer(LocalOptimizer):
 
         jnp = _jnp()
         n = self.n_shards
-        # int8 wire needs whole quantization blocks per shard
-        quantum = n * self.int8_block if self.wire_dtype == "int8" else n
+        # scaled wires (int8/fp8) need whole quantization blocks per
+        # shard; everything else just whole shards
+        quantum = n * self.int8_block \
+            if (self.wire is not None and self.wire.scaled) else n
         self._pad = (-flat.size) % quantum
         shard_len = (flat.size + self._pad) // n
         opt = self.optim_method
@@ -238,6 +267,25 @@ class DistriOptimizer(LocalOptimizer):
                         v, NamedSharding(self.mesh, P())
                     )
             opt.state = sharded
+        # error-feedback residual (parallel/wire.py): one f32 row per
+        # device in flat-parameter coordinates, sharded so each device
+        # owns exactly its own row.  Lives in the optimizer state so it
+        # rides checkpoints with the flat ZeRO-1 vectors and is re-laid
+        # -out by elastic.ensure_shard_layout on world resize (a
+        # checkpointed residual from a DIFFERENT world is reset to
+        # zeros there — safe: it is a correction term, not state the
+        # update depends on).
+        padded = flat.size + self._pad
+        if self.wire is not None and self.wire.error_feedback:
+            ef = opt.state.get("wire_ef")
+            if ef is None or tuple(ef.shape) != (n, padded):
+                opt.state["wire_ef"] = jax.device_put(
+                    jnp.zeros((n, padded), jnp.float32),
+                    NamedSharding(self.mesh, P(self.axis, None)))
+        else:
+            # resumed without EF: drop a checkpointed residual instead
+            # of threading dead state through the step
+            opt.state.pop("wire_ef", None)
         return opt.state
 
     def _collective_byte_footprint(self):
@@ -258,11 +306,13 @@ class DistriOptimizer(LocalOptimizer):
         pdtype = self._flat_dtype
         fp = C.StepFootprint()
         # ---- putGradients + aggregate: the gradient exchange ---------
-        if self.wire_dtype == "int8":
-            ex = C.int8_blockwise_exchange_bytes(padded, n, self.int8_block)
-            fp.add("all_to_all", "int8", ex["int8"])
-            fp.add("all_to_all", "float32", ex["float32"])
-            exchange = ex["int8"] + ex["float32"]
+        if self._staged_ring:
+            ex = C.staged_ring_exchange_bytes(
+                padded, n, self.int8_block, self.wire.wire_name)
+            exchange = 0.0
+            for name, b in ex.items():
+                fp.add("ring_rs", name, b)
+                exchange += b
         else:
             wire = {"bfloat16": "bfloat16", "float32": "float32"}.get(
                 self.wire_dtype, pdtype)  # "none" ships the grad dtype
@@ -294,13 +344,10 @@ class DistriOptimizer(LocalOptimizer):
         # same static budget (obs/goodput.py, BIGDL_WIRE_GBPS)
         self._obs_ledger.set_comm_bytes_per_step(fp.total())
         # the EQuARX argument as a gauge: f32 exchange bytes over what
-        # the configured wire actually ships
+        # the configured wire actually ships, on the gradient path
         f32_exchange = C.reduce_scatter_bytes(padded, "float32", n)
-        ratio = f32_exchange / exchange if exchange else 1.0
-        obs.get_registry().gauge(
-            "bigdl_collective_wire_savings_ratio",
-            "f32 gradient-exchange bytes over the configured wire's "
-            "bytes (psum_scatter vs bf16/int8 blockwise)").set(ratio)
+        ratio = C.record_savings("grad", f32_exchange, exchange,
+                                 registry=obs.get_registry())
         tracer = obs.get_tracer()
         if tracer.enabled:
             tracer.event("collective.footprint",
@@ -355,6 +402,9 @@ class DistriOptimizer(LocalOptimizer):
         pad = self._pad
         wire = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
                 "none": None}.get(self.wire_dtype, None)
+        wire_spec = self.wire
+        staged_ring = self._staged_ring
+        ef_on = wire_spec is not None and wire_spec.error_feedback
         global_batch = self.batch_size
         # per-layer health telemetry on the ZeRO shard (obs/health.py):
         # layer boundaries in the ravelled layout — each device
@@ -431,9 +481,18 @@ class DistriOptimizer(LocalOptimizer):
             with jax.named_scope("put_gradient"):
                 # ---- putGradients + aggregateGradientPartition ----------
                 g = jnp.pad(grad, (0, pad))
-                if self.wire_dtype == "int8":
-                    gshard = int8_blockwise_reduce_scatter(
-                        g, axis, n, self.int8_block)
+                new_ef = None
+                if staged_ring:
+                    from bigdl_tpu.parallel import wire as W
+
+                    # in-reduce quantization (parallel/wire.py): the
+                    # partial sums ride the ring re-quantized per hop,
+                    # accumulated in f32; with EF on, this device's
+                    # residual rows ride along and come back updated
+                    ef = opt_st.get("wire_ef")
+                    efl = None if ef is None else ef.reshape(n, -1)
+                    gshard, new_ef = W.reduce_scatter(
+                        g, axis, n, wire_spec, ef=efl)
                 else:
                     if wire is not None and wire != g.dtype:
                         g = g.astype(wire)
@@ -483,7 +542,17 @@ class DistriOptimizer(LocalOptimizer):
                     jnp.pad(flat_p, (0, pad)), (idx * shard_len,),
                     (shard_len,)
                 )
-                new_wshard, new_opt = opt.step(gshard, wshard, opt_st)
+                # the EF residual is wire state, not optimizer state —
+                # the method never sees it; it re-enters the state dict
+                # updated by the staged ring above
+                opt_in = {k: v for k, v in opt_st.items()
+                          if k != "wire_ef"} if ef_on else opt_st
+                new_wshard, new_opt = opt.step(gshard, wshard, opt_in)
+                if ef_on:
+                    new_opt = dict(new_opt)
+                    new_opt["wire_ef"] = (
+                        new_ef.reshape(opt_st["wire_ef"].shape)
+                        if new_ef is not None else opt_st["wire_ef"])
                 if guard:
                     # skipped step: owner shard and opt state pass
                     # through unchanged (graceful degradation — the
@@ -543,8 +612,10 @@ class DistriOptimizer(LocalOptimizer):
                         health_stats)
             return new_flat, new_opt, new_mstate, loss, ok
 
-        opt_state_specs = {k: P(axis) if v.ndim == 1 else P()
-                           for k, v in opt.state.items()}
+        opt_state_specs = {
+            k: P(axis) if v.ndim == 1
+            else (P(axis, None) if k == "wire_ef" else P())
+            for k, v in opt.state.items()}
         mstate_spec = jax.tree.map(lambda _: P(), self.model.state())
 
         in_specs = (P(), opt_state_specs, mstate_spec, P(), P(axis), P(axis))

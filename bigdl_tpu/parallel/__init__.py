@@ -25,6 +25,11 @@ the TPU-first capabilities the mesh seams were left open for:
 * `moe` — expert parallelism: GShard-style dense dispatch/combine
   einsums with the expert axis sharded over the mesh (all_to_all falls
   out of GSPMD).
+* `wire` — the compressed-collective layer (quantized collectives v2):
+  one WireSpec (bfloat16/int8/fp8 + blockwise scales + error feedback)
+  behind reduce_scatter / psum / all_to_all / ppermute, used by
+  DistriOptimizer's gradient exchange and the opt-in compressed wires
+  on the TP/MoE/ring paths above.
 
 All strategies compose with DistriOptimizer's data axis by adding axes
 to `Engine.build_mesh({"data": ..., "seq": ..., "model": ...})`.
@@ -39,8 +44,12 @@ from bigdl_tpu.parallel.tensor_parallel import (  # noqa: F401
     shard_params,
     constrain,
     param_specs,
+    gradient_psum,
+    wire_psum,
     TRANSFORMER_TP_RULES,
 )
+from bigdl_tpu.parallel import wire  # noqa: F401
+from bigdl_tpu.parallel.wire import WireSpec  # noqa: F401
 from bigdl_tpu.parallel.pipeline import (  # noqa: F401
     pipeline_apply,
     pipelined,

@@ -43,7 +43,7 @@ class MoE(AbstractModule):
     def __init__(self, dim: int, hidden: int, n_experts: int,
                  top_k: int = 1, capacity_factor: float = 1.25,
                  mesh=None, expert_axis: str = "expert",
-                 aux_loss_weight: float = 0.01):
+                 aux_loss_weight: float = 0.01, wire=None):
         super().__init__()
         if top_k not in (1, 2):
             raise ValueError("top_k must be 1 or 2")
@@ -58,6 +58,12 @@ class MoE(AbstractModule):
         self.mesh = mesh
         self.expert_axis = expert_axis
         self.aux_loss_weight = aux_loss_weight
+        # opt-in compressed wire for the dispatch/combine all_to_all
+        # pair (parallel/wire.py WireSpec or dtype string); like mesh,
+        # a runtime-placement knob — not part of the serialized config
+        from bigdl_tpu.parallel import wire as W
+
+        self.wire = W.resolve(wire)
         self._init_method = Xavier()
         self.reset()
 
@@ -108,20 +114,48 @@ class MoE(AbstractModule):
         )))
         x = input.reshape(s, d)
 
+        # the dtype that actually crosses the expert all_to_all: the
+        # (E, C, D) buffers are cast to the activation dtype at the
+        # exchange boundary (a bf16 model must not be billed — or
+        # shipped — at f32 width)
+        buf_dtype = input.dtype
+        n_exp = 1
         if self.mesh is not None and self.expert_axis in getattr(
                 self.mesh, "shape", {}):
             n_exp = int(self.mesh.shape[self.expert_axis])
-            if n_exp > 1:
-                from bigdl_tpu.obs import collectives as C
+        if n_exp > 1:
+            from bigdl_tpu.obs import collectives as C
+            from bigdl_tpu.parallel import wire as W
 
-                # static-shape accounting (trace time): with the expert
-                # dim sharded, XLA lowers the dispatch and combine
-                # contractions into an all_to_all pair over the f32
-                # (E, C, D) expert buffers
-                C.record("all_to_all", "float32",
-                         2 * C.all_to_all_bytes(e * cap * d, "float32",
-                                                n_exp),
+            # static-shape accounting (trace time): with the expert
+            # dim sharded, XLA lowers the dispatch and combine
+            # contractions into an all_to_all pair over the (E, C, D)
+            # expert buffers
+            baseline = 2 * C.all_to_all_bytes(e * cap * d, buf_dtype,
+                                              n_exp)
+            if self.wire is None:
+                C.record("all_to_all", buf_dtype, baseline,
                          axis_size=n_exp)
+            elif not self.wire.scaled:  # bfloat16 cast-only wire
+                moved = 2 * C.all_to_all_bytes(e * cap * d, "bfloat16",
+                                               n_exp)
+                C.record("all_to_all", self.wire.wire_name, moved,
+                         axis_size=n_exp)
+                C.record_savings("moe", baseline, moved)
+            else:
+                # per-destination slice of the buffer, blocked to the
+                # wire quantum the quantizer actually uses
+                blk = W.effective_block(e * cap * d // n_exp,
+                                        self.wire.block)
+                payload = 2 * C.all_to_all_bytes(
+                    e * cap * d, self.wire.wire_name, n_exp)
+                scales = 2 * C.all_to_all_bytes(
+                    e * cap * d // blk, "float32", n_exp)
+                C.record("all_to_all", self.wire.wire_name, payload,
+                         axis_size=n_exp)
+                C.record("all_to_all", "float32", scales,
+                         axis_size=n_exp)
+                C.record_savings("moe", baseline, payload + scales)
 
         logits = x @ params["gate"]                     # (S, E)
         probs = jax.nn.softmax(logits, axis=-1)
@@ -158,9 +192,20 @@ class MoE(AbstractModule):
         )
 
         # --- dispatch → expert FFN → combine -------------------------
-        xin = jnp.einsum("sec,sd->ecd", dispatch, x,
-                         preferred_element_type=jnp.float32)
-        xin = self._constrain(xin, self.expert_axis, None, None)
+        # the (E, C, D) buffers cross the expert all_to_all in the
+        # activation dtype; with a wire configured, the compressed
+        # roundtrip (custom_vjp — the cotangent is compressed too)
+        # applies the quantization the payload would carry
+        def exchange(buf):
+            buf = buf.astype(buf_dtype)
+            if self.wire is not None and n_exp > 1:
+                from bigdl_tpu.parallel import wire as W
+
+                buf = W.roundtrip(buf, self.wire)
+            return self._constrain(buf, self.expert_axis, None, None)
+
+        xin = exchange(jnp.einsum("sec,sd->ecd", dispatch, x,
+                                  preferred_element_type=jnp.float32))
         h = jax.nn.relu(
             jnp.einsum("ecd,edh->ech", xin, params["w_in"],
                        preferred_element_type=jnp.float32)
@@ -169,7 +214,7 @@ class MoE(AbstractModule):
         out = jnp.einsum("ech,ehd->ecd", h, params["w_out"],
                          preferred_element_type=jnp.float32) \
             + params["b_out"][:, None, :]
-        out = self._constrain(out, self.expert_axis, None, None)
+        out = exchange(out)
         y = jnp.einsum("sec,ecd->sd", combine, out,
                        preferred_element_type=jnp.float32)
         # renormalize top-2 so kept gates sum to 1 (dropped → residual 0)

@@ -68,17 +68,27 @@ def _combine(m, l, acc, mi, li, acci):
 
 
 def ring_attention(q, k, v, axis_name: str, *, causal: bool = False,
-                   scale: Optional[float] = None):
+                   scale: Optional[float] = None, wire=None):
     """Exact ring attention.  MUST run inside shard_map (or pmap) with
     `axis_name` bound; q/k/v are the LOCAL (B, H, T/n, D) blocks, laid
     out in ring order (device i holds positions [i·T/n, (i+1)·T/n)).
+
+    ``wire`` (a ``parallel/wire.WireSpec`` or dtype string) compresses
+    the K/V rotation: each hop ships the blockwise-quantized payload +
+    scales instead of full-width K/V, dequantized on arrival.  Each
+    block is re-quantized from its received (already once-quantized)
+    value, so the error stays one quantization deep per hop chain —
+    the attention math itself stays f32.
     """
     import jax
     from jax import lax
     import jax.numpy as jnp
 
+    from bigdl_tpu.parallel import wire as W
+
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
+    spec = W.resolve(wire)
     n = lax.psum(1, axis_name)  # static: the axis size
     idx = lax.axis_index(axis_name)
     t_loc = q.shape[2]
@@ -98,8 +108,8 @@ def ring_attention(q, k, v, axis_name: str, *, causal: bool = False,
         mi, li, acci = _block_partials(q, ks, vs, scale, causal, q_off, k_off)
         m, l, acc = _combine(m, l, acc, mi, li, acci)
         if s != n - 1:  # last hop would be a wasted full-circle rotation
-            ks = lax.ppermute(ks, axis_name, perm)
-            vs = lax.ppermute(vs, axis_name, perm)
+            ks = W.ppermute(ks, axis_name, perm, spec)
+            vs = W.ppermute(vs, axis_name, perm, spec)
     out = acc / jnp.maximum(l, 1e-30)[..., None]
     return out.astype(q.dtype)
 
@@ -107,31 +117,55 @@ def ring_attention(q, k, v, axis_name: str, *, causal: bool = False,
 def ring_attention_sharded(q, k, v, mesh, *, seq_axis: str = "seq",
                            batch_axis: Optional[str] = None,
                            causal: bool = False,
-                           scale: Optional[float] = None):
+                           scale: Optional[float] = None, wire=None):
     """shard_map wrapper: q/k/v are GLOBAL (B, H, T, D) arrays; the seq
     dim is sharded over `seq_axis` (and optionally batch over
     `batch_axis`).  Composable under jit — GSPMD reshards inputs to the
-    in_specs automatically.
+    in_specs automatically.  ``wire`` compresses the K/V rotation
+    (see :func:`ring_attention`); the byte account then prices the
+    quantized payload + per-block f32 scales per hop and publishes the
+    ``path="ring"`` wire-savings ratio.
     """
     from functools import partial
 
     from jax.sharding import PartitionSpec as P
 
     from bigdl_tpu.obs import collectives as C
+    from bigdl_tpu.parallel import wire as W
     from bigdl_tpu.optim.distri_optimizer import _shard_map
 
+    wspec = W.resolve(wire)
     n = int(mesh.shape[seq_axis])
     if n > 1:
         # wire accounting from the GLOBAL static shapes (trace time —
         # once per compile under jit): K and V blocks each ride the
         # ring for n-1 hops at 1/n of the global array per device
-        C.record("ppermute", k.dtype,
-                 C.ppermute_bytes(int(k.size) // n, k.dtype, hops=n - 1)
-                 + C.ppermute_bytes(int(v.size) // n, v.dtype, hops=n - 1),
-                 axis_size=n)
+        baseline = (
+            C.ppermute_bytes(int(k.size) // n, k.dtype, hops=n - 1)
+            + C.ppermute_bytes(int(v.size) // n, v.dtype, hops=n - 1))
+        if wspec is None:
+            C.record("ppermute", k.dtype, baseline, axis_size=n)
+        elif not wspec.scaled:  # bfloat16: cast-only hops
+            moved = (
+                C.ppermute_bytes(int(k.size) // n, "bfloat16", hops=n - 1)
+                + C.ppermute_bytes(int(v.size) // n, "bfloat16",
+                                   hops=n - 1))
+            C.record("ppermute", wspec.wire_name, moved, axis_size=n)
+            C.record_savings("ring", baseline, moved)
+        else:
+            # the local K (and V) block quantizes to whole scale
+            # blocks (zero-padded): payload + f32 scales per hop
+            padded = W.padded_elems(int(k.size) // n, wspec, 1)
+            payload = 2 * C.ppermute_bytes(padded, wspec.wire_name,
+                                           hops=n - 1)
+            scales = 2 * C.ppermute_bytes(padded // wspec.block,
+                                          "float32", hops=n - 1)
+            C.record("ppermute", wspec.wire_name, payload, axis_size=n)
+            C.record("ppermute", "float32", scales, axis_size=n)
+            C.record_savings("ring", baseline, payload + scales)
     spec = P(batch_axis, None, seq_axis, None)
     f = partial(ring_attention, axis_name=seq_axis, causal=causal,
-                scale=scale)
+                scale=scale, wire=wspec)
     return _shard_map(f, mesh, in_specs=(spec, spec, spec),
                       out_specs=spec)(q, k, v)
 
@@ -149,17 +183,19 @@ class RingMultiHeadAttention(MultiHeadAttention):
     def __init__(self, dim: int, n_head: int, mesh, *,
                  seq_axis: str = "seq", batch_axis: Optional[str] = None,
                  causal: bool = False, with_bias: bool = True,
-                 dropout: float = 0.0):
+                 dropout: float = 0.0, wire=None):
         super().__init__(dim, n_head, causal=causal, with_bias=with_bias,
                          dropout=dropout)
         self.mesh = mesh
         self.seq_axis = seq_axis
         self.batch_axis = batch_axis
+        self.wire = wire
 
     def _inner_attention(self, q, k, v):
         return ring_attention_sharded(
             q, k, v, self.mesh, seq_axis=self.seq_axis,
             batch_axis=self.batch_axis, causal=self.causal,
+            wire=self.wire,
         )
 
     def __repr__(self):

@@ -119,6 +119,91 @@ def shard_params(params, mesh, rules=TRANSFORMER_TP_RULES):
     )
 
 
+def wire_psum(x, axis_name: str, wire=None, ef=None):
+    """Compressed all-reduce for hand-rolled TP blocks — call INSIDE
+    shard_map with ``axis_name`` bound.  With ``wire`` unset this is
+    ``lax.psum``; with a compressed spec the sum runs as the staged
+    ring reduce-scatter + quantized all-gather of
+    ``parallel/wire.psum`` (payload + per-block f32 scales on every
+    hop, f32 accumulation).  ``ef`` threads an optional error-feedback
+    residual (``(n, chunk)`` per device); returns ``(value, new_ef)``
+    so gradient loops can carry it."""
+    from jax import lax
+
+    from bigdl_tpu.parallel import wire as W
+
+    n = lax.psum(1, axis_name)  # static: the axis size
+    return W.psum(x, axis_name, n, W.resolve(wire), ef=ef)
+
+
+def gradient_psum(grads, mesh, axis: str = "model", wire=None):
+    """Sum per-device gradient contributions over a mesh axis with an
+    opt-in compressed wire — the explicit form of the gradient psums
+    GSPMD inserts behind TP layouts, for driver loops that hold each
+    device's local gradients (leaves stacked on a leading ``n`` dim).
+
+    Returns the summed pytree (leading dim dropped, f32).  Byte
+    accounting from static shapes at build time: uncompressed psums
+    record the leaf dtype's ring all-reduce; a compressed wire records
+    the staged-ring + quantized-gather bytes and publishes the
+    ``path="tp"`` wire-savings ratio."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from bigdl_tpu.obs import collectives as C
+    from bigdl_tpu.parallel import wire as W
+    from bigdl_tpu.optim.distri_optimizer import _shard_map
+
+    spec = W.resolve(wire)
+    n = int(mesh.shape[axis])
+    leaves = [x for x in jax.tree.leaves(grads) if x is not None]
+    for leaf in leaves:
+        if leaf.ndim < 1 or leaf.shape[0] != n:
+            raise ValueError(
+                f"gradient_psum leaves need a leading {axis!r}-sized "
+                f"({n}) device dim; got shape {tuple(leaf.shape)}")
+    if n > 1:
+        baseline = wire_bytes = 0.0
+        for leaf in leaves:
+            sz = int(leaf.size) // n
+            baseline += C.all_reduce_bytes(sz, leaf.dtype, n)
+            if spec is None:
+                wire_bytes += C.all_reduce_bytes(sz, leaf.dtype, n)
+            elif not spec.scaled:
+                wire_bytes += C.all_reduce_bytes(sz, "bfloat16", n)
+            else:
+                padded, blk = W.psum_layout(sz, spec, n)
+                ex = C.staged_ring_exchange_bytes(padded, n, blk,
+                                                  spec.wire_name)
+                wire_bytes += sum(ex.values())
+                wire_bytes += C.all_gather_bytes(padded, spec.wire_name,
+                                                 n)
+                wire_bytes += C.all_gather_bytes(padded // blk,
+                                                 "float32", n)
+        name = spec.wire_name if spec is not None else "float32"
+        C.record("psum", name, wire_bytes, axis_size=n)
+        if spec is not None:
+            C.record_savings("tp", baseline, wire_bytes)
+
+    flat, treedef = jax.tree.flatten(grads)
+    if n == 1:
+        import jax.numpy as jnp
+
+        return jax.tree.unflatten(
+            treedef, [jnp.sum(g.astype(jnp.float32), axis=0)
+                      for g in flat])
+    in_specs = tuple(P(*((axis,) + (None,) * (g.ndim - 1)))
+                     for g in flat)
+    out_specs = tuple(P() for _ in flat)
+
+    def body(*ls):
+        return tuple(W.psum(g[0], axis, n, spec)[0] for g in ls)
+
+    mapped = _shard_map(body, mesh, in_specs=in_specs,
+                        out_specs=out_specs)
+    return jax.tree.unflatten(treedef, list(mapped(*flat)))
+
+
 def constrain(x, mesh, *spec_axes):
     """`with_sharding_constraint` shorthand: constrain(x, mesh, 'data',
     None, 'model') pins activation layout where XLA's propagation needs

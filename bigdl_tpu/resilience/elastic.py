@@ -368,6 +368,15 @@ def ensure_shard_layout(state: dict, flat_elems: int, pad: int,
     re-pad for the M-world quantum, and place ``P(axis)`` over the new
     mesh.  Entries already matching the current layout (same-world
     resume, the common case) pass through untouched; scalars always do.
+
+    The ``wire_ef`` error-feedback residual (parallel/wire.py; one
+    ``(world, padded)`` f32 row per device) is *per-device* state — an
+    N-world residual has no positional meaning at M devices — so a
+    resize **resets it to zeros** in the new layout.  Safe by
+    construction: the residual is a correction term the next exchange
+    re-derives; dropping it costs one step of ordinary (un-fed-back)
+    quantization error, never correctness.  Same-world resumes keep
+    the checkpointed residual bit-for-bit.
     """
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -375,9 +384,26 @@ def ensure_shard_layout(state: dict, flat_elems: int, pad: int,
     import jax.numpy as jnp
 
     padded = flat_elems + pad
+    ef = state.get("wire_ef")
+    ef_stale = ef is not None and tuple(ef.shape) != (n_shards, padded)
     stale = [k for k, v in state.items()
-             if getattr(v, "ndim", None) == 1
+             if k != "wire_ef" and getattr(v, "ndim", None) == 1
              and v.shape[0] >= flat_elems and v.shape[0] != padded]
+    if ef_stale:
+        state = dict(state)
+        state["wire_ef"] = jax.device_put(
+            jnp.zeros((n_shards, padded), jnp.float32),
+            NamedSharding(mesh, P(axis, None)))
+        log.info("elastic: reset the wire_ef error-feedback residual "
+                 "%s -> %s on world resize", tuple(ef.shape),
+                 (n_shards, padded))
+        from bigdl_tpu import obs
+
+        obs.get_tracer().event(
+            "elastic.ef_reset", old_shape=list(ef.shape),
+            new_shape=[n_shards, padded],
+            old_world=(topology or {}).get("world_size"),
+            new_world=n_shards)
     if not stale:
         return state
     old_len = state[stale[0]].shape[0]

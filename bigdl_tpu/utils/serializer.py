@@ -295,8 +295,11 @@ def write_manifest(path_prefix: str, topology: dict = None) -> str:
     """Record size + sha256 of every file in the ``path_prefix``
     checkpoint pair so verify-on-load can tell torn/rotted checkpoints
     from intact ones, plus the writer's ``topology``
-    (``{world_size, shard_layout, step}`` — resilience/elastic.py) so a
-    resize-resume can inspect the source world without opening the npz.
+    (``{world_size, shard_layout, step, wire}`` — resilience/elastic.py;
+    ``wire`` tags the compressed-collective config the run trained
+    under, incl. whether a ``wire_ef`` error-feedback residual rides
+    the ``.optim`` state arrays) so a resize-resume can inspect the
+    source world without opening the npz.
     Written atomically AFTER the pair is durable — a crash between pair
     and manifest degrades to the legacy no-manifest check, never to a
     manifest blessing garbage."""
@@ -323,10 +326,11 @@ def write_manifest(path_prefix: str, topology: dict = None) -> str:
 
 
 def read_checkpoint_topology(path_prefix: str) -> dict:
-    """The ``{world_size, shard_layout, step}`` metadata a checkpoint
-    was written under — from the manifest (no npz open), falling back
-    to the ``.optim`` meta for manifest-less pairs.  ``{}`` when the
-    checkpoint predates topology tagging."""
+    """The ``{world_size, shard_layout, step, wire}`` metadata a
+    checkpoint was written under — from the manifest (no npz open),
+    falling back to the ``.optim`` meta for manifest-less pairs.
+    ``{}`` when the checkpoint predates topology tagging; ``wire``
+    absent when it predates the compressed-collective tagging."""
     manifest_path = path_prefix + ".manifest.json"
     try:
         with open(manifest_path, "r", encoding="utf-8") as fh:

@@ -47,6 +47,17 @@
 #                                                # --json) and the bottleneck
 #                                                # classifier says input_bound
 #                                                # (no pytest)
+#   scripts/run-tests.sh --wire                  # quantized-collectives
+#                                                # smoke: a 2-host 200-step
+#                                                # A/B of the f32 vs int8-EF
+#                                                # vs fp8-EF gradient wires,
+#                                                # asserting golden byte
+#                                                # counts, savings ratio >=
+#                                                # 3.2x, and loss-trajectory
+#                                                # agreement with error
+#                                                # feedback on; banks
+#                                                # WIRE_SMOKE.json for BENCH
+#                                                # extras.wire (no pytest)
 #   scripts/run-tests.sh --live                  # live-telemetry smoke: a
 #                                                # 2-host run with /metrics +
 #                                                # /healthz servers on
@@ -91,6 +102,9 @@ elif [[ "${1:-}" == "--tune" ]]; then
 elif [[ "${1:-}" == "--live" ]]; then
   shift
   exec python scripts/live_smoke.py "$@"
+elif [[ "${1:-}" == "--wire" ]]; then
+  shift
+  exec python scripts/wire_smoke.py "$@"
 fi
 
 exec python -m pytest tests/ -q "${MARKER[@]}" "$@"

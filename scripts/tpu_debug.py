@@ -37,6 +37,15 @@ impl/blocks each site chose, from cache or measurement, and how far
 the static policy was off — see MIGRATION.md "Kernel auto-tuning" and
 ``scripts/run-tests.sh --tune`` for the end-to-end smoke.
 
+A healthy run whose goodput verdict says COMM-bound pays the wire
+first: turn on the compressed collective wire (`BIGDL_WIRE_DTYPE=int8
+BIGDL_WIRE_EF=1`, or `fp8_e4m3`) and read the report's collective
+bytes — `bigdl_collective_wire_savings_ratio{path=...}` says what the
+gradient/TP/MoE/ring exchanges ship vs f32 (>= 3.2x on the gradient
+path), with error feedback keeping the loss trajectory within the f32
+run's — see MIGRATION.md "Quantized collectives v2" and
+``scripts/run-tests.sh --wire`` for the measured A/B.
+
 A run that keeps DYING (preemption, host loss) rather than failing to
 compile belongs under the restart supervisor instead: ``python -m
 bigdl_tpu.resilience.supervisor -- <train cmd>`` resumes preempted

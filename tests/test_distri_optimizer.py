@@ -552,9 +552,13 @@ def test_freeze_survives_optimizer_weight_decay():
 
 
 def test_int8_blockwise_reduce_scatter_matches_exact():
-    """Unit spec for the quantized wire: the blockwise int8 exchange
-    reproduces psum_scatter within the per-block quantization bound
-    (sum over peers of blockmax/254)."""
+    """Unit spec for the quantized wire: the staged-ring int8 exchange
+    (parallel/wire.py) reproduces psum_scatter within the per-hop
+    quantization bound.  The partial for chunk ``c`` is quantized once
+    per hop; at hop ``h`` it holds peers ``c+1..c+h``, so each hop's
+    element error is bounded by that running partial's blockmax/254 —
+    the bound is the triangular cumsum of peer blockmaxes, not the old
+    quantize-once sum."""
     from jax.sharding import PartitionSpec as P
 
     from bigdl_tpu.optim.distri_optimizer import (
@@ -581,16 +585,26 @@ def test_int8_blockwise_reduce_scatter_matches_exact():
     got = np.asarray(sm(quantized)(jnp.asarray(g_all))).reshape(-1)
     want = np.asarray(sm(exact)(jnp.asarray(g_all))).reshape(-1)
 
-    # per-element bound: each peer contributes <= its block scale / 2
-    scales = np.abs(g_all.reshape(n, n, -1, block)).max(-1) / 127.0
-    bound = (scales / 2.0).sum(axis=0)  # (n_dest, nblocks)
-    err = np.abs(got - want).reshape(n, -1, block).reshape(
-        bound.shape + (block,))
-    assert np.all(err <= bound[..., None] + 1e-6), (
-        err.max(), bound.min())
-    # and it is actually close in aggregate
+    # blockmax[p, c, b]: device p's max |g| in block b of chunk c
+    bm = np.abs(g_all.reshape(n, n, -1, block)).max(-1)
+    # hop h of chunk c quantizes the partial over peers c+1..c+h:
+    # error <= partial blockmax / 254 <= cumsum of peer blockmaxes/254
+    bound = np.zeros_like(bm[0])  # (n_chunks, nblocks)
+    for c in range(n):
+        run = np.zeros_like(bm[0, 0])
+        for h in range(1, n):
+            run = run + bm[(c + h) % n, c]
+            bound[c] += run / 254.0
+    # 1% headroom: earlier hops' errors enter later partials' amax
+    bound = bound * 1.01 + 1e-6
+    err = np.abs(got - want).reshape(bound.shape + (block,))
+    assert np.all(err <= bound[..., None]), (err.max(), bound.min())
+    # and close in aggregate — per-hop staging compounds ~n/2 vs the
+    # quantize-once shape on this deliberately heavy-tailed data; the
+    # error-feedback residual is what cancels it across steps
+    # (tests/test_wire.py TestErrorFeedback)
     rel = np.abs(got - want).mean() / (np.abs(want).mean() + 1e-9)
-    assert rel < 0.02, rel
+    assert rel < 0.15, rel
 
 
 def test_distri_int8_wire_converges_and_tracks_exact():

@@ -374,6 +374,110 @@ class TestResizeResume:
             dopt.optimize()
 
 
+# ================================================= wire EF elasticity
+class TestWireEFElasticity:
+    """ISSUE 9 satellite: the error-feedback residual
+    (parallel/wire.py) rides checkpoints next to the flat ZeRO-1
+    vectors, survives a crash without double-applying, and is re-laid
+    -out (reset) by ensure_shard_layout on world resize."""
+
+    EF_KW = dict(wire_dtype="int8", int8_block=64, wire_ef=True)
+
+    def test_same_world_crash_resume_matches_uninterrupted(
+            self, _engine, tmp_path):
+        """Crash mid-run, resume at the SAME world: the residual
+        restores exactly as checkpointed (applied once, not twice, not
+        dropped), so the deterministic quantized arithmetic reproduces
+        the uninterrupted trajectory."""
+        import numpy as _np
+
+        base_tape = _Tape()
+        _distri(2, epochs=3, tape=base_tape, **self.EF_KW).optimize()
+
+        tape = _Tape(preempt_at=6)
+        with pytest.raises(Preempted):
+            _distri(2, tmp_path, epochs=3, tape=tape,
+                    **self.EF_KW).optimize()
+
+        # the emergency checkpoint carries the residual
+        from bigdl_tpu.utils.serializer import checkpoint_prefixes
+
+        newest = checkpoint_prefixes(str(tmp_path))[-1]
+        ckpt = np.load(os.path.join(str(tmp_path),
+                                    newest + ".optim.npz"))
+        assert "wire_ef" in ckpt.files
+        saved_ef = np.asarray(ckpt["wire_ef"])
+        assert saved_ef.ndim == 2 and saved_ef.shape[0] == 2
+        assert _np.abs(saved_ef).sum() > 0  # live residual, not zeros
+
+        resumed = _distri(2, tmp_path, epochs=3, **self.EF_KW)
+        assert elastic.restore_latest(resumed) is not None
+        # restored exactly as written — the crash did not double-apply
+        np.testing.assert_array_equal(
+            np.asarray(resumed.optim_method.state["wire_ef"]), saved_ef)
+        tape2 = _Tape()
+        resumed.set_train_summary(tape2)
+        resumed.optimize()
+        _assert_trajectories_match(base_tape.loss, tape2.loss)
+
+    def test_resize_2to1_resets_ef_and_matches_uninterrupted(
+            self, _engine, tmp_path):
+        """ISSUE satellite: 2→1 resize resume with the int8-EF wire
+        reproduces the uninterrupted 1-host trajectory.  An N-world
+        residual has no positional meaning at M devices, so the resize
+        resets it to zeros (one step of un-fed-back quantization error
+        — bounded, and at world 1 the exchange is exact anyway)."""
+        base_tape = _Tape()
+        _distri(1, epochs=3, tape=base_tape, **self.EF_KW).optimize()
+
+        tape = _Tape(preempt_at=6)
+        with pytest.raises(Preempted):
+            _distri(2, tmp_path, epochs=3, tape=tape,
+                    **self.EF_KW).optimize()
+
+        resumed = _distri(1, tmp_path, epochs=3, **self.EF_KW)
+        assert elastic.restore_latest(resumed) is not None
+        tape2 = _Tape()
+        resumed.set_train_summary(tape2)
+        resumed.optimize()
+        # pre-crash steps ran 2-world quantized vs the baseline's
+        # 1-world exact exchange: the trajectories agree within the
+        # accumulated quantization tolerance, not bit-for-bit
+        _assert_trajectories_match(base_tape.loss, tape2.loss,
+                                   rtol=5e-2)
+        ef = resumed.optim_method.state["wire_ef"]
+        padded = resumed._flat_elems + resumed._pad
+        assert tuple(ef.shape) == (1, padded)
+
+    def test_ensure_shard_layout_resets_stale_ef(self, _engine):
+        """Unit: a wrong-world residual is reset to zeros in the new
+        layout; a matching one passes through untouched; the 1-D flat
+        vectors keep their existing re-partition semantics."""
+        import jax.numpy as jnp
+
+        mesh = _mesh(2)
+        flat, pad = 10, 4
+        padded = flat + pad
+        old = {"velocity": jnp.arange(12, dtype=jnp.float32),
+               "wire_ef": jnp.ones((3, 12), jnp.float32),
+               "neval": jnp.asarray(3.0)}
+        new = elastic.ensure_shard_layout(
+            old, flat_elems=flat, pad=pad, n_shards=2, mesh=mesh,
+            axis="data", topology={"world_size": 3})
+        assert tuple(new["wire_ef"].shape) == (2, padded)
+        np.testing.assert_array_equal(np.asarray(new["wire_ef"]), 0.0)
+        np.testing.assert_array_equal(
+            np.asarray(new["velocity"])[:flat], np.arange(10))
+        # matching layout: identity pass-through keeps the residual
+        keep = {"velocity": new["velocity"],
+                "wire_ef": jnp.full((2, padded), 0.5),
+                "neval": jnp.asarray(3.0)}
+        again = elastic.ensure_shard_layout(
+            keep, flat_elems=flat, pad=pad, n_shards=2, mesh=mesh,
+            axis="data")
+        np.testing.assert_array_equal(np.asarray(again["wire_ef"]), 0.5)
+
+
 # ============================================================ heartbeat
 class TestHeartbeat:
     def test_peer_lost_classified_fatal(self):

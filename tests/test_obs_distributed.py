@@ -155,8 +155,8 @@ class TestCollectiveGolden:
         assert _counter_value("pmean", "float32") == scalar * steps
         assert _gauge_value("bigdl_collective_bytes_per_step",
                             op="psum_scatter", dtype="float32") == per_step
-        assert _gauge_value(
-            "bigdl_collective_wire_savings_ratio") == pytest.approx(1.0)
+        assert _gauge_value("bigdl_collective_wire_savings_ratio",
+                            path="grad") == pytest.approx(1.0)
 
     def test_bf16_wire_halves_exchange(self):
         steps = 5
@@ -167,22 +167,41 @@ class TestCollectiveGolden:
         # the gathered weights stay f32
         assert _counter_value("all_gather",
                               "float32") == 680 * 4 * 7 / 8 * steps
-        assert _gauge_value(
-            "bigdl_collective_wire_savings_ratio") == pytest.approx(2.0)
+        assert _gauge_value("bigdl_collective_wire_savings_ratio",
+                            path="grad") == pytest.approx(2.0)
 
     def test_int8_blockwise_golden(self):
         steps = 5
         self._run(steps, wire_dtype="int8", int8_block=16)
-        # quantum 8*16=128: pad 676 -> 768; nb = 768/8/16 = 6
-        q_bytes = 768 * 1 * 7 / 8            # int8 payload a2a
-        s_bytes = 8 * 6 * 4 * 7 / 8          # (n, nb) f32 scales a2a
-        assert _counter_value("all_to_all", "int8") == q_bytes * steps
-        assert _counter_value("all_to_all", "float32") == s_bytes * steps
+        # quantum 8*16=128: pad 676 -> 768; staged ring: 7 hops x
+        # 96-elem chunk payload + 7 hops x 6 f32 chunk scales — the
+        # SAME totals as the old quantize-once all_to_all pair, now
+        # moved through every reduction stage (op label ring_rs)
+        q_bytes = 7 * 96 * 1                 # int8 payload per hop
+        s_bytes = 7 * 6 * 4                  # f32 scales per hop
+        assert q_bytes == 768 * 1 * 7 / 8    # a2a-model equivalence
+        assert _counter_value("ring_rs", "int8") == q_bytes * steps
+        assert _counter_value("ring_rs", "float32") == s_bytes * steps
         # EQuARX headline: f32 exchange over int8+scales
         expect = (768 * 4 * 7 / 8) / (q_bytes + s_bytes)
-        assert _gauge_value(
-            "bigdl_collective_wire_savings_ratio") == pytest.approx(expect)
+        assert _gauge_value("bigdl_collective_wire_savings_ratio",
+                            path="grad") == pytest.approx(expect)
         assert expect == pytest.approx(3.2)
+
+    def test_fp8_ef_golden(self):
+        """fp8 wire + error feedback: same 1-byte staged-ring budget
+        as int8 (the EF residual rides device-local HBM, never the
+        wire), labeled with the fp8 dtype."""
+        steps = 3
+        self._run(steps, wire_dtype="fp8_e4m3", wire_block=16,
+                  wire_ef=True)
+        q_bytes = 7 * 96 * 1
+        s_bytes = 7 * 6 * 4
+        assert _counter_value("ring_rs", "float8_e4m3fn") == \
+            q_bytes * steps
+        assert _counter_value("ring_rs", "float32") == s_bytes * steps
+        assert _gauge_value("bigdl_collective_wire_savings_ratio",
+                            path="grad") == pytest.approx(3.2)
 
     def test_footprint_trace_event(self, tmp_path, monkeypatch):
         monkeypatch.setenv("BIGDL_TRACE_DIR", str(tmp_path))
