@@ -594,6 +594,23 @@ def _wire_extras():
         return None
 
 
+def _autoscale_extras():
+    """Autoscaling + exactly-once streaming evidence for the BENCH
+    JSON: the newest ``AUTOSCALE_SMOKE.json`` banked by
+    scripts/autoscale_smoke.py (supervised 1→2→1 resize decisions,
+    trajectory error, and the zero-duplicate/zero-drop stream audit).
+    None when the smoke has never been run."""
+    try:
+        smoke = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "AUTOSCALE_SMOKE.json")
+        if not os.path.exists(smoke):
+            return None
+        with open(smoke, "r", encoding="utf-8") as fh:
+            return {"smoke": json.load(fh)}
+    except Exception:
+        return None
+
+
 def _tuner_extras():
     """Auto-tuner evidence for the BENCH JSON (ops/autotune.py): the
     cache stats and every decision with its static baseline, measured
@@ -946,6 +963,9 @@ def _run_child(platform: str):
     wire = _wire_extras()
     if wire is not None:
         ex["wire"] = wire
+    autoscale = _autoscale_extras()
+    if autoscale is not None:
+        ex["autoscale"] = autoscale
     print(PARTIAL_MARK + json.dumps(result), flush=True)
 
 

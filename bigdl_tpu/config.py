@@ -126,6 +126,11 @@ class ObsConfig:
     # file, or POST to it when it is an http(s):// webhook
     # [BIGDL_ALERT_SINK]
     alert_sink: Optional[str] = None
+    # per-attempt connect/read timeout for the webhook sink POST (one
+    # retry on failure; a dead sink costs at most 2x this per
+    # transition and can never wedge the goodput window tick)
+    # [BIGDL_ALERT_SINK_TIMEOUT]
+    alert_sink_timeout: float = 1.0
 
     @property
     def active(self) -> bool:
@@ -154,6 +159,7 @@ class ObsConfig:
             obs_peers=_env_str("BIGDL_OBS_PEERS", None),
             alert_rules=_env_str("BIGDL_ALERT_RULES", None),
             alert_sink=_env_str("BIGDL_ALERT_SINK", None),
+            alert_sink_timeout=_env_float("BIGDL_ALERT_SINK_TIMEOUT", 1.0),
         )
 
 
@@ -221,6 +227,93 @@ class WireConfig:
             dtype=_env_str("BIGDL_WIRE_DTYPE", "bfloat16"),
             block=_env_int("BIGDL_WIRE_BLOCK", 512),
             error_feedback=_env_bool("BIGDL_WIRE_EF", False),
+        )
+
+
+@dataclasses.dataclass
+class AutoscaleConfig:
+    """Autoscaling supervisor policy loop (``resilience/autoscale.py``).
+
+    Off by default: the supervisor then only restarts, never resizes.
+    Enabled, a policy loop inside the supervisor scrapes the live fleet
+    signals (PR 8 ``/healthz``/``/metrics``), evaluates declarative
+    scale rules, and executes a decision by checkpoint-stop-restart at
+    the new world size through the elastic exit-code contract.
+    """
+
+    # master switch [BIGDL_AUTOSCALE]
+    enabled: bool = False
+    # world-size bounds a decision may never leave
+    # [BIGDL_AUTOSCALE_MIN_WORLD / BIGDL_AUTOSCALE_MAX_WORLD]
+    min_world: int = 1
+    max_world: int = 8
+    # scale step: up multiplies the world by this, down divides (the
+    # ZeRO-1 shard quantum likes powers of two) [BIGDL_AUTOSCALE_FACTOR]
+    factor: int = 2
+    # seconds between policy evaluations [BIGDL_AUTOSCALE_INTERVAL]
+    interval_s: float = 10.0
+    # after a (re)launch, no signal is trusted for this long — compile
+    # and restore make every fresh child look slow
+    # [BIGDL_AUTOSCALE_WARMUP]
+    warmup_s: float = 30.0
+    # after an executed (or dry-run) decision, no further decision for
+    # this long — one restart must finish paying for itself before the
+    # next is allowed [BIGDL_AUTOSCALE_COOLDOWN]
+    cooldown_s: float = 120.0
+    # hysteresis: a rule must breach on this many CONSECUTIVE
+    # evaluations before it may decide (a flapping signal resets its
+    # streak and can never thrash the world) [BIGDL_AUTOSCALE_HYSTERESIS]
+    hysteresis: int = 2
+    # target step-time band: sustained step time above `high` scales
+    # up, below `low` scales down; 0 disables either edge
+    # [BIGDL_AUTOSCALE_STEP_TIME_HIGH / _LOW]
+    step_time_high: float = 0.0
+    step_time_low: float = 0.0
+    # input/serving queue-depth band over the streaming tier's
+    # bigdl_stream_buffer_depth / bigdl_stream_lag_records gauges:
+    # sustained depth above `high` scales up (ingest outruns training),
+    # below `low` scales down (paying for idle chips); 0 disables
+    # [BIGDL_AUTOSCALE_QUEUE_HIGH / _LOW]
+    queue_high: float = 0.0
+    queue_low: float = 0.0
+    # cost/throughput ceiling: live goodput ratio sustained below this
+    # floor scales DOWN (overhead-bound runs don't get better with more
+    # hosts — they get cheaper with fewer); 0 disables
+    # [BIGDL_AUTOSCALE_GOODPUT_FLOOR]
+    goodput_floor: float = 0.0
+    # evict stragglers: a host /healthz reports as stalled triggers a
+    # scale-down decision (reason straggler_evict) so the next launch
+    # re-forms the world without it [BIGDL_AUTOSCALE_EVICT_STRAGGLERS]
+    evict_stragglers: bool = False
+    # dry-run: evaluate + count + trace every decision, execute none
+    # [BIGDL_AUTOSCALE_DRY_RUN]
+    dry_run: bool = False
+    # rule pack override: inline JSON list or a path to a JSON file
+    # (schema in resilience/autoscale.py); unset = rules derived from
+    # the band knobs above [BIGDL_AUTOSCALE_RULES]
+    rules: Optional[str] = None
+
+    @classmethod
+    def from_env(cls) -> "AutoscaleConfig":
+        return cls(
+            enabled=_env_bool("BIGDL_AUTOSCALE", False),
+            min_world=_env_int("BIGDL_AUTOSCALE_MIN_WORLD", 1),
+            max_world=_env_int("BIGDL_AUTOSCALE_MAX_WORLD", 8),
+            factor=_env_int("BIGDL_AUTOSCALE_FACTOR", 2),
+            interval_s=_env_float("BIGDL_AUTOSCALE_INTERVAL", 10.0),
+            warmup_s=_env_float("BIGDL_AUTOSCALE_WARMUP", 30.0),
+            cooldown_s=_env_float("BIGDL_AUTOSCALE_COOLDOWN", 120.0),
+            hysteresis=_env_int("BIGDL_AUTOSCALE_HYSTERESIS", 2),
+            step_time_high=_env_float("BIGDL_AUTOSCALE_STEP_TIME_HIGH",
+                                      0.0),
+            step_time_low=_env_float("BIGDL_AUTOSCALE_STEP_TIME_LOW", 0.0),
+            queue_high=_env_float("BIGDL_AUTOSCALE_QUEUE_HIGH", 0.0),
+            queue_low=_env_float("BIGDL_AUTOSCALE_QUEUE_LOW", 0.0),
+            goodput_floor=_env_float("BIGDL_AUTOSCALE_GOODPUT_FLOOR", 0.0),
+            evict_stragglers=_env_bool("BIGDL_AUTOSCALE_EVICT_STRAGGLERS",
+                                       False),
+            dry_run=_env_bool("BIGDL_AUTOSCALE_DRY_RUN", False),
+            rules=_env_str("BIGDL_AUTOSCALE_RULES", None),
         )
 
 
@@ -296,6 +389,24 @@ class BigDLConfig:
     # heartbeats and exit codes cannot catch; <= 0 disables
     # [BIGDL_HANG_TIMEOUT]
     hang_timeout: float = 0.0
+    # --- streaming datasets (dataset/stream.py) -------------------------
+    # bounded-buffer capacity (records) of the stream source adapter —
+    # the producer thread backpressures when the trainer falls this far
+    # behind [BIGDL_STREAM_BUFFER]
+    stream_buffer: int = 1024
+    # records per "epoch" of an unbounded stream, so epoch-keyed
+    # triggers (every_epoch checkpoints, max_epoch) stay meaningful on
+    # continuous ingest; 0 = one endless epoch (use max_iteration)
+    # [BIGDL_STREAM_EPOCH_RECORDS]
+    stream_epoch_records: int = 0
+
+    # --- autoscaling supervisor (resilience/autoscale.py) ---------------
+    # [BIGDL_AUTOSCALE / _MIN_WORLD / _MAX_WORLD / _FACTOR / _INTERVAL /
+    #  _WARMUP / _COOLDOWN / _HYSTERESIS / _STEP_TIME_HIGH / _STEP_TIME_LOW
+    #  / _QUEUE_HIGH / _QUEUE_LOW / _GOODPUT_FLOOR / _EVICT_STRAGGLERS /
+    #  _DRY_RUN / _RULES]
+    autoscale: AutoscaleConfig = dataclasses.field(
+        default_factory=AutoscaleConfig)
 
     # --- observability (obs/ package) -----------------------------------
     # span tracer / metrics registry / runtime profiling switches
@@ -339,6 +450,9 @@ class BigDLConfig:
             heartbeat_every=_env_int("BIGDL_HEARTBEAT_EVERY", 1),
             heartbeat_timeout=_env_float("BIGDL_HEARTBEAT_TIMEOUT", 60.0),
             hang_timeout=_env_float("BIGDL_HANG_TIMEOUT", 0.0),
+            stream_buffer=_env_int("BIGDL_STREAM_BUFFER", 1024),
+            stream_epoch_records=_env_int("BIGDL_STREAM_EPOCH_RECORDS", 0),
+            autoscale=AutoscaleConfig.from_env(),
             obs=ObsConfig.from_env(),
             tuner=TunerConfig.from_env(),
             wire=WireConfig.from_env(),

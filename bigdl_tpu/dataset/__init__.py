@@ -17,6 +17,13 @@ from bigdl_tpu.dataset.dataset import (
     to_dataset,
 )
 from bigdl_tpu.dataset.sample import Sample, MiniBatch
+from bigdl_tpu.dataset.stream import (
+    BoundedBuffer,
+    StreamDataSet,
+    StreamRecord,
+    StreamSource,
+    SyntheticStream,
+)
 from bigdl_tpu.dataset.transformer import (
     Transformer,
     SampleToMiniBatch,
@@ -27,6 +34,8 @@ from bigdl_tpu.dataset.transformer import (
 __all__ = [
     "DataSet", "LocalDataSet", "ArrayDataSet", "DistributedDataSet",
     "PartitionStreamDataSet",
+    "StreamDataSet", "StreamSource", "StreamRecord", "SyntheticStream",
+    "BoundedBuffer",
     "to_dataset", "Sample", "MiniBatch", "Transformer", "SampleToMiniBatch",
     "Shuffle", "Normalizer",
 ]

@@ -63,23 +63,18 @@ def _decode(path: str, image_size: int, train: bool,
             mean: Sequence[float], std: Sequence[float]) -> np.ndarray:
     """File -> (C, H, W) float32, reference ImageNet recipe transforms:
     train = scale-shorter-side-256 + random crop + random hflip,
-    eval = scale + center crop; channel-normalized.  Raises if PIL is
-    unavailable — a real-data entry must never silently train on
-    stand-in pixels."""
+    eval = scale + center crop; channel-normalized.  Decode is
+    PIL-backed when Pillow is present; plain ``.bmp`` files decode
+    through the stdlib/numpy reader (transform/vision.read_image)
+    otherwise.  Anything else without Pillow raises — a real-data entry
+    must never silently train on stand-in pixels."""
     from bigdl_tpu.transform.vision import (
         AspectScale, CenterCrop, ChannelNormalize, ImageFeature,
         MatToTensor, RandomCrop, RandomHFlip, _resize_bilinear,
+        read_image,
     )
 
-    try:
-        from PIL import Image
-    except ImportError as e:  # pragma: no cover - env without Pillow
-        raise ImportError(
-            "ImageFolderDataSet needs Pillow to decode image files"
-        ) from e
-    with Image.open(path) as im:
-        arr = np.asarray(im.convert("RGB"), np.float32)
-
+    arr = read_image(path).astype(np.float32)
     feat = ImageFeature(arr)
     chain = [AspectScale(256 if image_size <= 224 else image_size + 32)]
     if train:

@@ -423,24 +423,31 @@ class FleetAggregator:
                     continue
                 h = scraped["health"] or {}
                 host = h.get("host", addr)
-                fleet["hosts"][str(host)] = {
+                entry = {
                     "status": h.get("status"), "step": h.get("step"),
                     "step_age_s": h.get("step_age_s"),
                     "goodput_ratio": h.get("goodput_ratio"),
+                    "queue_depth": None,
                     "alerts": h.get("alerts") or [],
                     "heartbeat": h.get("heartbeat"), "source": addr}
+                fleet["hosts"][str(host)] = entry
                 for a in h.get("alerts") or []:
                     fleet["alerts"].append(dict(a, host=host))
                 for s in scraped["metrics"]["samples"]:
                     fleet["metrics"].setdefault(s["name"], []).append(
                         {"labels": s["labels"], "value": s["value"],
                          "source": addr})
+                    # the streaming tier's backlog, on the host row —
+                    # the signal the autoscaling policy loop scales on
+                    if s["name"] == "bigdl_stream_buffer_depth":
+                        entry["queue_depth"] = s["value"]
         elif self._tailer is not None:
             for fn, snap in sorted(self._tailer.poll().items()):
                 host = snap.get("host", fn)
                 entry = fleet["hosts"].setdefault(str(host), {
                     "status": "shard", "step": None, "step_age_s": None,
-                    "goodput_ratio": None, "alerts": [], "source": fn})
+                    "goodput_ratio": None, "queue_depth": None,
+                    "alerts": [], "source": fn})
                 for name, fam in (snap.get("metrics") or {}).items():
                     for s in fam.get("samples", []):
                         value = s.get("value", s.get("count"))
@@ -449,6 +456,8 @@ class FleetAggregator:
                              "value": value, "source": fn})
                         if name == "bigdl_goodput_ratio":
                             entry["goodput_ratio"] = value
+                        elif name == "bigdl_stream_buffer_depth":
+                            entry["queue_depth"] = value
                         elif name == "bigdl_alert_active" and value:
                             rule = (s.get("labels") or {}).get("rule")
                             entry["alerts"].append({"rule": rule})

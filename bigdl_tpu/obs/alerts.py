@@ -316,23 +316,50 @@ class AlertEngine:
             return out
 
 
-def _sink_write(sink: str, record: dict):
-    """Deliver one transition to the sink — JSONL append, or webhook
-    POST for http(s):// targets.  Best-effort: a full disk or a dead
-    receiver must never take the trainer down."""
-    try:
-        payload = json.dumps(record, default=str)
-        if sink.startswith(("http://", "https://")):
-            import urllib.request
+def _count_sink_failure():
+    from bigdl_tpu import obs
 
+    obs.get_registry().counter(
+        "bigdl_alert_sink_failures_total",
+        "Alert transitions the sink failed to accept (after the "
+        "retry)").inc()
+
+
+def _sink_write(sink: str, record: dict, timeout: Optional[float] = None):
+    """Deliver one transition to the sink — JSONL append, or webhook
+    POST for http(s):// targets.  Best-effort but BOUNDED: the POST
+    carries a connect/read timeout (``BIGDL_ALERT_SINK_TIMEOUT``, one
+    immediate retry on any failure), so a dead or wedged receiver costs
+    the goodput window tick at most two timeouts — and the loss is
+    visible in ``bigdl_alert_sink_failures_total``, never only a log
+    line."""
+    payload = json.dumps(record, default=str)
+    if sink.startswith(("http://", "https://")):
+        if timeout is None:
+            from bigdl_tpu.config import config
+
+            timeout = config.obs.alert_sink_timeout
+        import urllib.request
+
+        last = None
+        for attempt in range(2):  # one immediate retry
             req = urllib.request.Request(
                 sink, data=payload.encode("utf-8"),
                 headers={"Content-Type": "application/json"})
-            urllib.request.urlopen(req, timeout=2.0).close()
-        else:
-            with open(sink, "a", encoding="utf-8") as fh:
-                fh.write(payload + "\n")
+            try:
+                urllib.request.urlopen(req, timeout=timeout).close()
+                return
+            except Exception as e:  # noqa: BLE001 — counted below
+                last = e
+        _count_sink_failure()
+        log.warning("alert sink %s failed twice (timeout %.1fs): %s",
+                    sink, timeout, last)
+        return
+    try:
+        with open(sink, "a", encoding="utf-8") as fh:
+            fh.write(payload + "\n")
     except Exception as e:  # noqa: BLE001
+        _count_sink_failure()
         log.warning("alert sink %s failed: %s", sink, e)
 
 

@@ -107,6 +107,7 @@ def build_report(trace_dir: str, metrics_dir: Optional[str] = None) -> dict:
     anomaly_events: list = []
     tuner_events: list = []
     alert_events: list = []
+    autoscale_events: list = []
     for sh in shards:
         key = f"host{sh.host}/pid{sh.pid}"
         h = hosts.setdefault(key, {
@@ -150,6 +151,13 @@ def build_report(trace_dir: str, metrics_dir: Optional[str] = None) -> dict:
                     a["state"] = name.split(".", 1)[1]
                     a["wall_time"] = rec.get("wall_time")
                     alert_events.append(a)
+                elif name in ("elastic.autoscale", "supervisor.backoff",
+                              "elastic.stream_restore"):
+                    a = dict(rec.get("attrs") or {})
+                    a["host"] = sh.host
+                    a["event"] = name
+                    a["wall_time"] = rec.get("wall_time")
+                    autoscale_events.append(a)
 
     per_host = {}
     for key, h in hosts.items():
@@ -279,6 +287,45 @@ def build_report(trace_dir: str, metrics_dir: Optional[str] = None) -> dict:
         "events": alert_events,
     }
 
+    # ---- autoscaling & streaming (resilience/autoscale.py,
+    # dataset/stream.py) ------------------------------------------------
+    decisions: dict = {}
+    for labels, s, _host in _metric_samples(
+            snaps, "bigdl_autoscale_decisions_total"):
+        key = f"{labels.get('direction', '?')}:{labels.get('reason', '?')}"
+        decisions[key] = decisions.get(key, 0.0) + float(
+            s.get("value", 0.0))
+    resumes: dict = {}
+    for labels, s, _host in _metric_samples(snaps, "bigdl_resumes_total"):
+        key = labels.get("resize", "?")
+        resumes[key] = resumes.get(key, 0.0) + float(s.get("value", 0.0))
+
+    def _metric_max(name):
+        vals = [float(s.get("value", 0.0))
+                for _l, s, _h in _metric_samples(snaps, name)]
+        return max(vals) if vals else None
+
+    def _metric_sum(name):
+        return sum(float(s.get("value", 0.0))
+                   for _l, s, _h in _metric_samples(snaps, name))
+
+    autoscale_events.sort(key=lambda a: a.get("wall_time") or 0.0)
+    stream_records = _metric_sum("bigdl_stream_records_total")
+    autoscale = {
+        "decisions_total": decisions,
+        "resumes_total": resumes,
+        "events": autoscale_events,
+        "stream": None if not stream_records else {
+            "records_total": stream_records,
+            "offset": _metric_max("bigdl_stream_offset"),
+            "watermark": _metric_max("bigdl_stream_watermark"),
+            "buffer_depth": _metric_max("bigdl_stream_buffer_depth"),
+            "lag_records": _metric_max("bigdl_stream_lag_records"),
+            "backpressure_waits": _metric_sum(
+                "bigdl_stream_backpressure_waits_total"),
+        },
+    }
+
     # per-device HBM peaks (bigdl_hbm_peak_bytes, max across snapshots)
     hbm: dict = {}
     for labels, s, _host in _metric_samples(snaps, "bigdl_hbm_peak_bytes"):
@@ -314,6 +361,7 @@ def build_report(trace_dir: str, metrics_dir: Optional[str] = None) -> dict:
         "resilience_events": resilience,
         "slow_steps": slow_steps,
         "alerts": alerts,
+        "autoscale": autoscale,
         "health": health,
         "goodput": gp,
         "stragglers": stragglers,
@@ -405,6 +453,48 @@ def render_text(rep: dict) -> str:
                 f"  host{ev.get('host')} {ev.get('state'):>8s} "
                 f"{ev.get('rule')} [{ev.get('severity')}] "
                 f"{ev.get('metric')}={ev.get('value')}")
+    lines.append("")
+    lines.append("-- autoscaling & stream --")
+    asc = rep.get("autoscale") or {}
+    if not (asc.get("decisions_total") or asc.get("resumes_total")
+            or asc.get("stream") or asc.get("events")):
+        lines.append("  (no autoscale/stream activity)")
+    else:
+        for key, n in sorted(asc.get("decisions_total", {}).items()):
+            lines.append(f"  decision {key:28s} {int(n)}x")
+        if asc.get("resumes_total"):
+            lines.append("  resumes: " + ", ".join(
+                f"{k} {int(n)}x"
+                for k, n in sorted(asc["resumes_total"].items())))
+        st = asc.get("stream")
+        if st:
+            wm = st.get("watermark")
+            lines.append(
+                f"  stream: {int(st['records_total'])} records trained, "
+                f"offset {int(st['offset'] or 0)}"
+                + (f", watermark {wm:g}" if wm is not None else ""))
+            lines.append(
+                f"  stream buffer: depth {st.get('buffer_depth')}, "
+                f"lag {st.get('lag_records')}, "
+                f"{int(st.get('backpressure_waits') or 0)} "
+                "backpressure wait(s)")
+        for ev in asc.get("events", [])[-8:]:
+            if ev.get("event") == "elastic.autoscale":
+                if ev.get("suppressed"):
+                    lines.append(
+                        f"  host{ev.get('host')} suppressed "
+                        f"({ev.get('suppressed')}) rule {ev.get('rule')}")
+                else:
+                    lines.append(
+                        f"  host{ev.get('host')} {ev.get('direction')} "
+                        f"{ev.get('old_world')}->{ev.get('new_world')} "
+                        f"[{ev.get('reason')}]"
+                        + (" DRY-RUN" if ev.get("dry_run") else ""))
+            elif ev.get("event") == "supervisor.backoff":
+                lines.append(
+                    f"  host{ev.get('host')} backoff {ev.get('kind')} "
+                    f"{float(ev.get('delay_s') or 0):.2f}s (rc "
+                    f"{ev.get('rc')})")
     lines.append("")
     lines.append("-- goodput --")
     gp = rep.get("goodput")
@@ -521,11 +611,13 @@ def render_fleet(fleet: dict) -> str:
     for host, h in sorted(hosts.items()):
         gr = h.get("goodput_ratio")
         age = h.get("step_age_s")
+        qd = h.get("queue_depth")
         lines.append(
             f"  host{host}: status={h.get('status')} "
             f"step={h.get('step')}"
             + (f" age={age:.1f}s" if age is not None else "")
             + (f" goodput={gr:.3f}" if gr is not None else "")
+            + (f" queue={qd:g}" if qd is not None else "")
             + f"  [{h.get('source')}]")
         for a in h.get("alerts") or []:
             lines.append(f"    FIRING {a.get('rule')}"

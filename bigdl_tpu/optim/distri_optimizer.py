@@ -842,6 +842,13 @@ class DistriOptimizer(LocalOptimizer):
                     "epoch_neval0", self.state["neval"])
                 self._pending_fast_forward = max(
                     0, self.state["neval"] - self.state["epoch_neval0"])
+                # a streaming dataset seeks to the checkpoint's trained
+                # offset instead of fast-forwarding an epoch replay —
+                # the crashed attempt's records past the checkpoint are
+                # re-read and re-trained exactly once
+                from bigdl_tpu.resilience import elastic as _elastic
+
+                _elastic.restore_stream(self, extra)
                 # goodput: the in-process retry replays every step
                 # between the checkpoint and the crash — stamp this
                 # attempt's own max step as the rework high-water mark

@@ -313,12 +313,19 @@ class BaseOptimizer:
     def _checkpoint_extra(self) -> dict:
         """Everything a resume needs beyond the arrays: trigger/LR
         counters, the epoch's starting neval (mid-epoch fast-forward),
-        and the writer topology."""
-        return {"epoch": self.state["epoch"],
-                "neval": self.state["neval"],
-                "epoch_neval0": self.state.get("epoch_neval0",
-                                               self.state["neval"]),
-                "topology": self._topology()}
+        the writer topology, and — for streaming datasets — the trained
+        stream offset/watermark (the exactly-once commit point,
+        dataset/stream.py)."""
+        extra = {"epoch": self.state["epoch"],
+                 "neval": self.state["neval"],
+                 "epoch_neval0": self.state.get("epoch_neval0",
+                                                self.state["neval"]),
+                 "topology": self._topology()}
+        stream_state = getattr(self.dataset, "stream_checkpoint_state",
+                               None)
+        if stream_state is not None:
+            extra["stream"] = stream_state()
+        return extra
 
     def _elastic_shutdown(self, step, pvar, mod_state, opt_state):
         """Graceful preemption (resilience/elastic.py): the in-flight
@@ -750,6 +757,10 @@ class LocalOptimizer(BaseOptimizer):
             from bigdl_tpu.obs.server import note_step
         else:
             note_step = None
+        # streaming datasets (dataset/stream.py): advance the trained
+        # stream frontier once per dispatched batch, so the offset a
+        # checkpoint carries covers exactly the batches in the weights
+        note_stream = getattr(self.dataset, "note_batch_trained", None)
 
         # Async-dispatch pipelining: the device loss is read back ONE
         # iteration behind, so the next step is dispatched before the
@@ -908,6 +919,15 @@ class LocalOptimizer(BaseOptimizer):
                     with tracer.span("batch_prep", step=n):
                         prepared = self._prepare_batch(inp, tgt)
                     if prepared is None:
+                        if note_stream is not None:
+                            # a dropped batch still consumed its stream
+                            # records: advance the frontier so the meta
+                            # queue stays aligned (and say so — dropping
+                            # stream records is a configuration smell)
+                            log.warning("dropped a streaming batch at "
+                                        "iter %d — its records are "
+                                        "consumed, not trained", n)
+                            note_stream()
                         continue  # dropped (e.g. sub-mesh partial batch)
                     inp, tgt = prepared
                     if self._fault_injector is not None:
@@ -939,6 +959,8 @@ class LocalOptimizer(BaseOptimizer):
                     health_dev = out[5] if monitor is not None else None
                     bs = np.asarray(inp).shape[0]
                     records_total += bs
+                    if note_stream is not None:
+                        note_stream()
                     if sync_per_step:
                         resolve(n, loss, ok, bs, t0, health_dev)
                     else:

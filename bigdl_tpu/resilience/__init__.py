@@ -16,6 +16,11 @@ executor loss, driver ``retryNum < maxRetry`` checkpoint reload
 * :mod:`~bigdl_tpu.resilience.supervisor` — ``python -m
   bigdl_tpu.resilience.supervisor -- <train cmd>`` restart loop,
   classifying exit codes against the retry budget
+* :mod:`~bigdl_tpu.resilience.autoscale` — the policy loop that
+  *drives* a resize: declarative rules over the live fleet signals
+  (step time, stream queue depth, goodput, alerts, stragglers) decide
+  a new world size; the supervisor executes it as a graceful
+  checkpoint-stop-restart
 * checkpoint integrity lives in ``bigdl_tpu/utils/serializer.py``
   (manifest checksums, verify-on-load, newest-intact fallback,
   keep-last-K rotation)
@@ -23,6 +28,10 @@ executor loss, driver ``retryNum < maxRetry`` checkpoint reload
   (``optim/optimizer.py`` / ``optim/distri_optimizer.py``)
 """
 
+from bigdl_tpu.resilience.autoscale import (
+    AutoscaleController,
+    Decision,
+)
 from bigdl_tpu.resilience.elastic import (
     EXIT_FATAL,
     EXIT_PREEMPTED,
@@ -57,7 +66,9 @@ from bigdl_tpu.resilience.retry import (
 )
 
 __all__ = [
+    "AutoscaleController",
     "CheckpointWriteError",
+    "Decision",
     "EXIT_FATAL",
     "EXIT_PREEMPTED",
     "EXIT_TRANSIENT",

@@ -455,6 +455,25 @@ def record_resume(old_world: Optional[int], new_world: int,
     return resize
 
 
+def restore_stream(optimizer, extra) -> bool:
+    """Apply a checkpoint's ``stream`` state to a streaming dataset
+    (dataset/stream.py): seek the source back to the trained offset so
+    the resume re-reads exactly the records the rolled-back weights
+    never kept — the exactly-once half of a crash/resize resume.  A
+    streaming resume replaces the epoch fast-forward (the stream seeks
+    by offset, not by replaying an epoch's batch order).  Returns True
+    when the optimizer's dataset is streaming.  Both resume paths call
+    this: ``restore_latest`` and the DistriOptimizer in-process
+    retry."""
+    restore = getattr(getattr(optimizer, "dataset", None),
+                      "stream_restore", None)
+    if restore is None:
+        return False
+    restore((extra or {}).get("stream"))
+    optimizer._pending_fast_forward = 0
+    return True
+
+
 def restore_latest(optimizer, directory: Optional[str] = None):
     """Resume an optimizer from the newest intact checkpoint in
     ``directory`` (default: its own checkpoint path): load weights +
@@ -484,6 +503,9 @@ def restore_latest(optimizer, directory: Optional[str] = None):
     # driver loop skips that many so the replayed data order matches
     optimizer._pending_fast_forward = max(
         0, optimizer.state["neval"] - optimizer.state["epoch_neval0"])
+    # streaming datasets seek by offset instead (clears the
+    # fast-forward: a stream has no epoch order to replay)
+    restore_stream(optimizer, extra)
     topo = extra.get("topology") or {}
     record_resume(topo.get("world_size"),
                   getattr(optimizer, "n_shards", 1),

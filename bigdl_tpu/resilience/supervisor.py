@@ -137,7 +137,8 @@ class Supervisor:
                  runner: Optional[Callable] = None,
                  sleep: Callable[[float], None] = time.sleep,
                  fatal_codes: Sequence[int] = (EXIT_FATAL, 2, 126, 127),
-                 hang_timeout: Optional[float] = None):
+                 hang_timeout: Optional[float] = None,
+                 autoscaler=None, stop_grace_s: float = 30.0):
         if not cmd:
             raise ValueError("supervisor needs a command to run")
         self.cmd = list(cmd)
@@ -152,6 +153,21 @@ class Supervisor:
 
             hang_timeout = refresh_from_env().hang_timeout
         self.hang_timeout = float(hang_timeout or 0.0)
+        # autoscaling policy loop (resilience/autoscale.py): polled
+        # from the child-wait loop; a decision gracefully stops the
+        # child (emergency checkpoint) and relaunches at the new world
+        self.autoscaler = autoscaler
+        self.stop_grace_s = float(stop_grace_s)
+        self.resizes = 0
+        self._resize_decision = None
+        # resize restarts get the SAME deterministic-jitter exponential
+        # backoff shape as transient retries, but from their own policy
+        # so legitimate resizes never eat the failure budget — repeated
+        # rapid resizes back off harder (thrash damping on top of the
+        # controller's cooldown)
+        self._resize_policy = RetryPolicy.from_config(
+            max_retries=1_000_000)
+        self._resize_policy.window_budget = 1_000_000
         self.attempt = 0          # 0-based launch counter (all launches)
         self.preemptions = 0
         self.hangs = 0
@@ -182,36 +198,86 @@ class Supervisor:
                             port=port if port > 0 else None,
                             port_file=env.get("BIGDL_OBS_PORT_FILE"))
 
+    def _bind_autoscaler(self, env: dict):
+        """Point the policy loop's scraper at this launch's live
+        endpoint(s): explicit peers when the env names them, else the
+        child's own /healthz via the same port / port-file resolution
+        the hang watchdog uses."""
+        if self.autoscaler is None:
+            return
+        peers = env.get("BIGDL_OBS_PEERS") or None
+        port = None
+        if not peers:
+            try:
+                port = int(env.get("BIGDL_OBS_PORT") or 0) or None
+            except ValueError:
+                port = None
+        self.autoscaler.bind_endpoint(
+            port=port, port_file=env.get("BIGDL_OBS_PORT_FILE"),
+            peers=peers)
+        self.autoscaler.on_launch()
+
+    def _graceful_stop(self, why: str) -> int:
+        """SIGTERM the child (graceful preemption: it finishes the
+        in-flight step and writes an emergency checkpoint), escalate to
+        SIGKILL only past ``stop_grace_s``."""
+        log.warning("supervisor: stopping the child (%s) — SIGTERM, "
+                    "grace %.1fs", why, self.stop_grace_s)
+        self._child.terminate()
+        try:
+            return self._child.wait(timeout=self.stop_grace_s)
+        except subprocess.TimeoutExpired:
+            log.error("supervisor: child ignored SIGTERM for %.1fs — "
+                      "killing it", self.stop_grace_s)
+            self._child.kill()
+        return self._child.wait()
+
     def _spawn(self, cmd: List[str], env: dict) -> int:
         self._child = subprocess.Popen(cmd, env=env)
         watchdog = self._make_watchdog(env)
+        self._bind_autoscaler(env)
         try:
-            if watchdog is None:
+            if watchdog is None and self.autoscaler is None:
                 return self._child.wait()
-            # poll a few times per hang budget: fine-grained enough to
-            # catch a stall promptly, coarse enough that the scrape
-            # cost on the child is noise
-            poll = max(0.1, min(2.0, self.hang_timeout / 4.0))
+            # poll a few times per hang budget / policy interval:
+            # fine-grained enough to catch a stall or act on a decision
+            # promptly, coarse enough that the scrape cost is noise
+            polls = [2.0]
+            if watchdog is not None:
+                polls.append(self.hang_timeout / 4.0)
+            if self.autoscaler is not None:
+                polls.append(self.autoscaler.cfg.interval_s / 2.0)
+            poll = max(0.1, min(polls))
             while True:
                 try:
                     return self._child.wait(timeout=poll)
                 except subprocess.TimeoutExpired:
                     pass
-                if self._terminated or not watchdog.stalled():
+                if self._terminated:
                     continue
-                payload = watchdog.last_payload or {}
-                log.error(
-                    "supervisor: child step stamp stale for %.1fs "
-                    "(step %s, budget %.1fs) — killing the hung child",
-                    payload.get("step_age_s", -1.0), payload.get("step"),
-                    self.hang_timeout)
-                self._hang_detected = True
-                self._child.terminate()
-                try:
-                    self._child.wait(timeout=5.0)
-                except subprocess.TimeoutExpired:
-                    self._child.kill()
-                return self._child.wait()
+                if watchdog is not None and watchdog.stalled():
+                    payload = watchdog.last_payload or {}
+                    log.error(
+                        "supervisor: child step stamp stale for %.1fs "
+                        "(step %s, budget %.1fs) — killing the hung "
+                        "child", payload.get("step_age_s", -1.0),
+                        payload.get("step"), self.hang_timeout)
+                    self._hang_detected = True
+                    self._child.terminate()
+                    try:
+                        self._child.wait(timeout=5.0)
+                    except subprocess.TimeoutExpired:
+                        self._child.kill()
+                    return self._child.wait()
+                if self.autoscaler is not None \
+                        and self._resize_decision is None:
+                    decision = self.autoscaler.tick()
+                    if decision is not None and not decision.dry_run:
+                        self._resize_decision = decision
+                        return self._graceful_stop(
+                            f"autoscale {decision.direction} "
+                            f"{decision.old_world}->"
+                            f"{decision.new_world} [{decision.reason}]")
         finally:
             self._child = None
 
@@ -251,16 +317,38 @@ class Supervisor:
             "Child restarts, by exit classification",
             labels=("kind",)).labels(kind=kind).inc()
 
+    def _backoff_sleep(self, kind: str, rc: int, delay: float):
+        """Sleep a restart backoff, visibly: one ``supervisor.backoff``
+        trace event (what the chosen sleep was and why) plus the
+        goodput-ledger record the cross-attempt ratio attributes —
+        backoff is badput the children never see."""
+        from bigdl_tpu import obs
+
+        self._event("supervisor.backoff", kind=kind, rc=rc,
+                    delay_s=round(delay, 3))
+        if delay <= 0:
+            return
+        t0 = time.perf_counter()
+        self._sleep(delay)
+        obs.get_ledger().record("supervisor_backoff", t0,
+                                time.perf_counter() - t0, rc=rc,
+                                restart_kind=kind)
+
     def run(self) -> int:
         self._event("elastic.supervisor_start", cmd=self.cmd)
         while True:
             env = dict(os.environ)
             env["BIGDL_ELASTIC_ATTEMPT"] = str(self.attempt)
             env["BIGDL_ELASTIC_PREEMPTIONS"] = str(self.preemptions)
-            # hang watchdog on an ephemeral child port: the child must
-            # tell the supervisor where it bound, so inject a per-launch
-            # port file when the launcher didn't provide one
-            if self.hang_timeout > 0 \
+            if self.autoscaler is not None:
+                # the world-size contract: the child sizes its mesh
+                # from this (and the topology-tagged checkpoint makes
+                # the resume re-partition to match)
+                env["BIGDL_AUTOSCALE_WORLD"] = str(self.autoscaler.world)
+            # hang watchdog / policy loop on an ephemeral child port:
+            # the child must tell the supervisor where it bound, so
+            # inject a per-launch port file when the launcher didn't
+            if (self.hang_timeout > 0 or self.autoscaler is not None) \
                     and env.get("BIGDL_OBS_PORT") == "0" \
                     and not env.get("BIGDL_OBS_PORT_FILE"):
                 env["BIGDL_OBS_PORT_FILE"] = os.path.join(
@@ -277,6 +365,8 @@ class Supervisor:
             self._hang_detected = False
             rc = self._runner(self.cmd, env)
             hung = self._hang_detected
+            resize = self._resize_decision
+            self._resize_decision = None
             self.attempt += 1
             if rc == 0:
                 log.info("supervisor: command completed cleanly")
@@ -288,6 +378,30 @@ class Supervisor:
                 log.warning("supervisor: stopping after its own signal; "
                             "child exited %d", rc)
                 return rc
+            if resize is not None:
+                # the supervisor stopped this child itself to execute a
+                # resize — the exit code says nothing (usually
+                # EXIT_PREEMPTED from the graceful path; a child that
+                # was ALREADY preempting when the decision landed exits
+                # the same way and is handled identically).  Restart at
+                # the new world, free of the retry budget, paced by the
+                # resize backoff policy.
+                self.resizes += 1
+                self.autoscaler.commit(resize)
+                log.warning("supervisor: resize %s executed (%s) — "
+                            "relaunching at world %d (child rc %d)",
+                            resize.resize, resize.reason,
+                            self.autoscaler.world, rc)
+                self._event("elastic.restart", kind="resize", rc=rc,
+                            attempt=self.attempt,
+                            direction=resize.direction,
+                            reason=resize.reason,
+                            old_world=resize.old_world,
+                            new_world=resize.new_world)
+                self._count_restart("resize")
+                delay = self._resize_policy.record_failure() or 0.0
+                self._backoff_sleep("resize", rc, delay)
+                continue
             if rc == EXIT_PREEMPTED and not hung:
                 self.preemptions += 1
                 self._event("elastic.restart", kind="preempted", rc=rc,
@@ -329,17 +443,7 @@ class Supervisor:
                         "restart %d/%d in %.2fs", rc, kind,
                         self.policy.attempts, self.policy.max_retries,
                         delay)
-            if delay > 0:
-                # backoff is badput the children never see — the
-                # supervisor's own goodput shard carries it so the
-                # aggregated cross-attempt ratio includes the wait
-                from bigdl_tpu import obs
-
-                t0 = time.perf_counter()
-                self._sleep(delay)
-                obs.get_ledger().record(
-                    "supervisor_backoff", t0,
-                    time.perf_counter() - t0, rc=rc)
+            self._backoff_sleep(kind, rc, delay)
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -360,6 +464,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                          "stamp stops advancing for this many seconds "
                          "(default BIGDL_HANG_TIMEOUT; needs "
                          "BIGDL_OBS_PORT on the child)")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="enable the autoscaling policy loop "
+                         "(resilience/autoscale.py) even when "
+                         "BIGDL_AUTOSCALE is unset; rules/bands come "
+                         "from the BIGDL_AUTOSCALE_* knobs, the child "
+                         "endpoint from BIGDL_OBS_PORT(_FILE)/"
+                         "BIGDL_OBS_PEERS, and the chosen world is "
+                         "exported as BIGDL_AUTOSCALE_WORLD")
     ap.add_argument("cmd", nargs=argparse.REMAINDER,
                     help="training command (prefix with --)")
     args = ap.parse_args(argv)
@@ -371,9 +483,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    from bigdl_tpu.config import refresh_from_env
+
+    autoscaler = None
+    if args.autoscale or refresh_from_env().autoscale.enabled:
+        from bigdl_tpu.resilience.autoscale import AutoscaleController
+
+        autoscaler = AutoscaleController.from_config()
     sup = Supervisor(cmd, max_retries=args.max_retries,
                      max_preemptions=args.max_preemptions,
-                     hang_timeout=args.hang_timeout)
+                     hang_timeout=args.hang_timeout,
+                     autoscaler=autoscaler)
     sup.install_signal_forwarding()
     try:
         return sup.run()

@@ -73,6 +73,80 @@ class _ChainedFeature(FeatureTransformer):
         return self.b.transform(self.a.transform(feature))
 
 
+def write_bmp(path: str, arr: np.ndarray):
+    """Write an HWC uint8 RGB array as an uncompressed 24-bit BMP using
+    only the stdlib + numpy — the fixture writer that lets the
+    image-pipeline tests run 0-skip on containers without Pillow (the
+    decode side is :func:`read_bmp`; PIL keeps handling everything
+    else)."""
+    import struct
+
+    arr = np.ascontiguousarray(np.asarray(arr, np.uint8))
+    if arr.ndim != 3 or arr.shape[2] != 3:
+        raise ValueError(f"write_bmp wants HWC RGB, got {arr.shape}")
+    h, w = arr.shape[:2]
+    pad = (-w * 3) % 4          # BMP rows are 4-byte aligned
+    rows = arr[::-1, :, ::-1]   # bottom-up, BGR
+    body = bytearray()
+    zeros = b"\x00" * pad
+    for row in rows:
+        body += row.tobytes() + zeros
+    header = struct.pack("<2sIHHI", b"BM", 54 + len(body), 0, 0, 54)
+    header += struct.pack("<IiiHHIIiiII", 40, w, h, 1, 24, 0,
+                          len(body), 2835, 2835, 0, 0)
+    with open(path, "wb") as fh:
+        fh.write(header + bytes(body))
+
+
+def read_bmp(path: str) -> np.ndarray:
+    """Decode an uncompressed 24/32-bit BMP to an HWC uint8 RGB array
+    with only the stdlib + numpy (the PIL-less fallback for
+    :func:`write_bmp` fixtures and any plain BMP input)."""
+    import struct
+
+    with open(path, "rb") as fh:
+        data = fh.read()
+    if data[:2] != b"BM":
+        raise ValueError(f"{path!r} is not a BMP file")
+    pixel_off = struct.unpack_from("<I", data, 10)[0]
+    hdr_size = struct.unpack_from("<I", data, 14)[0]
+    if hdr_size < 40:
+        raise ValueError(f"unsupported BMP core header in {path!r}")
+    w, h = struct.unpack_from("<ii", data, 18)
+    planes, bpp = struct.unpack_from("<HH", data, 26)
+    compression = struct.unpack_from("<I", data, 30)[0]
+    if planes != 1 or compression != 0 or bpp not in (24, 32):
+        raise ValueError(
+            f"unsupported BMP variant in {path!r} (bpp={bpp}, "
+            f"compression={compression}) — only uncompressed 24/32-bit")
+    flipped = h > 0
+    h = abs(h)
+    nchan = bpp // 8
+    stride = (w * nchan + 3) & ~3
+    rows = np.frombuffer(
+        data, np.uint8, count=h * stride, offset=pixel_off
+    ).reshape(h, stride)[:, : w * nchan].reshape(h, w, nchan)
+    if flipped:
+        rows = rows[::-1]
+    return np.ascontiguousarray(rows[..., 2::-1])  # BGR(A) -> RGB
+
+
+def read_image(path: str) -> np.ndarray:
+    """File -> HWC uint8 RGB: PIL when present (every format), the
+    numpy BMP reader otherwise — so a bare container can still feed
+    the image pipeline real pixels."""
+    try:
+        from PIL import Image
+    except ImportError:
+        if path.lower().endswith(".bmp"):
+            return read_bmp(path)
+        raise ImportError(
+            f"decoding {path!r} needs Pillow (only .bmp decodes "
+            "without it)")
+    with Image.open(path) as im:
+        return np.asarray(im.convert("RGB"))
+
+
 def _resize_bilinear(img: np.ndarray, oh: int, ow: int) -> np.ndarray:
     """Pure-numpy bilinear resize (HWC), replacing the OpenCV JNI path."""
     try:

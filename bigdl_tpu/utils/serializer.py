@@ -291,7 +291,8 @@ def _sha256(path: str) -> str:
 _CKPT_SUFFIXES = (".model.npz", ".optim.npz")
 
 
-def write_manifest(path_prefix: str, topology: dict = None) -> str:
+def write_manifest(path_prefix: str, topology: dict = None,
+                   stream: dict = None) -> str:
     """Record size + sha256 of every file in the ``path_prefix``
     checkpoint pair so verify-on-load can tell torn/rotted checkpoints
     from intact ones, plus the writer's ``topology``
@@ -299,7 +300,10 @@ def write_manifest(path_prefix: str, topology: dict = None) -> str:
     ``wire`` tags the compressed-collective config the run trained
     under, incl. whether a ``wire_ef`` error-feedback residual rides
     the ``.optim`` state arrays) so a resize-resume can inspect the
-    source world without opening the npz.
+    source world without opening the npz, and — for streaming runs —
+    the ``stream`` frontier (``{offset, watermark, records}`` —
+    dataset/stream.py) so tooling and the autoscaling supervisor can
+    read the exactly-once commit point the same cheap way.
     Written atomically AFTER the pair is durable — a crash between pair
     and manifest degrades to the legacy no-manifest check, never to a
     manifest blessing garbage."""
@@ -315,6 +319,8 @@ def write_manifest(path_prefix: str, topology: dict = None) -> str:
     doc = {"format": 1, "files": files}
     if topology:
         doc["topology"] = topology
+    if stream:
+        doc["stream"] = stream
     tmp = manifest_path + ".tmp"
     with open(tmp, "w", encoding="utf-8") as fh:
         json.dump(doc, fh)
@@ -344,6 +350,28 @@ def read_checkpoint_topology(path_prefix: str) -> dict:
         with np.load(optim_path) as data:
             meta = json.loads(bytes(data["__meta__"]).decode("utf-8"))
         return (meta.get("extra") or {}).get("topology") or {}
+    except Exception:  # noqa: BLE001 — absent/torn pair = no metadata
+        return {}
+
+
+def read_checkpoint_stream(path_prefix: str) -> dict:
+    """The streaming frontier a checkpoint was written at
+    (``{offset, watermark, records}``) — from the manifest (no npz
+    open), falling back to the ``.optim`` meta for manifest-less
+    pairs; ``{}`` for non-streaming runs."""
+    manifest_path = path_prefix + ".manifest.json"
+    try:
+        with open(manifest_path, "r", encoding="utf-8") as fh:
+            stream = json.load(fh).get("stream")
+            if stream:
+                return stream
+    except (OSError, ValueError):
+        pass
+    optim_path = path_prefix + ".optim.npz"
+    try:
+        with np.load(optim_path) as data:
+            meta = json.loads(bytes(data["__meta__"]).decode("utf-8"))
+        return (meta.get("extra") or {}).get("stream") or {}
     except Exception:  # noqa: BLE001 — absent/torn pair = no metadata
         return {}
 
@@ -488,10 +516,12 @@ def write_checkpoint(snap: dict, path_prefix: str, keep_last: int = 0):
             )
             _atomic_savez(path_prefix + ".optim", opt_arrays)
         _atomic_savez(path_prefix + ".model", arrays)
-        topology = None
+        topology = stream = None
         if snap["optim"] is not None:
-            topology = (snap["optim"]["extra"] or {}).get("topology")
-        write_manifest(path_prefix, topology=topology)
+            extra = snap["optim"]["extra"] or {}
+            topology = extra.get("topology")
+            stream = extra.get("stream")
+        write_manifest(path_prefix, topology=topology, stream=stream)
         # chaos hook: post-write corruption the verify-on-load must catch
         from bigdl_tpu.resilience.faults import get_injector
 

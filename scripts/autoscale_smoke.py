@@ -1,0 +1,292 @@
+#!/usr/bin/env python
+"""Autoscaling + exactly-once streaming smoke — the whole loop, for
+real.
+
+Driven by ``scripts/run-tests.sh --autoscale``.  The parent runs the
+REAL restart supervisor with the REAL autoscaling policy loop
+(``resilience/autoscale.py``) over real training children, and nothing
+ever restarts a child manually:
+
+1. launch 0: a 1-"host" DistriOptimizer trains from an unbounded-style
+   :class:`SyntheticStream` with an **infinite backlog** (rate=None) —
+   the stream buffer pins at capacity, the controller scrapes the
+   child's live ``/metrics`` (``bigdl_stream_buffer_depth``) through
+   the port file the supervisor injects, the ``queue_high`` rule
+   breaches twice, and the supervisor executes **scale-up 1→2** by
+   graceful stop (SIGTERM → in-flight step finishes → emergency
+   checkpoint carrying the trained stream offset → exit 170);
+2. launch 1: the child re-forms at world 2, ``elastic.restore_latest``
+   re-partitions the ZeRO state AND seeks the stream to the trained
+   offset (``bigdl_resumes_total{resize="1to2"}``).  The synthetic
+   ingest rate is now **below** training throughput — the buffer
+   drains, ``queue_low`` breaches past the cooldown, and the
+   supervisor executes **scale-down 2→1**;
+3. launch 2: world 1 again (``resize="2to1"``); ``queue_low`` keeps
+   breaching but the world is at ``min_world`` — the decision is
+   suppressed (``at_bound``) and the child trains to completion.
+
+The parent then asserts:
+
+* resumed-vs-uninterrupted **trajectory equivalence**: the union of
+  the three attempts' per-step losses covers steps 1..N exactly once
+  and matches an uninterrupted 1-host baseline step-for-step;
+* the **exactly-once stream audit**: the attempts' trained-range logs
+  concatenate to every record id 0..TOTAL exactly once — none dropped,
+  none trained twice, across BOTH resizes;
+* ``bigdl_resumes_total{resize="1to2"} 1`` and ``{resize="2to1"} 1``
+  in the children's metrics shards, and both policy decisions in the
+  parent's ``bigdl_autoscale_decisions_total``.
+
+Results are banked as ``AUTOSCALE_SMOKE.json`` (bench.py folds them
+into BENCH ``extras.autoscale``).
+"""
+
+import dataclasses
+import glob
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+TOTAL_STEPS = 300
+BATCH = 32
+TOTAL_RECORDS = TOTAL_STEPS * BATCH
+THROTTLE_S = 0.04      # per-step sleep so launches outlive the warmup
+DRAIN_RATE = 600.0     # records/s on resumed launches (< consumption)
+
+
+def child():
+    baseline = os.environ.get("BIGDL_SMOKE_BASELINE") == "1"
+    attempt = int(os.environ.get("BIGDL_ELASTIC_ATTEMPT", "0"))
+    world = 1 if baseline else int(
+        os.environ.get("BIGDL_AUTOSCALE_WORLD", "1"))
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + f" --xla_force_host_platform_device_count"
+                                 f"={world}")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np  # noqa: F401 — keeps the import graph warm
+
+    from bigdl_tpu.common import RandomGenerator
+    from bigdl_tpu.dataset.stream import StreamDataSet, SyntheticStream
+    from bigdl_tpu.engine import Engine
+    from bigdl_tpu.nn import (
+        ClassNLLCriterion, Linear, LogSoftMax, ReLU, Sequential,
+    )
+    from bigdl_tpu.optim import DistriOptimizer, SGD, Trigger
+    from bigdl_tpu.resilience import elastic
+
+    smoke_dir = os.environ["BIGDL_SMOKE_DIR"]
+    Engine.init()
+    assert len(jax.devices()) == world, jax.devices()
+    RandomGenerator.RNG.set_seed(7)
+    model = Sequential().add(Linear(16, 32)).add(ReLU()) \
+        .add(Linear(32, 4)).add(LogSoftMax())
+    # launch 0 sees an infinite backlog (the buffer pins at capacity —
+    # the scale-UP signal); resumed launches follow a live edge slower
+    # than training drains it (depth ~0 — the scale-DOWN signal)
+    rate = None if (baseline or attempt == 0) else DRAIN_RATE
+    stream = SyntheticStream(feature_dim=16, n_classes=4, seed=3,
+                             limit=TOTAL_RECORDS, rate=rate)
+    ds = StreamDataSet(stream, batch_size=BATCH, buffer_records=128,
+                       audit_log=True)
+    opt = DistriOptimizer(model, ds, ClassNLLCriterion(),
+                          batch_size=BATCH, wire_dtype="none")
+    opt.set_optim_method(SGD(learningrate=0.5, momentum=0.9))
+    opt.set_end_when(Trigger.max_iteration(TOTAL_STEPS))
+    opt.set_checkpoint(os.path.join(smoke_dir, "ckpt"),
+                       Trigger.several_iteration(50))
+    opt.max_retry = 0
+
+    losses = {}
+    throttle = 0.0 if baseline else THROTTLE_S
+
+    class Tape:
+        def add_scalar(self, tag, value, step):
+            if tag == "Loss":
+                losses[step] = float(value)
+                if throttle:
+                    time.sleep(throttle)
+
+        def add_histogram(self, *a, **k):
+            pass
+
+        def get_summary_trigger(self, name):
+            return None
+
+        def add_resilience(self, *a, **k):
+            pass
+
+    opt.set_train_summary(Tape())
+    extra = None if baseline else elastic.restore_latest(opt)
+    print(f"SMOKE_CHILD attempt={attempt} world={world} "
+          f"resumed={extra is not None} "
+          f"offset={(extra or {}).get('stream', {}).get('offset')}",
+          flush=True)
+
+    def train():
+        try:
+            opt.optimize()
+        finally:
+            tag = "baseline" if baseline else f"attempt{attempt}"
+            with open(os.path.join(smoke_dir, f"losses.{tag}.json"),
+                      "w", encoding="utf-8") as fh:
+                json.dump(losses, fh)
+            with open(os.path.join(smoke_dir, f"audit.{tag}.json"),
+                      "w", encoding="utf-8") as fh:
+                json.dump(ds.audit_log, fh)
+
+    sys.exit(elastic.run_main(train))
+
+
+def run_baseline(smoke_dir, env):
+    bdir = os.path.join(smoke_dir, "baseline")
+    os.makedirs(bdir, exist_ok=True)
+    benv = dict(env)
+    benv.update(BIGDL_SMOKE_DIR=bdir, BIGDL_SMOKE_BASELINE="1",
+                BIGDL_METRICS_DIR=bdir, BIGDL_TRACE_DIR=bdir)
+    benv.pop("BIGDL_OBS_PORT", None)
+    subprocess.run([sys.executable, os.path.abspath(__file__),
+                    "--child"], env=benv, check=True)
+    with open(os.path.join(bdir, "losses.baseline.json"),
+              encoding="utf-8") as fh:
+        return {int(k): v for k, v in json.load(fh).items()}
+
+
+def main():
+    import tempfile
+
+    from bigdl_tpu.config import AutoscaleConfig
+    from bigdl_tpu.resilience.autoscale import AutoscaleController
+    from bigdl_tpu.resilience.elastic import EXIT_PREEMPTED
+    from bigdl_tpu.resilience.supervisor import Supervisor
+
+    smoke_dir = tempfile.mkdtemp(prefix="bigdl_autoscale_smoke_")
+    obs_dir = os.path.join(smoke_dir, "obs")
+    os.environ["BIGDL_RETRY_BACKOFF_BASE"] = "0"
+    os.environ.update(
+        BIGDL_SMOKE_DIR=smoke_dir, BIGDL_METRICS_DIR=obs_dir,
+        BIGDL_TRACE_DIR=obs_dir, BIGDL_OBS_PORT="0", PYTHONPATH=REPO,
+        # the parent's own atexit obs flush imports jax (device memory
+        # stats) — pin CPU or this container's TPU plugin probes the
+        # GCP metadata service forever; children pin it themselves too
+        JAX_PLATFORMS="cpu")
+    # children own their XLA_FLAGS (world-sized device count)
+    os.environ.pop("XLA_FLAGS", None)
+
+    cfg = AutoscaleConfig(
+        enabled=True, min_world=1, max_world=2, factor=2,
+        interval_s=0.4, warmup_s=6.0, cooldown_s=4.0, hysteresis=2,
+        queue_high=64.0, queue_low=4.0)
+    controller = AutoscaleController(cfg=cfg, world=1)
+    rcs = []
+
+    class TapeSupervisor(Supervisor):
+        def _spawn(self, cmd, env):
+            rc = super()._spawn(cmd, env)
+            rcs.append(rc)
+            return rc
+
+    sup = TapeSupervisor(
+        [sys.executable, os.path.abspath(__file__), "--child"],
+        max_retries=2, autoscaler=controller, stop_grace_s=60.0)
+    t0 = time.monotonic()
+    rc = sup.run()
+    wall = time.monotonic() - t0
+    assert rc == 0, f"supervisor gave up with rc {rc} (children: {rcs})"
+    assert sup.resizes == 2, \
+        f"expected 2 resizes (1to2, 2to1), got {sup.resizes}: {rcs}"
+    resizes = [d.resize for d in controller.decisions]
+    assert resizes == ["1to2", "2to1"], resizes
+    reasons = [d.reason for d in controller.decisions]
+    assert reasons == ["queue_high", "queue_low"], reasons
+    assert rcs[:2] == [EXIT_PREEMPTED, EXIT_PREEMPTED] and rcs[-1] == 0, \
+        f"expected graceful resize stops then success, got {rcs}"
+    print(f"SMOKE supervisor: launches={sup.attempt} rcs={rcs} "
+          f"resizes={resizes} ({wall:.1f}s)")
+
+    # --- exactly-once audit: every record id trained exactly once ----
+    ranges = []
+    for a in range(sup.attempt):
+        with open(os.path.join(smoke_dir, f"audit.attempt{a}.json"),
+                  encoding="utf-8") as fh:
+            ranges.extend(tuple(r) for r in json.load(fh))
+    trained = [o for s, e in ranges for o in range(s, e)]
+    dup = len(trained) - len(set(trained))
+    missing = TOTAL_RECORDS - len(set(trained))
+    assert dup == 0, f"{dup} records trained twice across resizes"
+    assert missing == 0 and sorted(trained) == list(
+        range(TOTAL_RECORDS)), f"{missing} records dropped"
+    print(f"SMOKE exactly-once: {TOTAL_RECORDS} record ids trained "
+          f"exactly once across {sup.attempt} launches (0 dup, 0 drop)")
+
+    # --- trajectory equivalence vs an uninterrupted 1-host run -------
+    resumed = {}
+    for a in range(sup.attempt):
+        with open(os.path.join(smoke_dir, f"losses.attempt{a}.json"),
+                  encoding="utf-8") as fh:
+            for k, v in json.load(fh).items():
+                step = int(k)
+                assert step not in resumed, f"step {step} trained twice"
+                resumed[step] = v
+    assert sorted(resumed) == list(range(1, TOTAL_STEPS + 1)), \
+        f"step gaps: have {len(resumed)} of {TOTAL_STEPS}"
+    base = run_baseline(smoke_dir, dict(os.environ))
+    worst = 0.0
+    for step, val in sorted(resumed.items()):
+        rel = abs(val - base[step]) / max(1.0, abs(base[step]))
+        worst = max(worst, rel)
+        assert rel < 1e-3, \
+            f"loss diverged at step {step}: {val} vs {base[step]}"
+    print(f"SMOKE trajectory: {len(resumed)} steps across 3 launches "
+          f"match the uninterrupted baseline (worst rel {worst:.2e})")
+
+    # --- resize resumes counted in the children's metrics shards -----
+    proms = glob.glob(os.path.join(obs_dir, "metrics.*.prom"))
+    blob = "".join(open(p, encoding="utf-8").read() for p in proms)
+    for needle in ('bigdl_resumes_total{resize="1to2"} 1',
+                   'bigdl_resumes_total{resize="2to1"} 1'):
+        assert needle in blob, \
+            f"{needle!r} not in metrics shards:\n{blob[-2000:]}"
+    print("SMOKE metrics: both resize resumes counted")
+
+    # --- policy decisions counted in the parent's registry -----------
+    from bigdl_tpu import obs
+
+    counts = {}
+    for fam in obs.get_registry().families():
+        if fam.name == "bigdl_autoscale_decisions_total":
+            for key, c in fam.child_items():
+                counts[dict(zip(fam.labelnames, key))["reason"]] = c.value
+    assert counts == {"queue_high": 1.0, "queue_low": 1.0}, counts
+    print(f"SMOKE decisions: {counts}")
+
+    bank = {
+        "resizes": resizes,
+        "decisions": [dataclasses.asdict(d) for d in controller.decisions],
+        "child_rcs": rcs,
+        "launches": sup.attempt,
+        "steps": TOTAL_STEPS,
+        "records": TOTAL_RECORDS,
+        "duplicate_records": dup,
+        "dropped_records": missing,
+        "worst_rel_err": worst,
+        "wall_s": round(wall, 2),
+    }
+    with open(os.path.join(REPO, "AUTOSCALE_SMOKE.json"), "w",
+              encoding="utf-8") as fh:
+        json.dump(bank, fh, indent=2, sort_keys=True)
+    print("AUTOSCALE SMOKE PASS (banked AUTOSCALE_SMOKE.json)")
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        child()
+    else:
+        main()

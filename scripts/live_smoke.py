@@ -197,6 +197,10 @@ def main() -> int:
     fleet = FleetAggregator(peers=peers).snapshot()
     assert fleet["mode"] == "peers" and not fleet["errors"], fleet
     assert set(fleet["hosts"]) == {"0", "1"}, fleet["hosts"].keys()
+    for host, row in fleet["hosts"].items():
+        # the autoscaler's queue signal rides every host row (None on
+        # a non-streaming run like this one — the key must exist)
+        assert "queue_depth" in row and row["queue_depth"] is None, row
     print(f"[live-smoke] fleet snapshot merged hosts "
           f"{sorted(fleet['hosts'])} from {peers}")
 

@@ -58,6 +58,21 @@
 #                                                # feedback on; banks
 #                                                # WIRE_SMOKE.json for BENCH
 #                                                # extras.wire (no pytest)
+#   scripts/run-tests.sh --autoscale             # autoscaling + streaming
+#                                                # smoke: the REAL supervisor
+#                                                # + policy loop resize a
+#                                                # streaming training child
+#                                                # 1->2->1 from live queue
+#                                                # signals; asserts resumed
+#                                                # trajectory equivalence, an
+#                                                # exactly-once stream audit
+#                                                # (every record id trained
+#                                                # once across both resizes),
+#                                                # and the resize/decision
+#                                                # counters; banks
+#                                                # AUTOSCALE_SMOKE.json for
+#                                                # BENCH extras.autoscale
+#                                                # (no pytest)
 #   scripts/run-tests.sh --live                  # live-telemetry smoke: a
 #                                                # 2-host run with /metrics +
 #                                                # /healthz servers on
@@ -102,6 +117,9 @@ elif [[ "${1:-}" == "--tune" ]]; then
 elif [[ "${1:-}" == "--live" ]]; then
   shift
   exec python scripts/live_smoke.py "$@"
+elif [[ "${1:-}" == "--autoscale" ]]; then
+  shift
+  exec python scripts/autoscale_smoke.py "$@"
 elif [[ "${1:-}" == "--wire" ]]; then
   shift
   exec python scripts/wire_smoke.py "$@"

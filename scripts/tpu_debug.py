@@ -54,6 +54,19 @@ transient crashes under the retry budget — see MIGRATION.md "Elastic
 training" for the exit-code/heartbeat/resize knobs, and
 ``scripts/run-tests.sh --elastic`` for the end-to-end smoke.
 
+A run that is the WRONG SIZE for its load — step time over target,
+the streaming input buffer backing up, or chips idling on a drained
+queue — doesn't need an operator either: add ``--autoscale`` (or
+``BIGDL_AUTOSCALE=1``) and the supervisor's policy loop scrapes the
+live `/healthz`/`/metrics` signals and executes checkpoint-stop-
+restart resizes inside ``BIGDL_AUTOSCALE_MIN_WORLD..MAX_WORLD`` —
+with hysteresis + cooldown so flapping signals can't thrash, dry-run
+mode to watch it decide, and exactly-once streaming resume
+(`dataset/stream.py` offsets ride the checkpoint).  The report's
+"autoscaling & stream" section shows every decision; see MIGRATION.md
+"Autoscaling & streaming training" and ``scripts/run-tests.sh
+--autoscale`` for the end-to-end 1→2→1 smoke.
+
 A run you need to watch RIGHT NOW (not post-mortem) has the live
 telemetry plane: export ``BIGDL_OBS_PORT`` and curl the host's
 ``/healthz`` (status / last-step age / live goodput / firing alerts)

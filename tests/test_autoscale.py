@@ -1,0 +1,464 @@
+"""Autoscaling policy-loop specs (resilience/autoscale.py +
+supervisor integration) and the hardened alert sink.
+
+The resize-under-load edge cases the ISSUE names are here: a decision
+landing while the child is already writing its emergency checkpoint,
+cooldown suppressing an immediate reverse decision, dry-run never
+restarting, and (in test_stream.py) scale-down below the streaming
+buffer's prefetched frontier.
+"""
+
+import dataclasses
+import json
+import os
+import sys
+import time
+
+import pytest
+
+from bigdl_tpu import obs
+from bigdl_tpu.config import AutoscaleConfig
+from bigdl_tpu.resilience.autoscale import (
+    AutoscaleController,
+    Decision,
+    EndpointScraper,
+    derive_signals,
+    load_rules,
+)
+from bigdl_tpu.resilience.elastic import EXIT_PREEMPTED, EXIT_TRANSIENT
+from bigdl_tpu.resilience.supervisor import Supervisor
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs(monkeypatch):
+    for var in ("BIGDL_OBS", "BIGDL_TRACE_DIR", "BIGDL_METRICS_DIR",
+                "BIGDL_AUTOSCALE", "BIGDL_AUTOSCALE_WORLD",
+                "BIGDL_OBS_PORT", "BIGDL_OBS_PORT_FILE",
+                "BIGDL_RETRY_BACKOFF_BASE"):
+        monkeypatch.delenv(var, raising=False)
+    obs.reset()
+    yield
+    obs.reset()
+
+
+def _cfg(**kw):
+    base = dict(enabled=True, min_world=1, max_world=8, factor=2,
+                interval_s=0.0, warmup_s=0.0, cooldown_s=10.0,
+                hysteresis=2)
+    base.update(kw)
+    return AutoscaleConfig(**base)
+
+
+def _counter_value(name, **labels):
+    for fam in obs.get_registry().families():
+        if fam.name == name:
+            for key, child in fam.child_items():
+                if dict(zip(fam.labelnames, key)) == labels:
+                    return child.value
+    return None
+
+
+# ---------------------------------------------------------------- rules
+class TestRules:
+    def test_default_pack_from_band_knobs(self):
+        cfg = _cfg(queue_high=100, queue_low=5, step_time_high=0.5,
+                   step_time_low=0.05, goodput_floor=0.3,
+                   evict_stragglers=True)
+        names = [r["name"] for r in load_rules(None, cfg)]
+        assert names == ["straggler_evict", "queue_high", "queue_low",
+                         "step_time_high", "step_time_low",
+                         "cost_goodput_floor"]
+
+    def test_band_knobs_off_mean_empty_pack(self):
+        assert load_rules(None, _cfg()) == []
+
+    def test_inline_json_and_hysteresis_default(self):
+        cfg = _cfg(hysteresis=3)
+        rules = load_rules(
+            '[{"name":"q","signal":"queue_depth","op":">",'
+            '"value":7,"action":"up"}]', cfg)
+        assert rules[0]["for"] == 3 and rules[0]["value"] == 7.0
+
+    def test_file_pack(self, tmp_path):
+        p = tmp_path / "rules.json"
+        p.write_text(json.dumps([
+            {"name": "g", "signal": "goodput_ratio", "op": "<",
+             "value": 0.2, "action": "down", "for": 1}]))
+        assert load_rules(str(p), _cfg())[0]["name"] == "g"
+
+    @pytest.mark.parametrize("bad,msg", [
+        ('[{"signal":"queue_depth","op":">","value":1,"action":"up"}]',
+         "missing"),
+        ('[{"name":"x","signal":"queue_depth","op":"~","value":1,'
+         '"action":"up"}]', "unknown op"),
+        ('[{"name":"x","signal":"queue_depth","op":">","value":1,'
+         '"action":"sideways"}]', "action"),
+        ('[{"name":"x","signal":"nope","op":">","value":1,'
+         '"action":"up"}]', "unknown signal"),
+        ('[{"name":"x","signal":"queue_depth","op":">","action":"up"}]',
+         "needs a 'value'"),
+        ('[{"name":"x","signal":"alerts","op":"nonempty","action":"up"},'
+         '{"name":"x","signal":"alerts","op":"nonempty","action":"up"}]',
+         "duplicate"),
+        ('{"name":"x"}', "JSON list"),
+    ])
+    def test_validation_is_loud(self, bad, msg):
+        with pytest.raises(ValueError, match=msg):
+            load_rules(bad, _cfg())
+
+
+# -------------------------------------------------------------- signals
+def _peer(addr="h0:1", step=None, t=None, ratio=None, alerts=(),
+          status="ok", samples=()):
+    return {"addr": addr, "ok": True,
+            "health": {"host": 0, "step": step, "time": t,
+                       "goodput_ratio": ratio, "status": status,
+                       "alerts": [{"rule": a} for a in alerts]},
+            "metrics": {"samples": list(samples)}}
+
+
+class TestSignals:
+    def test_step_time_from_stamp_deltas(self):
+        prev = {}
+        s1 = derive_signals([_peer(step=10, t=100.0)], prev, 1)
+        assert "step_time_s" not in s1  # one observation is no rate
+        s2 = derive_signals([_peer(step=20, t=102.0)], prev, 1)
+        assert s2["step_time_s"] == pytest.approx(0.2)
+
+    def test_slowest_host_gates(self):
+        prev = {}
+        derive_signals([_peer("a", step=0, t=0.0),
+                        _peer("b", step=0, t=0.0)], prev, 2)
+        s = derive_signals([_peer("a", step=10, t=1.0),
+                            _peer("b", step=10, t=4.0)], prev, 2)
+        assert s["step_time_s"] == pytest.approx(0.4)
+
+    def test_queue_depth_max_over_gauges(self):
+        s = derive_signals([_peer(samples=[
+            {"name": "bigdl_stream_buffer_depth", "labels": {},
+             "value": 12.0},
+            {"name": "bigdl_stream_lag_records", "labels": {},
+             "value": 400.0}])], {}, 1)
+        assert s["queue_depth"] == 400.0
+
+    def test_goodput_alerts_stragglers(self):
+        s = derive_signals(
+            [_peer("a", ratio=0.9, alerts=("r1",)),
+             _peer("b", ratio=0.4, status="stalled")], {}, 2)
+        assert s["goodput_ratio"] == 0.4
+        assert s["alerts"] == ["r1"]
+        assert s["stragglers"] == [0]
+
+    def test_dead_peer_contributes_nothing(self):
+        s = derive_signals([{"addr": "x", "ok": False}], {}, 1)
+        assert "step_time_s" not in s and "queue_depth" not in s
+
+
+# ----------------------------------------------------------- controller
+class TestController:
+    def _ctl(self, cfg, world=1, t0=1000.0):
+        clock = {"t": t0}
+        ctl = AutoscaleController(cfg=cfg, world=world,
+                                  scrape=lambda: [],
+                                  clock=lambda: clock["t"])
+        return ctl, clock
+
+    def test_hysteresis_then_decision_and_counter(self):
+        ctl, _ = self._ctl(_cfg(queue_high=100))
+        assert ctl.evaluate({"queue_depth": 500.0}) is None  # streak 1
+        d = ctl.evaluate({"queue_depth": 500.0})
+        assert d.direction == "up" and (d.old_world, d.new_world) == (1, 2)
+        assert d.reason == "queue_high" and not d.dry_run
+        assert _counter_value("bigdl_autoscale_decisions_total",
+                              direction="up", reason="queue_high") == 1.0
+
+    def test_flapping_signal_resets_streak(self):
+        ctl, _ = self._ctl(_cfg(queue_high=100))
+        ctl.evaluate({"queue_depth": 500.0})
+        ctl.evaluate({"queue_depth": 1.0})  # breach streak resets
+        assert ctl.evaluate({"queue_depth": 500.0}) is None
+
+    def test_cooldown_suppresses_immediate_reverse_decision(self):
+        """The thrash case: scale-up followed at once by the opposite
+        rule breaching must NOT bounce the world back."""
+        cfg = _cfg(queue_high=100, queue_low=5, cooldown_s=50.0,
+                   hysteresis=1)
+        ctl, clock = self._ctl(cfg)
+        up = ctl.evaluate({"queue_depth": 500.0})
+        assert up is not None
+        ctl.commit(up)
+        assert ctl.world == 2
+        # queue drains instantly after the resize — reverse rule breaches
+        clock["t"] += 1.0
+        assert ctl.evaluate({"queue_depth": 0.0}) is None  # cooldown
+        clock["t"] += 100.0  # past the cooldown: now it may decide
+        down = ctl.evaluate({"queue_depth": 0.0})
+        assert down.direction == "down" and down.new_world == 1
+
+    def test_clamped_at_bound_is_no_decision(self):
+        ctl, _ = self._ctl(_cfg(queue_high=100, max_world=2,
+                                hysteresis=1), world=2)
+        assert ctl.evaluate({"queue_depth": 500.0}) is None
+        assert _counter_value("bigdl_autoscale_decisions_total",
+                              direction="up", reason="queue_high") is None
+
+    def test_min_world_clamps_down(self):
+        ctl, _ = self._ctl(_cfg(queue_low=5, hysteresis=1), world=1)
+        assert ctl.evaluate({"queue_depth": 0.0}) is None
+
+    def test_straggler_evict_rule(self):
+        ctl, _ = self._ctl(_cfg(evict_stragglers=True, hysteresis=1),
+                           world=4)
+        d = ctl.evaluate({"stragglers": [2]})
+        assert d.direction == "down" and d.reason == "straggler_evict"
+        assert d.new_world == 2
+
+    def test_dry_run_decision_flagged_and_counted(self):
+        ctl, _ = self._ctl(_cfg(queue_high=100, hysteresis=1,
+                                dry_run=True))
+        d = ctl.evaluate({"queue_depth": 500.0})
+        assert d is not None and d.dry_run
+        assert _counter_value("bigdl_autoscale_decisions_total",
+                              direction="up", reason="queue_high") == 1.0
+
+    def test_tick_gates_warmup_interval_and_scrape_failure(self):
+        cfg = _cfg(queue_high=100, warmup_s=10.0, interval_s=5.0,
+                   hysteresis=1)
+        clock = {"t": 0.0}
+        calls = []
+
+        def scrape():
+            calls.append(clock["t"])
+            return [_peer(samples=[{"name": "bigdl_stream_buffer_depth",
+                                    "labels": {}, "value": 500.0}])]
+
+        ctl = AutoscaleController(cfg=cfg, world=1, scrape=scrape,
+                                  clock=lambda: clock["t"])
+        assert ctl.tick() is None and not calls     # warmup
+        clock["t"] = 11.0
+        d = ctl.tick()
+        assert d is not None and calls == [11.0]
+        ctl.commit(d)
+        clock["t"] = 12.0
+        assert ctl.tick() is None and len(calls) == 1  # interval gate
+
+    def test_tick_conservative_on_empty_or_failing_scrape(self):
+        cfg = _cfg(queue_high=100, hysteresis=1)
+        clock = {"t": 100.0}
+
+        def boom():
+            raise OSError("scrape died")
+
+        ctl = AutoscaleController(cfg=cfg, world=1, scrape=boom,
+                                  clock=lambda: clock["t"])
+        assert ctl.tick() is None  # failure is data-free, not fatal
+
+    def test_on_launch_resets_memory(self):
+        ctl, clock = self._ctl(_cfg(queue_high=100, warmup_s=5.0))
+        ctl.evaluate({"queue_depth": 500.0})
+        assert ctl._streaks["queue_high"] == 1
+        clock["t"] += 100.0
+        ctl.on_launch()
+        assert ctl._streaks["queue_high"] == 0
+        assert ctl.tick() is None  # fresh warmup
+
+
+# ----------------------------------------------------- endpoint scraper
+class TestEndpointScraper:
+    def test_port_file_resolution_and_scrape_shape(self, tmp_path):
+        pf = tmp_path / "port"
+
+        def fetch(url):
+            if url.endswith("/healthz"):
+                return json.dumps({"host": 0, "step": 3, "time": 1.0,
+                                   "status": "ok"})
+            return ("# HELP bigdl_stream_buffer_depth d\n"
+                    "# TYPE bigdl_stream_buffer_depth gauge\n"
+                    "bigdl_stream_buffer_depth 7.0\n")
+
+        sc = EndpointScraper(port_file=str(pf), fetch=fetch)
+        assert sc() == []  # no port yet: no data, no decision
+        pf.write_text("12345")
+        out = sc()
+        assert out[0]["ok"] and out[0]["health"]["step"] == 3
+        assert out[0]["metrics"]["samples"][0]["value"] == 7.0
+
+
+# ------------------------------------------------- supervisor execution
+class _StubScaler:
+    """Controller stand-in for supervisor unit tests."""
+
+    def __init__(self, world=1, decisions=()):
+        self.cfg = _cfg(interval_s=0.1, warmup_s=0.0)
+        self.world = world
+        self._decisions = list(decisions)
+        self.launches = 0
+
+    def bind_endpoint(self, **kw):
+        pass
+
+    def on_launch(self):
+        self.launches += 1
+
+    def tick(self, now=None):
+        return self._decisions.pop(0) if self._decisions else None
+
+    def commit(self, decision):
+        self.world = decision.new_world
+
+
+def _decision(old=1, new=2, dry=False):
+    return Decision(direction="up" if new > old else "down",
+                    reason="queue_high", old_world=old, new_world=new,
+                    dry_run=dry)
+
+
+class TestSupervisorResize:
+    def test_resize_restart_free_of_retry_budget(self, monkeypatch):
+        """The fake runner plays the poll loop's part (it sets the
+        pending decision) and exits like a gracefully-preempted child;
+        run() must restart at the new world without burning retries."""
+        monkeypatch.setenv("BIGDL_RETRY_BACKOFF_BASE", "0")
+        scaler = _StubScaler()
+        worlds, rcs = [], [EXIT_PREEMPTED, 0]
+
+        def runner(cmd, env):
+            worlds.append(env["BIGDL_AUTOSCALE_WORLD"])
+            rc = rcs.pop(0)
+            if rc == EXIT_PREEMPTED:
+                sup._resize_decision = _decision()
+            return rc
+
+        sup = Supervisor(["cmd"], runner=runner, sleep=lambda s: None,
+                         autoscaler=scaler)
+        assert sup.run() == 0
+        assert worlds == ["1", "2"]
+        assert sup.resizes == 1 and scaler.world == 2
+        assert sup.policy.attempts == 0      # no retry budget consumed
+        assert sup.preemptions == 0          # and not counted preempted
+        assert _counter_value("bigdl_supervisor_restarts_total",
+                              kind="resize") == 1.0
+
+    def test_decision_during_inflight_emergency_checkpoint(self,
+                                                           monkeypatch):
+        """The child was ALREADY preempting (external SIGTERM, its
+        emergency checkpoint in flight) when the decision landed: one
+        resize restart, no double handling, any rc accepted."""
+        monkeypatch.setenv("BIGDL_RETRY_BACKOFF_BASE", "0")
+        scaler = _StubScaler()
+        rcs = [EXIT_TRANSIENT, 0]  # even a non-graceful rc is a resize
+
+        def runner(cmd, env):
+            rc = rcs.pop(0)
+            if rc != 0:
+                sup._resize_decision = _decision()
+            return rc
+
+        sup = Supervisor(["cmd"], runner=runner, sleep=lambda s: None,
+                         autoscaler=scaler)
+        assert sup.run() == 0
+        assert sup.resizes == 1 and sup.policy.attempts == 0
+
+    def test_resize_backoff_uses_retry_policy_shape(self, monkeypatch):
+        monkeypatch.setenv("BIGDL_RETRY_BACKOFF_BASE", "0.5")
+        scaler = _StubScaler()
+        sleeps = []
+        rcs = [EXIT_PREEMPTED, EXIT_PREEMPTED, 0]
+
+        def runner(cmd, env):
+            rc = rcs.pop(0)
+            if rc != 0:
+                sup._resize_decision = _decision(
+                    old=scaler.world, new=scaler.world * 2)
+            return rc
+
+        sup = Supervisor(["cmd"], runner=runner,
+                         sleep=lambda s: sleeps.append(s),
+                         autoscaler=scaler)
+        assert sup.run() == 0
+        assert len(sleeps) == 2
+        # deterministic-jitter exponential: second sleep ~2x the first
+        assert sleeps[1] > sleeps[0] >= 0.5
+
+    def test_dry_run_never_restarts_spawned_child(self):
+        """_spawn path with a real child: dry-run decisions must leave
+        the child alone — it runs to its own completion."""
+        scaler = _StubScaler(
+            decisions=[_decision(dry=True)] * 50)
+        sup = Supervisor([sys.executable, "-c",
+                          "import time; time.sleep(1.0)"],
+                         autoscaler=scaler, sleep=lambda s: None)
+        assert sup.run() == 0
+        assert sup.resizes == 0 and scaler.world == 1
+
+    def test_spawn_executes_decision_by_graceful_stop(self):
+        """_spawn path end to end: the poll loop ticks, stops the child
+        (SIGTERM), and run() relaunches at the new world — the child
+        observes BIGDL_AUTOSCALE_WORLD=2 and completes."""
+        scaler = _StubScaler(decisions=[_decision()])
+        child = ("import os, sys, time\n"
+                 "sys.exit(0) if os.environ.get('BIGDL_AUTOSCALE_WORLD')"
+                 " == '2' else time.sleep(60)\n")
+        sup = Supervisor([sys.executable, "-c", child],
+                         autoscaler=scaler, sleep=lambda s: None,
+                         stop_grace_s=5.0)
+        t0 = time.monotonic()
+        assert sup.run() == 0
+        assert time.monotonic() - t0 < 30.0
+        assert sup.resizes == 1 and scaler.world == 2
+        assert scaler.launches == 2
+
+
+# ------------------------------------------------- hardened alert sink
+class TestAlertSinkHardening:
+    def test_webhook_retries_once_then_counts_failure(self, monkeypatch):
+        from bigdl_tpu.obs import alerts
+
+        attempts = []
+
+        def boom(req, timeout=None):
+            attempts.append(timeout)
+            raise OSError("connection refused")
+
+        import urllib.request
+
+        monkeypatch.setattr(urllib.request, "urlopen", boom)
+        alerts._sink_write("http://127.0.0.1:1/alerts", {"a": 1},
+                           timeout=0.25)
+        assert attempts == [0.25, 0.25]  # bounded timeout, one retry
+        assert _counter_value("bigdl_alert_sink_failures_total") == 1.0
+
+    def test_webhook_success_after_retry_not_counted(self, monkeypatch):
+        from bigdl_tpu.obs import alerts
+
+        calls = []
+
+        class _Resp:
+            def close(self):
+                pass
+
+        def flaky(req, timeout=None):
+            calls.append(1)
+            if len(calls) == 1:
+                raise OSError("blip")
+            return _Resp()
+
+        import urllib.request
+
+        monkeypatch.setattr(urllib.request, "urlopen", flaky)
+        alerts._sink_write("http://127.0.0.1:1/alerts", {"a": 1},
+                           timeout=0.25)
+        assert len(calls) == 2
+        assert _counter_value("bigdl_alert_sink_failures_total") is None
+
+    def test_file_sink_failure_counted(self, tmp_path):
+        from bigdl_tpu.obs import alerts
+
+        alerts._sink_write(str(tmp_path), {"a": 1})  # a dir: open fails
+        assert _counter_value("bigdl_alert_sink_failures_total") == 1.0
+
+    def test_timeout_default_from_config(self, monkeypatch):
+        monkeypatch.setenv("BIGDL_ALERT_SINK_TIMEOUT", "0.125")
+        from bigdl_tpu.config import refresh_from_env
+
+        assert refresh_from_env().obs.alert_sink_timeout == 0.125
