@@ -611,6 +611,23 @@ def _autoscale_extras():
         return None
 
 
+def _overlap_extras():
+    """Overlapped-step evidence for the BENCH JSON: the newest
+    ``OVERLAP_SMOKE.json`` banked by scripts/overlap_smoke.py (the
+    on-vs-off A/B — trajectory error, byte parity, comm/input badput
+    fractions, checkpoint badput, goodput ratios).  None when the
+    smoke has never been run."""
+    try:
+        smoke = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "OVERLAP_SMOKE.json")
+        if not os.path.exists(smoke):
+            return None
+        with open(smoke, "r", encoding="utf-8") as fh:
+            return {"smoke": json.load(fh)}
+    except Exception:
+        return None
+
+
 def _tuner_extras():
     """Auto-tuner evidence for the BENCH JSON (ops/autotune.py): the
     cache stats and every decision with its static baseline, measured
@@ -966,6 +983,9 @@ def _run_child(platform: str):
     autoscale = _autoscale_extras()
     if autoscale is not None:
         ex["autoscale"] = autoscale
+    overlap = _overlap_extras()
+    if overlap is not None:
+        ex["overlap"] = overlap
     print(PARTIAL_MARK + json.dumps(result), flush=True)
 
 

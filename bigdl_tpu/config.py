@@ -400,6 +400,28 @@ class BigDLConfig:
     # [BIGDL_STREAM_EPOCH_RECORDS]
     stream_epoch_records: int = 0
 
+    # --- overlapped training step (ISSUE 11) ----------------------------
+    # bucketed comm/compute overlap: DistriOptimizer partitions the
+    # flat gradient into ~this many MiB per bucket and launches the
+    # compressed reduce-scatter per bucket (last-layer-first) so the
+    # wire rides under the remaining backward; <= 0 = one monolithic
+    # exchange (the pre-overlap behavior) [BIGDL_OVERLAP_BUCKET_MB]
+    overlap_bucket_mb: float = 0.0
+    # fully async checkpointing: trigger-driven checkpoints snapshot to
+    # host synchronously (the only blocking span), then serialize +
+    # fsync + manifest on a background writer thread.  Emergency /
+    # preemption checkpoints ALWAYS stay synchronous — the process is
+    # about to exit, there is no step to overlap
+    # [BIGDL_CHECKPOINT_ASYNC]
+    checkpoint_async: bool = False
+    # double-buffered host->device input: batch N+1 is fetched,
+    # prepared and device_put while step N is still in flight, so the
+    # input pipeline overlaps device compute instead of stalling the
+    # loop (disabled automatically under an active fault-injection
+    # plan — chaos poisoning targets the foreground path)
+    # [BIGDL_INPUT_DOUBLE_BUFFER]
+    input_double_buffer: bool = False
+
     # --- autoscaling supervisor (resilience/autoscale.py) ---------------
     # [BIGDL_AUTOSCALE / _MIN_WORLD / _MAX_WORLD / _FACTOR / _INTERVAL /
     #  _WARMUP / _COOLDOWN / _HYSTERESIS / _STEP_TIME_HIGH / _STEP_TIME_LOW
@@ -452,6 +474,10 @@ class BigDLConfig:
             hang_timeout=_env_float("BIGDL_HANG_TIMEOUT", 0.0),
             stream_buffer=_env_int("BIGDL_STREAM_BUFFER", 1024),
             stream_epoch_records=_env_int("BIGDL_STREAM_EPOCH_RECORDS", 0),
+            overlap_bucket_mb=_env_float("BIGDL_OVERLAP_BUCKET_MB", 0.0),
+            checkpoint_async=_env_bool("BIGDL_CHECKPOINT_ASYNC", False),
+            input_double_buffer=_env_bool("BIGDL_INPUT_DOUBLE_BUFFER",
+                                          False),
             autoscale=AutoscaleConfig.from_env(),
             obs=ObsConfig.from_env(),
             tuner=TunerConfig.from_env(),

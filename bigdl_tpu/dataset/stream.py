@@ -214,6 +214,12 @@ class BoundedBuffer:
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._cond:
             while not self._q:
+                # the consumer outran the producer: decay the depth
+                # gauge NOW, not at the producer's next put — the
+                # autoscaler's queue signal must fall promptly when the
+                # double-buffered consumer drains faster than the
+                # producer refills (ISSUE 11 satellite)
+                self._depth_gauge.set(0.0)
                 remain = None if deadline is None \
                     else deadline - time.monotonic()
                 if remain is not None and remain <= 0:
@@ -225,11 +231,20 @@ class BoundedBuffer:
             rec = self._q.popleft()
             if rec is _END:
                 self._q.append(_END)  # idempotent end for late callers
+                # the sentinel is not a record: a drained stream's
+                # queue signal is zero, not the last put's depth
+                self._depth_gauge.set(0.0)
                 if self._error is not None:
                     raise RuntimeError(
                         "stream source failed") from self._error
                 return None
-            self._depth_gauge.set(float(len(self._q)))
+            # stamp on takes as well as puts, so the signal tracks the
+            # consumer side of the queue too (the end sentinel is not a
+            # record — don't let it hold the gauge at 1)
+            depth = len(self._q)
+            if depth and self._q[-1] is _END:
+                depth -= 1
+            self._depth_gauge.set(float(depth))
             self._cond.notify_all()
             return rec
 

@@ -98,6 +98,14 @@ def default_rules(heartbeat_timeout: float = 60.0) -> List[dict]:
          "metric": "bigdl_heartbeat_age_seconds", "op": ">",
          "value": max(1.0, float(heartbeat_timeout)) * 0.5,
          "for": 1, "severity": "warning"},
+        # overlapped step (ISSUE 11): the bucketed exchange should hide
+        # most of the wire under backward — a sustained exposed-comm
+        # share past half the budget means the buckets are too coarse
+        # (or comm outruns backward entirely); inert on runs without
+        # the overlap gauges (threshold rules never fire on absence)
+        {"name": "exposed_comm_high", "type": "threshold",
+         "metric": "bigdl_overlap_exposed_comm_fraction", "op": ">",
+         "value": 0.5, "for": 2, "severity": "warning"},
     ]
 
 

@@ -135,6 +135,9 @@ class NullLedger:
     def set_comm_bytes_per_step(self, nbytes):
         pass
 
+    def set_exposed_comm_bytes_per_step(self, nbytes):
+        pass
+
     def set_high_water(self, step):
         pass
 
@@ -190,6 +193,10 @@ class GoodputLedger:
         self._max_step_seen = 0
         self._saw_step = False
         self.comm_bytes_per_step = 0.0
+        # bucketed overlap (ISSUE 11): the share of the static comm
+        # budget NOT hidden under backward; None = no overlap model,
+        # the classifier then charges the full budget
+        self.exposed_comm_bytes_per_step = None
         # running productive/badput seconds — the O(1) live goodput
         # ratio the /healthz endpoint and the alert engine read between
         # full classifications (rework excluded: replay is badput)
@@ -283,6 +290,16 @@ class GoodputLedger:
         ``bytes / (BIGDL_WIRE_GBPS * 1e9)``."""
         self.comm_bytes_per_step = float(nbytes)
 
+    def set_exposed_comm_bytes_per_step(self, nbytes):
+        """Bucketed-overlap model (ISSUE 11): with K exchange buckets,
+        the first K-1 launches ride under the remaining backward — only
+        this many bytes are EXPOSED wall time.  The window classifier's
+        comm-seconds estimate then uses the exposed bytes, so hiding
+        the wire actually moves the ``comm_bound`` verdict.  ``None``
+        disables the model (monolithic exchange: everything exposed)."""
+        self.exposed_comm_bytes_per_step = (
+            None if nbytes is None else float(nbytes))
+
     def set_high_water(self, step: int):
         """Steps at or below this mark recorded from now on are
         ``rework`` (re-execution after a restart)."""
@@ -349,9 +366,14 @@ class GoodputLedger:
         self._win_steps = 0
         self._win_first_step = None
         comm_s = 0.0
-        if config.obs.wire_gbps > 0 and self.comm_bytes_per_step:
-            comm_s = n * self.comm_bytes_per_step / (
-                config.obs.wire_gbps * 1e9)
+        # the overlap model narrows the comm estimate to the EXPOSED
+        # bytes (what backward cannot hide); monolithic runs charge the
+        # full static budget as before
+        comm_bytes = (self.comm_bytes_per_step
+                      if self.exposed_comm_bytes_per_step is None
+                      else self.exposed_comm_bytes_per_step)
+        if config.obs.wire_gbps > 0 and comm_bytes:
+            comm_s = n * comm_bytes / (config.obs.wire_gbps * 1e9)
         verdict = classify_bottleneck(step_s, wait_s, comm_s, host_s)
         from bigdl_tpu import obs
 

@@ -99,7 +99,7 @@ def tree_layer_stats(grad_tree, params_tree, new_params_tree):
 
 
 def flat_shard_stats(gshard, wshard, new_wshard, shard_offset, boundaries,
-                     axis):
+                     axis, positions=None):
     """DistriOptimizer (ZeRO) path: each device holds a contiguous shard
     of the flat vector starting at ``shard_offset`` (traced).  Layers
     occupy contiguous flat ranges (``ravel_pytree`` concatenates in
@@ -108,13 +108,19 @@ def flat_shard_stats(gshard, wshard, new_wshard, shard_offset, boundaries,
     layer end offsets.  Per-layer partial sums via ``segment_sum``, then
     ONE ``(L, 4)`` psum over the data axis makes every host's stats
     **global** — pad positions past the true size land in an extra
-    dropped segment."""
+    dropped segment.
+
+    ``positions`` (optional, traced int32, same length as the shard)
+    overrides the contiguous-shard assumption: the bucketed overlap
+    exchange leaves each device owning one chunk of every bucket, so
+    the caller hands the per-position flat coordinates over directly."""
     import jax
     import jax.numpy as jnp
 
     n_layers = int(boundaries.shape[0])
     shard_len = gshard.shape[0]
-    idx = jax.lax.iota(jnp.int32, shard_len) + shard_offset
+    idx = positions if positions is not None else \
+        jax.lax.iota(jnp.int32, shard_len) + shard_offset
     seg = jnp.searchsorted(boundaries, idx, side="right")
 
     def seg_sum(v):

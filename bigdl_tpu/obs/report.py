@@ -102,6 +102,8 @@ def build_report(trace_dir: str, metrics_dir: Optional[str] = None) -> dict:
     hosts: dict = {}
     resilience: dict = {}
     slow_steps: list = []
+    ckpt_async_writes = 0
+    ckpt_snapshots = 0
     compile_events: list = []
     nonfinite_events: list = []
     anomaly_events: list = []
@@ -122,6 +124,10 @@ def build_report(trace_dir: str, metrics_dir: Optional[str] = None) -> dict:
                                    (rec.get("attrs") or {}).get("step")))
                 if name == "computing":
                     h["step_times"].append(dur)
+                if name == "checkpoint.write_async":
+                    ckpt_async_writes += 1
+                elif name == "checkpoint.snapshot":
+                    ckpt_snapshots += 1
                 if name.endswith(".compile"):
                     compile_events.append(
                         {"host": sh.host, "name": name,
@@ -326,6 +332,23 @@ def build_report(trace_dir: str, metrics_dir: Optional[str] = None) -> dict:
         },
     }
 
+    # ---- overlapped step (ISSUE 11: bucketed exchange, async
+    # checkpointing, double-buffered input) ----------------------------
+    buckets = _metric_max("bigdl_overlap_buckets")
+    overlap = {
+        "buckets": buckets,
+        "exposed_comm_fraction": _metric_max(
+            "bigdl_overlap_exposed_comm_fraction"),
+        "exposed_comm_seconds_per_step": _metric_max(
+            "bigdl_overlap_exposed_comm_seconds"),
+        "checkpoint_snapshot_seconds": _metric_max(
+            "bigdl_checkpoint_snapshot_seconds"),
+        "checkpoint_write_seconds": _metric_max(
+            "bigdl_checkpoint_write_seconds"),
+        "async_checkpoint_writes": ckpt_async_writes,
+        "checkpoint_snapshots": ckpt_snapshots,
+    }
+
     # per-device HBM peaks (bigdl_hbm_peak_bytes, max across snapshots)
     hbm: dict = {}
     for labels, s, _host in _metric_samples(snaps, "bigdl_hbm_peak_bytes"):
@@ -362,6 +385,7 @@ def build_report(trace_dir: str, metrics_dir: Optional[str] = None) -> dict:
         "slow_steps": slow_steps,
         "alerts": alerts,
         "autoscale": autoscale,
+        "overlap": overlap,
         "health": health,
         "goodput": gp,
         "stragglers": stragglers,
@@ -495,6 +519,42 @@ def render_text(rep: dict) -> str:
                     f"  host{ev.get('host')} backoff {ev.get('kind')} "
                     f"{float(ev.get('delay_s') or 0):.2f}s (rc "
                     f"{ev.get('rc')})")
+    lines.append("")
+    lines.append("-- overlap --")
+    ov = rep.get("overlap") or {}
+    has_overlap = (ov.get("buckets") or 0) > 1 \
+        or ov.get("async_checkpoint_writes") \
+        or ov.get("checkpoint_snapshot_seconds") is not None
+    if not has_overlap:
+        lines.append("  (no overlap activity — set BIGDL_OVERLAP_BUCKET_MB"
+                     " / BIGDL_CHECKPOINT_ASYNC / "
+                     "BIGDL_INPUT_DOUBLE_BUFFER)")
+    else:
+        b = ov.get("buckets")
+        if b and b > 1:
+            frac = ov.get("exposed_comm_fraction")
+            secs = ov.get("exposed_comm_seconds_per_step")
+            lines.append(
+                f"  gradient exchange: {int(b)} buckets, exposed comm "
+                + (f"{frac * 100:.0f}% of the wire"
+                   if frac is not None else "n/a")
+                + (f" (~{secs * 1000:.2f}ms/step)"
+                   if secs is not None else ""))
+        elif b:
+            lines.append("  gradient exchange: monolithic (1 bucket — "
+                         "everything exposed)")
+        snap = ov.get("checkpoint_snapshot_seconds")
+        wr = ov.get("checkpoint_write_seconds")
+        if snap is not None or wr is not None:
+            lines.append(
+                "  checkpoint: snapshot "
+                + (f"{snap * 1000:.1f}ms (blocking)"
+                   if snap is not None else "n/a")
+                + ", write "
+                + (f"{wr * 1000:.1f}ms" if wr is not None else "n/a")
+                + (f" — {int(ov['async_checkpoint_writes'])} async "
+                   "write(s) off the critical path"
+                   if ov.get("async_checkpoint_writes") else ""))
     lines.append("")
     lines.append("-- goodput --")
     gp = rep.get("goodput")

@@ -89,7 +89,7 @@ class DistriOptimizer(LocalOptimizer):
 
     def __init__(self, model, dataset, criterion, batch_size=32, mesh=None,
                  wire_dtype=None, data_axes=None, int8_block=None,
-                 wire_block=None, wire_ef=None):
+                 wire_block=None, wire_ef=None, overlap_bucket_mb=None):
         super().__init__(model, dataset, criterion, batch_size)
         from bigdl_tpu.engine import Engine
         from bigdl_tpu.parallel import wire as W
@@ -158,6 +158,16 @@ class DistriOptimizer(LocalOptimizer):
                 f"the {wire_dtype!r} staged-ring wire over hierarchical "
                 "data axes is not supported; use a single data axis or "
                 "bfloat16")
+        # bucketed comm/compute overlap (ISSUE 11): the gradient
+        # exchange is split into ~bucket_mb MiB buckets launched
+        # last-layer-first, so each bucket's reduce-scatter rides under
+        # the remaining backward; <= 0 keeps the monolithic exchange.
+        # The plan is derived lazily against the padded layout in
+        # _init_opt_state (it needs the alignment quantum).
+        if overlap_bucket_mb is None:
+            overlap_bucket_mb = config.overlap_bucket_mb
+        self.overlap_bucket_mb = float(overlap_bucket_mb)
+        self._buckets = None
         self._pad = 0
         self._warned_batch_sizes = set()
         self._host_mask = None
@@ -188,7 +198,7 @@ class DistriOptimizer(LocalOptimizer):
         world size and padding it was written under, so restore at a
         different world knows exactly what to strip and re-pad
         (resilience/elastic.py ensure_shard_layout)."""
-        return {"world_size": self.n_shards,
+        topo = {"world_size": self.n_shards,
                 "shard_layout": "zero1_flat",
                 "step": self.state["neval"],
                 "flat_elems": getattr(self, "_flat_elems", None),
@@ -200,6 +210,14 @@ class DistriOptimizer(LocalOptimizer):
                          "block": self.int8_block,
                          "ef": bool(self.wire is not None
                                     and self.wire.error_feedback)}}
+        # overlapped runs leave the ZeRO-1 state vectors in the
+        # bucketed shard-major layout — the manifest must carry the
+        # plan so a resume at a different plan/world can re-permute
+        # (resilience/elastic.ensure_shard_layout); single-bucket runs
+        # omit the key (parameter-major, the historical layout)
+        if self._buckets is not None and len(self._buckets) > 1:
+            topo["buckets"] = [[s, z] for s, z in self._buckets]
+        return topo
 
     def _write_back(self, pvar, mod_state):
         # unravel allocates fresh arrays; mod_state is copied so the model
@@ -227,6 +245,19 @@ class DistriOptimizer(LocalOptimizer):
             if (self.wire is not None and self.wire.scaled) else n
         self._pad = (-flat.size) % quantum
         shard_len = (flat.size + self._pad) // n
+        # bucketed overlap plan (parallel/wire.py): contiguous quantum-
+        # aligned slices of the padded flat layout, each ~bucket_mb MiB
+        # of gradient; the step launches one exchange per bucket,
+        # last-layer-first.  Summed wire bytes equal the monolithic
+        # exchange exactly (every bucket is whole quanta).
+        from bigdl_tpu.parallel import wire as _W
+
+        itemsize = max(1, np.dtype(self._flat_dtype).itemsize) \
+            if getattr(self, "_flat_dtype", None) else 4
+        target = int(self.overlap_bucket_mb * (1 << 20) / itemsize) \
+            if self.overlap_bucket_mb > 0 else 0
+        self._buckets = _W.plan_buckets(flat.size + self._pad, quantum,
+                                        target)
         opt = self.optim_method
         if opt.state is not None:
             # guard against an OptimMethod whose state was built by
@@ -249,7 +280,8 @@ class DistriOptimizer(LocalOptimizer):
             opt.state = elastic.ensure_shard_layout(
                 opt.state, flat_elems=int(flat.size), pad=self._pad,
                 n_shards=n, mesh=self.mesh, axis=self.axis,
-                topology=getattr(opt, "loaded_topology", None))
+                topology=getattr(opt, "loaded_topology", None),
+                buckets=self._buckets)
         if opt.state is None:
             # build state against a single shard-sized template, then
             # expand vector entries across the mesh
@@ -286,6 +318,13 @@ class DistriOptimizer(LocalOptimizer):
             # resumed without EF: drop a checkpointed residual instead
             # of threading dead state through the step
             opt.state.pop("wire_ef", None)
+        # stamp the method with the layout its state is NOW in: a later
+        # re-init (second optimize(), a bucket-plan or world change)
+        # then re-partitions from accurate provenance instead of a
+        # stale checkpoint tag — with the bucketed shard-major layout,
+        # "what order are these vectors in" is no longer answerable
+        # from their length alone
+        opt.loaded_topology = self._topology()
         return opt.state
 
     def _collective_byte_footprint(self):
@@ -343,6 +382,36 @@ class DistriOptimizer(LocalOptimizer):
         # the goodput window classifier estimates comm seconds from the
         # same static budget (obs/goodput.py, BIGDL_WIRE_GBPS)
         self._obs_ledger.set_comm_bytes_per_step(fp.total())
+        # overlap accounting (ISSUE 11): with K buckets, the first K-1
+        # exchanges (in launch order) ride under the remaining backward
+        # — only the final bucket's exchange (plus the gathers/psums the
+        # update chain serializes on) is EXPOSED wall time.  The ledger
+        # classifies comm_bound from the exposed bytes; the gauges make
+        # the overlap itself observable (obs/report.py "overlap" block,
+        # the exposed_comm_high alert rule).
+        n_buckets = len(self._buckets) if self._buckets else 1
+        registry = obs.get_registry()
+        registry.gauge(
+            "bigdl_overlap_buckets",
+            "Gradient-exchange buckets of the overlapped step "
+            "(1 = monolithic, no overlap)").set(float(n_buckets))
+        if n_buckets > 1:
+            hidden = exchange * (n_buckets - 1) / n_buckets
+            exposed = fp.total() - hidden
+            self._obs_ledger.set_exposed_comm_bytes_per_step(exposed)
+            registry.gauge(
+                "bigdl_overlap_exposed_comm_fraction",
+                "Share of the per-step collective bytes NOT hidden "
+                "under backward by the bucketed exchange").set(
+                round(exposed / fp.total(), 6) if fp.total() else 0.0)
+            if config.obs.wire_gbps > 0:
+                registry.gauge(
+                    "bigdl_overlap_exposed_comm_seconds",
+                    "Estimated per-step collective seconds not hidden "
+                    "by backward (exposed bytes / BIGDL_WIRE_GBPS)").set(
+                    exposed / (config.obs.wire_gbps * 1e9))
+        else:
+            self._obs_ledger.set_exposed_comm_bytes_per_step(None)
         # the EQuARX argument as a gauge: f32 exchange bytes over what
         # the configured wire actually ships, on the gradient path
         f32_exchange = C.reduce_scatter_bytes(padded, "float32", n)
@@ -406,6 +475,11 @@ class DistriOptimizer(LocalOptimizer):
         staged_ring = self._staged_ring
         ef_on = wire_spec is not None and wire_spec.error_feedback
         global_batch = self.batch_size
+        # overlap plan (ISSUE 11): contiguous quantum-aligned buckets of
+        # the padded flat layout; one exchange per bucket, emitted
+        # last-layer-first so each bucket's wire launches under the
+        # remaining backward.  One bucket = the monolithic exchange.
+        buckets = [(int(s), int(z)) for s, z in self._buckets]
         # per-layer health telemetry on the ZeRO shard (obs/health.py):
         # layer boundaries in the ravelled layout — each device
         # segment-sums its shard's contribution and ONE (L, 4) psum
@@ -480,24 +554,52 @@ class DistriOptimizer(LocalOptimizer):
                     grad = grad * _keep_mask(0, grad.shape[0], grad.dtype)
             with jax.named_scope("put_gradient"):
                 # ---- putGradients + aggregateGradientPartition ----------
+                # one exchange per overlap bucket, emitted last-layer-
+                # first: the ravel layout is first-layer-first and the
+                # backward resolves the LAST layers' gradients first, so
+                # the highest-offset bucket's wire can start while the
+                # rest of the backward is still running.  This device
+                # ends up owning its slice of EVERY bucket (the shard-
+                # major layout _topology records); one bucket reproduces
+                # the monolithic exchange exactly.
                 g = jnp.pad(grad, (0, pad))
                 new_ef = None
+                pieces = [None] * len(buckets)
                 if staged_ring:
                     from bigdl_tpu.parallel import wire as W
 
                     # in-reduce quantization (parallel/wire.py): the
                     # partial sums ride the ring re-quantized per hop,
                     # accumulated in f32; with EF on, this device's
-                    # residual rows ride along and come back updated
+                    # residual rows (flat-parameter coords) ride along
+                    # per bucket and come back updated
                     ef = opt_st.get("wire_ef")
-                    efl = None if ef is None else ef.reshape(n, -1)
-                    gshard, new_ef = W.reduce_scatter(
-                        g, axis, n, wire_spec, ef=efl)
+                    ef_flat = None if ef is None else ef.reshape(-1)
+                    ef_pieces = [None] * len(buckets)
+                    for b in reversed(range(len(buckets))):
+                        s, z = buckets[b]
+                        ef_b = None if ef_flat is None else \
+                            jax.lax.slice_in_dim(
+                                ef_flat, s, s + z).reshape(n, z // n)
+                        pieces[b], ef_pieces[b] = W.reduce_scatter(
+                            jax.lax.slice_in_dim(g, s, s + z), axis, n,
+                            wire_spec, ef=ef_b)
+                    if ef_flat is not None:
+                        # per-bucket rows flatten back to flat-parameter
+                        # coords; ascending concat rebuilds the full row
+                        new_ef = ef_pieces[0] if len(ef_pieces) == 1 \
+                            else jnp.concatenate(
+                                [e.reshape(-1) for e in ef_pieces])
                 else:
                     if wire is not None and wire != g.dtype:
                         g = g.astype(wire)
-                    gshard = jax.lax.psum_scatter(
-                        g, axis, scatter_dimension=0, tiled=True)
+                    for b in reversed(range(len(buckets))):
+                        s, z = buckets[b]
+                        pieces[b] = jax.lax.psum_scatter(
+                            jax.lax.slice_in_dim(g, s, s + z), axis,
+                            scatter_dimension=0, tiled=True)
+                gshard = pieces[0] if len(pieces) == 1 \
+                    else jnp.concatenate(pieces)
             with jax.named_scope("aggregate_gradient"):
                 gshard = gshard.astype(flat_p.dtype)
                 # reference: gradient /= numSamples — the global batch,
@@ -538,10 +640,17 @@ class DistriOptimizer(LocalOptimizer):
                 else:
                     idx = jax.lax.axis_index(axis)
                 shard_len = (flat_p.size + pad) // n
-                wshard = jax.lax.dynamic_slice(
-                    jnp.pad(flat_p, (0, pad)), (idx * shard_len,),
-                    (shard_len,)
-                )
+                padded_p = jnp.pad(flat_p, (0, pad))
+                if len(buckets) == 1:
+                    wshard = jax.lax.dynamic_slice(
+                        padded_p, (idx * shard_len,), (shard_len,))
+                else:
+                    # bucketed ownership: this device's chunk of every
+                    # bucket, ascending — element-aligned with gshard
+                    wshard = jnp.concatenate([
+                        jax.lax.dynamic_slice(
+                            padded_p, (s + idx * (z // n),), (z // n,))
+                        for s, z in buckets])
                 # the EF residual is wire state, not optimizer state —
                 # the method never sees it; it re-enters the state dict
                 # updated by the staged ring above
@@ -569,8 +678,14 @@ class DistriOptimizer(LocalOptimizer):
                     # Padding positions (flat idx >= true size) fall in
                     # no frozen interval, so the tail mask is 1 — the
                     # padded lanes are discarded by the final slice.
-                    mshard = _keep_mask(idx * shard_len, shard_len,
-                                        wshard.dtype)
+                    if len(buckets) == 1:
+                        mshard = _keep_mask(idx * shard_len, shard_len,
+                                            wshard.dtype)
+                    else:
+                        mshard = jnp.concatenate([
+                            _keep_mask(s + idx * (z // n), z // n,
+                                       wshard.dtype)
+                            for s, z in buckets])
                     new_wshard = wshard + mshard * (new_wshard - wshard)
                 if health_on:
                     from bigdl_tpu.obs import health as H
@@ -578,13 +693,36 @@ class DistriOptimizer(LocalOptimizer):
                     # (L, 4) global per-layer stats: new_wshard is
                     # post-guard/post-freeze, so a skipped step reports
                     # a zero update; nonfinite counts come from the
-                    # summed pre-clip gradient
+                    # summed pre-clip gradient.  Bucketed shards are not
+                    # contiguous in flat coords — hand the per-position
+                    # coordinates over explicitly.
+                    positions = None
+                    if len(buckets) > 1:
+                        positions = jnp.concatenate([
+                            jax.lax.iota(jnp.int32, z // n)
+                            + (s + idx * (z // n))
+                            for s, z in buckets])
                     health_stats = H.flat_shard_stats(
                         g_for_health, wshard, new_wshard,
-                        idx * shard_len, boundaries, axis)
+                        idx * shard_len, boundaries, axis,
+                        positions=positions)
             with jax.named_scope("send_weights"):
                 # ---- sendWeightPartition + getWeights -------------------
-                new_flat = jax.lax.all_gather(new_wshard, axis, tiled=True)
+                if len(buckets) == 1:
+                    new_flat = jax.lax.all_gather(new_wshard, axis,
+                                                  tiled=True)
+                else:
+                    # per-bucket gather mirrors the per-bucket scatter;
+                    # ascending concat restores flat-parameter order
+                    off, parts = 0, []
+                    for s, z in buckets:
+                        c = z // n
+                        parts.append(jax.lax.all_gather(
+                            jax.lax.slice_in_dim(new_wshard, off,
+                                                 off + c),
+                            axis, tiled=True))
+                        off += c
+                    new_flat = jnp.concatenate(parts)
                 new_flat = new_flat[: flat_p.size]
             if guard:
                 # a poisoned forward also poisons BN running stats —

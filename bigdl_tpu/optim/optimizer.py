@@ -151,24 +151,32 @@ class BaseOptimizer:
         self.validation_methods = methods
         return self
 
-    def set_checkpoint(self, path, trigger=None, background=False,
+    def set_checkpoint(self, path, trigger=None, background=None,
                        keep_last=None):
-        """``background=True`` writes checkpoints on a host thread: the
-        synchronous part only captures device-array refs (immutable
-        snapshot), so training resumes immediately while the
-        device->host transfer and file IO happen off-thread.  At most
-        one write is in flight; the next trigger waits for it.
+        """``background=True`` writes checkpoints fully async: the
+        blocking part snapshots every array to host (the only span on
+        the training critical path, stamped as the only
+        ``checkpoint_save`` badput), then serialize/fsync/manifest run
+        on a background writer thread.  At most one write is in flight;
+        the next trigger waits for it.  Default from
+        ``BIGDL_CHECKPOINT_ASYNC``; emergency/preemption checkpoints
+        ALWAYS write synchronously regardless (the process is exiting —
+        there is nothing to overlap, and the checkpoint must be durable
+        before the exit code).
 
         ``keep_last=K`` keeps only the newest K checkpoint pairs on
         disk (GC after each write); default from
         ``config.checkpoint_keep_last``, 0 = unlimited."""
-        from bigdl_tpu.config import config
+        from bigdl_tpu.config import refresh_from_env
         from bigdl_tpu.optim.triggers import Trigger
 
+        config = refresh_from_env()
         os.makedirs(path, exist_ok=True)
         self.checkpoint_path = path
         self.checkpoint_trigger = trigger or Trigger.every_epoch()
-        self.checkpoint_background = background
+        self.checkpoint_background = (config.checkpoint_async
+                                      if background is None
+                                      else bool(background))
         self.checkpoint_keep_last = (config.checkpoint_keep_last
                                      if keep_last is None else int(keep_last))
         return self
@@ -257,10 +265,15 @@ class BaseOptimizer:
                     max_workers=1, thread_name_prefix="bigdl-ckpt")
                 self._ckpt_future = None
             self._flush_checkpoints()  # at most one write in flight
+            # snapshot-to-host is the ONLY blocking span (and the only
+            # checkpoint_save badput); the extra dict — incl. the
+            # exactly-once stream offset — was captured above, at
+            # snapshot time, with every dispatched step resolved.  The
+            # writer thread then owns plain numpy, no device refs.
             snap = snapshot_checkpoint(self.model, self.optim_method,
-                                       extra)
+                                       extra, to_host=True)
             self._ckpt_future = self._ckpt_executor.submit(
-                write_checkpoint, snap, prefix, keep)
+                write_checkpoint, snap, prefix, keep, True)
             log.info("checkpoint scheduled at epoch %s iter %s",
                      self.state["epoch"], self.state["neval"])
             return
@@ -743,6 +756,15 @@ class LocalOptimizer(BaseOptimizer):
         from bigdl_tpu.resilience.retry import NonFiniteStepError
 
         max_nonfinite = config.max_nonfinite_skips
+        # double-buffered host->device input (ISSUE 11): batch N+1 is
+        # fetched, prepared and device_put right after step N
+        # dispatches, so the whole input pipeline overlaps the in-
+        # flight device step instead of stalling the loop top (the
+        # input_bound badput the goodput ledger measures).  Chaos runs
+        # keep the foreground path: the injector poisons host batches
+        # at dispatch time, before the transfer.
+        double_buffer = (config.input_double_buffer
+                         and self._fault_injector is None)
         # session-local obs handles (set up by optimize()): tracer is the
         # shared no-op when disabled, runtime None — zero hot-loop cost
         tracer = self._obs_tracer
@@ -885,20 +907,76 @@ class LocalOptimizer(BaseOptimizer):
                         next(batches)
                     except StopIteration:
                         break
+            # double-buffer slot: the prefetcher parks the next batch
+            # (host arrays + device buffers) here while the current
+            # step runs; a discarded staged batch (stop/preemption) is
+            # harmless — streams re-read anything yielded-but-untrained
+            staged = None
+            staged_end = False
+
+            def _prep_and_put(raw_inp, raw_tgt, step_tag):
+                """One host batch through prepare + device transfer;
+                None = dropped (its stream records are consumed)."""
+                with tracer.span("batch_prep", step=step_tag):
+                    prepared = self._prepare_batch(raw_inp, raw_tgt)
+                if prepared is None:
+                    if note_stream is not None:
+                        log.warning("dropped a streaming batch at "
+                                    "iter %d — its records are "
+                                    "consumed, not trained", step_tag)
+                        note_stream()
+                    return None
+                p_inp, p_tgt = prepared
+                with self.metrics.timer("put batch time"), \
+                        tracer.span("device_put", step=step_tag):
+                    inp_d, tgt_d = self._put_batch(p_inp, p_tgt)
+                return p_inp, p_tgt, inp_d, tgt_d
+
+            def _prefetch(step_tag):
+                """Double-buffer: pull the NEXT batch through the full
+                prepare + device_put pipeline while the just-dispatched
+                step is still in flight — traced as ``input_prefetch``
+                (overlapped host work), never ``data_wait`` badput."""
+                nonlocal staged_end
+                t_pre = time.perf_counter()
+                out = None
+                while out is None:
+                    try:
+                        raw_inp, raw_tgt = next(batches)
+                    except StopIteration:
+                        staged_end = True
+                        break
+                    out = _prep_and_put(raw_inp, raw_tgt, step_tag)
+                tracer.complete("input_prefetch", t_pre,
+                                time.perf_counter() - t_pre,
+                                step=step_tag)
+                return out
+
             while True:
                 # reference Metrics phases: the fused XLA step folds the
                 # collective phases ("put gradient"/"aggregate"/"send
                 # weights") into "computing time"; the host-side phases
                 # stay separately visible (SURVEY.md §5 Tracing)
-                t_wait = time.perf_counter()
-                try:
-                    inp, tgt = next(batches)
-                except StopIteration:
+                n = self.state["neval"]
+                batch = None
+                if staged is not None:
+                    # the double-buffered batch is already on device:
+                    # the loop top pays ~0 input wait
+                    batch, staged = staged, None
+                    t_wait = time.perf_counter()
+                    dt_wait = 0.0
+                elif staged_end:
                     batch_exhausted = True
                     break
-                dt_wait = time.perf_counter() - t_wait
+                else:
+                    t_wait = time.perf_counter()
+                    try:
+                        inp, tgt = next(batches)
+                    except StopIteration:
+                        batch_exhausted = True
+                        break
+                    dt_wait = time.perf_counter() - t_wait
                 self.metrics.add("data wait time", dt_wait)
-                n = self.state["neval"]
                 # elastic boundary: heartbeat + peer-liveness check (may
                 # raise the classified-fatal PeerLostError BEFORE the
                 # collective that would hang on a dead peer) and the
@@ -916,32 +994,38 @@ class LocalOptimizer(BaseOptimizer):
                 # child spans carry the step too: the slow-step detector
                 # and the merged cross-host timeline both key on it
                 with tracer.span("iteration", step=n):
-                    with tracer.span("batch_prep", step=n):
-                        prepared = self._prepare_batch(inp, tgt)
-                    if prepared is None:
-                        if note_stream is not None:
-                            # a dropped batch still consumed its stream
-                            # records: advance the frontier so the meta
-                            # queue stays aligned (and say so — dropping
-                            # stream records is a configuration smell)
-                            log.warning("dropped a streaming batch at "
-                                        "iter %d — its records are "
-                                        "consumed, not trained", n)
-                            note_stream()
-                        continue  # dropped (e.g. sub-mesh partial batch)
-                    inp, tgt = prepared
-                    if self._fault_injector is not None:
-                        # chaos hook: may raise InjectedFault (transient)
-                        # or poison this batch to exercise the non-finite
-                        # guard
-                        action = self._fault_injector.on_step(n)
-                        if action == "nan_grad":
-                            inp = self._fault_injector.poison_batch(inp)
+                    if batch is not None:
+                        # double-buffered: prepared + transferred while
+                        # the previous step was in flight
+                        inp, tgt, inp_d, tgt_d = batch
+                    else:
+                        with tracer.span("batch_prep", step=n):
+                            prepared = self._prepare_batch(inp, tgt)
+                        if prepared is None:
+                            if note_stream is not None:
+                                # a dropped batch still consumed its
+                                # stream records: advance the frontier so
+                                # the meta queue stays aligned (and say so
+                                # — dropping stream records is a
+                                # configuration smell)
+                                log.warning("dropped a streaming batch at "
+                                            "iter %d — its records are "
+                                            "consumed, not trained", n)
+                                note_stream()
+                            continue  # dropped (e.g. sub-mesh partial batch)
+                        inp, tgt = prepared
+                        if self._fault_injector is not None:
+                            # chaos hook: may raise InjectedFault
+                            # (transient) or poison this batch to exercise
+                            # the non-finite guard
+                            action = self._fault_injector.on_step(n)
+                            if action == "nan_grad":
+                                inp = self._fault_injector.poison_batch(inp)
+                        with self.metrics.timer("put batch time"), \
+                                tracer.span("device_put", step=n):
+                            inp_d, tgt_d = self._put_batch(inp, tgt)
                     profiler.step()
                     rng = jax.random.fold_in(base_key, n)
-                    with self.metrics.timer("put batch time"), \
-                            tracer.span("device_put", step=n):
-                        inp_d, tgt_d = self._put_batch(inp, tgt)
                     t0 = time.perf_counter()
                     # driver-side prep (batch_prep + device_put + rng
                     # fold) feeds the host_bound share of the window
@@ -961,6 +1045,12 @@ class LocalOptimizer(BaseOptimizer):
                     records_total += bs
                     if note_stream is not None:
                         note_stream()
+                    if double_buffer and not staged_end:
+                        # overlap the NEXT batch's fetch/prepare/
+                        # device_put with the in-flight device step —
+                        # this is the double-buffer: by the time the
+                        # loop comes back around, the input is on device
+                        staged = _prefetch(n + 1)
                     if sync_per_step:
                         resolve(n, loss, ok, bs, t0, health_dev)
                     else:

@@ -62,6 +62,9 @@ __all__ = [
     "all_to_all",
     "ppermute",
     "padded_elems",
+    "plan_buckets",
+    "bucket_param_coords",
+    "buckets_equal",
 ]
 
 # wire dtype name -> (jnp attribute, symmetric clip max).  bfloat16 is
@@ -181,6 +184,74 @@ def padded_elems(n_elems: int, spec: Optional["WireSpec"],
     quantum = n_shards * (spec.block if spec is not None and spec.scaled
                           else 1)
     return n_elems + (-n_elems) % quantum
+
+
+# ----------------------------------------------------- overlap bucketing
+def plan_buckets(padded: int, quantum: int, target_elems: int):
+    """Partition the padded flat-parameter layout ``[0, padded)`` into
+    contiguous ``(start, size)`` buckets for the overlapped gradient
+    exchange (ISSUE 11): each bucket's reduce-scatter launches as soon
+    as its gradients leave the backward, riding under the remaining
+    backward compute.
+
+    Every bucket size is a positive multiple of ``quantum`` (the wire's
+    alignment unit: ``n_shards * block`` for scaled dtypes, ``n_shards``
+    otherwise) so per-bucket chunks stay whole quantization blocks and
+    the summed wire bytes equal the monolithic exchange exactly.
+    ``target_elems <= 0`` returns the single monolithic bucket."""
+    q = max(1, int(quantum))
+    padded = int(padded)
+    if padded % q:
+        raise ValueError(f"padded length {padded} not a multiple of the "
+                         f"alignment quantum {q}")
+    if target_elems is None or int(target_elems) <= 0 or padded == 0:
+        return [(0, padded)]
+    per = max(q, ((int(target_elems) + q - 1) // q) * q)
+    out = []
+    start = 0
+    while start < padded:
+        size = min(per, padded - start)
+        out.append((start, size))
+        start += size
+    return out
+
+
+def bucket_param_coords(buckets, n_shards: int):
+    """The shard-major -> flat-parameter index map of a bucketed ZeRO-1
+    layout, as an ``np.int64`` array ``coords`` of length ``padded``:
+    the element stored at shard-major position ``p`` (device ``p //
+    shard_len``, offset ``p % shard_len`` — the layout the bucketed
+    exchange leaves the optimizer-state vectors in) is flat-parameter
+    coordinate ``coords[p]``.
+
+    With one bucket this is the identity (the monolithic layout IS
+    parameter-major); ``resilience/elastic.ensure_shard_layout`` uses
+    it to re-partition checkpointed state across bucket plans and world
+    sizes: ``param_major[coords] = shard_major``."""
+    import numpy as np
+
+    buckets = [(int(s), int(z)) for s, z in buckets]
+    n = int(n_shards)
+    padded = sum(z for _, z in buckets)
+    shard_len = padded // n
+    coords = np.empty(padded, np.int64)
+    for d in range(n):
+        off = d * shard_len
+        for s, z in buckets:
+            c = z // n
+            coords[off:off + c] = np.arange(s + d * c, s + (d + 1) * c,
+                                            dtype=np.int64)
+            off += c
+    return coords
+
+
+def buckets_equal(a, b) -> bool:
+    """Whether two bucket plans (possibly None / list-of-lists from a
+    JSON topology manifest) describe the same layout.  ``None`` means
+    "single monolithic bucket" and equals any one-bucket plan."""
+    norm = lambda p: None if p is None or len(p) <= 1 \
+        else [(int(s), int(z)) for s, z in p]
+    return norm(a) == norm(b)
 
 
 # ------------------------------------------------------------ quantizers

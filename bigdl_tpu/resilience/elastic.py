@@ -358,7 +358,8 @@ class ElasticSession:
 # -------------------------------------------------- topology-aware resume
 def ensure_shard_layout(state: dict, flat_elems: int, pad: int,
                         n_shards: int, mesh, axis,
-                        topology: Optional[dict] = None) -> dict:
+                        topology: Optional[dict] = None,
+                        buckets=None) -> dict:
     """Re-partition loaded ZeRO-1 optimizer state for the CURRENT mesh.
 
     The flat shard layout makes resize mechanical: a state vector saved
@@ -369,41 +370,65 @@ def ensure_shard_layout(state: dict, flat_elems: int, pad: int,
     mesh.  Entries already matching the current layout (same-world
     resume, the common case) pass through untouched; scalars always do.
 
+    **Bucketed overlap layouts** (ISSUE 11): a run trained with
+    ``overlap_bucket_mb`` leaves the state vectors in shard-major
+    bucket-chunk order — each device owns one chunk of every bucket —
+    recorded as ``topology["buckets"]``.  Restoring under a different
+    plan (or world) first un-permutes to flat-parameter coordinates via
+    :func:`parallel.wire.bucket_param_coords`, strips/re-pads, then
+    permutes into the NEW plan (``buckets``).  Same-plan same-world
+    resumes still pass through bit-for-bit.
+
     The ``wire_ef`` error-feedback residual (parallel/wire.py; one
-    ``(world, padded)`` f32 row per device) is *per-device* state — an
-    N-world residual has no positional meaning at M devices — so a
-    resize **resets it to zeros** in the new layout.  Safe by
-    construction: the residual is a correction term the next exchange
-    re-derives; dropping it costs one step of ordinary (un-fed-back)
-    quantization error, never correctness.  Same-world resumes keep
-    the checkpointed residual bit-for-bit.
+    ``(world, padded)`` f32 row per device, flat-parameter coords) is
+    *per-device per-chunk* state — an N-world (or different-bucket-
+    plan) residual has no chunk-assignment meaning under the new
+    layout — so a resize or plan change **resets it to zeros**, per
+    bucket and all at once.  Safe by construction: the residual is a
+    correction term the next exchange re-derives; dropping it costs one
+    step of ordinary (un-fed-back) quantization error, never
+    correctness.  Same-world same-plan resumes keep the checkpointed
+    residual bit-for-bit.
     """
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     import jax.numpy as jnp
 
+    from bigdl_tpu.parallel import wire as _W
+
     padded = flat_elems + pad
+    old_topo = topology or {}
+    old_buckets = old_topo.get("buckets")
+    old_world = old_topo.get("world_size")
+    plan_changed = not _W.buckets_equal(old_buckets, buckets)
+    # multi-bucket plans at different worlds permute differently even
+    # when the plan itself matches (chunk = size // world)
+    if not plan_changed and buckets is not None and len(buckets) > 1 \
+            and old_world is not None and int(old_world) != int(n_shards):
+        plan_changed = True
     ef = state.get("wire_ef")
-    ef_stale = ef is not None and tuple(ef.shape) != (n_shards, padded)
+    ef_stale = ef is not None and (
+        tuple(ef.shape) != (n_shards, padded) or plan_changed)
     stale = [k for k, v in state.items()
              if k != "wire_ef" and getattr(v, "ndim", None) == 1
-             and v.shape[0] >= flat_elems and v.shape[0] != padded]
+             and v.shape[0] >= flat_elems
+             and (v.shape[0] != padded or plan_changed)]
     if ef_stale:
         state = dict(state)
         state["wire_ef"] = jax.device_put(
             jnp.zeros((n_shards, padded), jnp.float32),
             NamedSharding(mesh, P(axis, None)))
         log.info("elastic: reset the wire_ef error-feedback residual "
-                 "%s -> %s on world resize", tuple(ef.shape),
-                 (n_shards, padded))
+                 "%s -> %s on world resize / bucket-plan change",
+                 tuple(ef.shape), (n_shards, padded))
         from bigdl_tpu import obs
 
         obs.get_tracer().event(
             "elastic.ef_reset", old_shape=list(ef.shape),
             new_shape=[n_shards, padded],
-            old_world=(topology or {}).get("world_size"),
-            new_world=n_shards)
+            old_world=old_world, new_world=n_shards,
+            plan_changed=bool(plan_changed))
     if not stale:
         return state
     old_len = state[stale[0]].shape[0]
@@ -413,22 +438,46 @@ def ensure_shard_layout(state: dict, flat_elems: int, pad: int,
                 "inconsistent optimizer-state vector lengths "
                 f"{ {k: int(state[k].shape[0]) for k in stale} }; the "
                 "checkpoint does not look like one flat ZeRO layout")
-    old_world = (topology or {}).get("world_size")
+    # index maps between shard-major and flat-parameter order; None =
+    # identity (the monolithic single-bucket layout IS parameter-major)
+    old_coords = None
+    if old_buckets is not None and len(old_buckets) > 1:
+        if not old_world:
+            raise ValueError(
+                "checkpoint topology carries a bucket plan but no "
+                "world_size — cannot un-permute the shard-major state")
+        old_coords = _W.bucket_param_coords(old_buckets, int(old_world))
+        if old_coords.shape[0] != old_len:
+            raise ValueError(
+                f"topology bucket plan covers {old_coords.shape[0]} "
+                f"elems but the state vectors hold {old_len}")
+    new_coords = None
+    if buckets is not None and len(buckets) > 1:
+        new_coords = _W.bucket_param_coords(buckets, int(n_shards))
     new_state = dict(state)
     for k in stale:
-        v = jnp.asarray(state[k])[:flat_elems]
+        v = jnp.asarray(state[k])
+        if old_coords is not None:
+            # param_major[old_coords] = shard_major
+            v = jnp.zeros_like(v).at[old_coords].set(v)
+        v = v[:flat_elems]
         if pad:
             v = jnp.concatenate([v, jnp.zeros((pad,), v.dtype)])
+        if new_coords is not None:
+            v = v[new_coords]
         new_state[k] = jax.device_put(v, NamedSharding(mesh, P(axis)))
     log.info("elastic: re-partitioned optimizer state %s from a "
-             "%s-shard layout (%d elems) to %d shards (%d elems)",
-             sorted(stale), old_world or "?", old_len, n_shards, padded)
+             "%s-shard layout (%d elems) to %d shards (%d elems)%s",
+             sorted(stale), old_world or "?", old_len, n_shards, padded,
+             " across bucket plans" if plan_changed and (
+                 old_coords is not None or new_coords is not None)
+             else "")
     from bigdl_tpu import obs
 
     obs.get_tracer().event(
         "elastic.resize", old_world=old_world, new_world=n_shards,
         old_elems=int(old_len), new_elems=int(padded),
-        keys=sorted(stale))
+        keys=sorted(stale), plan_changed=bool(plan_changed))
     return new_state
 
 

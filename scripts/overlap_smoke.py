@@ -1,0 +1,269 @@
+#!/usr/bin/env python
+"""--overlap smoke: the overlapped training step, A/B'd end to end.
+
+Driven by ``scripts/run-tests.sh --overlap``.  One process, a 2-"host"
+(2 forced CPU devices) data mesh — the same simulated-host convention
+as the wire smoke — running the SAME 160-step job twice with a
+synthetically slow input producer (every batch arrives ~8ms late):
+
+* **overlap OFF** — monolithic f32 gradient exchange, foreground
+  input, synchronous checkpoints (the pre-ISSUE-11 step);
+* **overlap ON** — bucketed exchange (``overlap_bucket_mb`` small
+  enough for several buckets), double-buffered input
+  (``BIGDL_INPUT_DOUBLE_BUFFER=1``) and fully-async checkpoints
+  (``BIGDL_CHECKPOINT_ASYNC=1``).
+
+Asserted, not eyeballed:
+
+* per-step trajectory equivalence (worst relative loss error < 1e-5 —
+  bucketing changes WHEN bytes move, never the math);
+* golden byte parity: both runs ship EXACTLY the same total exchange
+  bytes (``bigdl_collective_bytes_total``);
+* the ``comm_bound`` signal falls: the mean per-window comm fraction
+  (goodput.bottleneck events, estimated over ``BIGDL_WIRE_GBPS``) is
+  strictly lower with the bucketed exchange;
+* the ``input_bound`` signal falls: ``data_wait`` badput seconds (and
+  their share of wall clock) drop with double-buffering;
+* checkpoint badput falls: ``checkpoint_save`` seconds shrink to the
+  snapshot span, while the async write is durable (the newest
+  checkpoint verifies and its manifest carries the bucket plan + the
+  per-bucket EF-capable topology);
+* ``bigdl_goodput_ratio`` strictly improves overlap-on;
+* the report renders the new "overlap" block.
+
+Results are banked to ``OVERLAP_SMOKE.json`` at the repo root, which
+``bench.py`` folds into its BENCH JSON as ``extras.overlap``.
+"""
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+EPOCHS = 20           # x 8 batches = 160 steps
+BATCH_DELAY = 0.008   # synthetic producer latency per batch
+TOL = 1e-5
+OUT = os.path.join(REPO, "OVERLAP_SMOKE.json")
+
+
+def main() -> int:
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=2")
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import tempfile
+
+    import numpy as np
+
+    from bigdl_tpu import obs
+    import bigdl_tpu.native as native
+    from bigdl_tpu.common import RandomGenerator
+    from bigdl_tpu.engine import Engine
+    from bigdl_tpu.nn import (
+        ClassNLLCriterion, Linear, LogSoftMax, ReLU, Sequential,
+    )
+    from bigdl_tpu.obs import goodput as G
+    from bigdl_tpu.optim import DistriOptimizer, SGD, Trigger
+
+    # synthetic input starvation: the producer delivers every batch
+    # late, so the un-overlapped loop eats a data_wait per step while
+    # the double-buffered loop hides the same latency under the step
+    _P = native.PrefetchIterator
+
+    class Slow:
+        def __init__(self, iterable, depth=2):
+            self._it = iter(_P(iterable, depth))
+
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            time.sleep(BATCH_DELAY)
+            return next(self._it)
+
+    native.PrefetchIterator = Slow
+
+    Engine.init()
+    import jax
+
+    n = 2
+    assert len(jax.devices()) == n, jax.devices()
+
+    rng = np.random.RandomState(0)
+    # a model big enough that the exchange dominates the byte budget
+    d, h, k = 32, 128, 4
+    w = rng.randn(d, k)
+    x = rng.randn(256, d).astype(np.float32)
+    y = (np.argmax(x @ w, axis=1) + 1).astype(np.float32)
+
+    class Tape:
+        def __init__(self):
+            self.loss = {}
+
+        def add_scalar(self, tag, value, step):
+            if tag == "Loss":
+                self.loss[step] = float(value)
+
+        def add_histogram(self, *a, **kw):
+            pass
+
+        def get_summary_trigger(self, name):
+            return None
+
+        def add_resilience(self, step, **c):
+            pass
+
+    def exchange_bytes():
+        fam = obs.get_registry().counter(
+            "bigdl_collective_bytes_total", labels=("op", "dtype"))
+        return fam.labels(op="psum_scatter", dtype="float32").value
+
+    def run(tag, overlap):
+        tmp = tempfile.mkdtemp(prefix=f"bigdl_overlap_{tag}_")
+        os.environ["BIGDL_METRICS_DIR"] = os.path.join(tmp, "metrics")
+        os.environ["BIGDL_TRACE_DIR"] = os.path.join(tmp, "trace")
+        os.environ["BIGDL_GOODPUT_WINDOW"] = "8"
+        # assumed wire bandwidth for the comm-seconds estimate: slow
+        # enough that the monolithic exchange reads as a real cost,
+        # fast enough that the fraction stays under the min(1, ...) cap
+        # so the A/B difference is visible
+        os.environ["BIGDL_WIRE_GBPS"] = "0.03"
+        os.environ["BIGDL_INPUT_DOUBLE_BUFFER"] = "1" if overlap else "0"
+        os.environ["BIGDL_CHECKPOINT_ASYNC"] = "1" if overlap else "0"
+        from bigdl_tpu.config import reload_from_env
+
+        reload_from_env()
+        obs.reset()
+        RandomGenerator.RNG.set_seed(7)
+        model = Sequential().add(Linear(d, h)).add(ReLU()) \
+            .add(Linear(h, k)).add(LogSoftMax())
+        opt = DistriOptimizer(
+            model, (x, y), ClassNLLCriterion(), batch_size=32,
+            wire_dtype="float32",
+            overlap_bucket_mb=0.004 if overlap else 0)
+        opt.set_optim_method(SGD(learningrate=0.5, momentum=0.9))
+        opt.set_end_when(Trigger.max_epoch(EPOCHS))
+        opt.set_checkpoint(os.path.join(tmp, "ck"),
+                           Trigger.several_iteration(40))
+        tape = Tape()
+        opt.set_train_summary(tape)
+        t0 = time.perf_counter()
+        opt.optimize()
+        wall = time.perf_counter() - t0
+        # per-window comm fractions from the bottleneck events, before
+        # the reset drops the flight ring
+        comm_fracs = [float(r["attrs"]["comm_fraction"])
+                      for r in obs.get_tracer().recent()
+                      if r.get("name") == "goodput.bottleneck"]
+        bytes_total = exchange_bytes()
+        obs.flush()
+        gp = G.aggregate_goodput(os.environ["BIGDL_METRICS_DIR"])
+        return {"tape": tape, "opt": opt, "tmp": tmp, "wall": wall,
+                "gp": gp, "comm_fracs": comm_fracs,
+                "exchange_bytes": bytes_total,
+                "buckets": len(opt._buckets)}
+
+    print(f"== overlap smoke: {EPOCHS * 8}-step A/B on a {n}-host mesh, "
+          f"{BATCH_DELAY * 1000:.0f}ms/batch producer ==")
+    off = run("off", overlap=False)
+    on = run("on", overlap=True)
+    steps = EPOCHS * 8
+    assert len(off["tape"].loss) == steps, len(off["tape"].loss)
+    assert off["buckets"] == 1 and on["buckets"] > 1, (
+        off["buckets"], on["buckets"])
+
+    # -- 1: trajectory equivalence ------------------------------------
+    worst = max(abs(on["tape"].loss[s] - off["tape"].loss[s])
+                / (abs(off["tape"].loss[s]) + 1e-9)
+                for s in off["tape"].loss)
+    assert worst < TOL, worst
+    print(f"   trajectory: worst per-step rel err {worst:.2e} "
+          f"(< {TOL:g}) over {steps} steps")
+
+    # -- 2: golden byte parity ----------------------------------------
+    assert on["exchange_bytes"] == off["exchange_bytes"] > 0, (
+        on["exchange_bytes"], off["exchange_bytes"])
+    print(f"   wire: {on['exchange_bytes']:.0f} exchange bytes, "
+          f"identical across {on['buckets']} buckets vs monolithic")
+
+    # -- 3: the comm signal falls -------------------------------------
+    assert off["comm_fracs"] and on["comm_fracs"]
+    comm_off = sum(off["comm_fracs"]) / len(off["comm_fracs"])
+    comm_on = sum(on["comm_fracs"]) / len(on["comm_fracs"])
+    assert comm_on < comm_off, (comm_on, comm_off)
+    print(f"   comm fraction: {comm_off:.3f} -> {comm_on:.3f} "
+          f"({on['buckets']} buckets hide the exchange under backward)")
+
+    # -- 4: the input signal falls ------------------------------------
+    wait_off = off["gp"]["badput_s"].get("data_wait", 0.0)
+    wait_on = on["gp"]["badput_s"].get("data_wait", 0.0)
+    input_off = wait_off / off["gp"]["total_s"]
+    input_on = wait_on / on["gp"]["total_s"]
+    assert wait_on < wait_off and input_on < input_off, (
+        wait_on, wait_off)
+    print(f"   input badput: {wait_off:.2f}s ({input_off * 100:.0f}% of "
+          f"wall) -> {wait_on:.2f}s ({input_on * 100:.0f}%) "
+          "double-buffered")
+
+    # -- 5: checkpoint badput shrinks to the snapshot span ------------
+    ck_off = off["gp"]["badput_s"].get("checkpoint_save", 0.0)
+    ck_on = on["gp"]["badput_s"].get("checkpoint_save", 0.0)
+    assert ck_off > 0, off["gp"]["badput_s"]
+    assert ck_on < ck_off, (ck_on, ck_off)
+    from bigdl_tpu.utils.serializer import (
+        checkpoint_prefixes, read_checkpoint_topology, verify_checkpoint,
+    )
+
+    ck_dir = os.path.join(on["tmp"], "ck")
+    newest = os.path.join(ck_dir, checkpoint_prefixes(ck_dir)[-1])
+    ok, reason = verify_checkpoint(newest)
+    assert ok, reason
+    topo = read_checkpoint_topology(newest)
+    assert len(topo.get("buckets") or []) > 1, topo
+    print(f"   checkpoint_save badput: {ck_off * 1000:.1f}ms sync -> "
+          f"{ck_on * 1000:.1f}ms async (snapshot only; newest intact, "
+          "manifest carries the bucket plan)")
+
+    # -- 6: goodput strictly improves ---------------------------------
+    ratio_off = off["gp"]["goodput_ratio"]
+    ratio_on = on["gp"]["goodput_ratio"]
+    assert ratio_on > ratio_off, (ratio_on, ratio_off)
+    print(f"   goodput ratio: {ratio_off:.3f} -> {ratio_on:.3f}")
+
+    # -- 7: the report renders the overlap block ----------------------
+    from bigdl_tpu.obs.report import build_report, render_text
+
+    rep = build_report(os.path.join(on["tmp"], "trace"),
+                       os.path.join(on["tmp"], "metrics"))
+    ov = rep["overlap"]
+    assert (ov["buckets"] or 0) > 1 and ov["async_checkpoint_writes"], ov
+    text = render_text(rep)
+    assert "-- overlap --" in text and "buckets" in text, text
+    print(f"   report: overlap block renders ({int(ov['buckets'])} "
+          f"buckets, {ov['async_checkpoint_writes']} async write(s), "
+          f"exposed comm {ov['exposed_comm_fraction']:.2f})")
+
+    results = {
+        "steps": steps, "hosts": n, "batch_delay_s": BATCH_DELAY,
+        "buckets": on["buckets"],
+        "worst_step_rel": worst,
+        "exchange_bytes_total": on["exchange_bytes"],
+        "comm_fraction": {"off": comm_off, "on": comm_on},
+        "data_wait_s": {"off": wait_off, "on": wait_on},
+        "checkpoint_save_s": {"off": ck_off, "on": ck_on},
+        "goodput_ratio": {"off": ratio_off, "on": ratio_on},
+        "exposed_comm_fraction": ov["exposed_comm_fraction"],
+        "wall_s": {"off": off["wall"], "on": on["wall"]},
+    }
+    with open(OUT, "w", encoding="utf-8") as fh:
+        json.dump(results, fh, indent=1, sort_keys=True)
+    print(f"   banked {OUT}")
+    print("== overlap smoke PASS ==")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -73,6 +73,22 @@
 #                                                # AUTOSCALE_SMOKE.json for
 #                                                # BENCH extras.autoscale
 #                                                # (no pytest)
+#   scripts/run-tests.sh --overlap               # overlapped-step smoke: a
+#                                                # 2-host 160-step A/B of
+#                                                # overlap on (bucketed
+#                                                # exchange + async ckpt +
+#                                                # double-buffered input) vs
+#                                                # off, asserting per-step
+#                                                # trajectory equivalence,
+#                                                # unchanged golden exchange
+#                                                # bytes, lower comm/input
+#                                                # badput fractions, smaller
+#                                                # checkpoint_save badput and
+#                                                # a strictly higher goodput
+#                                                # ratio; banks
+#                                                # OVERLAP_SMOKE.json for
+#                                                # BENCH extras.overlap
+#                                                # (no pytest)
 #   scripts/run-tests.sh --live                  # live-telemetry smoke: a
 #                                                # 2-host run with /metrics +
 #                                                # /healthz servers on
@@ -123,6 +139,9 @@ elif [[ "${1:-}" == "--autoscale" ]]; then
 elif [[ "${1:-}" == "--wire" ]]; then
   shift
   exec python scripts/wire_smoke.py "$@"
+elif [[ "${1:-}" == "--overlap" ]]; then
+  shift
+  exec python scripts/overlap_smoke.py "$@"
 fi
 
 exec python -m pytest tests/ -q "${MARKER[@]}" "$@"

@@ -44,7 +44,18 @@ bytes — `bigdl_collective_wire_savings_ratio{path=...}` says what the
 gradient/TP/MoE/ring exchanges ship vs f32 (>= 3.2x on the gradient
 path), with error feedback keeping the loss trajectory within the f32
 run's — see MIGRATION.md "Quantized collectives v2" and
-``scripts/run-tests.sh --wire`` for the measured A/B.
+``scripts/run-tests.sh --wire`` for the measured A/B.  Still
+comm-bound (or input-bound, or stalling on checkpoints) after the
+wire is compressed?  HIDE the cost instead of shrinking it: the
+overlapped step (`BIGDL_OVERLAP_BUCKET_MB` bucketed last-layer-first
+gradient exchange, `BIGDL_CHECKPOINT_ASYNC=1` snapshot-then-
+background-write checkpoints, `BIGDL_INPUT_DOUBLE_BUFFER=1`
+prefetched device transfer) rides comm/IO under backward — the
+report's "overlap" block shows buckets, the exposed-comm share and
+snapshot-vs-write times, and the `exposed_comm_high` alert pages when
+the buckets are too coarse to hide the wire — see MIGRATION.md
+"Overlapped step" and ``scripts/run-tests.sh --overlap`` for the
+measured on-vs-off A/B.
 
 A run that keeps DYING (preemption, host loss) rather than failing to
 compile belongs under the restart supervisor instead: ``python -m

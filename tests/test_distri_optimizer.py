@@ -712,3 +712,123 @@ def test_background_checkpoint_with_distri_retry(tmp_path):
     (acc,) = evaluate_dataset(trained, ArrayDataSet(x, y, 64),
                               [Top1Accuracy()])
     assert acc.result()[0] > 0.9, acc.result()
+
+
+# ---------------------------------------------- overlapped step (ISSUE 11)
+def _seeded_model(seed=7):
+    from bigdl_tpu.common import RandomGenerator
+
+    RandomGenerator.RNG.set_seed(seed)
+    return _model()
+
+
+def _small_mesh(n):
+    return Engine.build_mesh({"data": n}, devices=jax.devices()[:n])
+
+
+def _overlap_run(**kw):
+    x, y = _toy(128)
+    opt = DistriOptimizer(_seeded_model(), ArrayDataSet(x, y, 32,
+                                                        shuffle=False),
+                          ClassNLLCriterion(), batch_size=32,
+                          mesh=_small_mesh(2), **kw)
+    opt.set_optim_method(SGD(learningrate=0.5, momentum=0.9))
+    opt.set_end_when(Trigger.max_epoch(2))
+
+    class Tape:
+        loss: dict = {}
+
+        def __init__(self):
+            self.loss = {}
+
+        def add_scalar(self, tag, v, s):
+            if tag == "Loss":
+                self.loss[s] = float(v)
+
+        def add_histogram(self, *a, **k):
+            pass
+
+        def get_summary_trigger(self, name):
+            return None
+
+        def add_resilience(self, *a, **k):
+            pass
+
+    tape = Tape()
+    opt.set_train_summary(tape)
+    opt.optimize()
+    return tape.loss, opt
+
+
+def test_bucketed_exchange_matches_monolithic_trajectory():
+    """ISSUE 11 tentpole: splitting the f32 gradient exchange into
+    last-layer-first buckets changes WHEN bytes move, not the math —
+    the per-step loss trajectory matches the monolithic exchange."""
+    base, mono = _overlap_run(wire_dtype="none")
+    over, bopt = _overlap_run(wire_dtype="none", overlap_bucket_mb=0.0005)
+    assert len(bopt._buckets) > 1, bopt._buckets
+    assert mono._buckets == [(0, mono._flat_elems + mono._pad)]
+    worst = max(abs(over[s] - base[s]) / (abs(base[s]) + 1e-9)
+                for s in base)
+    assert worst < 1e-5, worst
+    # the shard-major layout is recorded for the resize path
+    topo = bopt._topology()
+    assert topo["buckets"] == [[s, z] for s, z in bopt._buckets]
+    assert "buckets" not in mono._topology()
+
+
+def test_bucketed_wire_bytes_match_monolithic_golden():
+    """Golden byte-count parity: the bucketed int8 staged ring ships
+    EXACTLY the monolithic wire's bytes (payload and scales) — overlap
+    is free on the wire."""
+    from bigdl_tpu import obs
+    from bigdl_tpu.obs import collectives as C
+
+    def ring_bytes():
+        fam = obs.get_registry().counter(
+            "bigdl_collective_bytes_total", labels=("op", "dtype"))
+        return {d: fam.labels(op="ring_rs", dtype=d).value
+                for d in ("int8", "float32")}
+
+    obs.reset()
+    _, mono = _overlap_run(wire_dtype="int8", wire_block=64)
+    mono_bytes = ring_bytes()
+    obs.reset()
+    _, bopt = _overlap_run(wire_dtype="int8", wire_block=64,
+                           overlap_bucket_mb=0.001)
+    over_bytes = ring_bytes()
+    assert len(bopt._buckets) > 1
+    assert over_bytes == mono_bytes and mono_bytes["int8"] > 0
+    # and both match the static model exactly
+    padded = mono._flat_elems + mono._pad
+    model = C.staged_ring_exchange_bytes(padded, 2, 64, "int8")
+    steps = 8  # 2 epochs x 128/32 batches over the 2-shard mesh
+    assert mono_bytes["int8"] == model["int8"] * steps
+    assert mono_bytes["float32"] >= model["float32"] * steps
+
+
+def test_exposed_comm_gauges_published_with_buckets():
+    """Satellite: the overlap gauges say how much of the wire stays
+    exposed — 1/K of the exchange with K buckets (plus the serialized
+    gathers), and nothing is published for monolithic runs."""
+    from bigdl_tpu import obs
+
+    obs.reset()
+    _, mono = _overlap_run(wire_dtype="none")
+    reg = obs.get_registry()
+    assert reg.gauge(
+        "bigdl_overlap_buckets", "x").labels().value == 1.0
+    obs.reset()
+    _, bopt = _overlap_run(wire_dtype="none", overlap_bucket_mb=0.0005)
+    reg = obs.get_registry()
+    k = len(bopt._buckets)
+    assert reg.gauge("bigdl_overlap_buckets", "x").labels().value == float(k)
+    frac = reg.gauge("bigdl_overlap_exposed_comm_fraction",
+                     "x").labels().value
+    assert 0.0 < frac < 1.0, frac
+    # exposed = total - hidden exchange share
+    fp = bopt._collective_footprint
+    exchange = sum(b for op, _d, b in fp.entries if op == "ring_rs"
+                   or op == "psum_scatter")
+    expected = (fp.total() - exchange * (k - 1) / k) / fp.total()
+    assert abs(frac - expected) < 1e-4, (frac, expected)

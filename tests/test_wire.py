@@ -743,3 +743,66 @@ class TestDistriWire:
         finally:
             monkeypatch.delenv("BIGDL_FAULT_PLAN", raising=False)
             reset_injector()
+
+
+# ==================================================== overlap bucketing
+class TestBucketPlan:
+    """ISSUE 11: the bucketed-overlap plan and the shard-major layout
+    map the elastic re-partition path keys on."""
+
+    def test_plan_covers_and_aligns(self):
+        plan = wire.plan_buckets(1024, quantum=128, target_elems=300)
+        # sizes round UP to whole quanta and cover [0, padded) exactly
+        assert plan == [(0, 384), (384, 384), (768, 256)]
+        assert sum(z for _, z in plan) == 1024
+        assert all(s % 128 == 0 and z % 128 == 0 for s, z in plan)
+
+    def test_plan_monolithic_when_target_unset(self):
+        assert wire.plan_buckets(1024, 128, 0) == [(0, 1024)]
+        assert wire.plan_buckets(1024, 128, None) == [(0, 1024)]
+        # a target below one quantum still yields whole quanta
+        assert wire.plan_buckets(256, 128, 1) == [(0, 128), (128, 128)]
+
+    def test_plan_rejects_misaligned_padded(self):
+        with pytest.raises(ValueError, match="quantum"):
+            wire.plan_buckets(1000, 128, 300)
+
+    def test_param_coords_identity_for_single_bucket(self):
+        coords = wire.bucket_param_coords([(0, 20)], 4)
+        np.testing.assert_array_equal(coords, np.arange(20))
+
+    def test_param_coords_roundtrip(self):
+        buckets = [(0, 8), (8, 8), (16, 4)]
+        coords = wire.bucket_param_coords(buckets, 2)
+        param = np.arange(20, dtype=np.float32) * 10
+        shard_major = param[coords]
+        # device 0 owns the first half of every bucket, ascending
+        np.testing.assert_array_equal(
+            shard_major[:10],
+            np.array([0, 1, 2, 3, 8, 9, 10, 11, 16, 17]) * 10.0)
+        back = np.empty_like(param)
+        back[coords] = shard_major
+        np.testing.assert_array_equal(back, param)
+
+    def test_buckets_equal_normalizes_single_and_none(self):
+        assert wire.buckets_equal(None, None)
+        assert wire.buckets_equal(None, [(0, 640)])  # mono == identity
+        assert wire.buckets_equal([[0, 64], [64, 64]], [(0, 64), (64, 64)])
+        assert not wire.buckets_equal(None, [(0, 64), (64, 64)])
+        assert not wire.buckets_equal([[0, 32], [32, 96]],
+                                      [[0, 64], [64, 64]])
+
+    def test_bucketed_staged_ring_bytes_match_monolithic(self):
+        """Byte-count parity: the per-bucket staged-ring exchanges sum
+        to EXACTLY the monolithic model — bucketing changes when bytes
+        move, never how many."""
+        padded, n, block = 1536, 4, 64
+        mono = C.staged_ring_exchange_bytes(padded, n, block, "int8")
+        plan = wire.plan_buckets(padded, n * block, 512)
+        assert len(plan) > 1
+        summed: dict = {}
+        for _s, z in plan:
+            for k, v in C.staged_ring_exchange_bytes(
+                    z, n, block, "int8").items():
+                summed[k] = summed.get(k, 0.0) + v
+        assert summed == mono
