@@ -628,6 +628,23 @@ def _overlap_extras():
         return None
 
 
+def _serve_extras():
+    """Serving-tier evidence for the BENCH JSON: the newest
+    ``SERVE_SMOKE.json`` banked by scripts/serve_smoke.py (continuous
+    vs static tokens/sec + p99, batcher occupancy, the int8 classifier
+    run and the queue-driven autoscale decision).  None when the smoke
+    has never been run."""
+    try:
+        smoke = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "SERVE_SMOKE.json")
+        if not os.path.exists(smoke):
+            return None
+        with open(smoke, "r", encoding="utf-8") as fh:
+            return {"smoke": json.load(fh)}
+    except Exception:
+        return None
+
+
 def _tuner_extras():
     """Auto-tuner evidence for the BENCH JSON (ops/autotune.py): the
     cache stats and every decision with its static baseline, measured
@@ -986,6 +1003,9 @@ def _run_child(platform: str):
     overlap = _overlap_extras()
     if overlap is not None:
         ex["overlap"] = overlap
+    serve = _serve_extras()
+    if serve is not None:
+        ex["serve"] = serve
     print(PARTIAL_MARK + json.dumps(result), flush=True)
 
 

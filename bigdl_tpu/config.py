@@ -285,6 +285,13 @@ class AutoscaleConfig:
     # scale-down decision (reason straggler_evict) so the next launch
     # re-forms the world without it [BIGDL_AUTOSCALE_EVICT_STRAGGLERS]
     evict_stragglers: bool = False
+    # serving latency band over the bigdl_request_latency_seconds
+    # e2e histogram (resilience/autoscale.derive_signals computes the
+    # fleet-worst p99 from the scraped buckets): sustained p99 above
+    # `high` scales up, below `low` scales down; 0 disables
+    # [BIGDL_AUTOSCALE_P99_HIGH / _LOW, seconds]
+    p99_high: float = 0.0
+    p99_low: float = 0.0
     # dry-run: evaluate + count + trace every decision, execute none
     # [BIGDL_AUTOSCALE_DRY_RUN]
     dry_run: bool = False
@@ -312,8 +319,58 @@ class AutoscaleConfig:
             goodput_floor=_env_float("BIGDL_AUTOSCALE_GOODPUT_FLOOR", 0.0),
             evict_stragglers=_env_bool("BIGDL_AUTOSCALE_EVICT_STRAGGLERS",
                                        False),
+            p99_high=_env_float("BIGDL_AUTOSCALE_P99_HIGH", 0.0),
+            p99_low=_env_float("BIGDL_AUTOSCALE_P99_LOW", 0.0),
             dry_run=_env_bool("BIGDL_AUTOSCALE_DRY_RUN", False),
             rules=_env_str("BIGDL_AUTOSCALE_RULES", None),
+        )
+
+
+@dataclasses.dataclass
+class ServeConfig:
+    """Inference serving tier defaults (``bigdl_tpu/serving``).
+
+    Constructor arguments on :class:`~bigdl_tpu.serving.LMEngine` /
+    :class:`~bigdl_tpu.serving.ClassifierEngine` win; these are the
+    process-wide fallbacks a deployment sets once.
+    """
+
+    # decode slots / classifier micro-batch rows [BIGDL_SERVE_MAX_BATCH]
+    max_batch: int = 8
+    # tokens per KV-cache page [BIGDL_SERVE_PAGE]
+    page_size: int = 16
+    # KV page pool size; 0 = full residency (every slot can hold a
+    # max_len sequence) [BIGDL_SERVE_PAGES]
+    num_pages: int = 0
+    # bounded request-queue capacity — submits past it backpressure
+    # the client [BIGDL_SERVE_QUEUE]
+    queue_capacity: int = 64
+    # int8 weights for the memory-bound decode matmuls (LM) / the
+    # quantize() module swap (classifier) [BIGDL_SERVE_INT8]
+    int8: bool = False
+    # e2e latency SLO target in seconds; > 0 publishes the
+    # bigdl_serve_latency_slo_ratio gauge the serve_latency_slo_burn
+    # alert rule watches [BIGDL_SERVE_SLO_MS, milliseconds]
+    slo_s: float = 0.0
+    # "continuous" admits at step boundaries (the point of the tier);
+    # "static" drains the whole batch first — the A/B baseline
+    # [BIGDL_SERVE_ADMISSION]
+    admission: str = "continuous"
+    # HTTP front-end port for serving/server.py (0 = ephemeral);
+    # unset = constructor default [BIGDL_SERVE_PORT]
+    port: Optional[int] = None
+
+    @classmethod
+    def from_env(cls) -> "ServeConfig":
+        return cls(
+            max_batch=_env_int("BIGDL_SERVE_MAX_BATCH", 8),
+            page_size=_env_int("BIGDL_SERVE_PAGE", 16),
+            num_pages=_env_int("BIGDL_SERVE_PAGES", 0),
+            queue_capacity=_env_int("BIGDL_SERVE_QUEUE", 64),
+            int8=_env_bool("BIGDL_SERVE_INT8", False),
+            slo_s=_env_float("BIGDL_SERVE_SLO_MS", 0.0) / 1000.0,
+            admission=_env_str("BIGDL_SERVE_ADMISSION", "continuous"),
+            port=_env_opt_int("BIGDL_SERVE_PORT", None),
         )
 
 
@@ -445,6 +502,11 @@ class BigDLConfig:
     # [BIGDL_WIRE_DTYPE / BIGDL_WIRE_BLOCK / BIGDL_WIRE_EF]
     wire: WireConfig = dataclasses.field(default_factory=WireConfig)
 
+    # --- inference serving tier (serving/ package) ----------------------
+    # [BIGDL_SERVE_MAX_BATCH / _PAGE / _PAGES / _QUEUE / _INT8 /
+    #  _SLO_MS / _ADMISSION / _PORT]
+    serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
+
     # --- benchmarking [BENCH_* kept for bench.py compat] ----------------
 
     @classmethod
@@ -482,6 +544,7 @@ class BigDLConfig:
             obs=ObsConfig.from_env(),
             tuner=TunerConfig.from_env(),
             wire=WireConfig.from_env(),
+            serve=ServeConfig.from_env(),
         )
 
     def describe(self) -> str:

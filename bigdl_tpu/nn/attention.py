@@ -292,10 +292,12 @@ class TransformerBlock(_Composite):
         h, _ = self._children["ln1"].apply(params["ln1"], {}, x)
         q, k, v = self._project_qkv(params["attn"], h)
         qh = attn._split(q)
+        # the caches may be narrower than the activations (bf16 K/V on
+        # an f32 model — generate()'s cache_dtype); cast on write
         cache_k = lax.dynamic_update_slice(
-            cache_k, attn._split(k), (0, 0, t, 0))
+            cache_k, attn._split(k).astype(cache_k.dtype), (0, 0, t, 0))
         cache_v = lax.dynamic_update_slice(
-            cache_v, attn._split(v), (0, 0, t, 0))
+            cache_v, attn._split(v).astype(cache_v.dtype), (0, 0, t, 0))
         scale = 1.0 / float(np.sqrt(attn.head_dim))
         scores = jnp.einsum("bhqd,bhkd->bhqk", qh, cache_k) * scale
         mask = (jnp.arange(cache_k.shape[2]) <= t)[None, None, None, :]

@@ -437,10 +437,12 @@ class FleetAggregator:
                     fleet["metrics"].setdefault(s["name"], []).append(
                         {"labels": s["labels"], "value": s["value"],
                          "source": addr})
-                    # the streaming tier's backlog, on the host row —
+                    # the streaming/serving backlog, on the host row —
                     # the signal the autoscaling policy loop scales on
-                    if s["name"] == "bigdl_stream_buffer_depth":
-                        entry["queue_depth"] = s["value"]
+                    if s["name"] in ("bigdl_stream_buffer_depth",
+                                     "bigdl_serve_queue_depth"):
+                        entry["queue_depth"] = max(
+                            entry["queue_depth"] or 0.0, s["value"])
         elif self._tailer is not None:
             for fn, snap in sorted(self._tailer.poll().items()):
                 host = snap.get("host", fn)
@@ -456,8 +458,10 @@ class FleetAggregator:
                              "value": value, "source": fn})
                         if name == "bigdl_goodput_ratio":
                             entry["goodput_ratio"] = value
-                        elif name == "bigdl_stream_buffer_depth":
-                            entry["queue_depth"] = value
+                        elif name in ("bigdl_stream_buffer_depth",
+                                      "bigdl_serve_queue_depth"):
+                            entry["queue_depth"] = max(
+                                entry["queue_depth"] or 0.0, value)
                         elif name == "bigdl_alert_active" and value:
                             rule = (s.get("labels") or {}).get("rule")
                             entry["alerts"].append({"rule": rule})

@@ -106,6 +106,15 @@ def default_rules(heartbeat_timeout: float = 60.0) -> List[dict]:
         {"name": "exposed_comm_high", "type": "threshold",
          "metric": "bigdl_overlap_exposed_comm_fraction", "op": ">",
          "value": 0.5, "for": 2, "severity": "warning"},
+        # serving tier (ISSUE 12): the LM engine publishes the fraction
+        # of recent requests completing within BIGDL_SERVE_SLO_MS as a
+        # ratio gauge; burning the 1% error budget at 2x+ sustainable
+        # means the p99 SLO is on track to be blown — the serving
+        # analogue of goodput_slo_burn.  Inert on non-serving runs
+        # (burn_rate rules never fire on an absent metric)
+        {"name": "serve_latency_slo_burn", "type": "burn_rate",
+         "metric": "bigdl_serve_latency_slo_ratio", "slo": 0.99,
+         "threshold": 2.0, "for": 2, "severity": "warning"},
     ]
 
 

@@ -332,6 +332,71 @@ def build_report(trace_dir: str, metrics_dir: Optional[str] = None) -> dict:
         },
     }
 
+    # ---- serving tier (serving/ package) -----------------------------
+    def _hist_stats(metric, key_labels=("engine", "kind")):
+        """Per-label-combo count/mean/p50/p95/p99 from the snapshot's
+        cumulative histogram buckets (summed across hosts — cumulative
+        counts add)."""
+        acc: dict = {}
+        for labels, s, _host in _metric_samples(snaps, metric):
+            key = ":".join(labels.get(k, "?") for k in key_labels)
+            cur = acc.setdefault(key, {"count": 0, "sum": 0.0,
+                                       "buckets": {}})
+            cur["count"] += int(s.get("count", 0))
+            cur["sum"] += float(s.get("sum", 0.0))
+            for le, c in s.get("buckets", []):
+                le_f = float("inf") if le in ("+Inf", "inf") \
+                    else float(le)
+                cur["buckets"][le_f] = cur["buckets"].get(le_f, 0.0) \
+                    + float(c)
+        out = {}
+        for key, cur in acc.items():
+            total = cur["count"]
+            finite = sorted(b for b in cur["buckets"]
+                            if b != float("inf"))
+
+            def q(p, _cur=cur, _total=total, _finite=finite):
+                if _total <= 0:
+                    return None
+                for le in _finite:
+                    if _cur["buckets"][le] >= p * _total:
+                        return le
+                return _finite[-1] if _finite else None
+
+            out[key] = {"count": total,
+                        "mean_s": (cur["sum"] / total) if total else None,
+                        "p50_s": q(0.5), "p95_s": q(0.95),
+                        "p99_s": q(0.99)}
+        return out
+
+    serve_requests: dict = {}
+    for labels, s, _host in _metric_samples(
+            snaps, "bigdl_serve_requests_total"):
+        key = f"{labels.get('engine', '?')}:{labels.get('status', '?')}"
+        serve_requests[key] = serve_requests.get(key, 0.0) + float(
+            s.get("value", 0.0))
+    slo_vals = [float(s.get("value", 0.0)) for _l, s, _h in
+                _metric_samples(snaps, "bigdl_serve_latency_slo_ratio")]
+    serving = None
+    if serve_requests or slo_vals:
+        serving = {
+            "requests_total": serve_requests,
+            "tokens_total": _metric_sum("bigdl_serve_tokens_total"),
+            "tokens_per_second": _metric_max(
+                "bigdl_serve_tokens_per_second"),
+            "batch_occupancy": _metric_max(
+                "bigdl_serve_batch_occupancy"),
+            "queue_depth": _metric_max("bigdl_serve_queue_depth"),
+            "kv_pages_in_use": _metric_max(
+                "bigdl_serve_kv_pages_in_use"),
+            "admission_waits": _metric_sum(
+                "bigdl_serve_admission_waits_total"),
+            "preemptions": _metric_sum(
+                "bigdl_serve_preemptions_total"),
+            "slo_ratio": min(slo_vals) if slo_vals else None,
+            "latency": _hist_stats("bigdl_request_latency_seconds"),
+        }
+
     # ---- overlapped step (ISSUE 11: bucketed exchange, async
     # checkpointing, double-buffered input) ----------------------------
     buckets = _metric_max("bigdl_overlap_buckets")
@@ -384,6 +449,7 @@ def build_report(trace_dir: str, metrics_dir: Optional[str] = None) -> dict:
         "resilience_events": resilience,
         "slow_steps": slow_steps,
         "alerts": alerts,
+        "serving": serving,
         "autoscale": autoscale,
         "overlap": overlap,
         "health": health,
@@ -477,6 +543,37 @@ def render_text(rep: dict) -> str:
                 f"  host{ev.get('host')} {ev.get('state'):>8s} "
                 f"{ev.get('rule')} [{ev.get('severity')}] "
                 f"{ev.get('metric')}={ev.get('value')}")
+    lines.append("")
+    lines.append("-- serving --")
+    sv = rep.get("serving")
+    if not sv:
+        lines.append("  (no serving activity — see bigdl_tpu/serving)")
+    else:
+        req = ", ".join(f"{k} {int(n)}" for k, n in
+                        sorted(sv.get("requests_total", {}).items()))
+        lines.append(f"  requests: {req or '(none)'}")
+        tps = sv.get("tokens_per_second")
+        lines.append(
+            f"  tokens: {int(sv.get('tokens_total') or 0)} generated"
+            + (f", {tps:.1f} tok/s" if tps else ""))
+        occ = sv.get("batch_occupancy")
+        lines.append(
+            "  batcher: occupancy "
+            + (f"{occ * 100:.0f}%" if occ is not None else "n/a")
+            + f", queue depth {sv.get('queue_depth')}"
+            + f", {int(sv.get('admission_waits') or 0)} admission "
+              "wait(s)"
+            + f", {int(sv.get('preemptions') or 0)} preemption(s)")
+        for key, st in sorted((sv.get("latency") or {}).items()):
+            def ms(v):
+                return "-" if v is None else f"{v * 1000:.1f}ms"
+
+            lines.append(
+                f"  latency {key:16s} n={st['count']} "
+                f"p50<={ms(st['p50_s'])} p95<={ms(st['p95_s'])} "
+                f"p99<={ms(st['p99_s'])}")
+        if sv.get("slo_ratio") is not None:
+            lines.append(f"  latency SLO ratio: {sv['slo_ratio']:.3f}")
     lines.append("")
     lines.append("-- autoscaling & stream --")
     asc = rep.get("autoscale") or {}

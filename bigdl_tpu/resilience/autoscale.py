@@ -66,11 +66,33 @@ _OPS = {
 }
 _ACTIONS = ("up", "down")
 SIGNALS = ("step_time_s", "queue_depth", "goodput_ratio", "alerts",
-           "stragglers", "step", "world")
+           "stragglers", "step", "world", "p99_latency_s")
 
-# the streaming tier's queue gauges (dataset/stream.py) — the
-# queue_depth signal is the max over both on any host
-_QUEUE_METRICS = ("bigdl_stream_buffer_depth", "bigdl_stream_lag_records")
+# queue gauges: the streaming tier's buffer/lag (dataset/stream.py)
+# AND the serving tier's request queue (serving/batcher.py) — the
+# queue_depth signal is the max over all of them on any host
+_QUEUE_METRICS = ("bigdl_stream_buffer_depth", "bigdl_stream_lag_records",
+                  "bigdl_serve_queue_depth")
+
+# the serving tier's e2e request-latency histogram, as exposed on
+# /metrics (bucket samples carry their literal _bucket name)
+_LATENCY_BUCKET = "bigdl_request_latency_seconds_bucket"
+
+
+def _hist_p99(buckets: dict) -> Optional[float]:
+    """p99 upper-bound from cumulative ``{le: count}`` buckets (the
+    conservative nearest-bucket estimate — +Inf falls back to the
+    largest finite bound, so a pathological tail still yields a
+    finite, breachable signal)."""
+    total = buckets.get(float("inf"), 0.0)
+    if total <= 0:
+        return None
+    finite = sorted(b for b in buckets if b != float("inf"))
+    target = 0.99 * total
+    for le in finite:
+        if buckets[le] >= target:
+            return le
+    return finite[-1] if finite else None
 
 
 @dataclasses.dataclass
@@ -103,6 +125,14 @@ def default_rules(cfg) -> List[dict]:
     if cfg.queue_low > 0:
         rules.append({"name": "queue_low", "signal": "queue_depth",
                       "op": "<", "value": cfg.queue_low, "action": "down"})
+    if cfg.p99_high > 0:
+        rules.append({"name": "latency_p99_high",
+                      "signal": "p99_latency_s", "op": ">",
+                      "value": cfg.p99_high, "action": "up"})
+    if cfg.p99_low > 0:
+        rules.append({"name": "latency_p99_low",
+                      "signal": "p99_latency_s", "op": "<",
+                      "value": cfg.p99_low, "action": "down"})
     if cfg.step_time_high > 0:
         rules.append({"name": "step_time_high", "signal": "step_time_s",
                       "op": ">", "value": cfg.step_time_high,
@@ -218,10 +248,11 @@ def derive_signals(scraped: List[dict], prev_steps: dict,
     Conservative: a signal that cannot be derived is absent, and an
     absent signal never breaches a rule."""
     sig = {"world": world, "alerts": [], "stragglers": []}
-    step_times, depths, ratios, steps = [], [], [], []
+    step_times, depths, ratios, steps, p99s = [], [], [], [], []
     for peer in scraped:
         if not peer.get("ok"):
             continue
+        lat_buckets: dict = {}
         h = peer.get("health") or {}
         addr = peer.get("addr", "?")
         step, now = h.get("step"), h.get("time")
@@ -244,6 +275,17 @@ def derive_signals(scraped: List[dict], prev_steps: dict,
         for s in (peer.get("metrics") or {}).get("samples", []):
             if s.get("name") in _QUEUE_METRICS:
                 depths.append(float(s.get("value", 0.0)))
+            elif s.get("name") == _LATENCY_BUCKET and \
+                    (s.get("labels") or {}).get("kind") == "e2e":
+                try:
+                    le = float((s.get("labels") or {}).get("le", "nan"))
+                except ValueError:
+                    le = float("inf")  # "+Inf"
+                lat_buckets[le] = lat_buckets.get(le, 0.0) + float(
+                    s.get("value", 0.0))
+        p99 = _hist_p99(lat_buckets)
+        if p99 is not None:
+            p99s.append(p99)
     if step_times:
         # the slowest host gates every synchronous collective
         sig["step_time_s"] = max(step_times)
@@ -253,6 +295,9 @@ def derive_signals(scraped: List[dict], prev_steps: dict,
         sig["goodput_ratio"] = min(ratios)
     if steps:
         sig["step"] = max(steps)
+    if p99s:
+        # the worst host's tail gates the user-facing SLO
+        sig["p99_latency_s"] = max(p99s)
     return sig
 
 

@@ -1,0 +1,33 @@
+"""Continuous-batching inference serving tier (ISSUE 12).
+
+The trained models become a traffic-serving system: a paged KV cache
+with per-request page tables (serving/cache.py), a continuous-batching
+decode engine that admits requests at step boundaries instead of
+waiting for a batch to drain (serving/engine.py), a bounded request
+queue riding the streaming tier's backpressure machinery
+(serving/batcher.py), a micro-batching classifier engine on the
+existing int8 ``quantize()`` path (serving/classifier.py), optional
+TP-sharded decode over the compressed-collective wire (serving/tp.py),
+and a stdlib HTTP front-end (serving/server.py).
+
+The loop closes through the observability planes: request-latency
+histograms + SLO burn-rate alerting (obs/alerts.py), a "serving"
+report section (obs/report.py), and request-driven autoscaling signals
+— queue depth and p99 — in resilience/autoscale.py.
+"""
+
+from bigdl_tpu.serving.batcher import RequestQueue, ServeRequest
+from bigdl_tpu.serving.cache import PagedKVCache, gather_pages
+from bigdl_tpu.serving.classifier import ClassifierEngine
+from bigdl_tpu.serving.engine import LMEngine
+from bigdl_tpu.serving.server import ServingServer
+
+__all__ = [
+    "ClassifierEngine",
+    "LMEngine",
+    "PagedKVCache",
+    "RequestQueue",
+    "ServeRequest",
+    "ServingServer",
+    "gather_pages",
+]

@@ -1,0 +1,164 @@
+"""Paged KV cache — the memory substrate of continuous batching.
+
+``TransformerLM.generate`` keeps one contiguous ``(B, H, T_total, Dh)``
+cache per layer, sized for the *longest possible* sequence and owned by
+the whole batch for the whole decode — a request that finishes early
+keeps its columns hot until the slowest batchmate drains.  Serving
+needs the vLLM-style alternative: K/V live in fixed-size **pages**
+(``(page_size, Dh)`` per head), each request owns only the pages its
+tokens actually fill (a per-slot **page table**), pages return to a
+free list the moment a request completes, and a new request is admitted
+into the freed slot at the next step boundary.
+
+Layout (one array per K and V, all layers stacked so the decode step
+carries two device buffers instead of 2·L):
+
+* ``kp``/``vp``: ``(n_layer, num_pages, n_head, page_size, head_dim)``
+  device arrays in the cache dtype (defaults to the model dtype — bf16
+  weights get a bf16 cache, halving decode HBM traffic);
+* page table: ``(max_slots, max_pages_per_slot)`` int32, host-owned and
+  shipped to the device per step (a few hundred bytes);
+* page 0 is a reserved **trash page**: unallocated table entries and
+  the padded tail of a bucketed prefill write there, and the decode
+  mask (``position <= length``) guarantees it is never read.
+
+The allocator is plain host Python — a free list and per-slot page
+lists.  Decode grows a slot one page at a time as its length crosses a
+page boundary; exhaustion is surfaced to the engine, which preempts the
+youngest request (its pages return to the pool, the request re-queues
+with its generated prefix as prompt) — the standard paged-attention
+answer to overcommit.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+
+class PagedKVCache:
+    """Host-side page allocator + device-side paged K/V buffers."""
+
+    def __init__(self, n_layer: int, n_head: int, head_dim: int, *,
+                 page_size: int = 16, num_pages: int = 64,
+                 max_slots: int = 8, max_len: int = 256,
+                 dtype=None):
+        import jax.numpy as jnp
+
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        self.n_layer = int(n_layer)
+        self.n_head = int(n_head)
+        self.head_dim = int(head_dim)
+        self.page_size = int(page_size)
+        self.max_slots = int(max_slots)
+        self.max_len = int(max_len)
+        # every slot must be able to address a full-length sequence
+        self.max_pages_per_slot = -(-self.max_len // self.page_size)
+        # +1: page 0 is the reserved trash page, never allocated
+        self.num_pages = max(int(num_pages), 2)
+        self.dtype = jnp.dtype(dtype) if dtype is not None else jnp.float32
+        shape = (self.n_layer, self.num_pages, self.n_head,
+                 self.page_size, self.head_dim)
+        self.kp = jnp.zeros(shape, self.dtype)
+        self.vp = jnp.zeros(shape, self.dtype)
+        self.page_tables = np.zeros(
+            (self.max_slots, self.max_pages_per_slot), np.int32)
+        self.lengths = np.zeros((self.max_slots,), np.int32)
+        self._free: List[int] = list(range(1, self.num_pages))
+        self._slot_pages: List[List[int]] = [[] for _ in
+                                             range(self.max_slots)]
+        from bigdl_tpu import obs
+
+        self._pages_gauge = obs.get_registry().gauge(
+            "bigdl_serve_kv_pages_in_use",
+            "KV-cache pages currently owned by in-flight requests")
+
+    # --------------------------------------------------------- allocator
+    def pages_for(self, n_tokens: int) -> int:
+        return max(1, -(-int(n_tokens) // self.page_size))
+
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def pages_in_use(self) -> int:
+        return (self.num_pages - 1) - len(self._free)
+
+    def can_admit(self, n_tokens: int) -> bool:
+        return len(self._free) >= self.pages_for(n_tokens)
+
+    def alloc(self, slot: int, n_tokens: int) -> List[int]:
+        """Give ``slot`` enough pages for ``n_tokens``; returns the page
+        ids (raises on exhaustion — the engine checks ``can_admit``
+        first and preempts on decode-time growth failure)."""
+        need = self.pages_for(n_tokens)
+        if len(self._free) < need:
+            raise RuntimeError(
+                f"KV cache exhausted: need {need} pages, "
+                f"{len(self._free)} free")
+        pages = [self._free.pop() for _ in range(need)]
+        self._slot_pages[slot] = pages
+        row = np.zeros((self.max_pages_per_slot,), np.int32)
+        row[:need] = pages
+        self.page_tables[slot] = row
+        self.lengths[slot] = 0
+        self._pages_gauge.set(float(self.pages_in_use()))
+        return pages
+
+    def grow(self, slot: int) -> bool:
+        """One more page for ``slot`` (its length is about to cross a
+        page boundary).  False on exhaustion — the engine preempts."""
+        if not self._free:
+            return False
+        pages = self._slot_pages[slot]
+        if len(pages) >= self.max_pages_per_slot:
+            return False
+        page = self._free.pop()
+        pages.append(page)
+        self.page_tables[slot, len(pages) - 1] = page
+        self._pages_gauge.set(float(self.pages_in_use()))
+        return True
+
+    def needs_growth(self, slot: int) -> bool:
+        """True when the next token's position lands past the slot's
+        allocated pages."""
+        return (int(self.lengths[slot]) // self.page_size
+                >= len(self._slot_pages[slot]))
+
+    def release(self, slot: int):
+        """Request finished (or preempted): pages back to the pool, the
+        table row points at the trash page again."""
+        self._free.extend(self._slot_pages[slot])
+        self._slot_pages[slot] = []
+        self.page_tables[slot] = 0
+        self.lengths[slot] = 0
+        self._pages_gauge.set(float(self.pages_in_use()))
+
+    def slot_pages(self, slot: int) -> List[int]:
+        return list(self._slot_pages[slot])
+
+    # ------------------------------------------------------ device state
+    def device_tables(self):
+        """(page_tables, lengths) as jnp arrays for the next step."""
+        import jax.numpy as jnp
+
+        return (jnp.asarray(self.page_tables),
+                jnp.asarray(self.lengths))
+
+    def padded_positions(self) -> int:
+        """Columns of the gathered per-slot attention window."""
+        return self.max_pages_per_slot * self.page_size
+
+
+def gather_pages(pages, page_table):
+    """``(num_pages, H, P, Dh)`` pages + ``(B, maxp)`` table ->
+    ``(B, H, maxp*P, Dh)`` per-slot contiguous K/V view (positions past
+    a slot's length are trash and must be masked by the caller)."""
+    b, maxp = page_table.shape
+    g = pages[page_table]                      # (B, maxp, H, P, Dh)
+    g = g.transpose(0, 2, 1, 3, 4)             # (B, H, maxp, P, Dh)
+    return g.reshape(b, g.shape[1], maxp * g.shape[3], g.shape[4])
+
+
+__all__ = ["PagedKVCache", "gather_pages"]

@@ -1,0 +1,637 @@
+"""Continuous-batching LM decode engine.
+
+``TransformerLM.generate`` decodes one fixed batch to completion — the
+whole batch waits for its slowest member (head-of-line blocking), and a
+new request waits for the whole batch to drain.  This engine replaces
+that with the production shape:
+
+* **slots**: up to ``max_batch`` requests decode together in one jitted
+  step over the paged KV cache (serving/cache.py);
+* **continuous admission**: at every step boundary, free slots are
+  refilled from the request queue (serving/batcher.py) — a finished
+  request's slot and pages are reused immediately, not when the batch
+  drains (``admission="static"`` keeps the drain-first behavior as the
+  A/B baseline the serve smoke measures against);
+* **prefill/decode split**: a new request's prompt runs one batched
+  forward (``TransformerBlock.prefill`` — the identical attention path
+  training uses) padded to a page-aligned bucket, writing its K/V pages
+  and producing its first token; the shared decode step then advances
+  every active slot one token;
+* **int8 decode** (``int8=True``): the decode matmuls run on
+  pre-quantized per-output-channel int8 weights via the existing
+  ``ops.quantized_matmul`` path (the same math ``module.quantize()``
+  rides) — decode is memory-bound, so halving/quartering weight bytes
+  is the lever; prefill stays float (it is compute-bound);
+* **TP-sharded decode** (``tp=N``): the step runs under shard_map with
+  Megatron row/col-split weights and the block reductions on
+  ``parallel/wire.py``'s compressed collectives (serving/tp.py);
+* **preemption**: if the page pool is exhausted mid-decode, the
+  youngest request is preempted — pages freed, the request re-queued
+  with its generated prefix as prompt — instead of deadlocking the
+  batch.
+
+Telemetry closes the serving loop: ``bigdl_request_latency_seconds
+{engine,kind=ttft|per_token|e2e}`` histograms, token/request counters,
+batch-occupancy and queue-depth gauges (the autoscaler's signals), a
+``bigdl_serve_latency_slo_ratio`` gauge the p99 burn-rate alert rule
+watches, and the live ``/healthz`` step stamp via ``obs.server``.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from bigdl_tpu.serving.batcher import RequestQueue, ServeRequest
+from bigdl_tpu.serving.cache import PagedKVCache, gather_pages
+
+LAT_META = ("bigdl_request_latency_seconds",
+            "Request latency by engine and kind (ttft = time to first "
+            "token, per_token = mean inter-token, e2e = submit to done)")
+
+
+def _quantize_tree(params, n_layer):
+    """Per-output-channel int8 twins of every decode matmul weight —
+    the ``quantize_per_channel`` path ``module.quantize()`` uses."""
+    from bigdl_tpu.ops.quantized_matmul import quantize_per_channel
+
+    q = {}
+    for i in range(n_layer):
+        pa = params[f"h{i}"]["attn"]
+        blk = {"attn": {}, "fc1": None, "fc2": None}
+        for w in ("wq", "wk", "wv", "wo"):
+            blk["attn"][w] = quantize_per_channel(pa[w], axis=0)
+        blk["fc1"] = quantize_per_channel(
+            params[f"h{i}"]["fc1"]["weight"], axis=0)
+        blk["fc2"] = quantize_per_channel(
+            params[f"h{i}"]["fc2"]["weight"], axis=0)
+        q[f"h{i}"] = blk
+    q["head"] = quantize_per_channel(params["head"]["weight"], axis=0)
+    return q
+
+
+def paged_decode_math(children, n_layer, page_size, params, qparams,
+                      kp, vp, tables, lengths, tokens, temps, active,
+                      key, *, n_head=None, psum=None):
+    """One decode step over the paged cache — the single source of
+    truth shared by the jitted single-host step and the TP shard_map
+    body (``n_head`` is the LOCAL head count there, ``psum`` the
+    compressed block reduction).  Mirrors
+    ``TransformerBlock.decode_step`` exactly in the float path so paged
+    decode bit-matches ``generate()`` at temperature 0."""
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.ops.quantized_matmul import int8_matmul
+
+    attn0 = children["h0"]._children["attn"]
+    heads = attn0.n_head if n_head is None else int(n_head)
+    head_dim = attn0.head_dim
+    bsz = tokens.shape[0]
+    scale = 1.0 / float(np.sqrt(head_dim))
+
+    def mm(x, w, qw):
+        if qparams is not None and qw is not None:
+            return int8_matmul(x, qw[0], qw[1])
+        return jnp.matmul(x, w.T)
+
+    x = jnp.take(params["wte"]["weight"], tokens, axis=0)[:, None, :]
+    x = x + jnp.take(params["wpe"]["weight"], lengths, axis=0)[:, None, :]
+    for i in range(n_layer):
+        block = children[f"h{i}"]
+        p = params[f"h{i}"]
+        pa = p["attn"]
+        qb = None if qparams is None else qparams[f"h{i}"]
+        h, _ = block._children["ln1"].apply(p["ln1"], {}, x)
+        if qb is None:
+            q, k, v = block._project_qkv(pa, h)
+        else:
+            q = mm(h, pa["wq"], qb["attn"]["wq"])
+            k = mm(h, pa["wk"], qb["attn"]["wk"])
+            v = mm(h, pa["wv"], qb["attn"]["wv"])
+            if pa.get("bq") is not None:
+                q, k, v = q + pa["bq"], k + pa["bk"], v + pa["bv"]
+
+        def split(t):
+            return t.reshape(bsz, 1, heads, head_dim).transpose(0, 2, 1, 3)
+
+        qh = split(q)
+        kh = split(k)[:, :, 0, :]            # (B, H, Dh)
+        vh = split(v)[:, :, 0, :]
+        pidx = jnp.take_along_axis(
+            tables, (lengths // page_size)[:, None], axis=1)[:, 0]
+        off = lengths % page_size
+        kp = kp.at[i, pidx, :, off, :].set(kh.astype(kp.dtype))
+        vp = vp.at[i, pidx, :, off, :].set(vh.astype(vp.dtype))
+        kall = gather_pages(kp[i], tables)   # (B, H, maxp*P, Dh)
+        vall = gather_pages(vp[i], tables)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", qh, kall) * scale
+        mask = (jnp.arange(kall.shape[2])[None, None, None, :]
+                <= lengths[:, None, None, None])
+        scores = jnp.where(mask, scores, -jnp.inf)
+        probs = jax.nn.softmax(scores, axis=-1)
+        o = jnp.einsum("bhqk,bhkd->bhqd", probs, vall)
+        o = o.transpose(0, 2, 1, 3).reshape(bsz, 1, heads * head_dim)
+        y = mm(o, pa["wo"], None if qb is None else qb["attn"]["wo"])
+        if psum is not None:
+            y = psum(y)
+        if pa.get("bo") is not None:
+            y = y + pa["bo"]
+        x = x + y
+        # MLP (pre-LN): bias of the row-parallel fc1 is local, the
+        # col-parallel fc2's bias is added once, after the reduction
+        h, _ = block._children["ln2"].apply(p["ln2"], {}, x)
+        h = mm(h, p["fc1"]["weight"],
+               None if qb is None else qb["fc1"]) + p["fc1"]["bias"]
+        h = jax.nn.gelu(h)
+        h = mm(h, p["fc2"]["weight"],
+               None if qb is None else qb["fc2"])
+        if psum is not None:
+            h = psum(h)
+        if p["fc2"].get("bias") is not None:
+            h = h + p["fc2"]["bias"]
+        x = x + h
+    h, _ = children["ln_f"].apply(params["ln_f"], {}, x)
+    logits = mm(h, params["head"]["weight"],
+                None if qparams is None else qparams["head"])[:, 0, :]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    sampled = jax.random.categorical(
+        key, logits / jnp.maximum(temps, 1e-6)[:, None],
+        axis=-1).astype(jnp.int32)
+    nxt = jnp.where(temps > 0.0, sampled, greedy)
+    nxt = jnp.where(active, nxt, 0)
+    return kp, vp, nxt
+
+
+class _Active:
+    """Host bookkeeping for one occupied slot."""
+
+    __slots__ = ("req", "remaining", "last_token", "prompt_len",
+                 "t_admit", "order")
+
+    def __init__(self, req, remaining, last_token, prompt_len, order):
+        self.req = req
+        self.remaining = remaining
+        self.last_token = last_token
+        self.prompt_len = prompt_len
+        self.t_admit = time.monotonic()
+        self.order = order
+
+
+class LMEngine:
+    """Continuous-batching decode over a :class:`PagedKVCache`."""
+
+    def __init__(self, model, params=None, *, max_batch: Optional[int] = None,
+                 page_size: Optional[int] = None,
+                 num_pages: Optional[int] = None,
+                 queue_capacity: Optional[int] = None,
+                 int8: Optional[bool] = None, tp: int = 1, wire=None,
+                 cache_dtype=None, eos_id: Optional[int] = None,
+                 slo_s: Optional[float] = None,
+                 admission: Optional[str] = None, seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+
+        from bigdl_tpu.config import refresh_from_env
+
+        cfg = refresh_from_env().serve
+        self.model = model
+        self.params = model.params() if params is None else params
+        self.max_batch = int(max_batch or cfg.max_batch)
+        self.page_size = int(page_size or cfg.page_size)
+        self.int8 = cfg.int8 if int8 is None else bool(int8)
+        self.tp = int(tp or 1)
+        self.eos_id = eos_id
+        self.slo_s = cfg.slo_s if slo_s is None else float(slo_s)
+        self.admission = admission or cfg.admission
+        if self.admission not in ("continuous", "static"):
+            raise ValueError(
+                f"admission must be continuous|static, got "
+                f"{self.admission!r}")
+        if self.int8 and self.tp > 1:
+            raise ValueError("int8 decode and tp-sharded decode are "
+                             "currently exclusive")
+        mc = model._config
+        self.max_len = int(mc["max_len"])
+        self.n_layer = model.n_layer
+        self.n_head = int(mc["n_head"])
+        self.head_dim = model.dim // self.n_head
+        if cache_dtype is None:
+            cache_dtype = self.params["wte"]["weight"].dtype
+        pages = num_pages or cfg.num_pages or (
+            1 + self.max_batch * -(-self.max_len // self.page_size))
+        self.cache = PagedKVCache(
+            self.n_layer, self.n_head, self.head_dim,
+            page_size=self.page_size, num_pages=pages,
+            max_slots=self.max_batch, max_len=self.max_len,
+            dtype=cache_dtype)
+        self.queue = RequestQueue(queue_capacity or cfg.queue_capacity)
+        self._slots: List[Optional[_Active]] = [None] * self.max_batch
+        self._stash: collections.deque = collections.deque()
+        self._key = jax.random.key(int(seed))
+        self._qparams = (_quantize_tree(self.params, self.n_layer)
+                        if self.int8 else None)
+        self._order = 0
+        self._steps = 0
+        self._occ_sum = 0.0
+        self._tokens_total = 0
+        self._t_first_work: Optional[float] = None
+        self._t_last_done: Optional[float] = None
+        self.completed: List[dict] = []
+        self._slo_window: collections.deque = collections.deque(maxlen=256)
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.RLock()
+
+        if self.tp > 1:
+            from bigdl_tpu.serving.tp import build_tp_decode_step
+
+            self._step_fn = build_tp_decode_step(
+                model, tp=self.tp, wire=wire, page_size=self.page_size,
+                max_batch=self.max_batch,
+                positions=self.cache.padded_positions())
+        else:
+            self._step_fn = self._build_step()
+            self.params = jax.tree.map(
+                jnp.asarray, self.params,
+                is_leaf=lambda x: x is None or hasattr(x, "shape"))
+        self._prefill_fns: dict = {}
+        from bigdl_tpu import obs
+
+        reg = obs.get_registry()
+        self._lat = reg.histogram(*LAT_META, labels=("engine", "kind"))
+        self._tokens_counter = reg.counter(
+            "bigdl_serve_tokens_total", "Tokens generated by the LM "
+            "decode engine")
+        self._req_counter = reg.counter(
+            "bigdl_serve_requests_total",
+            "Requests completed, by engine and status",
+            labels=("engine", "status"))
+        self._occ_gauge = reg.gauge(
+            "bigdl_serve_batch_occupancy",
+            "Mean fraction of decode slots occupied per step")
+        self._tps_gauge = reg.gauge(
+            "bigdl_serve_tokens_per_second",
+            "LM decode throughput over the engine's busy wall clock")
+        self._slo_gauge = reg.gauge(
+            "bigdl_serve_latency_slo_ratio",
+            "Fraction of recent requests completing within the "
+            "latency SLO (feeds the serve_latency_slo_burn alert)")
+        self._preempt_counter = reg.counter(
+            "bigdl_serve_preemptions_total",
+            "Requests preempted (pages reclaimed, request re-queued) "
+            "on KV-page exhaustion")
+
+    # -------------------------------------------------------- jit builders
+    def _build_step(self):
+        import jax
+
+        children = self.model._children
+        n_layer, page_size = self.n_layer, self.page_size
+        qparams = self._qparams
+
+        def step(params, kp, vp, tables, lengths, tokens, temps,
+                 active, key):
+            return paged_decode_math(
+                children, n_layer, page_size, params, qparams, kp, vp,
+                tables, lengths, tokens, temps, active, key)
+
+        return jax.jit(step, donate_argnums=(1, 2))
+
+    def _prefill_fn(self, bucket: int):
+        fn = self._prefill_fns.get(bucket)
+        if fn is not None:
+            return fn
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        children = self.model._children
+        n_layer, page_size = self.n_layer, self.page_size
+        dim = self.model.dim
+        n_write = bucket // page_size
+
+        def prefill(params, kp, vp, prompt, t0, pages, temp, key):
+            # prompt is (1, bucket), zero-padded past t0 — causal
+            # attention keeps the real prefix exact
+            x = jnp.take(params["wte"]["weight"], prompt, axis=0)
+            x = x + params["wpe"]["weight"][:bucket][None]
+            for i in range(n_layer):
+                x, kh, vh = children[f"h{i}"].prefill(params[f"h{i}"], x)
+                for j in range(n_write):
+                    kp = kp.at[i, pages[j]].set(
+                        kh[0, :, j * page_size:(j + 1) * page_size,
+                           :].astype(kp.dtype))
+                    vp = vp.at[i, pages[j]].set(
+                        vh[0, :, j * page_size:(j + 1) * page_size,
+                           :].astype(vp.dtype))
+            h = lax.dynamic_slice(x, (0, t0 - 1, 0), (1, 1, dim))
+            h, _ = children["ln_f"].apply(params["ln_f"], {}, h)
+            logits, _ = children["head"].apply(params["head"], {}, h)
+            logits = logits[:, 0, :]
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            sampled = jax.random.categorical(
+                key, logits / jnp.maximum(temp, 1e-6),
+                axis=-1).astype(jnp.int32)
+            first = jnp.where(temp > 0.0, sampled, greedy)
+            return kp, vp, first[0]
+
+        fn = jax.jit(prefill, donate_argnums=(1, 2))
+        self._prefill_fns[bucket] = fn
+        return fn
+
+    def _bucket(self, t0: int) -> int:
+        b = self.page_size
+        while b < t0:
+            b *= 2
+        return min(b, -(-self.max_len // self.page_size) * self.page_size)
+
+    # ------------------------------------------------------------- clients
+    def submit(self, prompt, max_new_tokens: int, *,
+               temperature: float = 0.0,
+               timeout: Optional[float] = None) -> ServeRequest:
+        prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if len(prompt) + int(max_new_tokens) > self.max_len:
+            raise ValueError(
+                f"prompt {len(prompt)} + {max_new_tokens} new tokens "
+                f"exceeds max_len {self.max_len}")
+        if int(max_new_tokens) < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        # feasibility: a request that can NEVER fit the page pool even
+        # alone would preempt-loop forever — reject it at the door
+        worst = self.cache.pages_for(len(prompt) + int(max_new_tokens))
+        if worst > self.cache.num_pages - 1:
+            raise ValueError(
+                f"request needs {worst} KV pages but the pool has "
+                f"{self.cache.num_pages - 1}")
+        req = ServeRequest(payload=prompt,
+                           max_new_tokens=int(max_new_tokens),
+                           temperature=float(temperature))
+        return self.queue.submit(req, timeout=timeout)
+
+    # ----------------------------------------------------------- admission
+    def _free_slots(self) -> List[int]:
+        return [i for i, s in enumerate(self._slots) if s is None]
+
+    def active_count(self) -> int:
+        return sum(1 for s in self._slots if s is not None)
+
+    def _admit(self, wait_s: float = 0.0) -> int:
+        free = self._free_slots()
+        if not free:
+            return 0
+        if self.admission == "static" and self.active_count():
+            return 0  # static batching: drain fully before refilling
+        wanted = len(free)
+        incoming = list(self._stash)
+        self._stash.clear()
+        if len(incoming) < wanted:
+            incoming.extend(
+                self.queue.take(wanted - len(incoming), timeout=wait_s))
+        admitted = 0
+        for req in incoming:
+            slot = None
+            for i, s in enumerate(self._slots):
+                if s is None:
+                    slot = i
+                    break
+            # pages are allocated for the PROMPT, not the (pow2) compile
+            # bucket — the bucket's padded tail writes to the trash page
+            if slot is None or not self.cache.can_admit(len(req.payload)):
+                self._stash.append(req)  # head-of-line, retried first
+                continue
+            self._prefill_into(slot, req, self._bucket(len(req.payload)))
+            admitted += 1
+        return admitted
+
+    def _prefill_into(self, slot: int, req: ServeRequest, bucket: int):
+        import jax
+        import jax.numpy as jnp
+
+        t0 = len(req.payload)
+        pages = self.cache.alloc(slot, t0)
+        page_arg = np.zeros((bucket // self.page_size,), np.int32)
+        page_arg[:len(pages)] = pages
+        prompt = np.zeros((1, bucket), np.int32)
+        prompt[0, :t0] = req.payload
+        self._key, sub = jax.random.split(self._key)
+        kp, vp, first = self._prefill_fn(bucket)(
+            self.params, self.cache.kp, self.cache.vp,
+            jnp.asarray(prompt), t0, jnp.asarray(page_arg),
+            float(req.temperature), sub)
+        self.cache.kp, self.cache.vp = kp, vp
+        self.cache.lengths[slot] = t0
+        tok = int(first)
+        if req.t_first is None:
+            req.t_first = time.monotonic()
+            self._lat.labels(engine="lm", kind="ttft").observe(
+                req.t_first - req.t_submit)
+        req.tokens.append(tok)
+        self._tokens_total += 1
+        self._tokens_counter.inc()
+        if self._t_first_work is None:
+            self._t_first_work = time.monotonic()
+        self._order += 1
+        act = _Active(req, req.max_new_tokens - 1, tok, t0, self._order)
+        self._slots[slot] = act
+        from bigdl_tpu import obs
+
+        obs.get_tracer().event("serve.admit", slot=slot, request=req.id,
+                               prompt_len=t0, bucket=bucket)
+        if act.remaining <= 0 or tok == self.eos_id:
+            self._complete(slot)
+
+    def _preempt_youngest(self) -> Optional[int]:
+        """Free the youngest active slot's pages; its request re-queues
+        with the generated prefix folded into the prompt."""
+        victims = [(s.order, i) for i, s in enumerate(self._slots)
+                   if s is not None]
+        if not victims:
+            return None
+        _, slot = max(victims)
+        act = self._slots[slot]
+        req = act.req
+        # generated-since-admission tokens fold into the prompt; the
+        # still-owed budget becomes the new max_new_tokens (req.tokens
+        # keeps everything, so the client sees one contiguous output)
+        gen = req.max_new_tokens - act.remaining
+        req.payload = list(req.payload) + [int(t) for t in
+                                           req.tokens[-gen:]]
+        req.max_new_tokens = act.remaining
+        self.cache.release(slot)
+        self._slots[slot] = None
+        self._stash.appendleft(req)
+        self._preempt_counter.inc()
+        from bigdl_tpu import obs
+
+        obs.get_tracer().event("serve.preempt", slot=slot,
+                               request=req.id, owed=act.remaining)
+        return slot
+
+    # ---------------------------------------------------------------- step
+    def _complete(self, slot: int, error: Optional[str] = None):
+        act = self._slots[slot]
+        self.cache.release(slot)
+        self._slots[slot] = None
+        req = act.req
+        req.finish(error)
+        now = time.monotonic()
+        self._t_last_done = now
+        e2e = req.e2e_s
+        self._lat.labels(engine="lm", kind="e2e").observe(e2e)
+        n_tok = len(req.tokens)
+        if n_tok > 1:
+            self._lat.labels(engine="lm", kind="per_token").observe(
+                (req.t_done - req.t_first) / (n_tok - 1))
+        self._req_counter.labels(
+            engine="lm", status="error" if error else "ok").inc()
+        self.completed.append(
+            {"id": req.id, "e2e_s": e2e, "ttft_s": req.ttft_s,
+             "tokens": n_tok})
+        if self.slo_s > 0:
+            self._slo_window.append(1.0 if e2e <= self.slo_s else 0.0)
+            self._slo_gauge.set(
+                sum(self._slo_window) / len(self._slo_window))
+        if self._t_first_work is not None and now > self._t_first_work:
+            self._tps_gauge.set(
+                self._tokens_total / (now - self._t_first_work))
+
+    def _step(self):
+        import jax
+        import jax.numpy as jnp
+
+        active_slots = [i for i, s in enumerate(self._slots)
+                        if s is not None]
+        if not active_slots:
+            return False
+        # grow pages where the next position crosses a page boundary;
+        # exhaustion preempts the youngest request (possibly this one)
+        for slot in list(active_slots):
+            if self._slots[slot] is None:
+                continue
+            while self.cache.needs_growth(slot):
+                if self.cache.grow(slot):
+                    continue
+                victim = self._preempt_youngest()
+                if victim is None or victim == slot:
+                    break
+        active_slots = [i for i, s in enumerate(self._slots)
+                        if s is not None]
+        if not active_slots:
+            return False
+        tokens = np.zeros((self.max_batch,), np.int32)
+        temps = np.zeros((self.max_batch,), np.float32)
+        active = np.zeros((self.max_batch,), bool)
+        for i in active_slots:
+            tokens[i] = self._slots[i].last_token
+            temps[i] = self._slots[i].req.temperature
+            active[i] = True
+        tables, lengths = self.cache.device_tables()
+        self._key, sub = jax.random.split(self._key)
+        kp, vp, nxt = self._step_fn(
+            self.params, self.cache.kp, self.cache.vp, tables, lengths,
+            jnp.asarray(tokens), jnp.asarray(temps), jnp.asarray(active),
+            sub)
+        self.cache.kp, self.cache.vp = kp, vp
+        nxt = np.asarray(nxt)
+        self._steps += 1
+        self._occ_sum += len(active_slots) / self.max_batch
+        self._occ_gauge.set(self._occ_sum / self._steps)
+        for i in active_slots:
+            act = self._slots[i]
+            tok = int(nxt[i])
+            self.cache.lengths[i] += 1
+            act.last_token = tok
+            act.remaining -= 1
+            act.req.tokens.append(tok)
+            self._tokens_total += 1
+            self._tokens_counter.inc()
+            if act.remaining <= 0 or tok == self.eos_id:
+                self._complete(i)
+        try:
+            from bigdl_tpu.obs import server as obs_server
+
+            obs_server.note_step(self._steps)
+        except Exception:  # noqa: BLE001 — telemetry must not kill serving
+            pass
+        return True
+
+    # ---------------------------------------------------------- driving
+    def pump(self, wait_s: float = 0.0) -> bool:
+        """One admission + decode cycle; True while there is work."""
+        with self._lock:
+            self._admit(wait_s=wait_s if not self.active_count() else 0.0)
+            stepped = self._step()
+            return stepped or bool(self._stash) \
+                or self.queue.depth() > 0
+
+    def run_until_idle(self, timeout_s: float = 60.0):
+        """Drive synchronously until queue + slots drain (tests/smokes)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if not self.pump(wait_s=0.01):
+                if self.queue.depth() == 0 and not self.active_count() \
+                        and not self._stash:
+                    return
+        raise TimeoutError(f"engine not idle after {timeout_s:g}s")
+
+    def start(self):
+        if self._thread is not None:
+            return self
+        self._stop = False
+
+        def loop():
+            while not self._stop:
+                if not self.pump(wait_s=0.02):
+                    time.sleep(0.002)
+
+        self._thread = threading.Thread(
+            target=loop, name="bigdl-serve-lm", daemon=True)
+        self._thread.start()
+        return self
+
+    def close(self):
+        self._stop = True
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        self.queue.close()
+
+    # ------------------------------------------------------------- stats
+    def stats(self) -> dict:
+        e2e = [c["e2e_s"] for c in self.completed]
+        ttft = [c["ttft_s"] for c in self.completed
+                if c["ttft_s"] is not None]
+        busy = None
+        if self._t_first_work is not None and self._t_last_done:
+            busy = self._t_last_done - self._t_first_work
+
+        def pct(vals, q):
+            return float(np.percentile(vals, q)) if vals else None
+
+        return {
+            "requests": len(self.completed),
+            "tokens": self._tokens_total,
+            "steps": self._steps,
+            "busy_s": busy,
+            "tokens_per_s": (self._tokens_total / busy
+                             if busy else None),
+            "occupancy_mean": (self._occ_sum / self._steps
+                               if self._steps else None),
+            "queue_depth": self.queue.depth(),
+            "preemptions": int(self._preempt_counter._solo().value),
+            "e2e_p50_s": pct(e2e, 50), "e2e_p99_s": pct(e2e, 99),
+            "ttft_p50_s": pct(ttft, 50), "ttft_p99_s": pct(ttft, 99),
+            "admission": self.admission,
+            "int8": self.int8,
+            "tp": self.tp,
+        }
+
+
+__all__ = ["LMEngine", "paged_decode_math"]

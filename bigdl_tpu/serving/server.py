@@ -1,0 +1,145 @@
+"""HTTP front-end for the serving tier — stdlib only, like obs/server.
+
+One :class:`ThreadingHTTPServer` fronting an :class:`LMEngine` and/or a
+:class:`ClassifierEngine`:
+
+* ``POST /v1/generate``  ``{"prompt": [ids], "max_new_tokens": N,
+  "temperature": t}`` -> ``{"tokens": [...], "ttft_s": ..,
+  "e2e_s": ..}`` (blocks until the request completes — each client
+  connection holds one handler thread, which is exactly the concurrent-
+  clients shape the serve smoke drives);
+* ``POST /v1/classify`` ``{"inputs": [[...], ...]}`` ->
+  ``{"outputs": [[...]], "classes": [...]}``;
+* ``GET /stats`` -> both engines' stats dicts;
+* ``GET /healthz`` -> liveness (the *metrics* endpoint stays obs/server
+  — one telemetry plane, not two).
+
+Port 0 binds an ephemeral port (``.port`` has the real one).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+log = logging.getLogger("bigdl_tpu.serving")
+
+
+class ServingServer:
+    def __init__(self, lm=None, classifier=None, *,
+                 port: Optional[int] = None, host: str = "127.0.0.1",
+                 request_timeout_s: float = 60.0):
+        from bigdl_tpu.config import refresh_from_env
+
+        cfg = refresh_from_env().serve
+        if port is None:
+            port = cfg.port if cfg.port is not None else 0
+        self.lm = lm
+        self.classifier = classifier
+        self.request_timeout_s = float(request_timeout_s)
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # noqa: A003
+                log.debug("serving: " + fmt, *args)
+
+            def _send(self, obj, code=200):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                if self.path == "/healthz":
+                    return self._send({"status": "ok"})
+                if self.path == "/stats":
+                    return self._send({
+                        "lm": outer.lm.stats() if outer.lm else None,
+                        "classifier": (outer.classifier.stats()
+                                       if outer.classifier else None)})
+                return self._send({"error": "not found"}, 404)
+
+            def _body(self):
+                n = int(self.headers.get("Content-Length", 0) or 0)
+                return json.loads(self.rfile.read(n) or b"{}")
+
+            def do_POST(self):  # noqa: N802
+                try:
+                    payload = self._body()
+                    if self.path == "/v1/generate":
+                        return self._generate(payload)
+                    if self.path == "/v1/classify":
+                        return self._classify(payload)
+                    return self._send({"error": "not found"}, 404)
+                except Exception as e:  # noqa: BLE001 — client error surface
+                    return self._send(
+                        {"error": f"{type(e).__name__}: {e}"}, 400)
+
+            def _generate(self, payload):
+                if outer.lm is None:
+                    return self._send({"error": "no LM engine"}, 503)
+                req = outer.lm.submit(
+                    payload["prompt"],
+                    int(payload.get("max_new_tokens", 16)),
+                    temperature=float(payload.get("temperature", 0.0)),
+                    timeout=outer.request_timeout_s)
+                req.wait(outer.request_timeout_s)
+                if req.error:
+                    return self._send({"error": req.error}, 500)
+                return self._send({
+                    "id": req.id, "tokens": [int(t) for t in req.tokens],
+                    "prompt_len": len(payload["prompt"]),
+                    "ttft_s": req.ttft_s, "e2e_s": req.e2e_s})
+
+            def _classify(self, payload):
+                if outer.classifier is None:
+                    return self._send(
+                        {"error": "no classifier engine"}, 503)
+                x = np.asarray(payload["inputs"], np.float32)
+                reqs = [outer.classifier.submit(
+                    row, timeout=outer.request_timeout_s) for row in x]
+                outs = []
+                for r in reqs:
+                    r.wait(outer.request_timeout_s)
+                    if r.error:
+                        return self._send({"error": r.error}, 500)
+                    outs.append(np.asarray(r.result))
+                out = np.stack(outs)
+                return self._send({
+                    "outputs": out.tolist(),
+                    "classes": np.argmax(
+                        out.reshape(out.shape[0], -1), axis=-1)
+                    .tolist()})
+
+        self._httpd = ThreadingHTTPServer((host, int(port)), Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.1},
+            name="bigdl-serving-http", daemon=True)
+        self._thread.start()
+        log.info("serving front-end on %s:%d", host, self.port)
+
+    def url(self, path: str = "/stats") -> str:
+        return f"http://{self.host}:{self.port}{path}"
+
+    def close(self):
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except Exception:  # noqa: BLE001
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+
+__all__ = ["ServingServer"]

@@ -85,7 +85,7 @@ def run(cmd, **env):
                           text=True, timeout=300)
 
 
-def main() -> int:
+def _attempt(delay: str, frac: float) -> int:
     tmp = tempfile.mkdtemp(prefix="bigdl_goodput_smoke_")
     trace_dir = os.path.join(tmp, "trace")
     metrics_dir = os.path.join(tmp, "metrics")
@@ -94,7 +94,8 @@ def main() -> int:
     for host in (0, 1):
         p = run([sys.executable, "-c", _WORKER],
                 BIGDL_PROCESS_ID=host, BIGDL_TRACE_DIR=trace_dir,
-                BIGDL_METRICS_DIR=metrics_dir, BIGDL_GOODPUT_WINDOW=4)
+                BIGDL_METRICS_DIR=metrics_dir, BIGDL_GOODPUT_WINDOW=4,
+                SMOKE_BATCH_DELAY=delay)
         assert p.returncode == 0, \
             f"host {host} worker failed:\n{p.stdout}\n{p.stderr}"
         print(f"[goodput-smoke] host {host}: starved 10-step run ok")
@@ -137,13 +138,35 @@ def main() -> int:
     assert gp["bottleneck"]["label"] == "input_bound", gp["bottleneck"]
     assert gp["hosts"] == [0, 1], gp
     # the starved run's input share must clear the classifier threshold
-    assert gp["bottleneck"]["input_fraction"] >= 0.3, gp["bottleneck"]
+    assert gp["bottleneck"]["input_fraction"] >= frac, \
+        f"input_fraction {gp['bottleneck']['input_fraction']:.3f} < " \
+        f"{frac:g} ({gp['bottleneck']})"
     print(f"[goodput-smoke] --json: ratio {ratio:.3f}, data_wait "
           f"{gp['badput_s']['data_wait']:.2f}s vs productive "
           f"{gp['productive_s']:.2f}s, bottleneck "
           f"{gp['bottleneck']['label']} (via {gp['bottleneck']['source']})")
     print("[goodput-smoke] PASS")
     return 0
+
+
+def main() -> int:
+    # the input-share threshold is a *relative* signal: on a CPU-
+    # contended machine the (tiny) compute side slows down too, eroding
+    # the starved run's input fraction.  SMOKE_INPUT_FRACTION lowers
+    # the bar explicitly; otherwise one retry with a 2x slower input
+    # pipeline restores the designed contrast.
+    frac = float(os.environ.get("SMOKE_INPUT_FRACTION", "0.3"))
+    delay = os.environ.get("SMOKE_BATCH_DELAY", "0.03")
+    try:
+        return _attempt(delay, frac)
+    except AssertionError as e:
+        if "input_fraction" not in str(e):
+            raise
+        print(f"[goodput-smoke] {e}")
+        print("[goodput-smoke] input share below threshold (busy "
+              "machine?) — retrying once with a 2x slower input "
+              "pipeline")
+        return _attempt(str(2 * float(delay)), frac)
 
 
 if __name__ == "__main__":

@@ -89,6 +89,24 @@
 #                                                # OVERLAP_SMOKE.json for
 #                                                # BENCH extras.overlap
 #                                                # (no pytest)
+#   scripts/run-tests.sh --serve                 # serving-tier smoke: the
+#                                                # continuous-batching LM
+#                                                # engine A/B'd against
+#                                                # static batching on one
+#                                                # bursty request trace
+#                                                # (must win tokens/sec at
+#                                                # equal-or-better p99),
+#                                                # concurrent HTTP clients
+#                                                # against an int8 ResNet +
+#                                                # the LM decoder, a queue-
+#                                                # driven autoscale decision
+#                                                # scraped off the live
+#                                                # /metrics endpoint, and
+#                                                # the report's serving
+#                                                # section; banks
+#                                                # SERVE_SMOKE.json for
+#                                                # BENCH extras.serve
+#                                                # (no pytest)
 #   scripts/run-tests.sh --live                  # live-telemetry smoke: a
 #                                                # 2-host run with /metrics +
 #                                                # /healthz servers on
@@ -142,6 +160,19 @@ elif [[ "${1:-}" == "--wire" ]]; then
 elif [[ "${1:-}" == "--overlap" ]]; then
   shift
   exec python scripts/overlap_smoke.py "$@"
+elif [[ "${1:-}" == "--serve" ]]; then
+  shift
+  exec python scripts/serve_smoke.py "$@"
 fi
 
-exec python -m pytest tests/ -q "${MARKER[@]}" "$@"
+# tier-1 wall clock is budgeted (ROADMAP: 870s) — print where the suite
+# sits so creeping cost is visible on every run, not just when it blows
+START=$(date +%s)
+set +e
+python -m pytest tests/ -q "${MARKER[@]}" "$@"
+rc=$?
+set -e
+ELAPSED=$(( $(date +%s) - START ))
+BUDGET=870
+echo "[run-tests] wall clock: ${ELAPSED}s of the ${BUDGET}s tier-1 budget ($(( ELAPSED * 100 / BUDGET ))%)"
+exit $rc

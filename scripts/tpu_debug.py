@@ -78,6 +78,17 @@ mode to watch it decide, and exactly-once streaming resume
 "Autoscaling & streaming training" and ``scripts/run-tests.sh
 --autoscale`` for the end-to-end 1→2→1 smoke.
 
+A SERVING deployment (bigdl_tpu/serving) that is slow or backing up
+reads the report's "serving" section first: per-kind request-latency
+percentiles (ttft / per_token / e2e), tokens/sec, batcher occupancy
+and queue depth.  Low occupancy with a deep queue means admission is
+starved (pages exhausted? check bigdl_serve_kv_pages_in_use and
+preemptions); high occupancy with a rising p99 means the world is
+undersized — the autoscaler's queue band (BIGDL_AUTOSCALE_QUEUE_*) and
+latency band (BIGDL_AUTOSCALE_P99_*) scale on exactly these signals.
+See MIGRATION.md "Inference serving" and ``scripts/run-tests.sh
+--serve`` for the end-to-end smoke.
+
 A run you need to watch RIGHT NOW (not post-mortem) has the live
 telemetry plane: export ``BIGDL_OBS_PORT`` and curl the host's
 ``/healthz`` (status / last-step age / live goodput / firing alerts)

@@ -36,8 +36,10 @@ class TestRingAttention:
 
         ref = _reference_attention(q, k, v, causal=causal, scale=d**-0.5)
         mesh = _mesh({"seq": 8})
-        out = ring_attention_sharded(q, k, v, mesh, seq_axis="seq",
-                                     causal=causal)
+        # jit: one compile of the 7-hop ring beats eager per-op
+        # shard_map dispatch by ~10x wall clock, identical numerics
+        out = jax.jit(lambda a, b_, c: ring_attention_sharded(
+            a, b_, c, mesh, seq_axis="seq", causal=causal))(q, k, v)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=1e-5, atol=1e-5)
 
@@ -52,8 +54,9 @@ class TestRingAttention:
             for _ in range(3)
         )
         mesh = _mesh({"data": 2, "seq": 4})
-        out = ring_attention_sharded(q, k, v, mesh, seq_axis="seq",
-                                     batch_axis="data", causal=True)
+        out = jax.jit(lambda a, b_, c: ring_attention_sharded(
+            a, b_, c, mesh, seq_axis="seq", batch_axis="data",
+            causal=True))(q, k, v)
         ref = _reference_attention(q, k, v, causal=True, scale=d**-0.5)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=1e-5, atol=1e-5)
@@ -82,7 +85,7 @@ class TestRingAttention:
             return jnp.sum(ringm.update_output_pure(p, x) ** 2)
 
         ld, gd = jax.value_and_grad(f_dense)(p)
-        lr, gr = jax.value_and_grad(f_ring)(p)
+        lr, gr = jax.jit(jax.value_and_grad(f_ring))(p)
         np.testing.assert_allclose(float(ld), float(lr), rtol=1e-5)
         for name in ("wq", "wo"):
             np.testing.assert_allclose(np.asarray(gd[name]),

@@ -498,8 +498,10 @@ class TestMoEWire:
         x = jnp.asarray(np.random.RandomState(0).randn(
             2, 16, 8).astype(np.float32))
         p = {k: getattr(moe, k) for k in moe.param_names}
-        y0 = np.asarray(moe.update_output_pure(p, x))
-        y1 = np.asarray(moew.update_output_pure(p, x))
+        # jit both forwards (savings gauge + a2a counters are recorded
+        # at trace time — once per call either way)
+        y0 = np.asarray(jax.jit(moe.update_output_pure)(p, x))
+        y1 = np.asarray(jax.jit(moew.update_output_pure)(p, x))
         rel = np.abs(y0 - y1).mean() / (np.abs(y0).mean() + 1e-9)
         assert 0 < rel < 0.15, rel
         assert _gauge("moe") is not None and _gauge("moe") > 3.0
@@ -534,7 +536,7 @@ class TestMoEWire:
             y, aux = moew.forward_with_aux(pp, x)
             return jnp.sum(y * y) + aux
 
-        g = jax.grad(loss)(p)
+        g = jax.jit(jax.grad(loss))(p)
         leaves = jax.tree.leaves(g)
         assert all(bool(np.isfinite(np.asarray(t)).all())
                    for t in leaves)
@@ -564,11 +566,15 @@ class TestRingWire:
 
         mesh = self._mesh()
         q, k, v = self._qkv()
-        base = np.asarray(ring_attention_sharded(
-            q, k, v, mesh, causal=True))
+        # jit: the compressed ring unrolls per-hop quantize graphs —
+        # one compile beats eager op-by-op dispatch by ~10x wall clock;
+        # byte accounting rides trace time either way (once per call)
+        base = np.asarray(jax.jit(lambda a, b, c: ring_attention_sharded(
+            a, b, c, mesh, causal=True))(q, k, v))
         obs.reset()
-        wired = np.asarray(ring_attention_sharded(
-            q, k, v, mesh, causal=True, wire=WireSpec("int8", block=64)))
+        wired = np.asarray(jax.jit(lambda a, b, c: ring_attention_sharded(
+            a, b, c, mesh, causal=True,
+            wire=WireSpec("int8", block=64)))(q, k, v))
         rel = np.abs(base - wired).mean() / np.abs(base).mean()
         assert 0 < rel < 0.1, rel
         # local K block 1*2*8*8 = 128 elems (block-aligned): K and V
@@ -593,7 +599,10 @@ class TestRingWire:
                 q, kk, v, mesh, wire=WireSpec("int8", block=64))
             return jnp.sum(out * out)
 
-        g = np.asarray(jax.grad(loss)(k))
+        # jitted: the grad of the unrolled compressed-hop graph is the
+        # single slowest eager dispatch in the suite (>100s); compiled
+        # it is ~1s with identical gradients
+        g = np.asarray(jax.jit(jax.grad(loss))(k))
         assert np.isfinite(g).all() and np.abs(g).sum() > 0
 
 
