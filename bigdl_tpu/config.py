@@ -359,6 +359,15 @@ class ServeConfig:
     # HTTP front-end port for serving/server.py (0 = ephemeral);
     # unset = constructor default [BIGDL_SERVE_PORT]
     port: Optional[int] = None
+    # paged decode-attention dispatch (ops/decode_attention.py):
+    # "auto" = the static dense policy, overridden per shape by the
+    # cached decode_attn auto-tuner site when BIGDL_TUNER=1; "dense" /
+    # "fused" / "pallas" pin an impl [BIGDL_SERVE_DECODE_ATTN]
+    decode_attn: str = "auto"
+    # slice each step's page tables to the pow2 used-page prefix so
+    # even the dense baseline stops gathering the empty pool
+    # [BIGDL_SERVE_DECODE_BUCKET]
+    decode_bucket: bool = True
 
     @classmethod
     def from_env(cls) -> "ServeConfig":
@@ -371,6 +380,8 @@ class ServeConfig:
             slo_s=_env_float("BIGDL_SERVE_SLO_MS", 0.0) / 1000.0,
             admission=_env_str("BIGDL_SERVE_ADMISSION", "continuous"),
             port=_env_opt_int("BIGDL_SERVE_PORT", None),
+            decode_attn=_env_str("BIGDL_SERVE_DECODE_ATTN", "auto"),
+            decode_bucket=_env_bool("BIGDL_SERVE_DECODE_BUCKET", True),
         )
 
 

@@ -395,6 +395,10 @@ def build_report(trace_dir: str, metrics_dir: Optional[str] = None) -> dict:
                 "bigdl_serve_preemptions_total"),
             "slo_ratio": min(slo_vals) if slo_vals else None,
             "latency": _hist_stats("bigdl_request_latency_seconds"),
+            "decode_attn_ms": _metric_max(
+                "bigdl_serve_decode_attn_ms"),
+            "decode_hbm_bytes_per_token": _metric_max(
+                "bigdl_serve_decode_hbm_bytes_per_token"),
         }
 
     # ---- overlapped step (ISSUE 11: bucketed exchange, async
@@ -574,6 +578,13 @@ def render_text(rep: dict) -> str:
                 f"p99<={ms(st['p99_s'])}")
         if sv.get("slo_ratio") is not None:
             lines.append(f"  latency SLO ratio: {sv['slo_ratio']:.3f}")
+        dms = sv.get("decode_attn_ms")
+        if dms is not None:
+            bpt = sv.get("decode_hbm_bytes_per_token")
+            lines.append(
+                f"  decode: {dms:.2f}ms/step"
+                + (f", {bpt / 1e6:.2f} MB/token (HBM)"
+                   if bpt is not None else ""))
     lines.append("")
     lines.append("-- autoscaling & stream --")
     asc = rep.get("autoscale") or {}

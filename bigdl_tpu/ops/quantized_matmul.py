@@ -27,16 +27,43 @@ def quantize_per_channel(w, axis: int = 0):
     return q, scale
 
 
-def int8_matmul(x, w_q, w_scale, x_scale=None):
+def int8_matmul(x, w_q, w_scale, x_scale=None, impl=None):
     """y = x @ w_q.T * scales.
 
     x: float (..., K) activations — dynamically quantized per-row unless
     ``x_scale`` is given with an already-int8 ``x``.
     w_q: int8 (N, K); w_scale: (N, 1) float.
+
+    impl: None = the int8 ``dot_general`` path (the static policy);
+    "auto" consults the cached ``int8_mm`` auto-tuner site when
+    ``BIGDL_TUNER=1`` (ops/autotune.py — static path wins by default,
+    a measured probe can flip to "dequant"); "dequant" rescales the
+    int8 weight back to float and runs a plain matmul — fewer ops on
+    backends whose int8 gemm is slow, same per-channel quantization
+    error (the weight was already rounded to int8).
     """
     import jax.numpy as jnp
     from jax import lax
 
+    if impl in (None, "auto"):
+        chosen = "int8"
+        if impl == "auto":
+            from bigdl_tpu.ops import autotune
+
+            if autotune.enabled():
+                rec = autotune.decide_int8_mm(
+                    x.shape, w_q.shape, x.dtype,
+                    arrays=(x, w_q, w_scale))
+                if rec is not None:
+                    chosen = rec.get("impl", "int8")
+        impl = chosen
+    if impl == "dequant":
+        w = w_q.astype(jnp.float32) * w_scale          # (N, K)
+        xf = (x.astype(jnp.float32) * x_scale
+              if x_scale is not None else x)
+        return jnp.matmul(xf, w.T)
+    if impl != "int8":
+        raise ValueError(f"impl must be auto|int8|dequant, got {impl!r}")
     if x_scale is None:
         # dynamic per-row symmetric activation quantization
         absmax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)

@@ -139,12 +139,21 @@ class PagedKVCache:
         return list(self._slot_pages[slot])
 
     # ------------------------------------------------------ device state
-    def device_tables(self):
-        """(page_tables, lengths) as jnp arrays for the next step."""
+    def device_tables(self, pages: Optional[int] = None):
+        """(page_tables, lengths) as jnp arrays for the next step.
+
+        ``pages`` slices the table to its first N columns — the
+        engine's used-page prefix bucket (ops/decode_attention.py
+        ``used_page_bucket``), so a mostly-empty pool ships a few
+        dozen bytes and the decode step never gathers the unallocated
+        tail.  Entries past a slot's pages are 0 (trash) either way —
+        the mask contract is unchanged."""
         import jax.numpy as jnp
 
-        return (jnp.asarray(self.page_tables),
-                jnp.asarray(self.lengths))
+        tables = self.page_tables
+        if pages is not None and pages < self.max_pages_per_slot:
+            tables = tables[:, :int(pages)]
+        return (jnp.asarray(tables), jnp.asarray(self.lengths))
 
     def padded_positions(self) -> int:
         """Columns of the gathered per-slot attention window."""

@@ -47,7 +47,7 @@ from typing import List, Optional
 import numpy as np
 
 from bigdl_tpu.serving.batcher import RequestQueue, ServeRequest
-from bigdl_tpu.serving.cache import PagedKVCache, gather_pages
+from bigdl_tpu.serving.cache import PagedKVCache
 
 LAT_META = ("bigdl_request_latency_seconds",
             "Request latency by engine and kind (ttft = time to first "
@@ -76,16 +76,25 @@ def _quantize_tree(params, n_layer):
 
 def paged_decode_math(children, n_layer, page_size, params, qparams,
                       kp, vp, tables, lengths, tokens, temps, active,
-                      key, *, n_head=None, psum=None):
+                      key, *, n_head=None, psum=None, attn_impl="dense",
+                      attn_block_pages=0):
     """One decode step over the paged cache — the single source of
     truth shared by the jitted single-host step and the TP shard_map
     body (``n_head`` is the LOCAL head count there, ``psum`` the
     compressed block reduction).  Mirrors
     ``TransformerBlock.decode_step`` exactly in the float path so paged
-    decode bit-matches ``generate()`` at temperature 0."""
+    decode bit-matches ``generate()`` at temperature 0.
+
+    The attention body is ``ops.decode_attention.paged_decode_attention``
+    — ``attn_impl="dense"`` is the bit-match gather path, "auto" lets
+    the cached ``decode_attn`` tuner site dispatch the flash-decode
+    fused/Pallas kernels per (shape, dtype, platform); ``tables`` may
+    be the engine's used-page prefix bucket rather than the full table
+    width (same mask contract either way)."""
     import jax
     import jax.numpy as jnp
 
+    from bigdl_tpu.ops.decode_attention import paged_decode_attention
     from bigdl_tpu.ops.quantized_matmul import int8_matmul
 
     attn0 = children["h0"]._children["attn"]
@@ -96,7 +105,7 @@ def paged_decode_math(children, n_layer, page_size, params, qparams,
 
     def mm(x, w, qw):
         if qparams is not None and qw is not None:
-            return int8_matmul(x, qw[0], qw[1])
+            return int8_matmul(x, qw[0], qw[1], impl="auto")
         return jnp.matmul(x, w.T)
 
     x = jnp.take(params["wte"]["weight"], tokens, axis=0)[:, None, :]
@@ -127,15 +136,11 @@ def paged_decode_math(children, n_layer, page_size, params, qparams,
         off = lengths % page_size
         kp = kp.at[i, pidx, :, off, :].set(kh.astype(kp.dtype))
         vp = vp.at[i, pidx, :, off, :].set(vh.astype(vp.dtype))
-        kall = gather_pages(kp[i], tables)   # (B, H, maxp*P, Dh)
-        vall = gather_pages(vp[i], tables)
-        scores = jnp.einsum("bhqd,bhkd->bhqk", qh, kall) * scale
-        mask = (jnp.arange(kall.shape[2])[None, None, None, :]
-                <= lengths[:, None, None, None])
-        scores = jnp.where(mask, scores, -jnp.inf)
-        probs = jax.nn.softmax(scores, axis=-1)
-        o = jnp.einsum("bhqk,bhkd->bhqd", probs, vall)
-        o = o.transpose(0, 2, 1, 3).reshape(bsz, 1, heads * head_dim)
+        o = paged_decode_attention(
+            qh[:, :, 0, :], kp[i], vp[i], tables, lengths,
+            page_size=page_size, scale=scale, impl=attn_impl,
+            block_pages=attn_block_pages)       # (B, H, Dh)
+        o = o.reshape(bsz, 1, heads * head_dim)
         y = mm(o, pa["wo"], None if qb is None else qb["attn"]["wo"])
         if psum is not None:
             y = psum(y)
@@ -192,7 +197,9 @@ class LMEngine:
                  int8: Optional[bool] = None, tp: int = 1, wire=None,
                  cache_dtype=None, eos_id: Optional[int] = None,
                  slo_s: Optional[float] = None,
-                 admission: Optional[str] = None, seed: int = 0):
+                 admission: Optional[str] = None,
+                 decode_attn: Optional[str] = None,
+                 decode_bucket: Optional[bool] = None, seed: int = 0):
         import jax
         import jax.numpy as jnp
 
@@ -205,6 +212,14 @@ class LMEngine:
         self.page_size = int(page_size or cfg.page_size)
         self.int8 = cfg.int8 if int8 is None else bool(int8)
         self.tp = int(tp or 1)
+        self.decode_attn = decode_attn or cfg.decode_attn
+        if self.decode_attn not in ("auto", "dense", "fused", "pallas",
+                                    "pallas_interpret"):
+            raise ValueError(
+                f"decode_attn must be auto|dense|fused|pallas, got "
+                f"{self.decode_attn!r}")
+        self.decode_bucket = (cfg.decode_bucket if decode_bucket is None
+                              else bool(decode_bucket))
         self.eos_id = eos_id
         self.slo_s = cfg.slo_s if slo_s is None else float(slo_s)
         self.admission = admission or cfg.admission
@@ -247,13 +262,18 @@ class LMEngine:
         self._thread: Optional[threading.Thread] = None
         self._lock = threading.RLock()
 
+        self._last_bucket = self.cache.max_pages_per_slot
+        self._impl_by_bucket: dict = {}
+        self._decode_ms_sum = 0.0
+        self._weight_bytes = self._decode_weight_bytes()
         if self.tp > 1:
             from bigdl_tpu.serving.tp import build_tp_decode_step
 
             self._step_fn = build_tp_decode_step(
                 model, tp=self.tp, wire=wire, page_size=self.page_size,
                 max_batch=self.max_batch,
-                positions=self.cache.padded_positions())
+                positions=self.cache.padded_positions(),
+                attn_impl=self.decode_attn)
         else:
             self._step_fn = self._build_step()
             self.params = jax.tree.map(
@@ -285,6 +305,40 @@ class LMEngine:
             "bigdl_serve_preemptions_total",
             "Requests preempted (pages reclaimed, request re-queued) "
             "on KV-page exhaustion")
+        self._decode_ms_gauge = reg.gauge(
+            "bigdl_serve_decode_attn_ms",
+            "Mean wall-clock of the jitted paged-decode step "
+            "(attention-dominated, memory-bound) in milliseconds")
+        self._decode_bytes_gauge = reg.gauge(
+            "bigdl_serve_decode_hbm_bytes_per_token",
+            "Analytic HBM bytes streamed per generated token (decode "
+            "weights + the KV pages the step's page-table bucket "
+            "names)")
+
+    def _decode_weight_bytes(self) -> float:
+        """Static per-step weight-stream bytes of the decode matmuls —
+        one read of every parameter byte per token (decode is
+        memory-bound; int8 engines stream the 1-byte twins instead of
+        the float matmul weights)."""
+        total = 0.0
+        leaves = []
+
+        def walk(t):
+            if isinstance(t, dict):
+                for v in t.values():
+                    walk(v)
+            elif t is not None and hasattr(t, "size"):
+                leaves.append(t)
+
+        walk(self.params)
+        for leaf in leaves:
+            item = leaf.dtype.itemsize if hasattr(leaf, "dtype") else 4
+            # int8 decode replaces every >=2-D matmul weight with its
+            # 1-byte twin (+ negligible per-channel scales)
+            if self._qparams is not None and getattr(leaf, "ndim", 0) >= 2:
+                item = 1
+            total += float(leaf.size) * item
+        return total
 
     # -------------------------------------------------------- jit builders
     def _build_step(self):
@@ -293,14 +347,45 @@ class LMEngine:
         children = self.model._children
         n_layer, page_size = self.n_layer, self.page_size
         qparams = self._qparams
+        attn_impl = self.decode_attn
 
         def step(params, kp, vp, tables, lengths, tokens, temps,
                  active, key):
             return paged_decode_math(
                 children, n_layer, page_size, params, qparams, kp, vp,
-                tables, lengths, tokens, temps, active, key)
+                tables, lengths, tokens, temps, active, key,
+                attn_impl=attn_impl)
 
         return jax.jit(step, donate_argnums=(1, 2))
+
+    def _decode_impl_for(self, bucket: int) -> str:
+        """The decode-attention impl this step's bucket resolves to —
+        host-side mirror of the in-trace dispatch, cached per bucket
+        (drives the bytes-per-token gauge and ``stats()``; with the
+        tuner enabled this is also what pre-populates the
+        ``decode_attn`` cache entry the traced step then hits)."""
+        impl = self._impl_by_bucket.get(bucket)
+        if impl is not None:
+            return impl
+        impl = self.decode_attn
+        if impl == "auto":
+            impl = "dense"
+            try:
+                from bigdl_tpu.ops import autotune
+
+                if autotune.enabled():
+                    heads = self.n_head // self.tp
+                    q_dtype = self.params["wte"]["weight"].dtype
+                    rec = autotune.decide_decode_attn(
+                        (self.max_batch, heads, self.head_dim),
+                        self.page_size, bucket, q_dtype,
+                        kv_dtype=self.cache.dtype)
+                    if rec is not None:
+                        impl = rec.get("impl", "dense")
+            except Exception:  # noqa: BLE001 — a hint, never a sink
+                pass
+        self._impl_by_bucket[bucket] = impl
+        return impl
 
     def _prefill_fn(self, bucket: int):
         fn = self._prefill_fns.get(bucket)
@@ -532,15 +617,38 @@ class LMEngine:
             tokens[i] = self._slots[i].last_token
             temps[i] = self._slots[i].req.temperature
             active[i] = True
-        tables, lengths = self.cache.device_tables()
+        # used-page prefix bucket (pow2): even the dense baseline stops
+        # gathering the empty pool; each bucket is one compiled variant
+        from bigdl_tpu.ops.decode_attention import (decode_hbm_bytes,
+                                                    used_page_bucket)
+
+        if self.decode_bucket:
+            longest = max(int(self.cache.lengths[i])
+                          for i in active_slots)
+            bucket = used_page_bucket(longest, self.page_size,
+                                      self.cache.max_pages_per_slot)
+        else:
+            bucket = self.cache.max_pages_per_slot
+        self._last_bucket = bucket
+        impl = self._decode_impl_for(bucket)
+        tables, lengths = self.cache.device_tables(pages=bucket)
         self._key, sub = jax.random.split(self._key)
+        t0 = time.perf_counter()
         kp, vp, nxt = self._step_fn(
             self.params, self.cache.kp, self.cache.vp, tables, lengths,
             jnp.asarray(tokens), jnp.asarray(temps), jnp.asarray(active),
             sub)
         self.cache.kp, self.cache.vp = kp, vp
         nxt = np.asarray(nxt)
+        step_ms = (time.perf_counter() - t0) * 1000.0
         self._steps += 1
+        self._decode_ms_sum += step_ms
+        self._decode_ms_gauge.set(self._decode_ms_sum / self._steps)
+        kv_item = self.cache.dtype.itemsize
+        step_bytes = self._weight_bytes + self.n_layer * decode_hbm_bytes(
+            "dense" if impl == "dense" else "fused", self.max_batch,
+            self.n_head, self.head_dim, self.page_size, bucket, kv_item)
+        self._decode_bytes_gauge.set(step_bytes / len(active_slots))
         self._occ_sum += len(active_slots) / self.max_batch
         self._occ_gauge.set(self._occ_sum / self._steps)
         for i in active_slots:
@@ -631,6 +739,15 @@ class LMEngine:
             "admission": self.admission,
             "int8": self.int8,
             "tp": self.tp,
+            "decode_attn": self.decode_attn,
+            "decode_bucket": self.decode_bucket,
+            "decode_impl_by_bucket": dict(self._impl_by_bucket),
+            "last_bucket_pages": self._last_bucket,
+            "decode_ms_mean": (self._decode_ms_sum / self._steps
+                               if self._steps else None),
+            "decode_hbm_bytes_per_token":
+                float(self._decode_bytes_gauge._solo().value)
+                if self._steps else None,
         }
 
 

@@ -29,11 +29,13 @@
 #                                                # ratio with nonzero rework
 #                                                # badput (no pytest)
 #   scripts/run-tests.sh --tune                  # auto-tuner smoke: tunes one
-#                                                # attention and one conv+BN
-#                                                # shape on CPU (interpret
-#                                                # mode, measured candidates),
-#                                                # asserts a persisted JSON
-#                                                # cache, re-runs with zero
+#                                                # attention, one conv+BN, one
+#                                                # serving decode_attn and one
+#                                                # int8_mm shape on CPU
+#                                                # (interpret mode, measured
+#                                                # candidates), asserts a
+#                                                # persisted JSON cache,
+#                                                # re-runs with zero
 #                                                # re-measurements, and checks
 #                                                # the report's kernel
 #                                                # auto-tuner section
@@ -95,7 +97,13 @@
 #                                                # static batching on one
 #                                                # bursty request trace
 #                                                # (must win tokens/sec at
-#                                                # equal-or-better p99),
+#                                                # equal-or-better p99), the
+#                                                # flash-decode kernel A/B
+#                                                # (tuner-dispatched fused
+#                                                # path must beat the dense
+#                                                # full-width gather >=1.15x
+#                                                # at equal p99, token-
+#                                                # identical),
 #                                                # concurrent HTTP clients
 #                                                # against an int8 ResNet +
 #                                                # the LM decoder, a queue-

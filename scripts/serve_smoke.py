@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """--serve smoke: the continuous-batching serving tier, end to end.
 
-Driven by ``scripts/run-tests.sh --serve``.  Five stages, each a hard
+Driven by ``scripts/run-tests.sh --serve``.  Six stages, each a hard
 assert:
 
 1. **continuous vs static A/B** — the same bursty request trace (mixed
@@ -11,19 +11,29 @@ assert:
    refilling — the ``generate()`` baseline behavior) vs
    ``admission="continuous"`` (refill freed slots at step boundaries).
    Continuous must win on tokens/sec at equal-or-better p99.
-2. **concurrent clients over HTTP** — a ResNet classifier (int8 via the
+2. **decode-kernel A/B (ISSUE 13)** — the same long-decode trace on a
+   serving-sized model, decoded by the PR 12 dense-gather baseline
+   (``decode_attn="dense"``, full-width tables) vs the tuner-
+   dispatched flash-decode path (``BIGDL_TUNER=1``, used-page prefix
+   buckets).  The fused path must win >= 1.15x tokens/sec at
+   equal-or-better p99, with ``decode_attn`` tuner decisions visible,
+   byte-identical greedy tokens across arms (and vs ``generate()``),
+   and fused-vs-dense op output within 1e-5.
+3. **concurrent clients over HTTP** — a ResNet classifier (int8 via the
    existing ``quantize()``/folded-BN path) and the LM decoder behind
    one stdlib front-end, hammered by concurrent client threads mixing
    ``/v1/generate`` and ``/v1/classify``; every response must be
    well-formed.
-3. **queue-driven autoscale decision** — a burst is parked in the
+4. **queue-driven autoscale decision** — a burst is parked in the
    request queue while the policy loop scrapes the process's own live
    ``/metrics`` endpoint (the real ``EndpointScraper`` path); the
    ``queue_high`` rule must emit a scale-up decision (dry-run).
-4. **report** — ``obs.report`` must render the serving section in text
-   and carry the request-latency histograms + the autoscale decision
-   in ``--json``.
-5. **bank** — ``SERVE_SMOKE.json`` for BENCH ``extras.serve``.
+5. **report** — ``obs.report`` must render the serving section (now
+   incl. the decode ms/step + HBM bytes/token line) in text and carry
+   the request-latency histograms + the autoscale decision in
+   ``--json``.
+6. **bank** — ``SERVE_SMOKE.json`` (incl. ``decode_kernel``) for BENCH
+   ``extras.serve``.
 
 NOTE: the parent pins JAX_PLATFORMS=cpu for itself — importing
 bigdl_tpu pulls jax, which otherwise probes this container's TPU
@@ -60,20 +70,30 @@ def _trace(prompts_seed: int = 7, n: int = 24):
             for i in range(n)]
 
 
+def _reset_measures(eng):
+    """Zero the engine's throughput/latency accounting after compile
+    warmup so the measured window is pure steady-state decode."""
+    eng.completed.clear()
+    eng._tokens_total = 0
+    eng._occ_sum = eng._steps = 0
+    eng._decode_ms_sum = 0.0
+    eng._t_first_work = eng._t_last_done = None
+
+
 def _ab_arm(model, admission: str):
     from bigdl_tpu.serving import LMEngine
 
     eng = LMEngine(model, max_batch=4, page_size=8, admission=admission,
                    queue_capacity=64, slo_s=30.0, seed=3)
     # warm every compile OUTSIDE the measured window: one request per
-    # prefill bucket plus the shared decode step
+    # prefill bucket, plus one long decode that walks the step through
+    # every used-page table bucket the trace will touch (the decode
+    # step is compiled per pow2 bucket since ISSUE 13)
     for t0 in (4, 12):
         eng.submit(list(range(1, t0 + 1)), 2)
+    eng.submit(list(range(1, 5)), 30)
     eng.run_until_idle(120)
-    eng.completed.clear()
-    eng._tokens_total = 0
-    eng._occ_sum = eng._steps = 0
-    eng._t_first_work = eng._t_last_done = None
+    _reset_measures(eng)
     reqs = [eng.submit(p, m) for p, m in _trace()]
     eng.run_until_idle(180)
     assert all(r.done and len(r.tokens) == m
@@ -81,6 +101,40 @@ def _ab_arm(model, admission: str):
     st = eng.stats()
     eng.close()
     return st
+
+
+# -------------------------------------------------- decode-kernel A/B
+def _decode_trace(n: int = 16):
+    """Long-decode trace for the kernel A/B: short prompts, 40-56
+    generated tokens each, so the step count is decode-dominated and
+    slot lengths stay under 64 (= the 4-page bucket at page 16)."""
+    import numpy as np
+
+    rs = np.random.RandomState(11)
+    decodes = [48, 40, 56, 44, 52, 40, 54, 46] * (n // 8 + 1)
+    return [(rs.randint(0, 64, (4 + i % 5,)).tolist(), decodes[i])
+            for i in range(n)]
+
+
+def _decode_arm(model, label, **engine_kw):
+    from bigdl_tpu.serving import LMEngine
+
+    eng = LMEngine(model, max_batch=8, page_size=16, num_pages=64,
+                   queue_capacity=64, slo_s=30.0, seed=7, **engine_kw)
+    # warmup drives one slot through every decode bucket the trace
+    # touches (lengths 4 -> 60: 1-, 2- and 4-page tables) plus the
+    # prefill bucket, so the measured window has zero compiles
+    eng.submit([1, 2, 3, 4], 56)
+    eng.run_until_idle(300)
+    _reset_measures(eng)
+    reqs = [eng.submit(p, m) for p, m in _decode_trace()]
+    eng.run_until_idle(600)
+    assert all(r.done and len(r.tokens) == m
+               for r, (_, m) in zip(reqs, _decode_trace())), \
+        f"incomplete requests in {label} arm"
+    st = eng.stats()
+    eng.close()
+    return st, [list(r.tokens) for r in reqs]
 
 
 def main() -> int:
@@ -125,7 +179,77 @@ def main() -> int:
     print(f"[serve-smoke] continuous batching: {speedup:.2f}x tokens/s "
           "at equal-or-better p99 — PASS")
 
-    # -- 2: concurrent clients vs ResNet + LM over HTTP ---------------
+    # -- 2: decode-kernel A/B (flash-decode vs the dense gather) ------
+    import jax.numpy as jnp
+
+    from bigdl_tpu.models.transformer import build_transformer_lm
+    from bigdl_tpu.ops import autotune
+    from bigdl_tpu.ops.decode_attention import paged_decode_attention
+
+    RandomGenerator.RNG.set_seed(29)
+    # max_len 512 / page 16 = a 32-page table per slot, of which the
+    # trace only ever fills 4 — the PR 12 baseline gathers all 32 per
+    # layer per step (the gather tax the fused path deletes)
+    model2 = build_transformer_lm(64, dim=128, n_head=8, n_layer=4,
+                                  max_len=512, attn_impl="xla")
+    params2 = model2.params()
+    base_st, base_toks = _decode_arm(
+        model2, "dense-gather baseline", decode_attn="dense",
+        decode_bucket=False)
+    os.environ["BIGDL_TUNER"] = "1"
+    os.environ["BIGDL_TUNER_CACHE"] = os.path.join(TMP, "tuner.json")
+    autotune.reset()
+    fused_st, fused_toks = _decode_arm(model2, "tuner-dispatched")
+    dspeed = fused_st["tokens_per_s"] / base_st["tokens_per_s"]
+    impls = fused_st["decode_impl_by_bucket"]
+    decisions = [d for d in autotune.summary()["decisions"]
+                 if d["site"] == "decode_attn"]
+    print(f"[serve-smoke] decode dense-full:  "
+          f"{base_st['tokens_per_s']:.0f} tok/s, p99 "
+          f"{base_st['e2e_p99_s'] * 1000:.0f}ms, "
+          f"{base_st['decode_ms_mean']:.2f}ms/step, "
+          f"{base_st['decode_hbm_bytes_per_token'] / 1e6:.2f} MB/token")
+    print(f"[serve-smoke] decode tuned:       "
+          f"{fused_st['tokens_per_s']:.0f} tok/s, p99 "
+          f"{fused_st['e2e_p99_s'] * 1000:.0f}ms, "
+          f"{fused_st['decode_ms_mean']:.2f}ms/step, "
+          f"{fused_st['decode_hbm_bytes_per_token'] / 1e6:.2f} MB/token")
+    print(f"[serve-smoke] decode_attn tuner decisions: "
+          + ", ".join(f"{d['key'].split('|')[1]}->{d['label']}"
+                      f"({d['source']})" for d in decisions))
+    assert decisions, "no decode_attn tuner decisions recorded"
+    assert impls and all(v == "fused" for v in impls.values()), impls
+    assert fused_toks == base_toks, \
+        "tuned arm diverged from the dense baseline's greedy tokens"
+    p0, m0 = _decode_trace()[0]
+    ref0 = list(np.asarray(model2.generate(
+        params2, np.asarray(p0)[None, :], m0))[0])
+    assert [int(t) for t in p0 + base_toks[0]] == ref0, \
+        "dense baseline lost temperature-0 parity vs generate()"
+    assert dspeed >= 1.15, \
+        f"flash-decode speedup {dspeed:.2f}x < 1.15x"
+    assert fused_st["e2e_p99_s"] <= base_st["e2e_p99_s"] * 1.02, \
+        f"tuned p99 {fused_st['e2e_p99_s']:.3f}s worse than dense " \
+        f"{base_st['e2e_p99_s']:.3f}s"
+    # op-level fused-vs-dense parity at the serving shape
+    rs2 = np.random.RandomState(2)
+    pool = 33
+    qo = jnp.asarray(rs2.randn(8, 8, 16).astype(np.float32))
+    kpo = jnp.asarray(rs2.randn(pool, 8, 16, 16).astype(np.float32))
+    vpo = jnp.asarray(rs2.randn(pool, 8, 16, 16).astype(np.float32))
+    lens = jnp.asarray(rs2.randint(1, 63, (8,)).astype(np.int32))
+    tbls = jnp.asarray(rs2.randint(1, pool, (8, 4)).astype(np.int32))
+    od = paged_decode_attention(qo, kpo, vpo, tbls, lens, page_size=16,
+                                impl="dense")
+    of = paged_decode_attention(qo, kpo, vpo, tbls, lens, page_size=16,
+                                impl="fused")
+    op_diff = float(jnp.max(jnp.abs(od - of)))
+    assert op_diff < 1e-5, f"fused-vs-dense op diff {op_diff:g}"
+    print(f"[serve-smoke] flash-decode: {dspeed:.2f}x tokens/s at "
+          f"equal-or-better p99, token-identical, op diff "
+          f"{op_diff:.1e} — PASS")
+
+    # -- 3: concurrent clients vs ResNet + LM over HTTP ---------------
     from bigdl_tpu.models.resnet import build_resnet_cifar
     from bigdl_tpu.serving import (ClassifierEngine, LMEngine,
                                    ServingServer)
@@ -178,7 +302,7 @@ def main() -> int:
     print("[serve-smoke] 8 concurrent HTTP clients vs int8 ResNet-8 + "
           "LM decoder: all responses well-formed — PASS")
 
-    # -- 3: queue-driven autoscale decision off the live /metrics -----
+    # -- 4: queue-driven autoscale decision off the live /metrics -----
     os.environ.update({
         "BIGDL_AUTOSCALE_QUEUE_HIGH": "8",
         "BIGDL_AUTOSCALE_HYSTERESIS": "1",
@@ -219,7 +343,7 @@ def main() -> int:
 
     obs.flush()
 
-    # -- 4: the report renders the serving loop -----------------------
+    # -- 5: the report renders the serving loop -----------------------
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     p = subprocess.run(
         [sys.executable, "-m", "bigdl_tpu.obs.report",
@@ -228,7 +352,8 @@ def main() -> int:
         capture_output=True, text=True, timeout=300, cwd=REPO, env=env)
     assert p.returncode == 0, p.stdout + p.stderr
     for needle in ("-- serving --", "latency lm:e2e",
-                   "latency classifier:e2e", "tok/s"):
+                   "latency classifier:e2e", "tok/s", "decode: ",
+                   "MB/token"):
         assert needle in p.stdout, f"report missing {needle!r}:\n{p.stdout}"
     p = subprocess.run(
         [sys.executable, "-m", "bigdl_tpu.obs.report",
@@ -242,13 +367,18 @@ def main() -> int:
     assert sv["latency"]["lm:ttft"]["p99_s"] is not None, sv
     assert sv["latency"]["classifier:e2e"]["count"] >= 8, sv
     assert sv["tokens_per_second"] and sv["tokens_per_second"] > 0, sv
+    assert sv["decode_attn_ms"] and sv["decode_attn_ms"] > 0, sv
+    assert sv["decode_hbm_bytes_per_token"] > 0, sv
     decs = rep["autoscale"]["decisions_total"]
     assert decs.get("up:queue_high", 0) >= 1, decs
+    tn = rep.get("tuner")
+    assert tn and any(s.startswith("decode_attn")
+                      for s in tn["decisions_total"]), tn
     print("[serve-smoke] report: serving section + latency histograms "
           "+ the queue-driven decision all present (text + --json) — "
           "PASS")
 
-    # -- 5: bank for BENCH extras.serve -------------------------------
+    # -- 6: bank for BENCH extras.serve -------------------------------
     bank = {
         "static": {k: stat[k] for k in
                    ("tokens_per_s", "e2e_p99_s", "e2e_p50_s",
@@ -259,6 +389,22 @@ def main() -> int:
                         "steps")},
         "tokens_per_s_speedup": speedup,
         "p99_ratio": cont["e2e_p99_s"] / stat["e2e_p99_s"],
+        "decode_kernel": {
+            "dense_full": {k: base_st[k] for k in
+                           ("tokens_per_s", "e2e_p99_s", "e2e_p50_s",
+                            "decode_ms_mean",
+                            "decode_hbm_bytes_per_token", "steps",
+                            "tokens")},
+            "tuned": {k: fused_st[k] for k in
+                      ("tokens_per_s", "e2e_p99_s", "e2e_p50_s",
+                       "decode_ms_mean", "decode_hbm_bytes_per_token",
+                       "steps", "tokens")},
+            "tokens_per_s_speedup": dspeed,
+            "p99_ratio": fused_st["e2e_p99_s"] / base_st["e2e_p99_s"],
+            "impl_by_bucket": impls,
+            "fused_vs_dense_max_abs_diff": op_diff,
+            "tuner_decisions": decisions,
+        },
         "classifier": {"requests": stats["classifier"]["requests"],
                        "int8": True},
         "autoscale_decision": {"direction": decision.direction,

@@ -86,8 +86,15 @@ starved (pages exhausted? check bigdl_serve_kv_pages_in_use and
 preemptions); high occupancy with a rising p99 means the world is
 undersized — the autoscaler's queue band (BIGDL_AUTOSCALE_QUEUE_*) and
 latency band (BIGDL_AUTOSCALE_P99_*) scale on exactly these signals.
-See MIGRATION.md "Inference serving" and ``scripts/run-tests.sh
---serve`` for the end-to-end smoke.
+SLOW DECODE specifically starts at the serving section's "decode:
+X ms/step, Y MB/token" line (gauges bigdl_serve_decode_attn_ms /
+bigdl_serve_decode_hbm_bytes_per_token): a high MB/token with
+BIGDL_SERVE_DECODE_BUCKET off or decode_attn pinned to "dense" means
+you are paying the full-pool gather tax — enable BIGDL_TUNER=1 so the
+cached decode_attn site dispatches the fused/Pallas flash-decode path
+(pre-warm with autotune.prewarm_decode_attn; MIGRATION.md "Decode
+kernels").  See MIGRATION.md "Inference serving" and
+``scripts/run-tests.sh --serve`` for the end-to-end smoke.
 
 A run you need to watch RIGHT NOW (not post-mortem) has the live
 telemetry plane: export ``BIGDL_OBS_PORT`` and curl the host's

@@ -226,6 +226,142 @@ class TestTPDecode:
             LMEngine(lm_model, tp=3)
 
 
+# ------------------------------------------- decode kernels (ISSUE 13)
+class TestDecodeKernelDispatch:
+    """paged_decode_math's attention body is now
+    ops.decode_attention.paged_decode_attention — the fused flash-
+    decode path must reproduce the dense bit-match semantics through
+    every engine scenario (ragged admission, preemption refold, TP
+    head sharding, int8), and the used-page bucket must be observable.
+    """
+
+    def test_fused_engine_matches_generate_mid_batch(self, lm_model,
+                                                     lm_params):
+        from bigdl_tpu.serving import LMEngine
+
+        rs = np.random.RandomState(21)
+        p1, p2, p3 = (rs.randint(0, 48, (n,)) for n in (5, 9, 4))
+        eng = LMEngine(lm_model, max_batch=2, page_size=8,
+                       decode_attn="fused")
+        r1 = eng.submit(p1, 10)
+        r2 = eng.submit(p2, 3)
+        for _ in range(3):
+            eng.pump()
+        assert r2.done and not r1.done
+        r3 = eng.submit(p3, 7)     # admitted mid-flight
+        eng.run_until_idle(60)
+        eng.close()
+        assert _out(p1, r1) == _ref(lm_model, lm_params, p1, 10)
+        assert _out(p2, r2) == _ref(lm_model, lm_params, p2, 3)
+        assert _out(p3, r3) == _ref(lm_model, lm_params, p3, 7)
+
+    def test_fused_engine_survives_preemption_refold(self, lm_model,
+                                                     lm_params):
+        from bigdl_tpu.serving import LMEngine
+
+        rs = np.random.RandomState(22)
+        p1, p2 = rs.randint(0, 48, (5,)), rs.randint(0, 48, (9,))
+        eng = LMEngine(lm_model, max_batch=2, page_size=4, num_pages=8,
+                       decode_attn="fused")
+        a, b = eng.submit(p1, 12), eng.submit(p2, 12)
+        eng.run_until_idle(120)
+        assert eng.stats()["preemptions"] >= 1
+        eng.close()
+        assert _out(p1, a) == _ref(lm_model, lm_params, p1, 12)
+        assert _out(p2, b) == _ref(lm_model, lm_params, p2, 12)
+
+    def test_tp_fused_agrees(self, lm_model, lm_params):
+        from bigdl_tpu.serving import LMEngine
+
+        rs = np.random.RandomState(23)
+        p1, p2 = rs.randint(0, 48, (5,)), rs.randint(0, 48, (9,))
+        eng = LMEngine(lm_model, max_batch=2, page_size=8, tp=4,
+                       decode_attn="fused")
+        r1, r2 = eng.submit(p1, 6), eng.submit(p2, 3)
+        eng.run_until_idle(120)
+        eng.close()
+        assert _out(p1, r1) == _ref(lm_model, lm_params, p1, 6)
+        assert _out(p2, r2) == _ref(lm_model, lm_params, p2, 3)
+
+    def test_int8_fused_passthrough(self, lm_model):
+        from bigdl_tpu.serving import LMEngine
+
+        eng = LMEngine(lm_model, max_batch=2, page_size=8, int8=True,
+                       decode_attn="fused")
+        r = eng.submit([3, 1, 4, 1, 5], 8)
+        eng.run_until_idle(60)
+        eng.close()
+        assert r.done and len(r.tokens) == 8
+        assert all(0 <= t < 48 for t in r.tokens)
+
+    def test_bucket_slices_tables_and_gauges_publish(self, lm_model):
+        from bigdl_tpu import obs
+        from bigdl_tpu.serving import LMEngine
+
+        eng = LMEngine(lm_model, max_batch=2, page_size=8)
+        assert eng.decode_bucket       # default ON
+        r = eng.submit([1, 2, 3], 4)   # short: 1 page in use
+        eng.run_until_idle(60)
+        st = eng.stats()
+        eng.close()
+        assert r.done
+        assert st["last_bucket_pages"] < eng.cache.max_pages_per_slot
+        assert st["decode_ms_mean"] and st["decode_ms_mean"] > 0
+        assert st["decode_hbm_bytes_per_token"] > 0
+        reg = obs.get_registry()
+        assert reg.gauge(
+            "bigdl_serve_decode_attn_ms")._solo().value > 0
+        assert reg.gauge(
+            "bigdl_serve_decode_hbm_bytes_per_token")._solo().value > 0
+
+    def test_bucket_off_ships_full_tables(self, lm_model):
+        from bigdl_tpu.serving import LMEngine
+
+        eng = LMEngine(lm_model, max_batch=2, page_size=8,
+                       decode_bucket=False)
+        r = eng.submit([1, 2, 3], 3)
+        eng.run_until_idle(60)
+        st = eng.stats()
+        eng.close()
+        assert r.done
+        assert st["last_bucket_pages"] == eng.cache.max_pages_per_slot
+
+    def test_invalid_decode_attn_rejected(self, lm_model):
+        from bigdl_tpu.serving import LMEngine
+
+        with pytest.raises(ValueError, match="decode_attn"):
+            LMEngine(lm_model, decode_attn="nope")
+
+    def test_tuner_dispatches_fused_in_engine(self, lm_model, lm_params,
+                                              tmp_path, monkeypatch):
+        from bigdl_tpu.ops import autotune
+        from bigdl_tpu.serving import LMEngine
+
+        monkeypatch.setenv("BIGDL_TUNER", "1")
+        monkeypatch.setenv("BIGDL_TUNER_CACHE",
+                           str(tmp_path / "tuner.json"))
+        autotune.reset()
+        try:
+            rs = np.random.RandomState(24)
+            p1 = rs.randint(0, 48, (5,))
+            eng = LMEngine(lm_model, max_batch=2, page_size=8)
+            assert eng.decode_attn == "auto"
+            r1 = eng.submit(p1, 8)
+            eng.run_until_idle(60)
+            st = eng.stats()
+            eng.close()
+            # the analytic gather-tax model flips every bucket to the
+            # fused flash-decode path — and tokens still match the
+            # contiguous-cache generate()
+            assert st["decode_impl_by_bucket"]
+            assert set(st["decode_impl_by_bucket"].values()) == {"fused"}
+            assert _out(p1, r1) == _ref(lm_model, lm_params, p1, 8)
+            sites = {d["site"] for d in autotune.summary()["decisions"]}
+            assert "decode_attn" in sites
+        finally:
+            autotune.reset()
+
+
 # ----------------------------------------------------- queue / batcher
 class TestRequestQueue:
     def test_fifo_and_depth_gauge(self):
