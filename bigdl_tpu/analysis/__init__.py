@@ -1,0 +1,34 @@
+"""bigdl_tpu.analysis — graftlint, the repo-native static-analysis pass.
+
+The bug classes that cost the most here never fail a unit test: a host
+sync inside a jitted body (13x serve throughput collapse, PR 13), an
+inconsistent lock order between the obs registry and a serving thread,
+an env var or metric name minted ad hoc that no dashboard ever sees.
+``graftlint`` encodes those as AST rules over this repo's own idioms:
+
+* :mod:`bigdl_tpu.analysis.jax_rules` — JX001..JX005: host-sync /
+  tracer-leak / jit-in-loop / unhashable-static / tracer-branch;
+* :mod:`bigdl_tpu.analysis.concurrency` — CC001..CC003: lock-order
+  cycles, unlocked shared writes from thread entry points, bare
+  ``acquire()``;
+* :mod:`bigdl_tpu.analysis.registry_rules` — RD001..RD005: ``BIGDL_*``
+  env reads outside ``config.py``, metric names outside
+  ``obs/names.py``, undocumented/unrendered metrics, mint-shape drift.
+
+CLI: ``python -m bigdl_tpu.analysis.lint bigdl_tpu scripts`` (also
+``scripts/run-tests.sh --lint``).  Gated in tier-1 by
+``tests/test_lint.py::test_repo_is_clean``.
+"""
+
+from bigdl_tpu.analysis.core import Finding, Linter
+
+__all__ = ["Finding", "Linter", "run_lint"]
+
+
+def run_lint(*args, **kwargs):
+    """Lazy alias for :func:`bigdl_tpu.analysis.lint.run_lint` (the
+    submodule is imported on demand so ``python -m
+    bigdl_tpu.analysis.lint`` doesn't double-import it)."""
+    from bigdl_tpu.analysis.lint import run_lint as _run
+
+    return _run(*args, **kwargs)

@@ -1,0 +1,498 @@
+"""graftlint JX rules: JAX tracing hazards.
+
+The bug class pytest can't see: code that is *correct* on eager numpy
+but recompiles every step, silently syncs the host, or leaks tracers
+once it runs under ``jax.jit`` — the failures that cost a 13x serve
+throughput collapse before anyone notices.  All checks are syntactic
+and deliberately conservative: a "traced scope" is a function the
+module itself hands to a tracing transform (decorator, wrapping call,
+or a ``lax`` control-flow body), and value tracking is a simple
+forward taint from the traced function's non-static parameters.
+
+* **JX001 host-sync-in-traced** — ``float()/int()/bool()``,
+  ``np.asarray``/``np.array``, ``.item()/.tolist()``,
+  ``.block_until_ready()`` or ``jax.device_get`` applied to a
+  parameter-derived value inside a traced scope.  At best this is a
+  per-call device sync; at trace time it is a concretization error or
+  a silent constant-folding of live data.
+* **JX002 tracer-leak** — storing a parameter-derived value on
+  ``self``, a ``global`` or a ``nonlocal`` from inside a traced scope.
+  The stored tracer outlives the trace and poisons the next one.
+* **JX003 jit-in-loop** — constructing ``jax.jit``/``pmap``/
+  ``shard_map`` (call or decorated def) inside a ``for``/``while``
+  body: every iteration mints a fresh callable with an empty compile
+  cache.
+* **JX004 unhashable-static-arg** — a ``static_argnums``/
+  ``static_argnames`` parameter whose default or call-site value is a
+  list/dict/set display: unhashable statics raise, and per-value
+  hashing of ad-hoc containers recompiles on every new object.
+* **JX005 tracer-branch** — Python ``if``/``while`` on a
+  parameter-derived value inside a traced scope (``is``/``is None``
+  tests and string compares exempt — those are static trace-time
+  switches; ``.shape``/``.ndim``/``.dtype`` access is static too).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from bigdl_tpu.analysis import core
+from bigdl_tpu.analysis.core import Finding, ModuleInfo, dotted_name
+
+RULES = {
+    "JX001": "host sync / concretization of a traced value",
+    "JX002": "tracer stored on self/global/nonlocal from a traced scope",
+    "JX003": "jit/pmap/shard_map constructed inside a loop body",
+    "JX004": "unhashable object fed to a static jit argument",
+    "JX005": "Python branch on a traced value",
+}
+core.ALL_RULES.update(RULES)
+
+# transforms whose function argument is traced (and whose construction
+# in a loop is a recompile hazard)
+_TRACE_WRAPPERS = {"jit", "pjit", "pmap", "vmap", "shard_map", "remat",
+                   "xmap", "grad", "value_and_grad"}
+# jit-cache owners: constructing these per-iteration is JX003 (vmap /
+# grad construction is cheap — tracing happens at call time)
+_CACHE_WRAPPERS = {"jit", "pjit", "pmap", "shard_map"}
+# lax control-flow HOFs: (callable-argument positions)
+_LAX_HOFS = {"fori_loop": (2,), "while_loop": (0, 1), "scan": (0,),
+             "cond": (1, 2, 3), "switch": (1,), "map": (0,),
+             "associative_scan": (0,)}
+# attribute reads that are static even on a tracer
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "sharding",
+                 "weak_type"}
+_HOST_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+_NUMPY_FUNCS = {"asarray", "array", "copy", "save", "savez"}
+
+
+def _is_jax_module(name: Optional[str]) -> bool:
+    return name is not None and (name == "jax" or name.startswith("jax."))
+
+
+class _ModuleScan:
+    """Per-module import/alias resolution."""
+
+    def __init__(self, tree: ast.AST):
+        self.numpy_aliases: Set[str] = set()
+        self.jax_aliases: Set[str] = {"jax"}
+        self.lax_aliases: Set[str] = set()
+        self.from_jax: Set[str] = set()     # names imported from jax*
+        self.partial_names: Set[str] = {"partial"}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    alias = a.asname or a.name.split(".")[0]
+                    if a.name == "numpy":
+                        # host numpy only — jax.numpy stays on device
+                        self.numpy_aliases.add(alias)
+                    elif a.name == "jax":
+                        self.jax_aliases.add(alias)
+                    elif a.name == "jax.lax":
+                        self.lax_aliases.add(alias)
+            elif isinstance(node, ast.ImportFrom):
+                modname = node.module or ""
+                for a in node.names:
+                    alias = a.asname or a.name
+                    if modname == "jax" and a.name == "lax":
+                        self.lax_aliases.add(alias)
+                    elif _is_jax_module(modname):
+                        self.from_jax.add(alias)
+                    elif modname == "functools" and a.name == "partial":
+                        self.partial_names.add(alias)
+
+    # ---------------------------------------------------- wrapper kinds
+    def wrapper_kind(self, node) -> Optional[str]:
+        """'jit', 'vmap', ... when ``node`` is a tracing transform
+        expression (possibly through ``partial``)."""
+        name = dotted_name(node)
+        if name is not None:
+            head, _, last = name.rpartition(".")
+            if last in _TRACE_WRAPPERS:
+                if head:
+                    root = head.split(".")[0]
+                    if root in self.jax_aliases or root in self.lax_aliases \
+                            or _is_jax_module(head):
+                        return last
+                elif name in self.from_jax:
+                    return last
+            return None
+        if isinstance(node, ast.Call):
+            # partial(jax.jit, ...) / functools.partial(jax.jit, ...)
+            fname = dotted_name(node.func)
+            if fname and fname.split(".")[-1] in self.partial_names \
+                    and node.args:
+                return self.wrapper_kind(node.args[0])
+            # jax.jit(f, ...) used as a decorator factory result —
+            # @jax.jit(...) appears as Call(func=jax.jit)
+            return self.wrapper_kind(node.func)
+        return None
+
+    def lax_hof_positions(self, call: ast.Call):
+        name = dotted_name(call.func)
+        if name is None:
+            return None
+        head, _, last = name.rpartition(".")
+        if last not in _LAX_HOFS:
+            return None
+        root = head.split(".")[0] if head else ""
+        if root in self.lax_aliases or head.endswith("lax") \
+                or (root in self.jax_aliases and "lax" in head):
+            return _LAX_HOFS[last]
+        return None
+
+    def is_numpy_call(self, call: ast.Call) -> bool:
+        name = dotted_name(call.func)
+        if not name or "." not in name:
+            return False
+        head, _, last = name.rpartition(".")
+        return head in self.numpy_aliases and last in _NUMPY_FUNCS
+
+
+def _static_params(call_or_dec, scan: _ModuleScan,
+                   func: Optional[ast.AST]) -> Set[str]:
+    """Parameter names declared static via static_argnums/argnames on a
+    jit decorator/wrapping call."""
+    out: Set[str] = set()
+    node = call_or_dec
+    calls = []
+    while isinstance(node, ast.Call):
+        calls.append(node)
+        fname = dotted_name(node.func)
+        if fname and fname.split(".")[-1] in scan.partial_names \
+                and node.args:
+            node = node.args[0]
+        else:
+            break
+    params = []
+    if func is not None and isinstance(func, (ast.FunctionDef,
+                                              ast.AsyncFunctionDef)):
+        a = func.args
+        params = [p.arg for p in a.posonlyargs + a.args]
+    for call in calls:
+        for kw in call.keywords:
+            if kw.arg == "static_argnames":
+                for v in ast.walk(kw.value):
+                    s = core.str_const(v)
+                    if s:
+                        out.add(s)
+            elif kw.arg == "static_argnums":
+                nums = []
+                if isinstance(kw.value, ast.Constant) \
+                        and isinstance(kw.value.value, int):
+                    nums = [kw.value.value]
+                elif isinstance(kw.value, (ast.Tuple, ast.List)):
+                    nums = [e.value for e in kw.value.elts
+                            if isinstance(e, ast.Constant)
+                            and isinstance(e.value, int)]
+                for n in nums:
+                    if 0 <= n < len(params):
+                        out.add(params[n])
+    return out
+
+
+class JaxRules:
+    """The JX pack (stateless across files — every rule is per-module)."""
+
+    rules = RULES
+
+    def finalize(self) -> List[Finding]:
+        return []
+
+    def visit_module(self, mod: ModuleInfo) -> List[Finding]:
+        scan = _ModuleScan(mod.tree)
+        findings: List[Finding] = []
+        traced: Dict[ast.AST, Set[str]] = {}   # func node -> static params
+        func_defs: Dict[str, List[ast.AST]] = {}
+        parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(mod.tree):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                func_defs.setdefault(node.name, []).append(node)
+
+        def mark(func_node, statics: Set[str]):
+            if isinstance(func_node, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                prev = traced.get(func_node)
+                traced[func_node] = (statics if prev is None
+                                    else prev & statics)
+
+        def resolve_func(expr) -> List[ast.AST]:
+            if isinstance(expr, ast.Lambda):
+                return [expr]
+            if isinstance(expr, ast.Name):
+                return func_defs.get(expr.id, [])
+            return []
+
+        # ---------------------------------------------- mark traced scopes
+        wrapped_names: Dict[str, tuple] = {}  # jitted alias -> (call, func)
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if scan.wrapper_kind(dec):
+                        mark(node, _static_params(dec, scan, node))
+            if not isinstance(node, ast.Call):
+                continue
+            kind = scan.wrapper_kind(node.func)
+            if kind and node.args:
+                for fn in resolve_func(node.args[0]):
+                    mark(fn, _static_params(node, scan, fn))
+                # g = jax.jit(f, static_argnums=...) — remember the alias
+                par = parents.get(node)
+                if isinstance(par, ast.Assign) and len(par.targets) == 1 \
+                        and isinstance(par.targets[0], ast.Name):
+                    tgt = resolve_func(node.args[0])
+                    wrapped_names[par.targets[0].id] = (
+                        node, tgt[0] if tgt else None)
+            hof = scan.lax_hof_positions(node)
+            if hof is not None:
+                for pos in hof:
+                    if pos < len(node.args):
+                        for fn in resolve_func(node.args[pos]):
+                            mark(fn, set())
+
+        # decorated defs also own a wrapped name (their own)
+        for fns in func_defs.values():
+            for fn in fns:
+                if fn in traced and isinstance(fn, ast.FunctionDef):
+                    for dec in fn.decorator_list:
+                        if scan.wrapper_kind(dec):
+                            wrapped_names.setdefault(fn.name, (dec, fn))
+
+        # ------------------------------------------------ per-scope checks
+        for fn, statics in traced.items():
+            findings.extend(self._check_traced(mod, scan, fn, statics))
+
+        # ------------------------------------------------ JX003 jit-in-loop
+        for node in ast.walk(mod.tree):
+            hazard = None
+            if isinstance(node, ast.Call) \
+                    and scan.wrapper_kind(node.func) in _CACHE_WRAPPERS:
+                hazard = node
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and any(scan.wrapper_kind(d) in _CACHE_WRAPPERS
+                            for d in node.decorator_list):
+                hazard = node
+            if hazard is None:
+                continue
+            cur = parents.get(node)
+            inner = node
+            while cur is not None:
+                if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                    ast.Lambda)) and inner is not node:
+                    break  # construction deferred into a callable: fine
+                if isinstance(cur, (ast.For, ast.While)) \
+                        and inner in (cur.body + getattr(cur, "orelse", [])):
+                    findings.append(mod.finding(
+                        "JX003", hazard,
+                        "jit-in-loop: a tracing transform constructed "
+                        "inside a loop body gets a fresh compile cache "
+                        "every iteration; hoist it out of the loop"))
+                    break
+                inner, cur = cur, parents.get(cur)
+
+        # ---------------------------------------- JX004 unhashable statics
+        for alias, (call, fn) in wrapped_names.items():
+            statics = _static_params(call, scan, fn)
+            if not statics or fn is None:
+                continue
+            a = fn.args
+            pos_params = [p.arg for p in a.posonlyargs + a.args]
+            defaults = a.defaults
+            for p, d in zip(pos_params[len(pos_params) - len(defaults):],
+                            defaults):
+                if p in statics and isinstance(
+                        d, (ast.List, ast.Dict, ast.Set)):
+                    findings.append(mod.finding(
+                        "JX004",
+                        d, f"static arg {p!r} of {fn.name!r} defaults to "
+                        "an unhashable container; jit static args must "
+                        "hash (use a tuple or a frozen dataclass)"))
+            # call sites of the wrapped alias feeding containers
+            static_idx = {pos_params.index(p) for p in statics
+                          if p in pos_params}
+            for node in ast.walk(mod.tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Name)
+                        and node.func.id == alias):
+                    continue
+                for i, arg in enumerate(node.args):
+                    if i in static_idx and isinstance(
+                            arg, (ast.List, ast.Dict, ast.Set)):
+                        findings.append(mod.finding(
+                            "JX004", arg,
+                            f"unhashable container passed to static arg "
+                            f"#{i} of jitted {alias!r}; every new object "
+                            "recompiles (pass a tuple)"))
+                for kw in node.keywords:
+                    if kw.arg in statics and isinstance(
+                            kw.value, (ast.List, ast.Dict, ast.Set)):
+                        findings.append(mod.finding(
+                            "JX004", kw.value,
+                            f"unhashable container passed to static arg "
+                            f"{kw.arg!r} of jitted {alias!r}; every new "
+                            "object recompiles (pass a tuple)"))
+        return findings
+
+    # ------------------------------------------------------------ taint
+    def _check_traced(self, mod: ModuleInfo, scan: _ModuleScan, fn,
+                      statics: Set[str]) -> List[Finding]:
+        findings: List[Finding] = []
+        if isinstance(fn, ast.Lambda):
+            params = {a.arg for a in fn.args.args + fn.args.posonlyargs}
+        else:
+            a = fn.args
+            params = {p.arg for p in a.posonlyargs + a.args + a.kwonlyargs}
+            if a.vararg:
+                params.add(a.vararg.arg)
+        tainted = {p for p in params - statics if p != "self"}
+        globals_declared: Set[str] = set()
+        nonlocals_declared: Set[str] = set()
+
+        def contains_taint(expr) -> bool:
+            """Does ``expr`` reference a tainted name OUTSIDE a static
+            attribute chain (``x.shape``...) or a ``len()`` call?"""
+            if isinstance(expr, ast.Attribute) \
+                    and expr.attr in _STATIC_ATTRS:
+                return False
+            if isinstance(expr, ast.Call):
+                fname = dotted_name(expr.func)
+                if fname == "len":
+                    return False
+            if isinstance(expr, ast.Name):
+                return expr.id in tainted
+            return any(contains_taint(c)
+                       for c in ast.iter_child_nodes(expr))
+
+        def target_names(target):
+            if isinstance(target, ast.Name):
+                yield target.id
+            elif isinstance(target, (ast.Tuple, ast.List)):
+                for e in target.elts:
+                    yield from target_names(e)
+            elif isinstance(target, ast.Starred):
+                yield from target_names(target.value)
+
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        # statements in source order so taint flows forward
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Global):
+                    globals_declared.update(node.names)
+                elif isinstance(node, ast.Nonlocal):
+                    nonlocals_declared.update(node.names)
+                elif isinstance(node, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef, ast.Lambda)):
+                    # nested defs run at trace time too: their params
+                    # typically carry tracers (lax bodies, helpers)
+                    args = node.args
+                    tainted.update(
+                        p.arg for p in args.posonlyargs + args.args
+                        if p.arg != "self")
+                elif isinstance(node, ast.Assign):
+                    if contains_taint(node.value):
+                        for t in node.targets:
+                            tainted.update(target_names(t))
+                elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                    if node.value is not None \
+                            and contains_taint(node.value):
+                        tainted.update(target_names(node.target))
+                elif isinstance(node, ast.For):
+                    if contains_taint(node.iter):
+                        tainted.update(target_names(node.target))
+        # pass 2: report hazards with the final taint set
+        for stmt in body:
+            for node in ast.walk(stmt):
+                findings.extend(self._taint_hazards(
+                    mod, scan, node, contains_taint,
+                    globals_declared, nonlocals_declared))
+        return findings
+
+    def _taint_hazards(self, mod, scan, node, contains_taint,
+                       globals_declared, nonlocals_declared):
+        out = []
+        if isinstance(node, ast.Call):
+            fname = dotted_name(node.func)
+            if fname in ("float", "int", "bool", "complex") and node.args \
+                    and contains_taint(node.args[0]):
+                out.append(mod.finding(
+                    "JX001", node,
+                    f"{fname}() on a traced value forces host "
+                    "concretization inside a traced scope; keep it on "
+                    "device (jnp ops) or hoist the read out of the jit"))
+            elif fname and fname.rpartition(".")[2] == "device_get" \
+                    and node.args and contains_taint(node.args[0]):
+                out.append(mod.finding(
+                    "JX001", node,
+                    "jax.device_get inside a traced scope blocks on the "
+                    "device; move the fetch outside the traced function"))
+            elif scan.is_numpy_call(node) and node.args \
+                    and contains_taint(node.args[0]):
+                out.append(mod.finding(
+                    "JX001", node,
+                    "numpy call on a traced value pulls it to the host "
+                    "(sync + constant-fold); use jax.numpy inside "
+                    "traced code"))
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _HOST_SYNC_METHODS \
+                    and contains_taint(node.func.value):
+                out.append(mod.finding(
+                    "JX001", node,
+                    f".{node.func.attr}() on a traced value is a host "
+                    "sync inside a traced scope; return the value and "
+                    "read it outside the jit"))
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            if node.value is not None and contains_taint(node.value):
+                for t in targets:
+                    if isinstance(t, ast.Attribute) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id == "self":
+                        out.append(mod.finding(
+                            "JX002", node,
+                            f"traced value stored on self.{t.attr} from "
+                            "inside a traced scope — the tracer outlives "
+                            "the trace; return it instead"))
+                    elif isinstance(t, ast.Name) and (
+                            t.id in globals_declared
+                            or t.id in nonlocals_declared):
+                        out.append(mod.finding(
+                            "JX002", node,
+                            f"traced value stored in "
+                            f"{'global' if t.id in globals_declared else 'nonlocal'}"
+                            f" {t.id!r} from inside a traced scope; "
+                            "return it instead"))
+        elif isinstance(node, (ast.If, ast.While)):
+            test = node.test
+            if self._is_tracer_branch(test, contains_taint):
+                out.append(mod.finding(
+                    "JX005", node,
+                    "Python branch on a traced value — either a "
+                    "trace-time error or a silent shape-specialized "
+                    "recompile; use lax.cond/jnp.where or hoist the "
+                    "decision to a static argument"))
+        return out
+
+    def _is_tracer_branch(self, test, contains_taint) -> bool:
+        if isinstance(test, ast.BoolOp):
+            return any(self._is_tracer_branch(v, contains_taint)
+                       for v in test.values)
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            return self._is_tracer_branch(test.operand, contains_taint)
+        if isinstance(test, ast.Compare):
+            # `x is None` / `x is not None` and string compares are the
+            # static trace-time switch idiom — exempt
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops):
+                return False
+            for comp in [test.left] + test.comparators:
+                if isinstance(comp, ast.Constant) \
+                        and isinstance(comp.value, (str, type(None))):
+                    return False
+            return any(contains_taint(c)
+                       for c in [test.left] + test.comparators)
+        if isinstance(test, ast.Name):
+            return contains_taint(test)
+        return False

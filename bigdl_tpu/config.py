@@ -49,6 +49,15 @@ def _env_str(name: str, default):
     return os.environ.get(name, default)
 
 
+#: Bootstrap variables the smoke harnesses export for their child
+#: processes (repo path, scratch dir, A/B arm).  They are process
+#: plumbing, not framework configuration, so they are deliberately NOT
+#: config fields — but they are declared here so graftlint rule RD001
+#: can tell a known harness contract from an ad-hoc env spelling.
+#: Scripts may read them; library code may not.
+HARNESS_ENV = ("BIGDL_REPO", "BIGDL_SMOKE_DIR", "BIGDL_SMOKE_BASELINE")
+
+
 @dataclasses.dataclass
 class ObsConfig:
     """Observability layer switches (``bigdl_tpu/obs``).
@@ -131,6 +140,12 @@ class ObsConfig:
     # transition and can never wedge the goodput window tick)
     # [BIGDL_ALERT_SINK_TIMEOUT]
     alert_sink_timeout: float = 1.0
+    # strict metric registry: reject any bigdl_* metric registration
+    # not declared in obs/names.py (or whose kind/labels disagree) and
+    # enforce each family's label-cardinality ceiling.  CI and the
+    # smokes run with this on; production defaults off so a hotfixed
+    # counter can never crash a serving fleet [BIGDL_OBS_STRICT]
+    strict: bool = False
 
     @property
     def active(self) -> bool:
@@ -160,6 +175,7 @@ class ObsConfig:
             alert_rules=_env_str("BIGDL_ALERT_RULES", None),
             alert_sink=_env_str("BIGDL_ALERT_SINK", None),
             alert_sink_timeout=_env_float("BIGDL_ALERT_SINK_TIMEOUT", 1.0),
+            strict=_env_bool("BIGDL_OBS_STRICT", False),
         )
 
 
@@ -292,6 +308,10 @@ class AutoscaleConfig:
     # [BIGDL_AUTOSCALE_P99_HIGH / _LOW, seconds]
     p99_high: float = 0.0
     p99_low: float = 0.0
+    # current world size as exported by the supervisor for its children
+    # (the controller's starting point); 0 = unset, derive from
+    # min_world [BIGDL_AUTOSCALE_WORLD]
+    world: int = 0
     # dry-run: evaluate + count + trace every decision, execute none
     # [BIGDL_AUTOSCALE_DRY_RUN]
     dry_run: bool = False
@@ -321,6 +341,7 @@ class AutoscaleConfig:
                                        False),
             p99_high=_env_float("BIGDL_AUTOSCALE_P99_HIGH", 0.0),
             p99_low=_env_float("BIGDL_AUTOSCALE_P99_LOW", 0.0),
+            world=_env_int("BIGDL_AUTOSCALE_WORLD", 0),
             dry_run=_env_bool("BIGDL_AUTOSCALE_DRY_RUN", False),
             rules=_env_str("BIGDL_AUTOSCALE_RULES", None),
         )
@@ -401,6 +422,12 @@ class BigDLConfig:
     coordinator_address: Optional[str] = None
     num_processes: int = 1
     process_id: int = 0
+
+    # --- elastic attempt index [BIGDL_ELASTIC_ATTEMPT] ------------------
+    # which incarnation of an elastic run this process is (0 = first
+    # launch); the supervisor exports it into every child's environment
+    # and the goodput ledger / healthz payload key their shards on it
+    elastic_attempt: int = 0
 
     # --- native host library [BIGDL_TPU_NO_NATIVE] ----------------------
     # skip loading the C++ host data-plane .so (numpy fallback)
@@ -527,6 +554,7 @@ class BigDLConfig:
             coordinator_address=_env_str("BIGDL_COORDINATOR_ADDRESS", None),
             num_processes=_env_int("BIGDL_NUM_PROCESSES", 1),
             process_id=_env_int("BIGDL_PROCESS_ID", 0),
+            elastic_attempt=_env_int("BIGDL_ELASTIC_ATTEMPT", 0),
             no_native=_env_bool("BIGDL_TPU_NO_NATIVE", False),
             disable_logger=_env_bool("BIGDL_DISABLE_LOGGER", False),
             log_path=_env_str("BIGDL_LOG_PATH", None),

@@ -51,6 +51,7 @@ from typing import Iterator, NamedTuple, Optional
 import numpy as np
 
 from bigdl_tpu.dataset.dataset import DataSet
+from bigdl_tpu.obs import names
 
 log = logging.getLogger("bigdl_tpu.dataset")
 
@@ -171,10 +172,10 @@ class BoundedBuffer:
 
         reg = obs.get_registry()
         self._depth_gauge = reg.gauge(
-            "bigdl_stream_buffer_depth",
+            names.STREAM_BUFFER_DEPTH,
             "Records buffered between the stream source and the trainer")
         self._bp_counter = reg.counter(
-            "bigdl_stream_backpressure_waits_total",
+            names.STREAM_BACKPRESSURE_WAITS_TOTAL,
             "Producer waits on a full stream buffer")
 
     def depth(self) -> int:
@@ -313,17 +314,17 @@ class StreamDataSet(DataSet):
 
         reg = obs.get_registry()
         self._offset_gauge = reg.gauge(
-            "bigdl_stream_offset",
+            names.STREAM_OFFSET,
             "Trained stream frontier (records incorporated into the "
             "current trajectory)")
         self._watermark_gauge = reg.gauge(
-            "bigdl_stream_watermark",
+            names.STREAM_WATERMARK,
             "Event-time watermark of the trained stream frontier")
         self._lag_gauge = reg.gauge(
-            "bigdl_stream_lag_records",
+            names.STREAM_LAG_RECORDS,
             "Ingest frontier minus trained frontier (consumer lag)")
         self._records_counter = reg.counter(
-            "bigdl_stream_records_total",
+            names.STREAM_RECORDS_TOTAL,
             "Stream records consumed into training batches")
 
     # ------------------------------------------------------------ state

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import os
 from typing import Optional, Sequence
+from bigdl_tpu.obs import names
 
 
 class _EngineState:
@@ -131,7 +132,7 @@ class Engine:
             "engine.init_barrier", host=config.process_id,
             processes=config.num_processes, devices=n)
         obs.get_registry().counter(
-            "bigdl_engine_inits_total", "Engine.init calls").inc()
+            names.ENGINE_INITS_TOTAL, "Engine.init calls").inc()
         # live telemetry plane: bring the per-host /metrics + /healthz
         # endpoint up with the engine when BIGDL_OBS_PORT is set (unset:
         # one config read, no thread, no socket).  init is the choke

@@ -60,6 +60,7 @@ from bigdl_tpu.obs.runtime import (
     instrument_jit,
 )
 from bigdl_tpu.obs.trace import NULL_TRACER, NullTracer, Tracer
+from bigdl_tpu.obs import names
 
 __all__ = [
     "DEFAULT_BUCKETS", "MetricsRegistry", "Reservoir", "RuntimeStats",
@@ -168,18 +169,18 @@ def publish_runtime(registry: MetricsRegistry = None,
     snap = runtime.snapshot()
     st = snap["step_time_s"]
     g = registry.gauge(
-        "bigdl_step_time_seconds",
+        names.STEP_TIME_SECONDS,
         "Observed train-step completion time (dispatch -> resolved loss)",
         labels=("quantile",))
     for q in ("p50", "p95", "p99"):
         if st[q] is not None:
             g.labels(quantile=q).set(st[q])
     registry.gauge(
-        "bigdl_jit_compile_count",
+        names.JIT_COMPILE_COUNT,
         "Distinct jit compile events (new arg signatures)").set(
         snap["compile"]["count"])
     registry.gauge(
-        "bigdl_jit_compile_seconds_total",
+        names.JIT_COMPILE_SECONDS_TOTAL,
         "Wall seconds spent blocked on jit trace+compile").set(
         snap["compile"]["total_s"])
     # HLO-derived step FLOPs (compiled.cost_analysis(), normalized per
@@ -187,29 +188,29 @@ def publish_runtime(registry: MetricsRegistry = None,
     sf = snap.get("step_flops")
     if sf:
         registry.gauge(
-            "bigdl_step_flops",
+            names.STEP_FLOPS,
             "HLO cost-analysis FLOPs of one compiled train step").set(sf)
         p50 = st["p50"]
         if runtime.peak_flops and p50:
             registry.gauge(
-                "bigdl_mfu",
+                names.MFU,
                 "Model FLOPs utilization: HLO step FLOPs / (p50 step "
                 "time * peak chip FLOPs)").set(
                 sf / (p50 * runtime.peak_flops))
     rss = snap.get("host_rss_bytes")
     if rss:
-        registry.gauge("bigdl_host_rss_bytes",
+        registry.gauge(names.HOST_RSS_BYTES,
                        "Driver-process resident set size").set(rss)
     dm = snap.get("device_memory")
     if dm:
-        dg = registry.gauge("bigdl_device_memory_bytes",
+        dg = registry.gauge(names.DEVICE_MEMORY_BYTES,
                             "Device 0 memory stats", labels=("stat",))
         for k, v in dm.items():
             dg.labels(stat=k).set(v)
     dma = snap.get("device_memory_all")
     if dma:
         hg = registry.gauge(
-            "bigdl_hbm_peak_bytes",
+            names.HBM_PEAK_BYTES,
             "Peak HBM bytes in use, per local device",
             labels=("device",))
         for i, stats in dma.items():

@@ -36,6 +36,7 @@ import json
 import math
 import os
 from typing import List, Optional
+from bigdl_tpu.obs import names
 
 # the alignment anchor Engine.init emits after multi-host bring-up
 BARRIER_EVENT = "engine.init_barrier"
@@ -186,7 +187,7 @@ def detect_stragglers(shards: List[Shard],
         from bigdl_tpu import obs
 
         counter = obs.get_registry().counter(
-            "bigdl_straggler_steps_total",
+            names.STRAGGLER_STEPS_TOTAL,
             "Steps on which a host exceeded the cross-host median step "
             "time by BIGDL_STRAGGLER_FACTOR", labels=("host",))
         for h, v in hosts.items():
@@ -439,8 +440,8 @@ class FleetAggregator:
                          "source": addr})
                     # the streaming/serving backlog, on the host row —
                     # the signal the autoscaling policy loop scales on
-                    if s["name"] in ("bigdl_stream_buffer_depth",
-                                     "bigdl_serve_queue_depth"):
+                    if s["name"] in (names.STREAM_BUFFER_DEPTH,
+                                     names.SERVE_QUEUE_DEPTH):
                         entry["queue_depth"] = max(
                             entry["queue_depth"] or 0.0, s["value"])
         elif self._tailer is not None:
@@ -456,13 +457,13 @@ class FleetAggregator:
                         fleet["metrics"].setdefault(name, []).append(
                             {"labels": s.get("labels") or {},
                              "value": value, "source": fn})
-                        if name == "bigdl_goodput_ratio":
+                        if name == names.GOODPUT_RATIO:
                             entry["goodput_ratio"] = value
-                        elif name in ("bigdl_stream_buffer_depth",
-                                      "bigdl_serve_queue_depth"):
+                        elif name in (names.STREAM_BUFFER_DEPTH,
+                                      names.SERVE_QUEUE_DEPTH):
                             entry["queue_depth"] = max(
                                 entry["queue_depth"] or 0.0, value)
-                        elif name == "bigdl_alert_active" and value:
+                        elif name == names.ALERT_ACTIVE and value:
                             rule = (s.get("labels") or {}).get("rule")
                             entry["alerts"].append({"rule": rule})
                             fleet["alerts"].append(

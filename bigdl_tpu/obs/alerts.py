@@ -42,6 +42,7 @@ import operator
 import threading
 import time
 from typing import Callable, List, Optional
+from bigdl_tpu.obs import names
 
 log = logging.getLogger("bigdl_tpu.obs")
 
@@ -49,11 +50,11 @@ RULE_TYPES = ("threshold", "absence", "rate", "burn_rate")
 OPS = {"<": operator.lt, "<=": operator.le, ">": operator.gt,
        ">=": operator.ge, "==": operator.eq, "!=": operator.ne}
 
-_FIRED_META = ("bigdl_alerts_total",
+_FIRED_META = (names.ALERTS_TOTAL,
                "Alert firing transitions, by rule and severity")
-_RESOLVED_META = ("bigdl_alerts_resolved_total",
+_RESOLVED_META = (names.ALERTS_RESOLVED_TOTAL,
                   "Alert resolved transitions, by rule")
-_ACTIVE_META = ("bigdl_alert_active",
+_ACTIVE_META = (names.ALERT_ACTIVE,
                 "1 while the rule is firing, 0 otherwise")
 
 
@@ -80,22 +81,22 @@ def default_rules(heartbeat_timeout: float = 60.0) -> List[dict]:
     already *measure* but nothing *watched*."""
     return [
         {"name": "goodput_below_target", "type": "threshold",
-         "metric": "bigdl_goodput_ratio", "op": "<", "value": 0.5,
+         "metric": names.GOODPUT_RATIO, "op": "<", "value": 0.5,
          "for": 2, "severity": "warning"},
         {"name": "goodput_slo_burn", "type": "burn_rate",
-         "metric": "bigdl_goodput_window_ratio", "slo": 0.5,
+         "metric": names.GOODPUT_WINDOW_RATIO, "slo": 0.5,
          "threshold": 1.5, "for": 2, "severity": "warning"},
         {"name": "nonfinite_spike", "type": "rate",
-         "metric": "bigdl_nonfinite_skips_total", "op": ">", "value": 0,
+         "metric": names.NONFINITE_SKIPS_TOTAL, "op": ">", "value": 0,
          "for": 1, "severity": "critical"},
         {"name": "straggler_flagged", "type": "rate",
-         "metric": "bigdl_straggler_steps_total", "op": ">", "value": 0,
+         "metric": names.STRAGGLER_STEPS_TOTAL, "op": ">", "value": 0,
          "for": 1, "severity": "warning"},
         {"name": "checkpoint_write_failure", "type": "rate",
-         "metric": "bigdl_checkpoint_write_failures_total", "op": ">",
+         "metric": names.CHECKPOINT_WRITE_FAILURES_TOTAL, "op": ">",
          "value": 0, "for": 1, "severity": "critical"},
         {"name": "stale_peer_heartbeat", "type": "threshold",
-         "metric": "bigdl_heartbeat_age_seconds", "op": ">",
+         "metric": names.HEARTBEAT_AGE_SECONDS, "op": ">",
          "value": max(1.0, float(heartbeat_timeout)) * 0.5,
          "for": 1, "severity": "warning"},
         # overlapped step (ISSUE 11): the bucketed exchange should hide
@@ -104,7 +105,7 @@ def default_rules(heartbeat_timeout: float = 60.0) -> List[dict]:
         # (or comm outruns backward entirely); inert on runs without
         # the overlap gauges (threshold rules never fire on absence)
         {"name": "exposed_comm_high", "type": "threshold",
-         "metric": "bigdl_overlap_exposed_comm_fraction", "op": ">",
+         "metric": names.OVERLAP_EXPOSED_COMM_FRACTION, "op": ">",
          "value": 0.5, "for": 2, "severity": "warning"},
         # serving tier (ISSUE 12): the LM engine publishes the fraction
         # of recent requests completing within BIGDL_SERVE_SLO_MS as a
@@ -113,7 +114,7 @@ def default_rules(heartbeat_timeout: float = 60.0) -> List[dict]:
         # analogue of goodput_slo_burn.  Inert on non-serving runs
         # (burn_rate rules never fire on an absent metric)
         {"name": "serve_latency_slo_burn", "type": "burn_rate",
-         "metric": "bigdl_serve_latency_slo_ratio", "slo": 0.99,
+         "metric": names.SERVE_LATENCY_SLO_RATIO, "slo": 0.99,
          "threshold": 2.0, "for": 2, "severity": "warning"},
     ]
 
@@ -337,7 +338,7 @@ def _count_sink_failure():
     from bigdl_tpu import obs
 
     obs.get_registry().counter(
-        "bigdl_alert_sink_failures_total",
+        names.ALERT_SINK_FAILURES_TOTAL,
         "Alert transitions the sink failed to accept (after the "
         "retry)").inc()
 

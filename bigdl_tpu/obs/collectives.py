@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import math
 from typing import Optional
+from bigdl_tpu.obs import names
 
 # jax dtypes numpy can't name, plus the common spellings — fall back to
 # numpy's itemsize for everything else
@@ -161,7 +162,7 @@ def staged_ring_exchange_bytes(padded_elems: int, axis_size: int,
 
 
 _SAVINGS_META = (
-    "bigdl_collective_wire_savings_ratio",
+    names.COLLECTIVE_WIRE_SAVINGS_RATIO,
     "Uncompressed exchange bytes over what the configured wire "
     "actually ships, per exchange path (grad = DistriOptimizer's "
     "ZeRO-1 exchange, tp/moe/ring = the opt-in compressed wires)",
@@ -187,12 +188,12 @@ def record_savings(path: str, baseline_bytes: float, wire_bytes: float,
 
 # --------------------------------------------------------------- recording
 _COUNTER_META = (
-    "bigdl_collective_bytes_total",
+    names.COLLECTIVE_BYTES_TOTAL,
     "Wire bytes programmed into collectives, from static shapes "
     "(ring-algorithm cost model; no device reads)",
 )
 _GAUGE_META = (
-    "bigdl_collective_bytes_per_step",
+    names.COLLECTIVE_BYTES_PER_STEP,
     "Static per-train-step wire bytes of the optimizer's collective "
     "footprint",
 )

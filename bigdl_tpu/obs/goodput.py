@@ -52,6 +52,7 @@ import os
 import threading
 import time
 from typing import Dict, List, Optional
+from bigdl_tpu.obs import names
 
 log = logging.getLogger("bigdl_tpu.obs")
 
@@ -76,26 +77,26 @@ BADPUT_CAUSES = tuple(c for c in CAUSES if c != "step")
 BOTTLENECKS = ("input_bound", "compute_bound", "comm_bound", "host_bound")
 
 _RATIO_META = (
-    "bigdl_goodput_ratio",
+    names.GOODPUT_RATIO,
     "Productive step seconds over total accounted wall seconds "
     "(this attempt)",
 )
 _BADPUT_META = (
-    "bigdl_badput_seconds_total",
+    names.BADPUT_SECONDS_TOTAL,
     "Non-productive wall seconds, by cause (goodput ledger)",
 )
 _BOTTLENECK_META = (
-    "bigdl_bottleneck",
+    names.BOTTLENECK,
     "One-hot per-window bottleneck classification "
     "(input/compute/comm/host bound)",
 )
 _REWORK_META = (
-    "bigdl_rework_steps_total",
+    names.REWORK_STEPS_TOTAL,
     "Steps re-executed after a restart (restored step -> pre-crash "
     "high-water mark)",
 )
 _WINDOW_RATIO_META = (
-    "bigdl_goodput_window_ratio",
+    names.GOODPUT_WINDOW_RATIO,
     "Good share of the last classifier window's wall clock "
     "(1 - badput/wall; badput = input waits, compiles, checkpoints) "
     "— the live SLO burn-rate signal",
@@ -113,8 +114,10 @@ def _default_host_id() -> int:
 
 def _attempt_from_env() -> int:
     try:
-        return int(os.environ.get("BIGDL_ELASTIC_ATTEMPT", "0"))
-    except ValueError:
+        from bigdl_tpu.config import refresh_from_env
+
+        return int(refresh_from_env().elastic_attempt)
+    except Exception:  # noqa: BLE001 — the ledger must never fail bring-up
         return 0
 
 

@@ -46,6 +46,7 @@ import logging
 from typing import List, Optional, Sequence
 
 import numpy as np
+from bigdl_tpu.obs import names as mnames
 
 log = logging.getLogger("bigdl_tpu.obs")
 
@@ -197,26 +198,26 @@ class HealthMonitor:
             maxlen=max(8, int(window)))
         self.last: Optional[dict] = None
         self._grad_gauge = self.registry.gauge(
-            "bigdl_grad_norm",
+            mnames.GRAD_NORM,
             "Per-layer global gradient L2 norm (pre-clip)",
             labels=("layer",))
         self._param_gauge = self.registry.gauge(
-            "bigdl_param_norm", "Per-layer parameter L2 norm",
+            mnames.PARAM_NORM, "Per-layer parameter L2 norm",
             labels=("layer",))
         self._ratio_gauge = self.registry.gauge(
-            "bigdl_update_ratio",
+            mnames.UPDATE_RATIO,
             "Per-layer ||update|| / ||param|| ratio", labels=("layer",))
         self._gnorm_hist = self.registry.histogram(
-            "bigdl_global_grad_norm",
+            mnames.GLOBAL_GRAD_NORM,
             "Global (all-layer) gradient L2 norm per health sample",
             buckets=(1e-4, 1e-3, 1e-2, 0.1, 0.3, 1.0, 3.0, 10.0, 30.0,
                      100.0, 1e3, 1e4))
         self._nonfinite_ctr = self.registry.counter(
-            "bigdl_nonfinite_layers_total",
+            mnames.NONFINITE_LAYERS_TOTAL,
             "Non-finite-gradient steps attributed per layer",
             labels=("layer",))
         self._anomaly_ctr = self.registry.counter(
-            "bigdl_numerics_anomalies_total",
+            mnames.NUMERICS_ANOMALIES_TOTAL,
             "Loss / grad-norm spikes vs the rolling median",
             labels=("kind",))
 

@@ -35,6 +35,28 @@ DEFAULT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
                    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
 
 
+def _strict() -> bool:
+    """Is the strict metric registry on (``BIGDL_OBS_STRICT=1`` /
+    ``config.obs.strict``)?  Read at call time so tests and harnesses
+    can toggle it without rebuilding the registry."""
+    try:
+        from bigdl_tpu.config import refresh_from_env
+
+        return bool(refresh_from_env().obs.strict)
+    except Exception:  # noqa: BLE001 — metrics must never sink the host
+        return False
+
+
+def _declared_spec(name: str):
+    """The obs/names.py spec for a ``bigdl_*`` family (None when the
+    name is foreign — private registries may mint what they like)."""
+    if not name.startswith("bigdl_"):
+        return None
+    from bigdl_tpu.obs import names as _names
+
+    return _names.REGISTRY.get(name)
+
+
 def _escape(v) -> str:
     return (str(v).replace("\\", r"\\").replace("\n", r"\n")
             .replace('"', r'\"'))
@@ -164,12 +186,17 @@ class _Family:
 
     def __init__(self, name: str, help: str, kind: str,
                  labelnames: Tuple[str, ...] = (),
-                 buckets: Optional[Sequence[float]] = None):
+                 buckets: Optional[Sequence[float]] = None,
+                 max_children: Optional[int] = None):
         self.name = name
         self.help = help
         self.kind = kind
         self.labelnames = tuple(labelnames)
         self.buckets = tuple(buckets) if buckets else DEFAULT_BUCKETS
+        # label-cardinality ceiling from the obs/names.py spec —
+        # enforced only under BIGDL_OBS_STRICT so a production fleet
+        # degrades to an over-wide family instead of crashing
+        self.max_children = max_children
         self._lock = threading.Lock()
         self._children: Dict[tuple, object] = {}
 
@@ -182,6 +209,16 @@ class _Family:
         with self._lock:
             child = self._children.get(key)
             if child is None:
+                if self.max_children is not None \
+                        and len(self._children) >= self.max_children \
+                        and _strict():
+                    raise ValueError(
+                        f"{self.name}: label cardinality ceiling "
+                        f"{self.max_children} exceeded (new combination "
+                        f"{key!r}); an unbounded label eats the scrape "
+                        "surface — raise the ceiling in "
+                        "bigdl_tpu/obs/names.py only if the fan-out is "
+                        "really bounded")
                 cls = _KINDS[self.kind]
                 child = (cls(self._lock, self.buckets)
                          if self.kind == "histogram" else cls(self._lock))
@@ -234,7 +271,24 @@ class MetricsRegistry:
                         f"metric {name!r} already registered as "
                         f"{fam.kind}{fam.labelnames}, not {kind}{tuple(labels)}")
                 return fam
-            fam = _Family(name, help, kind, tuple(labels), buckets)
+            spec = _declared_spec(name)
+            if spec is None and name.startswith("bigdl_") and _strict():
+                raise ValueError(
+                    f"metric {name!r} is not declared in "
+                    "bigdl_tpu/obs/names.py and BIGDL_OBS_STRICT is on; "
+                    "declare it there (kind, labels, cardinality "
+                    "ceiling, doc) so the registry stays the single "
+                    "source of truth")
+            if spec is not None and _strict() and (
+                    spec.kind != kind
+                    or set(spec.labels) != set(labels)):
+                raise ValueError(
+                    f"metric {name!r} declared as {spec.kind}"
+                    f"{spec.labels} in bigdl_tpu/obs/names.py but "
+                    f"registered as {kind}{tuple(labels)}")
+            fam = _Family(name, help, kind, tuple(labels), buckets,
+                          max_children=(spec.cardinality
+                                        if spec is not None else None))
             self._families[name] = fam
             return fam
 

@@ -1,0 +1,321 @@
+"""Single source of truth for every published ``bigdl_*`` metric name.
+
+Every metric family the framework mints — counters, gauges, histograms,
+across obs/serving/resilience/optim/ops/dataset — is declared HERE,
+once, with its kind, label names, a label-cardinality ceiling and a
+one-line doc.  Mint sites reference these constants instead of string
+literals, which buys three guarantees:
+
+* a typo'd or ad-hoc metric name is an ImportError / lint failure, not
+  a silently-forked time series;
+* ``BIGDL_OBS_STRICT=1`` makes :class:`~bigdl_tpu.obs.metrics.
+  MetricsRegistry` reject any ``bigdl_*`` registration that is not
+  declared here (or whose kind/labels disagree), and cap each family at
+  its declared label cardinality — the runtime enforcement of the same
+  contract;
+* ``graftlint`` rule RD003/RD005 (``bigdl_tpu/analysis``) statically
+  pins every mint site in the tree to this registry, and RD004 requires
+  each declared name to be rendered by ``obs/report.py`` or documented.
+
+The ``cardinality`` ceiling is the maximum number of label-value
+combinations (children) the family may grow: a scrape surface is only
+as cheap as its widest family, and an unbounded label (request id,
+float bucket, raw exception text) is the classic way a registry eats
+the host.  Label-less families have ceiling 1.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class MetricSpec:
+    """Declared shape of one metric family."""
+
+    name: str
+    kind: str                    # "counter" | "gauge" | "histogram"
+    labels: Tuple[str, ...]      # declared label names, order-free
+    cardinality: int             # max label-value combinations
+    doc: str                     # one-line purpose (RD004 contract)
+
+
+#: name -> :class:`MetricSpec` for every declared family
+REGISTRY: Dict[str, MetricSpec] = {}
+
+_KINDS = ("counter", "gauge", "histogram")
+
+
+def _m(name: str, kind: str, labels: Tuple[str, ...] = (),
+       cardinality: int = 1, doc: str = "") -> str:
+    if kind not in _KINDS:
+        raise ValueError(f"{name}: bad kind {kind!r}")
+    if name in REGISTRY:
+        raise ValueError(f"duplicate metric declaration {name!r}")
+    if labels and cardinality <= 1:
+        raise ValueError(f"{name}: labeled metric needs a ceiling > 1")
+    REGISTRY[name] = MetricSpec(name, kind, tuple(labels),
+                                int(cardinality), doc)
+    return name
+
+
+# --------------------------------------------------------------- runtime
+STEP_TIME_SECONDS = _m(
+    "bigdl_step_time_seconds", "gauge", ("quantile",), 4,
+    "Observed train-step completion time percentiles")
+JIT_COMPILE_COUNT = _m(
+    "bigdl_jit_compile_count", "gauge",
+    doc="Distinct jit compile events (new arg signatures)")
+JIT_COMPILE_SECONDS_TOTAL = _m(
+    "bigdl_jit_compile_seconds_total", "gauge",
+    doc="Wall seconds spent blocked on jit trace+compile")
+STEP_FLOPS = _m(
+    "bigdl_step_flops", "gauge",
+    doc="HLO cost-analysis FLOPs of one compiled train step")
+MFU = _m(
+    "bigdl_mfu", "gauge",
+    doc="Model FLOPs utilization vs the chip's peak")
+HOST_RSS_BYTES = _m(
+    "bigdl_host_rss_bytes", "gauge",
+    doc="Driver-process resident set size")
+DEVICE_MEMORY_BYTES = _m(
+    "bigdl_device_memory_bytes", "gauge", ("stat",), 16,
+    "Device 0 memory stats, per allocator stat")
+HBM_PEAK_BYTES = _m(
+    "bigdl_hbm_peak_bytes", "gauge", ("device",), 64,
+    "Peak HBM bytes in use, per local device")
+ENGINE_INITS_TOTAL = _m(
+    "bigdl_engine_inits_total", "counter",
+    doc="Engine.init calls in this process")
+
+# --------------------------------------------------------------- optim
+PHASE_SECONDS = _m(
+    "bigdl_phase_seconds", "histogram", ("phase",), 24,
+    "Driver phase timers (the reference's optim.Metrics)")
+OVERLAP_BUCKETS = _m(
+    "bigdl_overlap_buckets", "gauge",
+    doc="Gradient-exchange buckets in the overlap plan")
+OVERLAP_EXPOSED_COMM_FRACTION = _m(
+    "bigdl_overlap_exposed_comm_fraction", "gauge",
+    doc="Exposed (non-overlapped) comm seconds / step seconds")
+OVERLAP_EXPOSED_COMM_SECONDS = _m(
+    "bigdl_overlap_exposed_comm_seconds", "gauge",
+    doc="Exposed comm seconds per step after overlap")
+RETRY_ATTEMPTS_TOTAL = _m(
+    "bigdl_retry_attempts_total", "counter",
+    ("classification", "error"), 64,
+    "Classified-retry attempts, by failure class and error type")
+CHECKPOINT_WRITE_FAILURES_TOTAL = _m(
+    "bigdl_checkpoint_write_failures_total", "counter",
+    doc="Checkpoint writes that raised (sync or background writer)")
+PREEMPTIONS_TOTAL = _m(
+    "bigdl_preemptions_total", "counter",
+    doc="SIGTERM/SIGINT preemptions handled by the elastic exit path")
+SLOW_STEPS_TOTAL = _m(
+    "bigdl_slow_steps_total", "counter",
+    doc="Steps slower than median * BIGDL_SLOW_STEP_FACTOR")
+NONFINITE_SKIPS_TOTAL = _m(
+    "bigdl_nonfinite_skips_total", "counter",
+    doc="Weight updates skipped by the non-finite step guard")
+
+# --------------------------------------------------------------- kernels
+KERNEL_FALLBACKS_TOTAL = _m(
+    "bigdl_kernel_fallbacks_total", "counter", ("site",), 16,
+    "Kernel dispatches that fell back to the reference path")
+TUNER_CACHE_HITS_TOTAL = _m(
+    "bigdl_tuner_cache_hits_total", "counter",
+    doc="Tuner decisions served from the cache")
+TUNER_CACHE_MISSES_TOTAL = _m(
+    "bigdl_tuner_cache_misses_total", "counter",
+    doc="Tuner cache misses (fresh searches)")
+TUNER_MEASUREMENTS_TOTAL = _m(
+    "bigdl_tuner_measurements_total", "counter",
+    doc="Wall-clock candidate probes run by the auto-tuner")
+TUNER_DECISIONS_TOTAL = _m(
+    "bigdl_tuner_decisions_total", "counter", ("site", "impl"), 64,
+    "Auto-tuner dispatch decisions, by call site and chosen impl")
+
+# --------------------------------------------------------------- wire
+COLLECTIVE_BYTES_TOTAL = _m(
+    "bigdl_collective_bytes_total", "counter", ("op", "dtype"), 64,
+    "Wire bytes programmed into collectives, from static shapes")
+COLLECTIVE_BYTES_PER_STEP = _m(
+    "bigdl_collective_bytes_per_step", "gauge", ("op", "dtype"), 64,
+    "Static per-train-step wire bytes of the collective footprint")
+COLLECTIVE_WIRE_SAVINGS_RATIO = _m(
+    "bigdl_collective_wire_savings_ratio", "gauge", ("path",), 8,
+    "Uncompressed exchange bytes over what the wire actually ships")
+
+# --------------------------------------------------------------- goodput
+GOODPUT_RATIO = _m(
+    "bigdl_goodput_ratio", "gauge",
+    doc="Productive step seconds over total accounted wall seconds")
+GOODPUT_WINDOW_RATIO = _m(
+    "bigdl_goodput_window_ratio", "gauge",
+    doc="Good share of the last classifier window's wall clock")
+BADPUT_SECONDS_TOTAL = _m(
+    "bigdl_badput_seconds_total", "counter", ("cause",), 16,
+    "Non-productive wall seconds, by cause (goodput ledger)")
+BOTTLENECK = _m(
+    "bigdl_bottleneck", "gauge", ("class",), 8,
+    "One-hot per-window bottleneck classification")
+REWORK_STEPS_TOTAL = _m(
+    "bigdl_rework_steps_total", "counter",
+    doc="Steps re-executed after a restart")
+STRAGGLER_STEPS_TOTAL = _m(
+    "bigdl_straggler_steps_total", "counter", ("host",), 1024,
+    "Cross-host straggler detections, by slow host")
+
+# --------------------------------------------------------------- health
+GRAD_NORM = _m(
+    "bigdl_grad_norm", "gauge", ("layer",), 4096,
+    "Per-layer gradient norm (BIGDL_HEALTH_EVERY)")
+PARAM_NORM = _m(
+    "bigdl_param_norm", "gauge", ("layer",), 4096,
+    "Per-layer parameter norm")
+UPDATE_RATIO = _m(
+    "bigdl_update_ratio", "gauge", ("layer",), 4096,
+    "Per-layer update-to-param norm ratio")
+GLOBAL_GRAD_NORM = _m(
+    "bigdl_global_grad_norm", "histogram",
+    doc="Global gradient norm distribution")
+NONFINITE_LAYERS_TOTAL = _m(
+    "bigdl_nonfinite_layers_total", "counter", ("layer",), 4096,
+    "Layers whose grads went NaN/inf, by layer")
+NUMERICS_ANOMALIES_TOTAL = _m(
+    "bigdl_numerics_anomalies_total", "counter", ("kind",), 8,
+    "Loss / grad-norm spikes vs the rolling median")
+
+# --------------------------------------------------------------- alerts
+ALERTS_TOTAL = _m(
+    "bigdl_alerts_total", "counter", ("rule", "severity"), 64,
+    "Alert firing transitions, by rule and severity")
+ALERTS_RESOLVED_TOTAL = _m(
+    "bigdl_alerts_resolved_total", "counter", ("rule",), 64,
+    "Alert resolved transitions, by rule")
+ALERT_ACTIVE = _m(
+    "bigdl_alert_active", "gauge", ("rule",), 64,
+    "1 while the rule is firing, 0 otherwise")
+ALERT_SINK_FAILURES_TOTAL = _m(
+    "bigdl_alert_sink_failures_total", "counter",
+    doc="Alert sink deliveries that failed after retry")
+
+# --------------------------------------------------------------- resilience
+HEARTBEAT_AGE_SECONDS = _m(
+    "bigdl_heartbeat_age_seconds", "gauge", ("host",), 1024,
+    "Seconds since each peer's last heartbeat touch")
+PEER_LOST_TOTAL = _m(
+    "bigdl_peer_lost_total", "counter",
+    doc="PeerLostError raised for silent heartbeat peers")
+RESUMES_TOTAL = _m(
+    "bigdl_resumes_total", "counter", ("resize",), 32,
+    "Checkpoint resumes, by world-size transition (e.g. 2to1)")
+SUPERVISOR_RESTARTS_TOTAL = _m(
+    "bigdl_supervisor_restarts_total", "counter", ("kind",), 8,
+    "Supervisor child restarts, by failure kind")
+AUTOSCALE_DECISIONS_TOTAL = _m(
+    "bigdl_autoscale_decisions_total", "counter",
+    ("direction", "reason"), 32,
+    "Autoscale policy decisions, by direction and firing rule")
+
+# --------------------------------------------------------------- checkpoint
+CHECKPOINT_SNAPSHOT_SECONDS = _m(
+    "bigdl_checkpoint_snapshot_seconds", "gauge",
+    doc="Blocking device-to-host snapshot span of the last checkpoint")
+CHECKPOINT_WRITE_SECONDS = _m(
+    "bigdl_checkpoint_write_seconds", "gauge",
+    doc="Serialize+fsync span of the last checkpoint write")
+CHECKPOINT_WRITES_TOTAL = _m(
+    "bigdl_checkpoint_writes_total", "counter",
+    doc="Completed checkpoint writes")
+CHECKPOINT_VERIFY_FAILURES_TOTAL = _m(
+    "bigdl_checkpoint_verify_failures_total", "counter",
+    doc="Checkpoint read-back verifications that failed")
+
+# --------------------------------------------------------------- streaming
+STREAM_BUFFER_DEPTH = _m(
+    "bigdl_stream_buffer_depth", "gauge",
+    doc="Records buffered between the stream producer and the trainer")
+STREAM_BACKPRESSURE_WAITS_TOTAL = _m(
+    "bigdl_stream_backpressure_waits_total", "counter",
+    doc="Producer blocks on a full stream buffer")
+STREAM_OFFSET = _m(
+    "bigdl_stream_offset", "gauge",
+    doc="Last source offset handed to the trainer")
+STREAM_WATERMARK = _m(
+    "bigdl_stream_watermark", "gauge",
+    doc="Highest source offset the producer has ingested")
+STREAM_LAG_RECORDS = _m(
+    "bigdl_stream_lag_records", "gauge",
+    doc="Producer watermark minus trainer offset")
+STREAM_RECORDS_TOTAL = _m(
+    "bigdl_stream_records_total", "counter",
+    doc="Records handed to the trainer, exactly-once audited")
+
+# --------------------------------------------------------------- serving
+SERVE_REQUESTS_TOTAL = _m(
+    "bigdl_serve_requests_total", "counter", ("engine", "status"), 16,
+    "Completed serve requests, by engine and outcome")
+REQUEST_LATENCY_SECONDS = _m(
+    "bigdl_request_latency_seconds", "histogram", ("engine", "kind"), 16,
+    "Request latency by engine and kind (ttft/per_token/e2e)")
+SERVE_TOKENS_TOTAL = _m(
+    "bigdl_serve_tokens_total", "counter",
+    doc="Tokens decoded by the LM engine")
+SERVE_TOKENS_PER_SECOND = _m(
+    "bigdl_serve_tokens_per_second", "gauge",
+    doc="Rolling decode throughput")
+SERVE_BATCH_OCCUPANCY = _m(
+    "bigdl_serve_batch_occupancy", "gauge",
+    doc="Fraction of decode slots / micro-batch rows in use")
+SERVE_QUEUE_DEPTH = _m(
+    "bigdl_serve_queue_depth", "gauge",
+    doc="Requests waiting in the bounded admission queue")
+SERVE_KV_PAGES_IN_USE = _m(
+    "bigdl_serve_kv_pages_in_use", "gauge",
+    doc="Pages allocated from the paged KV cache pool")
+SERVE_ADMISSION_WAITS_TOTAL = _m(
+    "bigdl_serve_admission_waits_total", "counter",
+    doc="Client submits that blocked on a full request queue")
+SERVE_PREEMPTIONS_TOTAL = _m(
+    "bigdl_serve_preemptions_total", "counter",
+    doc="In-flight sequences evicted to free KV pages")
+SERVE_LATENCY_SLO_RATIO = _m(
+    "bigdl_serve_latency_slo_ratio", "gauge",
+    doc="Share of recent requests inside the e2e latency SLO")
+SERVE_DECODE_ATTN_MS = _m(
+    "bigdl_serve_decode_attn_ms", "gauge",
+    doc="Mean decode-attention kernel milliseconds per step")
+SERVE_DECODE_HBM_BYTES_PER_TOKEN = _m(
+    "bigdl_serve_decode_hbm_bytes_per_token", "gauge",
+    doc="Modeled HBM traffic per decoded token")
+
+#: ``bigdl_``-prefixed spellings that are NOT metric families — process
+#: names, trace categories, logger names — so the RD003 "every bigdl_*
+#: literal must be declared" rule knows they are deliberate.
+KNOWN_STRINGS = frozenset({
+    "bigdl_tpu",            # tracer process name / root logger name
+    "bigdl_tpu_net",        # caffe export net name
+    "bigdl_obs_span",       # Chrome trace category
+    "bigdl_flight_recorder",  # postmortem bundle stem
+})
+
+
+def spec(name: str) -> MetricSpec:
+    """The declared spec for ``name`` (KeyError when undeclared)."""
+    return REGISTRY[name]
+
+
+def is_declared(name: str) -> bool:
+    """Is ``name`` a declared family, or a histogram-derived sample
+    (``_bucket``/``_sum``/``_count``) of one?"""
+    if name in REGISTRY:
+        return True
+    for suffix in ("_bucket", "_sum", "_count"):
+        if name.endswith(suffix):
+            base = name[: -len(suffix)]
+            s = REGISTRY.get(base)
+            if s is not None and s.kind == "histogram":
+                return True
+    return False

@@ -36,6 +36,7 @@ import os
 import re
 import time
 from typing import List, Optional
+from bigdl_tpu.obs import names
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
 
@@ -218,11 +219,11 @@ def flight_bundle(reason: str = "", trace_dir: Optional[str] = None,
     }
 
 
-_HEALTH_FAMILIES = ("bigdl_grad_norm", "bigdl_param_norm",
-                    "bigdl_update_ratio", "bigdl_global_grad_norm",
-                    "bigdl_nonfinite_layers_total",
-                    "bigdl_numerics_anomalies_total", "bigdl_step_flops",
-                    "bigdl_mfu")
+_HEALTH_FAMILIES = (names.GRAD_NORM, names.PARAM_NORM,
+                    names.UPDATE_RATIO, names.GLOBAL_GRAD_NORM,
+                    names.NONFINITE_LAYERS_TOTAL,
+                    names.NUMERICS_ANOMALIES_TOTAL, names.STEP_FLOPS,
+                    names.MFU)
 
 
 def _health_columns(metrics: dict, spans: list) -> dict:

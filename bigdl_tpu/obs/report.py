@@ -48,6 +48,7 @@ import os
 from typing import List, Optional
 
 from bigdl_tpu.obs.aggregate import read_shards
+from bigdl_tpu.obs import names
 
 _PCTS = (0.5, 0.95, 0.99)
 
@@ -186,26 +187,26 @@ def build_report(trace_dir: str, metrics_dir: Optional[str] = None) -> dict:
     # ---- collective bytes from the metric snapshots ------------------
     coll_total: dict = {}
     for labels, s, _host in _metric_samples(
-            snaps, "bigdl_collective_bytes_total"):
+            snaps, names.COLLECTIVE_BYTES_TOTAL):
         key = f"{labels.get('op', '?')}:{labels.get('dtype', '?')}"
         coll_total[key] = coll_total.get(key, 0.0) + float(
             s.get("value", 0.0))
     coll_step: dict = {}
     for labels, s, _host in _metric_samples(
-            snaps, "bigdl_collective_bytes_per_step"):
+            snaps, names.COLLECTIVE_BYTES_PER_STEP):
         key = f"{labels.get('op', '?')}:{labels.get('dtype', '?')}"
         coll_step[key] = float(s.get("value", 0.0))
     savings = [float(s.get("value", 0.0)) for _l, s, _h in _metric_samples(
-        snaps, "bigdl_collective_wire_savings_ratio")]
+        snaps, names.COLLECTIVE_WIRE_SAVINGS_RATIO)]
     savings_by_path: dict = {}
     for labels, s, _host in _metric_samples(
-            snaps, "bigdl_collective_wire_savings_ratio"):
+            snaps, names.COLLECTIVE_WIRE_SAVINGS_RATIO):
         savings_by_path[labels.get("path", "grad")] = float(
             s.get("value", 0.0))
 
     compile_count = sum(
         float(s.get("value", 0.0)) for _l, s, _h in _metric_samples(
-            snaps, "bigdl_jit_compile_count"))
+            snaps, names.JIT_COMPILE_COUNT))
 
     # ---- training health (obs/health.py) -----------------------------
     def _by_layer(metric):
@@ -223,9 +224,9 @@ def build_report(trace_dir: str, metrics_dir: Optional[str] = None) -> dict:
 
     step_flops = [float(s.get("value", 0.0))
                   for _l, s, _h in _metric_samples(snaps,
-                                                   "bigdl_step_flops")]
+                                                   names.STEP_FLOPS)]
     mfu = [float(s.get("value", 0.0))
-           for _l, s, _h in _metric_samples(snaps, "bigdl_mfu")]
+           for _l, s, _h in _metric_samples(snaps, names.MFU)]
 
     # ---- goodput ledger (obs/goodput.py) -----------------------------
     from bigdl_tpu.obs import goodput as G
@@ -237,7 +238,7 @@ def build_report(trace_dir: str, metrics_dir: Optional[str] = None) -> dict:
         # comm/host fractions); fall back to re-deriving the input
         # share from the ledger when no window ever ticked
         label, source = None, None
-        for labels, s, _host in _metric_samples(snaps, "bigdl_bottleneck"):
+        for labels, s, _host in _metric_samples(snaps, names.BOTTLENECK):
             if float(s.get("value", 0.0)) >= 1.0:
                 label, source = labels.get("class"), "gauge"
         derived = G.classify_bottleneck(
@@ -252,7 +253,7 @@ def build_report(trace_dir: str, metrics_dir: Optional[str] = None) -> dict:
     # ---- kernel auto-tuner (ops/autotune.py) -------------------------
     tuner_decisions: dict = {}
     for labels, s, _host in _metric_samples(
-            snaps, "bigdl_tuner_decisions_total"):
+            snaps, names.TUNER_DECISIONS_TOTAL):
         key = f"{labels.get('site', '?')}:{labels.get('impl', '?')}"
         tuner_decisions[key] = tuner_decisions.get(key, 0.0) + float(
             s.get("value", 0.0))
@@ -263,25 +264,25 @@ def build_report(trace_dir: str, metrics_dir: Optional[str] = None) -> dict:
 
     tuner = {
         "decisions_total": tuner_decisions,
-        "cache_hits": _tuner_count("bigdl_tuner_cache_hits_total"),
-        "cache_misses": _tuner_count("bigdl_tuner_cache_misses_total"),
-        "measurements": _tuner_count("bigdl_tuner_measurements_total"),
+        "cache_hits": _tuner_count(names.TUNER_CACHE_HITS_TOTAL),
+        "cache_misses": _tuner_count(names.TUNER_CACHE_MISSES_TOTAL),
+        "measurements": _tuner_count(names.TUNER_MEASUREMENTS_TOTAL),
         "events": tuner_events,
     }
 
     # ---- alerts (obs/alerts.py) --------------------------------------
     fired: dict = {}
-    for labels, s, _host in _metric_samples(snaps, "bigdl_alerts_total"):
+    for labels, s, _host in _metric_samples(snaps, names.ALERTS_TOTAL):
         key = f"{labels.get('rule', '?')}[{labels.get('severity', '?')}]"
         fired[key] = fired.get(key, 0.0) + float(s.get("value", 0.0))
     resolved: dict = {}
     for labels, s, _host in _metric_samples(
-            snaps, "bigdl_alerts_resolved_total"):
+            snaps, names.ALERTS_RESOLVED_TOTAL):
         rule = labels.get("rule", "?")
         resolved[rule] = resolved.get(rule, 0.0) + float(
             s.get("value", 0.0))
     active: dict = {}
-    for labels, s, _host in _metric_samples(snaps, "bigdl_alert_active"):
+    for labels, s, _host in _metric_samples(snaps, names.ALERT_ACTIVE):
         rule = labels.get("rule", "?")
         active[rule] = max(active.get(rule, 0.0),
                            float(s.get("value", 0.0)))
@@ -297,12 +298,12 @@ def build_report(trace_dir: str, metrics_dir: Optional[str] = None) -> dict:
     # dataset/stream.py) ------------------------------------------------
     decisions: dict = {}
     for labels, s, _host in _metric_samples(
-            snaps, "bigdl_autoscale_decisions_total"):
+            snaps, names.AUTOSCALE_DECISIONS_TOTAL):
         key = f"{labels.get('direction', '?')}:{labels.get('reason', '?')}"
         decisions[key] = decisions.get(key, 0.0) + float(
             s.get("value", 0.0))
     resumes: dict = {}
-    for labels, s, _host in _metric_samples(snaps, "bigdl_resumes_total"):
+    for labels, s, _host in _metric_samples(snaps, names.RESUMES_TOTAL):
         key = labels.get("resize", "?")
         resumes[key] = resumes.get(key, 0.0) + float(s.get("value", 0.0))
 
@@ -316,19 +317,19 @@ def build_report(trace_dir: str, metrics_dir: Optional[str] = None) -> dict:
                    for _l, s, _h in _metric_samples(snaps, name))
 
     autoscale_events.sort(key=lambda a: a.get("wall_time") or 0.0)
-    stream_records = _metric_sum("bigdl_stream_records_total")
+    stream_records = _metric_sum(names.STREAM_RECORDS_TOTAL)
     autoscale = {
         "decisions_total": decisions,
         "resumes_total": resumes,
         "events": autoscale_events,
         "stream": None if not stream_records else {
             "records_total": stream_records,
-            "offset": _metric_max("bigdl_stream_offset"),
-            "watermark": _metric_max("bigdl_stream_watermark"),
-            "buffer_depth": _metric_max("bigdl_stream_buffer_depth"),
-            "lag_records": _metric_max("bigdl_stream_lag_records"),
+            "offset": _metric_max(names.STREAM_OFFSET),
+            "watermark": _metric_max(names.STREAM_WATERMARK),
+            "buffer_depth": _metric_max(names.STREAM_BUFFER_DEPTH),
+            "lag_records": _metric_max(names.STREAM_LAG_RECORDS),
             "backpressure_waits": _metric_sum(
-                "bigdl_stream_backpressure_waits_total"),
+                names.STREAM_BACKPRESSURE_WAITS_TOTAL),
         },
     }
 
@@ -371,66 +372,66 @@ def build_report(trace_dir: str, metrics_dir: Optional[str] = None) -> dict:
 
     serve_requests: dict = {}
     for labels, s, _host in _metric_samples(
-            snaps, "bigdl_serve_requests_total"):
+            snaps, names.SERVE_REQUESTS_TOTAL):
         key = f"{labels.get('engine', '?')}:{labels.get('status', '?')}"
         serve_requests[key] = serve_requests.get(key, 0.0) + float(
             s.get("value", 0.0))
     slo_vals = [float(s.get("value", 0.0)) for _l, s, _h in
-                _metric_samples(snaps, "bigdl_serve_latency_slo_ratio")]
+                _metric_samples(snaps, names.SERVE_LATENCY_SLO_RATIO)]
     serving = None
     if serve_requests or slo_vals:
         serving = {
             "requests_total": serve_requests,
-            "tokens_total": _metric_sum("bigdl_serve_tokens_total"),
+            "tokens_total": _metric_sum(names.SERVE_TOKENS_TOTAL),
             "tokens_per_second": _metric_max(
-                "bigdl_serve_tokens_per_second"),
+                names.SERVE_TOKENS_PER_SECOND),
             "batch_occupancy": _metric_max(
-                "bigdl_serve_batch_occupancy"),
-            "queue_depth": _metric_max("bigdl_serve_queue_depth"),
+                names.SERVE_BATCH_OCCUPANCY),
+            "queue_depth": _metric_max(names.SERVE_QUEUE_DEPTH),
             "kv_pages_in_use": _metric_max(
-                "bigdl_serve_kv_pages_in_use"),
+                names.SERVE_KV_PAGES_IN_USE),
             "admission_waits": _metric_sum(
-                "bigdl_serve_admission_waits_total"),
+                names.SERVE_ADMISSION_WAITS_TOTAL),
             "preemptions": _metric_sum(
-                "bigdl_serve_preemptions_total"),
+                names.SERVE_PREEMPTIONS_TOTAL),
             "slo_ratio": min(slo_vals) if slo_vals else None,
-            "latency": _hist_stats("bigdl_request_latency_seconds"),
+            "latency": _hist_stats(names.REQUEST_LATENCY_SECONDS),
             "decode_attn_ms": _metric_max(
-                "bigdl_serve_decode_attn_ms"),
+                names.SERVE_DECODE_ATTN_MS),
             "decode_hbm_bytes_per_token": _metric_max(
-                "bigdl_serve_decode_hbm_bytes_per_token"),
+                names.SERVE_DECODE_HBM_BYTES_PER_TOKEN),
         }
 
     # ---- overlapped step (ISSUE 11: bucketed exchange, async
     # checkpointing, double-buffered input) ----------------------------
-    buckets = _metric_max("bigdl_overlap_buckets")
+    buckets = _metric_max(names.OVERLAP_BUCKETS)
     overlap = {
         "buckets": buckets,
         "exposed_comm_fraction": _metric_max(
-            "bigdl_overlap_exposed_comm_fraction"),
+            names.OVERLAP_EXPOSED_COMM_FRACTION),
         "exposed_comm_seconds_per_step": _metric_max(
-            "bigdl_overlap_exposed_comm_seconds"),
+            names.OVERLAP_EXPOSED_COMM_SECONDS),
         "checkpoint_snapshot_seconds": _metric_max(
-            "bigdl_checkpoint_snapshot_seconds"),
+            names.CHECKPOINT_SNAPSHOT_SECONDS),
         "checkpoint_write_seconds": _metric_max(
-            "bigdl_checkpoint_write_seconds"),
+            names.CHECKPOINT_WRITE_SECONDS),
         "async_checkpoint_writes": ckpt_async_writes,
         "checkpoint_snapshots": ckpt_snapshots,
     }
 
     # per-device HBM peaks (bigdl_hbm_peak_bytes, max across snapshots)
     hbm: dict = {}
-    for labels, s, _host in _metric_samples(snaps, "bigdl_hbm_peak_bytes"):
+    for labels, s, _host in _metric_samples(snaps, names.HBM_PEAK_BYTES):
         d = labels.get("device", "?")
         hbm[d] = max(hbm.get(d, 0.0), float(s.get("value", 0.0)))
     health = {
-        "grad_norm": _by_layer("bigdl_grad_norm"),
-        "param_norm": _by_layer("bigdl_param_norm"),
-        "update_ratio": _by_layer("bigdl_update_ratio"),
+        "grad_norm": _by_layer(names.GRAD_NORM),
+        "param_norm": _by_layer(names.PARAM_NORM),
+        "update_ratio": _by_layer(names.UPDATE_RATIO),
         "nonfinite_layers_total": _summed(
-            "bigdl_nonfinite_layers_total", "layer"),
+            names.NONFINITE_LAYERS_TOTAL, "layer"),
         "anomalies_total": _summed(
-            "bigdl_numerics_anomalies_total", "kind"),
+            names.NUMERICS_ANOMALIES_TOTAL, "kind"),
         "nonfinite_events": nonfinite_events,
         "anomaly_events": anomaly_events,
         "step_flops": max(step_flops) if step_flops else None,
@@ -828,8 +829,10 @@ def main(argv=None) -> int:
     if args.watch:
         from bigdl_tpu.obs.aggregate import FleetAggregator
 
+        from bigdl_tpu.config import refresh_from_env
+
         peers = args.peers if args.peers is not None else \
-            os.environ.get("BIGDL_OBS_PEERS")
+            refresh_from_env().obs.obs_peers
         agg = FleetAggregator(
             peers=peers,
             metrics_dir=args.metrics_dir or args.trace_dir)

@@ -40,6 +40,7 @@ import time
 import urllib.parse
 import weakref
 from typing import List, Optional
+from bigdl_tpu.obs import names
 
 log = logging.getLogger("bigdl_tpu.obs")
 
@@ -118,7 +119,7 @@ def _heartbeat_census() -> Optional[dict]:
     from bigdl_tpu import obs
 
     for fam in obs.get_registry().families():
-        if fam.name == "bigdl_heartbeat_age_seconds":
+        if fam.name == names.HEARTBEAT_AGE_SECONDS:
             census = {}
             for key, child in fam.child_items():
                 labels = dict(zip(fam.labelnames, key))
@@ -150,7 +151,7 @@ def health_payload() -> dict:
         "status": status,
         "host": int(config.process_id),
         "pid": os.getpid(),
-        "attempt": int(os.environ.get("BIGDL_ELASTIC_ATTEMPT", "0") or 0),
+        "attempt": int(config.elastic_attempt),
         "time": now,
         "port": srv.port if srv is not None else None,
         "uptime_s": (round(now - srv.started, 3)

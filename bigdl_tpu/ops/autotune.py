@@ -54,6 +54,7 @@ import os
 import threading
 import time
 from typing import Optional
+from bigdl_tpu.obs import names
 
 # rough per-platform (peak_flops, peak_hbm_bytes_per_s) for the
 # roofline score.  Only the RANKING matters — every candidate of one
@@ -162,11 +163,11 @@ class TunerCache:
         rec = self.decisions.get(key)
         if rec is not None:
             self.hits += 1
-            _counter("bigdl_tuner_cache_hits_total",
+            _counter(names.TUNER_CACHE_HITS_TOTAL,
                      "Tuner decisions served from the cache")
         else:
             self.misses += 1
-            _counter("bigdl_tuner_cache_misses_total",
+            _counter(names.TUNER_CACHE_MISSES_TOTAL,
                      "Tuner cache misses (fresh searches)")
         return rec
 
@@ -265,7 +266,7 @@ def _measure(jitted, args, iters: int) -> float:
     for _ in range(max(1, iters)):
         out = jitted(*args)
     jax.block_until_ready(out)
-    _counter("bigdl_tuner_measurements_total",
+    _counter(names.TUNER_MEASUREMENTS_TOTAL,
              "Wall-clock candidate probes run by the auto-tuner")
     return (time.perf_counter() - t0) / max(1, iters)
 
@@ -327,6 +328,8 @@ def _resolve(site, key, candidates, static_label, analytic, probes,
             # (Pallas custom calls are opaque to HloCostAnalysis — the
             # analytic kernel traffic plan stands in)
             try:
+                # one jit per DISTINCT candidate, once per cached search
+                # — not a per-step re-jit  # graftlint: disable=JX003
                 jitted = jax.jit(probes[label])
                 cost = _hlo_cost(jitted, arrays) if arrays else None
             except Exception:  # noqa: BLE001
@@ -343,8 +346,10 @@ def _resolve(site, key, candidates, static_label, analytic, probes,
             if label not in candidates:
                 continue
             try:
-                measured[label] = _measure(jax.jit(probe), arrays,
-                                           cfg.measure_iters)
+                # fresh jit per candidate is the measurement protocol
+                # (cold compile excluded by the warmup call)
+                measured[label] = _measure(  # graftlint: disable=JX003
+                    jax.jit(probe), arrays, cfg.measure_iters)
             except Exception:  # noqa: BLE001 — one broken candidate
                 measured.pop(label, None)   # must not sink the search
 
@@ -390,7 +395,7 @@ def _resolve(site, key, candidates, static_label, analytic, probes,
 
 
 def _emit(site, rec, source):
-    _counter("bigdl_tuner_decisions_total",
+    _counter(names.TUNER_DECISIONS_TOTAL,
              "Auto-tuner dispatch decisions, by call site and chosen "
              "impl", site=site, impl=rec.get("impl", "?"))
     _event("tuner.decision", site=site, key=rec.get("key"),

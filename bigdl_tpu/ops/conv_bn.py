@@ -49,6 +49,7 @@ import logging
 
 import jax
 import jax.numpy as jnp
+from bigdl_tpu.obs import names
 
 _log = logging.getLogger(__name__)
 
@@ -88,7 +89,7 @@ def _note_fallback(reason, x_shape, w_shape, stride, pad):
         k = rec["w_shape"][2] if len(rec["w_shape"]) > 2 else 1
         site = f"conv_bn_k{k}s{rec['stride']}"
         obs.get_registry().counter(
-            "bigdl_kernel_fallbacks_total",
+            names.KERNEL_FALLBACKS_TOTAL,
             "Fused-kernel call sites that fell back to the XLA "
             "reference path, by site (trace-time, once per compile)",
             labels=("site",)).labels(site=site).inc()

@@ -38,6 +38,7 @@ from __future__ import annotations
 import numpy as np
 
 from bigdl_tpu.optim.optimizer import BaseOptimizer, LocalOptimizer
+from bigdl_tpu.obs import names
 
 
 def _jnp():
@@ -392,7 +393,7 @@ class DistriOptimizer(LocalOptimizer):
         n_buckets = len(self._buckets) if self._buckets else 1
         registry = obs.get_registry()
         registry.gauge(
-            "bigdl_overlap_buckets",
+            names.OVERLAP_BUCKETS,
             "Gradient-exchange buckets of the overlapped step "
             "(1 = monolithic, no overlap)").set(float(n_buckets))
         if n_buckets > 1:
@@ -400,13 +401,13 @@ class DistriOptimizer(LocalOptimizer):
             exposed = fp.total() - hidden
             self._obs_ledger.set_exposed_comm_bytes_per_step(exposed)
             registry.gauge(
-                "bigdl_overlap_exposed_comm_fraction",
+                names.OVERLAP_EXPOSED_COMM_FRACTION,
                 "Share of the per-step collective bytes NOT hidden "
                 "under backward by the bucketed exchange").set(
                 round(exposed / fp.total(), 6) if fp.total() else 0.0)
             if config.obs.wire_gbps > 0:
                 registry.gauge(
-                    "bigdl_overlap_exposed_comm_seconds",
+                    names.OVERLAP_EXPOSED_COMM_SECONDS,
                     "Estimated per-step collective seconds not hidden "
                     "by backward (exposed bytes / BIGDL_WIRE_GBPS)").set(
                     exposed / (config.obs.wire_gbps * 1e9))
@@ -913,7 +914,7 @@ class DistriOptimizer(LocalOptimizer):
         log = logging.getLogger("bigdl_tpu.optim")
         policy = RetryPolicy.from_config(max_retries=self.max_retry)
         retry_counter = obs.get_registry().counter(
-            "bigdl_retry_attempts_total",
+            names.RETRY_ATTEMPTS_TOTAL,
             "Training failures handled by the retry policy",
             labels=("classification", "error"))
         while True:

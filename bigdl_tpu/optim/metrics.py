@@ -18,6 +18,7 @@ from contextlib import contextmanager
 from typing import Optional
 
 from bigdl_tpu.obs.metrics import MetricsRegistry
+from bigdl_tpu.obs import names
 
 # per-phase driver wall time spans ~100us host phases to multi-second
 # checkpoint/validation phases
@@ -38,7 +39,7 @@ class Metrics:
     def __init__(self, registry: Optional[MetricsRegistry] = None):
         self.registry = registry if registry is not None else MetricsRegistry()
         self._family = self.registry.histogram(
-            "bigdl_phase_seconds",
+            names.PHASE_SECONDS,
             "Per-phase driver wall time (reference Metrics.scala names)",
             labels=("phase",), buckets=PHASE_BUCKETS)
 

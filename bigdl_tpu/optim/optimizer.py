@@ -24,6 +24,7 @@ from functools import partial
 from typing import Optional, Sequence
 
 import numpy as np
+from bigdl_tpu.obs import names
 
 log = logging.getLogger("bigdl_tpu.optim")
 
@@ -306,7 +307,7 @@ class BaseOptimizer:
                     step=self.state["neval"], error=type(e).__name__,
                     total=self.checkpoint_write_failures)
                 obs.get_registry().counter(
-                    "bigdl_checkpoint_write_failures_total",
+                    names.CHECKPOINT_WRITE_FAILURES_TOTAL,
                     "Background checkpoint writes that raised").inc()
                 if raise_errors:
                     raise
@@ -387,7 +388,7 @@ class BaseOptimizer:
                              step=step, error=type(e).__name__)
                 prefix = None
         obs.get_registry().counter(
-            "bigdl_preemptions_total",
+            names.PREEMPTIONS_TOTAL,
             "Graceful preemption shutdowns (SIGTERM/SIGINT)").inc()
         tracer.event("elastic.preempted", step=step, signum=signum,
                      checkpoint=prefix and os.path.basename(prefix))
@@ -441,7 +442,7 @@ class BaseOptimizer:
         from bigdl_tpu import obs
 
         obs.get_registry().counter(
-            "bigdl_slow_steps_total",
+            names.SLOW_STEPS_TOTAL,
             "Steps exceeding median * BIGDL_SLOW_STEP_FACTOR").inc()
 
     def _params_tree(self, pvar):
@@ -861,7 +862,7 @@ class LocalOptimizer(BaseOptimizer):
                              consecutive=self._nonfinite_consec,
                              total=self.state["nonfinite_skips"])
                 obs.get_registry().counter(
-                    "bigdl_nonfinite_skips_total",
+                    names.NONFINITE_SKIPS_TOTAL,
                     "Train steps skipped by the non-finite guard").inc()
                 if self._nonfinite_consec >= max_nonfinite:
                     raise NonFiniteStepError(

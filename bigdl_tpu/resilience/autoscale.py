@@ -54,6 +54,7 @@ import logging
 import os
 import time
 from typing import Callable, List, Optional
+from bigdl_tpu.obs import names
 
 log = logging.getLogger("bigdl_tpu.resilience")
 
@@ -71,8 +72,8 @@ SIGNALS = ("step_time_s", "queue_depth", "goodput_ratio", "alerts",
 # queue gauges: the streaming tier's buffer/lag (dataset/stream.py)
 # AND the serving tier's request queue (serving/batcher.py) — the
 # queue_depth signal is the max over all of them on any host
-_QUEUE_METRICS = ("bigdl_stream_buffer_depth", "bigdl_stream_lag_records",
-                  "bigdl_serve_queue_depth")
+_QUEUE_METRICS = (names.STREAM_BUFFER_DEPTH, names.STREAM_LAG_RECORDS,
+                  names.SERVE_QUEUE_DEPTH)
 
 # the serving tier's e2e request-latency histogram, as exposed on
 # /metrics (bucket samples carry their literal _bucket name)
@@ -324,7 +325,7 @@ class AutoscaleController:
         self.rules = (load_rules(cfg.rules, cfg) if rules is None
                       else rules)
         if world is None:
-            world = int(os.environ.get("BIGDL_AUTOSCALE_WORLD", 0) or 0) \
+            world = int(getattr(cfg, "world", 0) or 0) \
                 or max(1, cfg.min_world)
         self.world = int(world)
         self._scrape = scrape
@@ -424,7 +425,7 @@ class AutoscaleController:
         from bigdl_tpu import obs
 
         obs.get_registry().counter(
-            "bigdl_autoscale_decisions_total",
+            names.AUTOSCALE_DECISIONS_TOTAL,
             "Autoscale resize decisions, by direction and rule",
             labels=("direction", "reason")).labels(
             direction=decision.direction, reason=decision.reason).inc()

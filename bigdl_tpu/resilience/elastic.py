@@ -47,6 +47,7 @@ import time
 from typing import Optional
 
 from bigdl_tpu.resilience.retry import PeerLostError
+from bigdl_tpu.obs import names
 
 log = logging.getLogger("bigdl_tpu.resilience")
 
@@ -263,7 +264,7 @@ class HeartbeatMonitor:
             from bigdl_tpu import obs
 
             gauge = obs.get_registry().gauge(
-                "bigdl_heartbeat_age_seconds",
+                names.HEARTBEAT_AGE_SECONDS,
                 "Seconds since each peer host's last heartbeat file "
                 "write", labels=("host",))
             for h, age in ages.items():
@@ -279,7 +280,7 @@ class HeartbeatMonitor:
                     "elastic.peer_lost", peer=h, age_s=round(age, 3),
                     timeout_s=self.timeout_s, host=self.host)
                 obs.get_registry().counter(
-                    "bigdl_peer_lost_total",
+                    names.PEER_LOST_TOTAL,
                     "Peers flagged dead by the heartbeat monitor").inc()
         return dict(self._lost)
 
@@ -495,7 +496,7 @@ def record_resume(old_world: Optional[int], new_world: int,
     from bigdl_tpu import obs
 
     obs.get_registry().counter(
-        "bigdl_resumes_total",
+        names.RESUMES_TOTAL,
         "Resumes from checkpoint, labeled by world resize",
         labels=("resize",)).labels(resize=resize).inc()
     obs.get_tracer().event("elastic.resume", resize=resize,

@@ -49,6 +49,7 @@ from bigdl_tpu.resilience.elastic import (
     EXIT_PREEMPTED,
 )
 from bigdl_tpu.resilience.retry import RetryPolicy
+from bigdl_tpu.obs import names
 
 log = logging.getLogger("bigdl_tpu.resilience")
 
@@ -313,7 +314,7 @@ class Supervisor:
         from bigdl_tpu import obs
 
         obs.get_registry().counter(
-            "bigdl_supervisor_restarts_total",
+            names.SUPERVISOR_RESTARTS_TOTAL,
             "Child restarts, by exit classification",
             labels=("kind",)).labels(kind=kind).inc()
 

@@ -33,6 +33,7 @@ from typing import Any, List, Optional
 import numpy as np
 
 from bigdl_tpu.dataset.stream import BoundedBuffer, StreamSource
+from bigdl_tpu.obs import names
 
 _ids = itertools.count()
 
@@ -94,7 +95,7 @@ class _PushSource(StreamSource):
         from bigdl_tpu import obs
 
         self._wait_counter = obs.get_registry().counter(
-            "bigdl_serve_admission_waits_total",
+            names.SERVE_ADMISSION_WAITS_TOTAL,
             "Client submits that blocked on a full request queue")
 
     def put(self, item, timeout: Optional[float] = None):
@@ -151,7 +152,7 @@ class RequestQueue:
         from bigdl_tpu import obs
 
         self._depth_gauge = obs.get_registry().gauge(
-            "bigdl_serve_queue_depth",
+            names.SERVE_QUEUE_DEPTH,
             "Requests queued ahead of engine admission (backlog + "
             "bounded buffer)")
 

@@ -35,6 +35,7 @@ from __future__ import annotations
 from typing import List, Optional
 
 import numpy as np
+from bigdl_tpu.obs import names
 
 
 class PagedKVCache:
@@ -72,7 +73,7 @@ class PagedKVCache:
         from bigdl_tpu import obs
 
         self._pages_gauge = obs.get_registry().gauge(
-            "bigdl_serve_kv_pages_in_use",
+            names.SERVE_KV_PAGES_IN_USE,
             "KV-cache pages currently owned by in-flight requests")
 
     # --------------------------------------------------------- allocator

@@ -23,6 +23,7 @@ import numpy as np
 
 from bigdl_tpu.serving.batcher import RequestQueue, ServeRequest
 from bigdl_tpu.serving.engine import LAT_META
+from bigdl_tpu.obs import names
 
 
 class ClassifierEngine:
@@ -65,11 +66,11 @@ class ClassifierEngine:
         reg = obs.get_registry()
         self._lat = reg.histogram(*LAT_META, labels=("engine", "kind"))
         self._req_counter = reg.counter(
-            "bigdl_serve_requests_total",
+            names.SERVE_REQUESTS_TOTAL,
             "Requests completed, by engine and status",
             labels=("engine", "status"))
         self._occ_gauge = reg.gauge(
-            "bigdl_serve_batch_occupancy",
+            names.SERVE_BATCH_OCCUPANCY,
             "Mean fraction of decode slots occupied per step")
 
     def submit(self, features,

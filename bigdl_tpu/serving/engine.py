@@ -48,8 +48,9 @@ import numpy as np
 
 from bigdl_tpu.serving.batcher import RequestQueue, ServeRequest
 from bigdl_tpu.serving.cache import PagedKVCache
+from bigdl_tpu.obs import names
 
-LAT_META = ("bigdl_request_latency_seconds",
+LAT_META = (names.REQUEST_LATENCY_SECONDS,
             "Request latency by engine and kind (ttft = time to first "
             "token, per_token = mean inter-token, e2e = submit to done)")
 
@@ -285,32 +286,32 @@ class LMEngine:
         reg = obs.get_registry()
         self._lat = reg.histogram(*LAT_META, labels=("engine", "kind"))
         self._tokens_counter = reg.counter(
-            "bigdl_serve_tokens_total", "Tokens generated by the LM "
+            names.SERVE_TOKENS_TOTAL, "Tokens generated by the LM "
             "decode engine")
         self._req_counter = reg.counter(
-            "bigdl_serve_requests_total",
+            names.SERVE_REQUESTS_TOTAL,
             "Requests completed, by engine and status",
             labels=("engine", "status"))
         self._occ_gauge = reg.gauge(
-            "bigdl_serve_batch_occupancy",
+            names.SERVE_BATCH_OCCUPANCY,
             "Mean fraction of decode slots occupied per step")
         self._tps_gauge = reg.gauge(
-            "bigdl_serve_tokens_per_second",
+            names.SERVE_TOKENS_PER_SECOND,
             "LM decode throughput over the engine's busy wall clock")
         self._slo_gauge = reg.gauge(
-            "bigdl_serve_latency_slo_ratio",
+            names.SERVE_LATENCY_SLO_RATIO,
             "Fraction of recent requests completing within the "
             "latency SLO (feeds the serve_latency_slo_burn alert)")
         self._preempt_counter = reg.counter(
-            "bigdl_serve_preemptions_total",
+            names.SERVE_PREEMPTIONS_TOTAL,
             "Requests preempted (pages reclaimed, request re-queued) "
             "on KV-page exhaustion")
         self._decode_ms_gauge = reg.gauge(
-            "bigdl_serve_decode_attn_ms",
+            names.SERVE_DECODE_ATTN_MS,
             "Mean wall-clock of the jitted paged-decode step "
             "(attention-dominated, memory-bound) in milliseconds")
         self._decode_bytes_gauge = reg.gauge(
-            "bigdl_serve_decode_hbm_bytes_per_token",
+            names.SERVE_DECODE_HBM_BYTES_PER_TOKEN,
             "Analytic HBM bytes streamed per generated token (decode "
             "weights + the KV pages the step's page-table bucket "
             "names)")

@@ -34,6 +34,7 @@ class CheckpointIntegrityError(RuntimeError):
 
 from bigdl_tpu.nn.module import AbstractModule, Container, Sequential
 from bigdl_tpu.nn.graph import Graph, Node, _InputModule
+from bigdl_tpu.obs import names
 
 
 # ---------------------------------------------------------------- registry
@@ -268,7 +269,7 @@ def snapshot_checkpoint(model, optim_method=None, extra: dict = None,
             }
     dt = time.perf_counter() - t_snap
     obs.get_registry().gauge(
-        "bigdl_checkpoint_snapshot_seconds",
+        names.CHECKPOINT_SNAPSHOT_SECONDS,
         "Blocking snapshot span of the newest checkpoint (the only "
         "critical-path cost of an async checkpoint)").set(round(dt, 6))
     if to_host:
@@ -427,7 +428,7 @@ def verify_checkpoint(path_prefix: str):
         tracer.event("resilience.checkpoint_verify_failed",
                      prefix=os.path.basename(path_prefix), reason=reason)
         obs.get_registry().counter(
-            "bigdl_checkpoint_verify_failures_total",
+            names.CHECKPOINT_VERIFY_FAILURES_TOTAL,
             "Checkpoint pairs that failed the integrity check").inc()
     return ok, reason
 
@@ -572,7 +573,7 @@ def write_checkpoint(snap: dict, path_prefix: str, keep_last: int = 0,
             gc_checkpoints(os.path.dirname(path_prefix) or ".", keep_last)
     dt = time.perf_counter() - t_ckpt
     obs.get_registry().gauge(
-        "bigdl_checkpoint_write_seconds",
+        names.CHECKPOINT_WRITE_SECONDS,
         "Serialize+fsync+manifest span of the newest checkpoint "
         "(off the critical path when written by the async writer)").set(
         round(dt, 6))
@@ -584,7 +585,7 @@ def write_checkpoint(snap: dict, path_prefix: str, keep_last: int = 0,
                     or {}).get("step")
         obs.get_ledger().record("checkpoint_save", t_ckpt, dt, step=step)
     obs.get_registry().counter(
-        "bigdl_checkpoint_writes_total",
+        names.CHECKPOINT_WRITES_TOTAL,
         "Checkpoint pairs written (model + optim + manifest)").inc()
     return path_prefix
 

@@ -75,9 +75,11 @@ def main():
                         ).astype(jnp.bfloat16)
         shift = jnp.asarray(rs.randn(cout).astype(np.float32) * 0.01)
         try:
-            fused = jax.jit(lambda a, b, s: conv_bn_stats(
+            # per-shape fresh jit is the probe protocol (each shape is
+            # measured with its own compile)
+            fused = jax.jit(lambda a, b, s: conv_bn_stats(  # graftlint: disable=JX003
                 a, b, s, stride=stride, pad=pad))
-            unfused = jax.jit(lambda a, b, s: _reference(
+            unfused = jax.jit(lambda a, b, s: _reference(  # graftlint: disable=JX003
                 a, b, s, stride, pad))
             tf_ = timeit(fused, x, w, shift)
             tu = timeit(unfused, x, w, shift)

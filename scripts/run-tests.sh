@@ -115,6 +115,16 @@
 #                                                # SERVE_SMOKE.json for
 #                                                # BENCH extras.serve
 #                                                # (no pytest)
+#   scripts/run-tests.sh --lint                  # graftlint static analysis:
+#                                                # JAX hazards (JX*), lock
+#                                                # discipline (CC*), config/
+#                                                # metric registry drift (RD*)
+#                                                # over bigdl_tpu + scripts,
+#                                                # gated on the checked-in
+#                                                # .graftlint-baseline.json
+#                                                # (also runs in tier-1 via
+#                                                # tests/test_lint.py::
+#                                                # test_repo_is_clean)
 #   scripts/run-tests.sh --live                  # live-telemetry smoke: a
 #                                                # 2-host run with /metrics +
 #                                                # /healthz servers on
@@ -156,6 +166,9 @@ elif [[ "${1:-}" == "--goodput" ]]; then
 elif [[ "${1:-}" == "--tune" ]]; then
   shift
   exec python scripts/tune_smoke.py "$@"
+elif [[ "${1:-}" == "--lint" ]]; then
+  shift
+  exec python -m bigdl_tpu.analysis.lint "$@"
 elif [[ "${1:-}" == "--live" ]]; then
   shift
   exec python scripts/live_smoke.py "$@"

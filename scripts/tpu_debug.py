@@ -109,6 +109,22 @@ hang watchdog restarts; the declarative alert pack
 burn, non-finite spikes, stragglers, checkpoint failures and stale
 heartbeats — see MIGRATION.md "Live telemetry & alerting" and
 ``scripts/run-tests.sh --live`` for the end-to-end smoke.
+
+A LINT FAILURE (``scripts/run-tests.sh --lint`` /
+``tests/test_lint.py::test_repo_is_clean``) is triaged from the
+finding line itself — ``path:line: RULE message``.  JX* findings are
+tracing hazards (host sync, tracer leak, jit-in-loop, unhashable
+static, tracer branch): fix the traced scope, don't suppress — these
+are exactly the recompile/host-sync bugs this ladder exists to chase
+after the fact.  CC* findings are lock-discipline (acquisition-order
+cycle, unlocked shared write, bare acquire): pick one global lock
+order / take the class lock.  RD* findings are registry drift: declare
+the env var in ``bigdl_tpu/config.py`` (or metric in
+``bigdl_tpu/obs/names.py``) instead of minting spellings inline.  A
+deliberate exception gets an inline ``# graftlint: disable=RULE`` with
+a rationale comment; a legacy finding you must ship around goes in
+the baseline via ``--write-baseline`` — see MIGRATION.md "Static
+analysis" for rule ids, the baseline lifecycle and suppression syntax.
 """
 
 import argparse

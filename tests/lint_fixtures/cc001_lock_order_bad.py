@@ -1,0 +1,20 @@
+"""Seeded CC001: two methods acquire the same two locks in opposite
+order — the classic ABBA deadlock."""
+import threading
+
+
+class Pair:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.v = 0
+
+    def ab(self):
+        with self._a:
+            with self._b:            # CC001: a -> b
+                self.v += 1
+
+    def ba(self):
+        with self._b:
+            with self._a:            # CC001: b -> a
+                self.v -= 1

@@ -1,0 +1,22 @@
+"""Clean twin of cc001: one global order, also through a helper call."""
+import threading
+
+
+class Pair:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.v = 0
+
+    def _bump_locked(self, d):
+        with self._b:
+            self.v += d
+
+    def ab(self):
+        with self._a:
+            with self._b:
+                self.v += 1
+
+    def ba(self):
+        with self._a:
+            self._bump_locked(-1)    # still a -> b through the call

@@ -1,0 +1,18 @@
+"""Seeded CC002: the worker thread writes an attribute the public API
+also writes, without taking the class's lock."""
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        while True:
+            self.count += 1          # CC002: races reset()
+
+    def reset(self):
+        with self._lock:
+            self.count = 0

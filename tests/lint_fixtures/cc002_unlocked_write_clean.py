@@ -1,0 +1,20 @@
+"""Clean twin of cc002: every shared write holds the lock."""
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self._ticks = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        while True:
+            with self._lock:
+                self.count += 1
+            self._ticks += 1         # thread-private: nobody else writes
+
+    def reset(self):
+        with self._lock:
+            self.count = 0

@@ -1,0 +1,11 @@
+"""Seeded CC003: acquire without try/finally — an exception between
+acquire and release leaks the lock."""
+import threading
+
+_lock = threading.Lock()
+
+
+def bump(counts, key):
+    _lock.acquire()                  # CC003
+    counts[key] = counts.get(key, 0) + 1
+    _lock.release()

@@ -1,0 +1,17 @@
+"""Clean twin of cc003: `with`, or acquire under try/finally."""
+import threading
+
+_lock = threading.Lock()
+
+
+def bump(counts, key):
+    with _lock:
+        counts[key] = counts.get(key, 0) + 1
+
+
+def bump_manual(counts, key):
+    _lock.acquire()
+    try:
+        counts[key] = counts.get(key, 0) + 1
+    finally:
+        _lock.release()

@@ -1,0 +1,11 @@
+"""Seeded JX001: host sync on a traced value inside a jitted body."""
+import jax
+import numpy as np
+
+
+@jax.jit
+def bad_step(x):
+    y = x * 2
+    lr = float(y)            # JX001: float() on a traced value
+    host = np.asarray(y)     # JX001: host numpy pull of a traced value
+    return y * lr, host

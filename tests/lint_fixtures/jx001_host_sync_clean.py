@@ -1,0 +1,16 @@
+"""Clean twin of jx001: the same reads, outside the traced scope."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def clean_step(x):
+    y = x * 2
+    n = int(x.shape[0])       # shape reads are static — fine
+    return jnp.asarray(y) / n  # jax.numpy stays on device — fine
+
+
+def host_read(arr):
+    # not a traced scope: syncing here is the caller's explicit choice
+    return float(np.asarray(arr)[0])

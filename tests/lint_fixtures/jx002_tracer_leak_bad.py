@@ -1,0 +1,25 @@
+"""Seeded JX002: tracer stored on self from inside a traced method."""
+from functools import partial
+
+import jax
+
+_last_out = None
+
+
+class Model:
+    def __init__(self):
+        self.last = None
+
+    @partial(jax.jit, static_argnums=0)
+    def step(self, x):
+        y = x + 1
+        self.last = y        # JX002: tracer outlives the trace
+        return y
+
+
+@jax.jit
+def stash(x):
+    global _last_out
+    y = x * x
+    _last_out = y            # JX002: tracer stored in a global
+    return y

@@ -1,0 +1,19 @@
+"""Clean twin of jx002: results are returned, never stored."""
+from functools import partial
+
+import jax
+
+
+class Model:
+    def __init__(self):
+        self.last = None
+
+    @partial(jax.jit, static_argnums=0)
+    def step(self, x):
+        y = x + 1
+        return y
+
+    def run(self, x):
+        # storing the *resolved* output outside the trace is fine
+        self.last = self.step(x)
+        return self.last

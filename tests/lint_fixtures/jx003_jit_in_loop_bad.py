@@ -1,0 +1,9 @@
+"""Seeded JX003: jit constructed inside the step loop."""
+import jax
+
+
+def train(steps, params, batch):
+    for _ in range(steps):
+        step = jax.jit(lambda p, b: p + b)   # JX003: fresh cache per iter
+        params = step(params, batch)
+    return params

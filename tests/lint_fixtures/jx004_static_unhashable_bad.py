@@ -1,0 +1,13 @@
+"""Seeded JX004: unhashable containers fed to static jit args."""
+import jax
+
+
+def reshape_to(x, sizes=[4, 4]):          # JX004: unhashable default
+    return x.reshape(sizes)
+
+
+g = jax.jit(reshape_to, static_argnames=("sizes",))
+
+
+def run(x):
+    return g(x, sizes=[2, 8])             # JX004: list per call recompiles

@@ -1,0 +1,13 @@
+"""Clean twin of jx004: static args are hashable tuples."""
+import jax
+
+
+def reshape_to(x, sizes=(4, 4)):
+    return x.reshape(sizes)
+
+
+g = jax.jit(reshape_to, static_argnames=("sizes",))
+
+
+def run(x):
+    return g(x, sizes=(2, 8))

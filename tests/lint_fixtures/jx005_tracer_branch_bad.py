@@ -1,0 +1,9 @@
+"""Seeded JX005: Python branch on a traced value."""
+import jax
+
+
+@jax.jit
+def clip_if_large(x, lim):
+    if lim > 0:              # JX005: lim is a tracer here
+        return x.clip(-lim, lim)
+    return x

@@ -1,0 +1,21 @@
+"""Clean twin of jx005: the branch input is static (or a lax.cond)."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("lim",))
+def clip_if_large(x, lim):
+    if lim > 0:              # static arg: trace-time switch, fine
+        return x.clip(-lim, lim)
+    return x
+
+
+@jax.jit
+def clip_on_device(x, lim, mask=None):
+    if mask is None:         # None-ness is static at trace time — fine
+        mask = jnp.ones_like(x)
+    if x.ndim > 1:           # shape branching is static — fine
+        x = x.reshape(-1)
+    return jnp.where(lim > 0, x.clip(-lim, lim), x) * mask.reshape(-1)

@@ -1,0 +1,10 @@
+"""Seeded RD001: a BIGDL_* env var nobody declared in config.py."""
+import os
+
+
+def attempt():
+    return int(os.environ.get("BIGDL_NOT_A_FIELD", "0"))   # RD001
+
+
+def flag():
+    return os.environ["BIGDL_ALSO_UNDECLARED"]             # RD001

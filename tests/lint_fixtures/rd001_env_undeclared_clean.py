@@ -1,0 +1,13 @@
+"""Clean twin of rd001: declared vars only (script context), and env
+*writes* are always the harness contract."""
+import os
+
+
+def attempt():
+    return int(os.environ.get("BIGDL_ELASTIC_ATTEMPT", "0"))
+
+
+def export_for_child(env):
+    env["BIGDL_NOT_A_FIELD_EITHER"] = "1"   # a write, not a read: fine
+    os.environ["BIGDL_OBS"] = "1"
+    return env

@@ -1,0 +1,7 @@
+"""Seeded RD002 (linted as library code): a declared var read raw
+instead of through the config object."""
+import os
+
+
+def obs_on():
+    return os.environ.get("BIGDL_OBS") == "1"   # RD002 in library mode

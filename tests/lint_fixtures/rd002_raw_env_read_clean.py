@@ -1,0 +1,7 @@
+"""Clean twin of rd002: the config object is the read path."""
+
+
+def obs_on():
+    from bigdl_tpu.config import refresh_from_env
+
+    return bool(refresh_from_env().obs.enabled)

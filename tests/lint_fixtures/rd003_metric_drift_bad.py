@@ -1,0 +1,8 @@
+"""Seeded RD003: metric names minted/spelled outside obs/names.py."""
+
+
+def publish(reg):
+    reg.counter("bigdl_bogus_total", "made up on the spot").inc()  # RD003
+
+
+BOGUS_SPELLING = "bigdl_other_bogus_ratio"                         # RD003

@@ -1,0 +1,14 @@
+"""Clean twin of rd003: declared names, via constants or (outside the
+library) declared literals — histogram sample derivations included."""
+from bigdl_tpu.obs import names
+
+
+def publish(reg):
+    reg.counter(names.SERVE_TOKENS_TOTAL, "tokens").inc()
+
+
+def read(parsed_samples):
+    # readers may spell declared names (and _bucket derivations) literally
+    return [s for s in parsed_samples
+            if s["name"] in ("bigdl_serve_tokens_total",
+                             "bigdl_request_latency_seconds_bucket")]

@@ -1,0 +1,8 @@
+"""Seeded RD005: mint sites disagreeing with the declared shape."""
+from bigdl_tpu.obs import names
+
+
+def publish(reg):
+    reg.counter(names.SERVE_QUEUE_DEPTH, "x").inc()          # RD005: kind
+    reg.gauge(names.SERVE_BATCH_OCCUPANCY, "x",
+              labels=("engine",))                            # RD005: labels

@@ -1,0 +1,312 @@
+"""graftlint specs (ISSUE 14): fixture rules, baseline lifecycle,
+lock-order unit, strict metric registry, and the tier-1 repo-clean gate.
+
+The fixture pairs under ``tests/lint_fixtures/`` are the rule
+contracts: each ``*_bad.py`` carries exactly its seeded violation(s)
+and each ``*_clean.py`` is the idiomatic twin the rule must stay silent
+on — a rule that fires on the clean twin is a false-positive
+regression, which for a gating linter is as bad as a miss.
+"""
+
+import os
+import time
+
+import pytest
+
+from bigdl_tpu.analysis.concurrency import ConcurrencyRules
+from bigdl_tpu.analysis.core import (Linter, load_baseline,
+                                     write_baseline)
+from bigdl_tpu.analysis.lint import main as lint_main
+from bigdl_tpu.analysis.lint import run_lint
+from bigdl_tpu.analysis.registry_rules import RegistryRules
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIX = os.path.join(REPO, "tests", "lint_fixtures")
+
+# (fixture stem, rule id, lib_mode the bad twin is linted under)
+PAIRS = [
+    ("jx001_host_sync", "JX001", "auto"),
+    ("jx002_tracer_leak", "JX002", "auto"),
+    ("jx003_jit_in_loop", "JX003", "auto"),
+    ("jx004_static_unhashable", "JX004", "auto"),
+    ("jx005_tracer_branch", "JX005", "auto"),
+    ("cc001_lock_order", "CC001", "auto"),
+    ("cc002_unlocked_write", "CC002", "auto"),
+    ("cc003_bare_acquire", "CC003", "auto"),
+    ("rd001_env_undeclared", "RD001", "auto"),
+    ("rd002_raw_env_read", "RD002", True),  # library context
+    ("rd003_metric_drift", "RD003", "auto"),
+    ("rd005_shape_mismatch", "RD005", "auto"),
+]
+
+
+def _lint(path, lib_mode="auto", rules=None):
+    return Linter([path], root=REPO, lib_mode=lib_mode,
+                  rules=rules).run()
+
+
+class TestFixtureRules:
+    @pytest.mark.parametrize("stem,rule,lib_mode", PAIRS,
+                             ids=[p[0] for p in PAIRS])
+    def test_bad_twin_fires_exactly_its_rule(self, stem, rule, lib_mode):
+        findings = _lint(os.path.join(FIX, f"{stem}_bad.py"),
+                         lib_mode=lib_mode)
+        assert findings, f"{stem}_bad.py produced no findings"
+        assert {f.rule for f in findings} == {rule}, \
+            "\n".join(f.render() for f in findings)
+        # findings carry a real location inside the fixture
+        for f in findings:
+            assert f.path.endswith(f"{stem}_bad.py") and f.line > 0
+
+    @pytest.mark.parametrize("stem,rule,lib_mode", PAIRS,
+                             ids=[p[0] for p in PAIRS])
+    def test_clean_twin_is_silent(self, stem, rule, lib_mode):
+        findings = _lint(os.path.join(FIX, f"{stem}_clean.py"),
+                         lib_mode=lib_mode)
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+    def test_seeded_violation_in_a_real_module_fails(self, tmp_path):
+        # the acceptance-criteria shape: re-introduce a drift bug into
+        # (a copy of) a real module and the pass must name rule+line
+        src = open(os.path.join(
+            REPO, "bigdl_tpu", "serving", "cache.py")).read()
+        assert "names.SERVE_KV_PAGES_IN_USE" in src
+        seeded = src.replace("names.SERVE_KV_PAGES_IN_USE",
+                             '"bigdl_serve_kv_pages_in_use"')
+        p = tmp_path / "cache.py"
+        p.write_text(seeded)
+        findings = Linter([str(p)], root=str(tmp_path),
+                          lib_mode=True).run()
+        assert any(f.rule == "RD003" and "cache.py" in f.path
+                   and f.line > 0 for f in findings), findings
+
+
+class TestSuppression:
+    def test_inline_disable(self, tmp_path):
+        src = open(os.path.join(FIX, "cc003_bare_acquire_bad.py")).read()
+        src = src.replace("_lock.acquire()                  # CC003",
+                          "_lock.acquire()  # graftlint: disable=CC003")
+        p = tmp_path / "mod.py"
+        p.write_text(src)
+        assert Linter([str(p)], root=str(tmp_path)).run() == []
+
+    def test_disable_wrong_rule_keeps_finding(self, tmp_path):
+        src = open(os.path.join(FIX, "cc003_bare_acquire_bad.py")).read()
+        src = src.replace("_lock.acquire()                  # CC003",
+                          "_lock.acquire()  # graftlint: disable=JX001")
+        p = tmp_path / "mod.py"
+        p.write_text(src)
+        findings = Linter([str(p)], root=str(tmp_path)).run()
+        assert [f.rule for f in findings] == ["CC003"]
+
+    def test_disable_file(self, tmp_path):
+        src = ("# graftlint: disable-file=CC003\n"
+               + open(os.path.join(FIX,
+                                   "cc003_bare_acquire_bad.py")).read())
+        p = tmp_path / "mod.py"
+        p.write_text(src)
+        assert Linter([str(p)], root=str(tmp_path)).run() == []
+
+
+class TestBaseline:
+    def test_add_drift_expire_roundtrip(self, tmp_path):
+        bad = open(os.path.join(FIX, "cc003_bare_acquire_bad.py")).read()
+        clean = open(os.path.join(FIX,
+                                  "cc003_bare_acquire_clean.py")).read()
+        mod = tmp_path / "legacy.py"
+        base = str(tmp_path / "baseline.json")
+        mod.write_text(bad)
+
+        linter = Linter([str(mod)], root=str(tmp_path))
+        found = linter.run()
+        assert [f.rule for f in found] == ["CC003"]
+
+        # accept into the baseline: the finding no longer fails
+        write_baseline(base, found, linter.modules)
+        fresh, stale, _ = run_lint([str(mod)], root=str(tmp_path),
+                                   baseline=base)
+        assert fresh == [] and stale == []
+
+        # unrelated line drift: the entry is content-addressed, so it
+        # still matches after the file shifts
+        mod.write_text("# new header comment\n# another line\n" + bad)
+        fresh, stale, _ = run_lint([str(mod)], root=str(tmp_path),
+                                   baseline=base)
+        assert fresh == [] and stale == []
+
+        # a NEW violation is never absorbed by the old entry
+        drifted = bad + ("\n\ndef more(c, k):\n"
+                         "    _lock.acquire()\n    c[k] = 1\n"
+                         "    _lock.release()\n")
+        mod.write_text(drifted)
+        fresh, stale, _ = run_lint([str(mod)], root=str(tmp_path),
+                                   baseline=base)
+        assert [f.rule for f in fresh] == ["CC003"] and stale == []
+
+        # fixing the violation expires the entry (reported stale)
+        mod.write_text(clean)
+        fresh, stale, _ = run_lint([str(mod)], root=str(tmp_path),
+                                   baseline=base)
+        assert fresh == [] and len(stale) == 1
+
+        # --write-baseline drops the stale entry
+        rc = lint_main(["--root", str(tmp_path), "--baseline", base,
+                        "--write-baseline", str(mod)])
+        assert rc == 0
+        assert load_baseline(base) == []
+
+    def test_cli_exit_codes(self, tmp_path, capsys):
+        rc = lint_main(["--root", REPO, "--no-baseline",
+                        os.path.join(FIX, "cc003_bare_acquire_bad.py")])
+        out = capsys.readouterr().out
+        assert rc == 1 and "CC003" in out \
+            and "cc003_bare_acquire_bad.py:9" in out
+        rc = lint_main(["--root", REPO, "--no-baseline",
+                        os.path.join(FIX, "cc003_bare_acquire_clean.py")])
+        assert rc == 0
+
+
+class TestLockOrderUnit:
+    def _edges(self, *pairs):
+        return {p: ("m.py", 10 + i) for i, p in enumerate(pairs)}
+
+    def test_abba_cycle_reported_on_both_edges(self):
+        cc = ConcurrencyRules()
+        cc.lock_kinds = {"m.py::A._a": "lock", "m.py::A._b": "lock"}
+        cc.edges = self._edges(("m.py::A._a", "m.py::A._b"),
+                               ("m.py::A._b", "m.py::A._a"))
+        findings = cc.finalize()
+        assert len(findings) == 2
+        assert all(f.rule == "CC001" and "cycle" in f.message
+                   for f in findings)
+
+    def test_three_lock_cycle(self):
+        cc = ConcurrencyRules()
+        cc.edges = self._edges(("a", "b"), ("b", "c"), ("c", "a"))
+        assert len(cc.finalize()) == 3
+
+    def test_consistent_order_is_clean(self):
+        cc = ConcurrencyRules()
+        cc.edges = self._edges(("a", "b"), ("b", "c"), ("a", "c"))
+        assert cc.finalize() == []
+
+    def test_nonreentrant_self_acquisition(self):
+        cc = ConcurrencyRules()
+        cc.lock_kinds = {"m.py::L": "lock"}
+        cc.edges = self._edges(("m.py::L", "m.py::L"))
+        findings = cc.finalize()
+        assert len(findings) == 1 and "self-deadlock" in findings[0].message
+
+    def test_reentrant_self_acquisition_is_fine(self):
+        cc = ConcurrencyRules()
+        cc.lock_kinds = {"m.py::L": "rlock"}
+        cc.edges = self._edges(("m.py::L", "m.py::L"))
+        assert cc.finalize() == []
+
+
+class TestRegistryUnits:
+    def test_rd004_undocumented_unrendered(self, tmp_path):
+        names_py = tmp_path / "names.py"
+        names_py.write_text(
+            'REGISTRY = {}\n'
+            'def _m(name, kind, labels=(), cardinality=1, doc=""):\n'
+            '    return name\n'
+            'GOOD = _m("bigdl_good_total", "counter", doc="documented")\n'
+            'BAD = _m("bigdl_ghost_total", "counter")\n')
+        report_py = tmp_path / "report.py"
+        report_py.write_text("# renders nothing\n")
+        pack = RegistryRules(names_path=str(names_py),
+                             report_path=str(report_py))
+        findings = pack.finalize()
+        assert [f.rule for f in findings] == ["RD004"]
+        assert "bigdl_ghost_total" in findings[0].message
+
+    def test_rd004_rendered_metric_needs_no_doc(self, tmp_path):
+        names_py = tmp_path / "names.py"
+        names_py.write_text(
+            'def _m(name, kind, labels=(), cardinality=1, doc=""):\n'
+            '    return name\n'
+            'SEEN = _m("bigdl_seen_total", "counter")\n')
+        report_py = tmp_path / "report.py"
+        report_py.write_text('rows.append("bigdl_seen_total")\n')
+        pack = RegistryRules(names_path=str(names_py),
+                             report_path=str(report_py))
+        assert pack.finalize() == []
+
+    def test_names_registry_is_well_formed(self):
+        from bigdl_tpu.obs import names
+
+        assert len(names.REGISTRY) >= 60
+        for spec in names.REGISTRY.values():
+            assert spec.kind in ("counter", "gauge", "histogram")
+            assert len(set(spec.labels)) == len(spec.labels)
+            assert spec.cardinality >= 1
+            assert spec.doc.strip(), f"{spec.name} undocumented"
+        assert names.is_declared("bigdl_request_latency_seconds_bucket")
+        assert not names.is_declared("bigdl_serve_tokens_total_bucket")
+
+
+class TestStrictRegistry:
+    """BIGDL_OBS_STRICT=1 — the runtime half of the RD003/RD005 pins."""
+
+    @pytest.fixture()
+    def strict(self, monkeypatch):
+        monkeypatch.setenv("BIGDL_OBS_STRICT", "1")
+        yield
+
+    def test_undeclared_name_rejected(self, strict):
+        from bigdl_tpu.obs.metrics import MetricsRegistry
+
+        with pytest.raises(ValueError, match="not declared"):
+            MetricsRegistry().counter("bigdl_ad_hoc_total", "x")
+
+    def test_shape_mismatch_rejected(self, strict):
+        from bigdl_tpu.obs import names
+        from bigdl_tpu.obs.metrics import MetricsRegistry
+
+        with pytest.raises(ValueError, match="declared as"):
+            MetricsRegistry().gauge(names.SERVE_TOKENS_TOTAL, "x")
+        with pytest.raises(ValueError, match="declared as"):
+            MetricsRegistry().counter(names.SERVE_REQUESTS_TOTAL, "x",
+                                      labels=("engine",))
+
+    def test_cardinality_ceiling(self, strict):
+        from bigdl_tpu.obs import names
+        from bigdl_tpu.obs.metrics import MetricsRegistry
+
+        g = MetricsRegistry().gauge(names.STEP_TIME_SECONDS, "x",
+                                    labels=("quantile",))
+        for q in ("p50", "p95", "p99", "max"):
+            g.labels(quantile=q).set(0.1)
+        with pytest.raises(ValueError, match="cardinality ceiling"):
+            g.labels(quantile="p1")
+        # existing children keep working at the ceiling
+        g.labels(quantile="p50").set(0.2)
+
+    def test_non_strict_tolerates_everything(self, monkeypatch):
+        monkeypatch.setenv("BIGDL_OBS_STRICT", "0")
+        from bigdl_tpu.obs.metrics import MetricsRegistry
+
+        r = MetricsRegistry()
+        r.counter("bigdl_ad_hoc_total", "x").inc()
+        r.gauge("other_system_gauge", "x").set(1)
+
+    def test_foreign_names_unaffected_by_strict(self, strict):
+        from bigdl_tpu.obs.metrics import MetricsRegistry
+
+        MetricsRegistry().counter("not_bigdl_total", "x").inc()
+
+
+def test_repo_is_clean():
+    """The tier-1 gate: the full pass over bigdl_tpu + scripts must be
+    clean (against the checked-in baseline) and fast (<20s budget so it
+    can gate every tier-1 run, not just the --lint flag)."""
+    t0 = time.monotonic()
+    fresh, stale, linter = run_lint(("bigdl_tpu", "scripts"), root=REPO,
+                                    baseline=".graftlint-baseline.json")
+    dt = time.monotonic() - t0
+    assert fresh == [], "fresh lint findings:\n" + "\n".join(
+        f.render() for f in fresh)
+    assert stale == [], f"stale baseline entries: {stale}"
+    assert len(linter.modules) > 100  # the pass really walked the tree
+    assert dt < 20.0, f"lint took {dt:.1f}s — over the tier-1 budget"
