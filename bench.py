@@ -645,6 +645,23 @@ def _serve_extras():
         return None
 
 
+def _fleet_extras():
+    """Fleet-simulator evidence for the BENCH JSON: the newest
+    ``FLEET_SIM.json`` banked by scripts/fleet_sim.py (per-scenario
+    invariant verdicts, decision/episode counts, aggregation-scaling
+    measurement at 200 synthetic hosts).  None when the smoke has
+    never been run."""
+    try:
+        smoke = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "FLEET_SIM.json")
+        if not os.path.exists(smoke):
+            return None
+        with open(smoke, "r", encoding="utf-8") as fh:
+            return {"smoke": json.load(fh)}
+    except Exception:
+        return None
+
+
 def _tuner_extras():
     """Auto-tuner evidence for the BENCH JSON (ops/autotune.py): the
     cache stats and every decision with its static baseline, measured
@@ -1006,6 +1023,9 @@ def _run_child(platform: str):
     serve = _serve_extras()
     if serve is not None:
         ex["serve"] = serve
+    fleet = _fleet_extras()
+    if fleet is not None:
+        ex["fleet"] = fleet
     print(PARTIAL_MARK + json.dumps(result), flush=True)
 
 

@@ -407,6 +407,46 @@ class ServeConfig:
 
 
 @dataclasses.dataclass
+class FleetSimConfig:
+    """Fleet-scale control-plane simulator (``bigdl_tpu/sim``).
+
+    The simulator stands up hundreds of synthetic ``/metrics`` +
+    ``/healthz`` hosts in one process and drives the REAL autoscaling
+    controller, alert engine and fleet aggregator through declarative
+    chaos scenarios on a virtual clock (``scripts/fleet_sim.py``).
+    These knobs parameterize that harness; they change nothing in a
+    training or serving process.
+    """
+
+    # synthetic host count the scenarios run at [BIGDL_FLEET_HOSTS]
+    hosts: int = 200
+    # scenario selection: a builtin name (``bigdl_tpu/sim/scenario.py``
+    # BUILTIN_SCENARIOS), a comma-separated list of names, inline JSON,
+    # or a path to a JSON scenario file; unset = the smoke's default
+    # matrix [BIGDL_FLEET_SCENARIO]
+    scenario: Optional[str] = None
+    # divide every virtual duration in the scenario (and the autoscale
+    # policy windows it carries) by this factor — the CI knob that runs
+    # the same scenario shape in fewer ticks.  The tick period itself
+    # is preserved, so heavy compression coarsens signal dynamics
+    # [BIGDL_FLEET_TIME_COMPRESSION]
+    time_compression: float = 1.0
+    # deterministic seed for host selection and per-host jitter
+    # [BIGDL_FLEET_SEED]
+    seed: int = 0
+
+    @classmethod
+    def from_env(cls) -> "FleetSimConfig":
+        return cls(
+            hosts=_env_int("BIGDL_FLEET_HOSTS", 200),
+            scenario=_env_str("BIGDL_FLEET_SCENARIO", None),
+            time_compression=_env_float("BIGDL_FLEET_TIME_COMPRESSION",
+                                        1.0),
+            seed=_env_int("BIGDL_FLEET_SEED", 0),
+        )
+
+
+@dataclasses.dataclass
 class BigDLConfig:
     """Process-global framework configuration.
 
@@ -545,6 +585,11 @@ class BigDLConfig:
     #  _SLO_MS / _ADMISSION / _PORT]
     serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
 
+    # --- fleet-scale control-plane simulator (sim/ package) -------------
+    # [BIGDL_FLEET_HOSTS / _SCENARIO / _TIME_COMPRESSION / _SEED]
+    fleet: FleetSimConfig = dataclasses.field(
+        default_factory=FleetSimConfig)
+
     # --- benchmarking [BENCH_* kept for bench.py compat] ----------------
 
     @classmethod
@@ -584,6 +629,7 @@ class BigDLConfig:
             tuner=TunerConfig.from_env(),
             wire=WireConfig.from_env(),
             serve=ServeConfig.from_env(),
+            fleet=FleetSimConfig.from_env(),
         )
 
     def describe(self) -> str:

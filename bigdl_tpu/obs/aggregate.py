@@ -32,9 +32,11 @@ the DistriOptimizer pod-slice runs in MULTICHIP_r*.json.
 
 from __future__ import annotations
 
+import concurrent.futures
 import json
 import math
 import os
+import time
 from typing import List, Optional
 from bigdl_tpu.obs import names
 
@@ -371,12 +373,15 @@ class FleetAggregator:
     tests (no sockets)."""
 
     def __init__(self, peers=None, metrics_dir: Optional[str] = None,
-                 fetch=None, timeout_s: float = 2.0):
+                 fetch=None, timeout_s: float = 2.0,
+                 max_workers: int = 16):
         if isinstance(peers, str):
             peers = [p.strip() for p in peers.split(",") if p.strip()]
         self.peers = list(peers or [])
         self.metrics_dir = metrics_dir
         self.timeout_s = float(timeout_s)
+        self.max_workers = max(1, int(max_workers))
+        self.last_scrape_s: Optional[float] = None
         self._fetch = fetch or self._http_fetch
         self._tailer = (ShardTailer(metrics_dir)
                         if metrics_dir and not self.peers else None)
@@ -412,13 +417,46 @@ class FleetAggregator:
             out["error"] = f"{type(e).__name__}: {e}"
         return out
 
+    def scrape_peers(self, addrs) -> List[dict]:
+        """One scrape cycle over ``addrs``, concurrently on a bounded
+        thread pool (results in input order).
+
+        Serially, a partitioned fleet costs N × timeout per cycle —
+        40 unreachable peers at the 2s default is an 80s scrape, long
+        past any policy interval.  Concurrently each peer's timeout
+        runs on its own worker, so a cycle costs
+        ``ceil(N / max_workers) × timeout`` worst-case.  The cycle
+        wall clock is published as ``bigdl_fleet_scrape_seconds`` (and
+        kept on ``last_scrape_s``) so a scrape that crowds its policy
+        interval is visible before it starves the controller."""
+        addrs = list(addrs)
+        if not addrs:
+            return []
+        t0 = time.perf_counter()
+        if len(addrs) == 1:
+            out = [self.scrape_peer(addrs[0])]
+        else:
+            workers = min(self.max_workers, len(addrs))
+            with concurrent.futures.ThreadPoolExecutor(
+                    max_workers=workers,
+                    thread_name_prefix="bigdl-fleet-scrape") as pool:
+                out = list(pool.map(self.scrape_peer, addrs))
+        self.last_scrape_s = time.perf_counter() - t0
+        from bigdl_tpu import obs
+
+        obs.get_registry().gauge(
+            names.FLEET_SCRAPE_SECONDS,
+            "Wall seconds of the last full fleet peer-scrape cycle"
+        ).set(self.last_scrape_s)
+        return out
+
     # --------------------------------------------------------- snapshot
     def snapshot(self) -> dict:
         fleet = {"mode": "peers" if self.peers else "shards",
                  "hosts": {}, "alerts": [], "metrics": {}, "errors": {}}
         if self.peers:
-            for addr in self.peers:
-                scraped = self.scrape_peer(addr)
+            for scraped in self.scrape_peers(self.peers):
+                addr = scraped["addr"]
                 if not scraped["ok"]:
                     fleet["errors"][addr] = scraped.get("error", "down")
                     continue

@@ -21,7 +21,14 @@ rule type      fires when
 =============  =========================================================
 
 Every rule carries ``for`` (consecutive breached evaluations before
-firing — one flaky window is not a page) and ``severity``.  On a
+firing — one flaky window is not a page), ``resolve_for`` (consecutive
+*good* evaluations before resolving, default 1 — raising it keeps a
+gauge that blips good for one window inside the SAME episode instead
+of splitting it into two pages) and ``severity``.  Each firing opens a
+new per-rule **episode**: a monotonically increasing id stamped on the
+``firing`` transition and echoed on its matching ``resolved`` — the
+fleet simulator's exactly-once-per-episode invariant pairs transitions
+on it, and a sink consumer can dedupe on ``(rule, episode)``.  On a
 fire/resolve transition the engine emits ``alert.firing`` /
 ``alert.resolved`` trace events, increments ``bigdl_alerts_total
 {rule,severity}`` / ``bigdl_alerts_resolved_total{rule}``, mirrors
@@ -153,6 +160,10 @@ def load_rules(spec: Optional[str],
             raise ValueError(f"rule {r['name']!r}: burn_rate needs slo")
         r.setdefault("type", kind)
         r.setdefault("for", 1)
+        r.setdefault("resolve_for", 1)
+        if int(r["resolve_for"]) < 1:
+            raise ValueError(f"rule {r['name']!r}: resolve_for must be "
+                             f">= 1, got {r['resolve_for']!r}")
         r.setdefault("severity", "warning")
     return rules
 
@@ -169,9 +180,15 @@ class AlertEngine:
         self.sink = sink
         self._clock = clock
         self._lock = threading.Lock()
-        self._state = {r["name"]: {"breaches": 0, "firing": False,
-                                   "since": None, "value": None,
-                                   "labels": None}
+        # `episode` is the per-rule firing ordinal: incremented when a
+        # firing transition opens, echoed on the matching resolve —
+        # the identity the exactly-once-per-episode invariant pairs on.
+        # `good` is the consecutive-clean streak gating the resolve
+        # (the symmetric half of the `for` firing debounce).
+        self._state = {r["name"]: {"breaches": 0, "good": 0,
+                                   "firing": False, "since": None,
+                                   "value": None, "labels": None,
+                                   "episode": 0}
                        for r in self.rules}
         # rate baselines are primed at engine build: counts that exist
         # NOW are history (an engine rebuilt mid-run must not re-page
@@ -266,15 +283,23 @@ class AlertEngine:
                 st["value"], st["labels"] = value, labels
                 if breached:
                     st["breaches"] += 1
+                    st["good"] = 0
                     if not st["firing"] and \
                             st["breaches"] >= int(rule.get("for", 1)):
                         st["firing"] = True
                         st["since"] = now
+                        st["episode"] += 1
                         transitions.append(self._transition(
                             "firing", rule, st, now))
                 else:
                     st["breaches"] = 0
-                    if st["firing"]:
+                    st["good"] += 1
+                    # resolve only after `resolve_for` consecutive good
+                    # evaluations: a gauge that blips good for one
+                    # window mid-incident stays inside the SAME episode
+                    # instead of paging a second firing for it
+                    if st["firing"] and st["good"] >= int(
+                            rule.get("resolve_for", 1)):
                         st["firing"] = False
                         transitions.append(self._transition(
                             "resolved", rule, st, now))
@@ -289,7 +314,7 @@ class AlertEngine:
                 "severity": rule["severity"], "type": rule["type"],
                 "metric": rule["metric"], "value": st["value"],
                 "labels": st["labels"], "ts": now,
-                "since": st["since"]}
+                "since": st["since"], "episode": st["episode"]}
 
     def _emit(self, t: dict):
         from bigdl_tpu import obs
@@ -314,7 +339,8 @@ class AlertEngine:
         obs.get_tracer().event(f"alert.{t['state']}", rule=t["rule"],
                                severity=t["severity"],
                                metric=t["metric"], value=t["value"],
-                               labels=t["labels"])
+                               labels=t["labels"],
+                               episode=t["episode"])
         if self.sink:
             _sink_write(self.sink, t)
 
@@ -330,7 +356,8 @@ class AlertEngine:
                                 "metric": rule["metric"],
                                 "value": st["value"],
                                 "labels": st["labels"],
-                                "since": st["since"]})
+                                "since": st["since"],
+                                "episode": st["episode"]})
             return out
 
 

@@ -219,6 +219,12 @@ AUTOSCALE_DECISIONS_TOTAL = _m(
     ("direction", "reason"), 32,
     "Autoscale policy decisions, by direction and firing rule")
 
+# --------------------------------------------------------------- fleet
+FLEET_SCRAPE_SECONDS = _m(
+    "bigdl_fleet_scrape_seconds", "gauge",
+    doc="Wall seconds of the last full fleet peer-scrape cycle "
+        "(bounded-pool concurrent scrape, FleetAggregator.scrape_peers)")
+
 # --------------------------------------------------------------- checkpoint
 CHECKPOINT_SNAPSHOT_SECONDS = _m(
     "bigdl_checkpoint_snapshot_seconds", "gauge",

@@ -111,6 +111,7 @@ def build_report(trace_dir: str, metrics_dir: Optional[str] = None) -> dict:
     tuner_events: list = []
     alert_events: list = []
     autoscale_events: list = []
+    fleet_events: list = []
     for sh in shards:
         key = f"host{sh.host}/pid{sh.pid}"
         h = hosts.setdefault(key, {
@@ -165,6 +166,10 @@ def build_report(trace_dir: str, metrics_dir: Optional[str] = None) -> dict:
                     a["event"] = name
                     a["wall_time"] = rec.get("wall_time")
                     autoscale_events.append(a)
+                elif name == "fleet.scenario":
+                    a = dict(rec.get("attrs") or {})
+                    a["wall_time"] = rec.get("wall_time")
+                    fleet_events.append(a)
 
     per_host = {}
     for key, h in hosts.items():
@@ -333,6 +338,26 @@ def build_report(trace_dir: str, metrics_dir: Optional[str] = None) -> dict:
         },
     }
 
+    # ---- fleet simulation (bigdl_tpu/sim, scripts/fleet_sim.py) ------
+    # scenario verdicts ride fleet.scenario trace events; the scrape
+    # latency gauge (names.FLEET_SCRAPE_SECONDS) comes from the
+    # bounded-pool concurrent peer scrape
+    fleet_scrape = None
+    for _labels, s, _host in _metric_samples(
+            snaps, names.FLEET_SCRAPE_SECONDS):
+        v = float(s.get("value", 0.0))
+        fleet_scrape = v if fleet_scrape is None else max(fleet_scrape,
+                                                          v)
+    fleet = None
+    if fleet_events or fleet_scrape is not None:
+        fleet_events.sort(key=lambda a: a.get("wall_time") or 0.0)
+        fleet = {
+            "scenarios": fleet_events,
+            "scrape_seconds": fleet_scrape,
+            "decisions_total": decisions,
+            "alert_episodes": {"fired": fired, "resolved": resolved},
+        }
+
     # ---- serving tier (serving/ package) -----------------------------
     def _hist_stats(metric, key_labels=("engine", "kind")):
         """Per-label-combo count/mean/p50/p95/p99 from the snapshot's
@@ -456,6 +481,7 @@ def build_report(trace_dir: str, metrics_dir: Optional[str] = None) -> dict:
         "alerts": alerts,
         "serving": serving,
         "autoscale": autoscale,
+        "fleet": fleet,
         "overlap": overlap,
         "health": health,
         "goodput": gp,
@@ -628,6 +654,37 @@ def render_text(rep: dict) -> str:
                     f"  host{ev.get('host')} backoff {ev.get('kind')} "
                     f"{float(ev.get('delay_s') or 0):.2f}s (rc "
                     f"{ev.get('rc')})")
+    lines.append("")
+    lines.append("-- fleet simulation --")
+    fl = rep.get("fleet")
+    if not fl:
+        lines.append("  (no fleet sim activity — scripts/fleet_sim.py "
+                     "/ run-tests.sh --fleet)")
+    else:
+        for ev in (fl.get("scenarios") or [])[-8:]:
+            bad = sorted(k for k, v in (ev.get("invariants")
+                                        or {}).items() if not v)
+            lines.append(
+                f"  {str(ev.get('scenario')):14s} "
+                f"{'PASS' if ev.get('ok') else 'FAIL'} "
+                f"hosts={ev.get('hosts')} ticks={ev.get('ticks')} "
+                f"world->{ev.get('final_world')} "
+                f"decisions={ev.get('decisions')} "
+                f"episodes={ev.get('episodes')}"
+                + (f"  FAILED: {','.join(bad)}" if bad else ""))
+        for key, n in sorted((fl.get("decisions_total") or {}).items()):
+            lines.append(f"  decision {key:28s} {int(n)}x")
+        ep = fl.get("alert_episodes") or {}
+        if ep.get("fired"):
+            lines.append("  alert episodes: " + ", ".join(
+                f"{key.split('[', 1)[0]} fired "
+                f"{int(n)}x/resolved "
+                f"{int(ep.get('resolved', {}).get(key.split('[', 1)[0], 0))}x"
+                for key, n in sorted(ep["fired"].items())))
+        if fl.get("scrape_seconds") is not None:
+            lines.append(f"  scrape cycle: "
+                         f"{fl['scrape_seconds'] * 1000:.1f}ms "
+                         "(bounded-pool concurrent peer scrape)")
     lines.append("")
     lines.append("-- overlap --")
     ov = rep.get("overlap") or {}

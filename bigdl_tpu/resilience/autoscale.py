@@ -237,7 +237,10 @@ class EndpointScraper:
             if not port:
                 return []
             addrs = [f"127.0.0.1:{port}"]
-        return [self._agg.scrape_peer(a) for a in addrs]
+        # concurrent bounded-pool scrape: N partitioned peers cost
+        # ceil(N/pool) timeouts per cycle, not N (and the cycle wall is
+        # published as bigdl_fleet_scrape_seconds)
+        return self._agg.scrape_peers(addrs)
 
 
 def derive_signals(scraped: List[dict], prev_steps: dict,

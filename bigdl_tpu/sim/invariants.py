@@ -1,0 +1,341 @@
+"""Fleet-level invariants — what every chaos scenario must uphold.
+
+A scenario run produces an observation bundle (decisions with virtual
+timestamps, per-host alert transitions with episode ids, per-cycle
+scrape walls, the final world, sink failure counts);
+:func:`check_scenario` turns it + the scenario's ``expect`` block into
+a list of :class:`InvariantResult` verdicts:
+
+* **no_flap** — the autoscaler never issues opposite-direction
+  decisions inside one cooldown window, and the decision count stays
+  inside the scenario's declared bounds with every required reason
+  present;
+* **convergence** — the run ends at the expected world, quiet through
+  the declared tail;
+* **exactly_once_episodes** — per (host, rule): transitions strictly
+  alternate firing → resolved, episode ids are consecutive and pair
+  each resolve to its firing, per-host episode counts stay in the
+  declared range, and (when declared) everything is resolved by
+  scenario end.  This is the invariant that pins the alert-engine
+  double-fire fix;
+* **conservative_degradation** — no decision lands inside a window
+  where the scenario declares signals unreliable (partitions): an
+  absent signal must never breach a rule;
+* **scrape_budget** — no scrape cycle's wall clock exceeded the
+  declared bound (the concurrent bounded-pool scrape's contract; a
+  serial scrape fails this the moment peers time out);
+* **sink_failures** — a poisoned alert sink is *counted*, not wedging:
+  at least the declared number of delivery failures landed while the
+  episode invariant above still held.
+
+Standalone probes for the properties a tick loop cannot express:
+
+* :func:`check_aggregation_scaling` — the real
+  :class:`~bigdl_tpu.obs.aggregate.FleetAggregator` snapshot cost at N
+  hosts stays within a wall budget AND grows ~linearly (measured
+  against a fleet a quarter the size);
+* :func:`check_supervisor_flap` — the real
+  :class:`~bigdl_tpu.resilience.supervisor.Supervisor` rides a
+  flapping (preemption-class) child without spending ONE unit of the
+  transient retry budget;
+* :func:`check_watchdog` — the real :class:`~bigdl_tpu.resilience.
+  supervisor.HangWatchdog` flags a genuinely stalled host and stays
+  conservative on a partitioned (unreachable) one.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List
+
+
+@dataclasses.dataclass
+class InvariantResult:
+    """One invariant verdict (JSON-able via dataclasses.asdict)."""
+
+    name: str
+    ok: bool
+    detail: str
+
+    def __str__(self) -> str:
+        return f"[{'PASS' if self.ok else 'FAIL'}] {self.name}: " \
+               f"{self.detail}"
+
+
+def _result(name: str, ok: bool, detail: str) -> InvariantResult:
+    return InvariantResult(name, bool(ok), detail)
+
+
+# ------------------------------------------------------------- checks
+def check_no_flap(decisions: List[dict], cooldown_s: float,
+                  expect: dict) -> InvariantResult:
+    problems = []
+    for prev, cur in zip(decisions, decisions[1:]):
+        gap = cur["t"] - prev["t"]
+        if cur["direction"] != prev["direction"] and gap < cooldown_s:
+            problems.append(
+                f"{prev['direction']}@{prev['t']:.0f}s then "
+                f"{cur['direction']}@{cur['t']:.0f}s ({gap:.0f}s < "
+                f"cooldown {cooldown_s:.0f}s)")
+    lo = int(expect.get("min_decisions", 0))
+    hi = expect.get("max_decisions")
+    n = len(decisions)
+    if n < lo:
+        problems.append(f"only {n} decision(s), expected >= {lo}")
+    if hi is not None and n > int(hi):
+        problems.append(f"{n} decision(s), expected <= {hi}")
+    reasons = [d["reason"] for d in decisions]
+    for want in expect.get("reasons", []):
+        if want not in reasons:
+            problems.append(f"required reason {want!r} never decided "
+                            f"(got {sorted(set(reasons))})")
+    return _result(
+        "no_flap", not problems,
+        "; ".join(problems) or
+        f"{n} decision(s), no up/down inside the "
+        f"{cooldown_s:.0f}s cooldown")
+
+
+def check_convergence(decisions: List[dict], final_world: int,
+                      duration_s: float,
+                      expect: dict) -> InvariantResult:
+    problems = []
+    fw = expect.get("final_world")
+    if fw is not None:
+        lo, hi = (fw if isinstance(fw, (list, tuple)) else (fw, fw))
+        if not int(lo) <= int(final_world) <= int(hi):
+            problems.append(f"final world {final_world} outside "
+                            f"[{lo}, {hi}]")
+    tail = expect.get("quiet_tail_s")
+    if tail is not None:
+        cutoff = duration_s - float(tail)
+        late = [d for d in decisions if d["t"] >= cutoff]
+        if late:
+            problems.append(f"{len(late)} decision(s) inside the "
+                            f"final {tail:.0f}s quiet tail")
+    return _result("convergence", not problems,
+                   "; ".join(problems) or f"settled at world "
+                                          f"{final_world}")
+
+
+def check_exactly_once_episodes(transitions: List[dict],
+                                expect: dict) -> InvariantResult:
+    """Per (host, rule): firing/resolved strictly alternate, episode
+    ids are consecutive and pair each resolve with its firing — the
+    'exactly once per episode' contract."""
+    problems = []
+    by_key: Dict[tuple, List[dict]] = {}
+    for t in transitions:
+        by_key.setdefault((t["host"], t["rule"]), []).append(t)
+    fired_rules = set()
+    episode_counts: Dict[str, List[int]] = {}
+    for (host, rule), seq in sorted(by_key.items()):
+        fired_rules.add(rule)
+        episodes = 0
+        expect_state = "firing"
+        for t in seq:
+            if t["state"] != expect_state:
+                problems.append(
+                    f"h{host}/{rule}: got {t['state']!r} where "
+                    f"{expect_state!r} was due (episode "
+                    f"{t.get('episode')})")
+                break
+            if t["state"] == "firing":
+                episodes += 1
+                if t.get("episode") != episodes:
+                    problems.append(
+                        f"h{host}/{rule}: firing #{episodes} carries "
+                        f"episode id {t.get('episode')} — the same "
+                        "episode fired twice or an id was skipped")
+                    break
+                expect_state = "resolved"
+            else:
+                if t.get("episode") != episodes:
+                    problems.append(
+                        f"h{host}/{rule}: resolve pairs episode "
+                        f"{t.get('episode')} with firing {episodes}")
+                    break
+                expect_state = "firing"
+        episode_counts.setdefault(rule, []).append(episodes)
+        if expect.get("all_resolved") and seq and \
+                seq[-1]["state"] != "resolved":
+            problems.append(f"h{host}/{rule}: still firing at "
+                            "scenario end")
+    for rule, bounds in (expect.get("alert_episodes") or {}).items():
+        lo, hi = (bounds if isinstance(bounds, (list, tuple))
+                  else (bounds, bounds))
+        for n in episode_counts.get(rule, []):
+            if not int(lo) <= n <= int(hi):
+                problems.append(f"{rule}: a host saw {n} episode(s), "
+                                f"expected [{lo}, {hi}]")
+                break
+    for rule in expect.get("alerts_required", []):
+        if rule not in fired_rules:
+            problems.append(f"required alert {rule!r} never fired on "
+                            "any host")
+    n_eps = sum(sum(v) for v in episode_counts.values())
+    return _result(
+        "exactly_once_episodes", not problems,
+        "; ".join(problems[:4]) or
+        f"{n_eps} episode(s) across {len(by_key)} host-rule pairs, "
+        "all paired")
+
+
+def check_conservative(decisions: List[dict],
+                       expect: dict) -> InvariantResult:
+    windows = expect.get("no_decisions_during_s") or []
+    bad = [d for d in decisions
+           for a, b in windows if a <= d["t"] < b]
+    return _result(
+        "conservative_degradation", not bad,
+        (f"{len(bad)} decision(s) inside degraded windows "
+         f"{windows}: " + ", ".join(
+             f"{d['reason']}@{d['t']:.0f}s" for d in bad[:4]))
+        if bad else
+        f"no decisions inside {len(windows)} degraded window(s)")
+
+
+def check_scrape_budget(scrape_cycles: List[dict],
+                        expect: dict) -> InvariantResult:
+    budget = expect.get("max_scrape_cycle_s")
+    if budget is None or not scrape_cycles:
+        return _result("scrape_budget", True,
+                       "no budget declared" if budget is None
+                       else "no scrape cycles observed")
+    worst = max(scrape_cycles, key=lambda c: c["wall_s"])
+    mean = sum(c["wall_s"] for c in scrape_cycles) / len(scrape_cycles)
+    ok = worst["wall_s"] <= float(budget)
+    return _result(
+        "scrape_budget", ok,
+        f"worst cycle {worst['wall_s'] * 1000:.1f}ms "
+        f"(mean {mean * 1000:.1f}ms, {len(scrape_cycles)} cycles, "
+        f"budget {float(budget) * 1000:.0f}ms, worst had "
+        f"{worst['down']} down peer(s))")
+
+
+def check_sink(sink_failures: float, expect: dict) -> InvariantResult:
+    need = expect.get("min_sink_failures")
+    if need is None:
+        return _result("sink_failures", True, "no sink expectation")
+    ok = sink_failures >= int(need)
+    return _result(
+        "sink_failures", ok,
+        f"{int(sink_failures)} failed sink delivery(ies) counted "
+        f"(needed >= {need}) while the episode invariant held")
+
+
+def check_scenario(observed: dict, expect: dict,
+                   cooldown_s: float) -> List[InvariantResult]:
+    """All applicable invariant checks over one scenario's observation
+    bundle (the runner builds ``observed``)."""
+    return [
+        check_no_flap(observed["decisions"], cooldown_s, expect),
+        check_convergence(observed["decisions"],
+                          observed["final_world"],
+                          observed["duration_s"], expect),
+        check_exactly_once_episodes(observed["transitions"], expect),
+        check_conservative(observed["decisions"], expect),
+        check_scrape_budget(observed["scrape_cycles"], expect),
+        check_sink(observed.get("sink_failures", 0.0), expect),
+    ]
+
+
+# -------------------------------------------------- standalone probes
+def check_aggregation_scaling(n_hosts: int, budget_s: float,
+                              seed: int = 0, cycles: int = 3,
+                              ratio_slack: float = 3.0
+                              ) -> InvariantResult:
+    """The real ``FleetAggregator.snapshot()`` over a fully healthy
+    fleet of ``n_hosts`` must finish inside ``budget_s`` AND scale
+    ~linearly: against a fleet a quarter the size, the cost ratio may
+    not exceed the host ratio times ``ratio_slack`` (a quadratic
+    aggregation blows this immediately)."""
+    from bigdl_tpu.obs.aggregate import FleetAggregator
+    from bigdl_tpu.sim.clock import VirtualClock
+    from bigdl_tpu.sim.fleet import SimFleet
+
+    def cycle_wall(n: int) -> float:
+        clock = VirtualClock()
+        fleet = SimFleet(n, clock, seed=seed)
+        fleet.tick(1.0)
+        agg = FleetAggregator(peers=fleet.addrs, fetch=fleet.fetch)
+        best = float("inf")
+        for _ in range(max(1, int(cycles))):
+            t0 = time.perf_counter()
+            snap = agg.snapshot()
+            best = min(best, time.perf_counter() - t0)
+            assert len(snap["hosts"]) == n, "snapshot dropped hosts"
+        return best
+
+    n_small = max(8, int(n_hosts) // 4)
+    small = cycle_wall(n_small)
+    full = cycle_wall(int(n_hosts))
+    host_ratio = n_hosts / n_small
+    grew = full / max(1e-9, small)
+    ok = full <= float(budget_s) and grew <= host_ratio * ratio_slack
+    return _result(
+        "aggregation_scaling", ok,
+        f"{n_hosts} hosts in {full * 1000:.1f}ms (budget "
+        f"{budget_s * 1000:.0f}ms); vs {n_small} hosts "
+        f"{small * 1000:.1f}ms -> grew {grew:.1f}x for {host_ratio:.1f}x "
+        f"hosts (slack {ratio_slack:g}x)")
+
+
+def check_supervisor_flap(flaps: int = 6,
+                          max_retries: int = 3) -> InvariantResult:
+    """A flapping child that exits the graceful-preemption way every
+    time must ride the supervisor's free preemption path: zero
+    transient retry budget spent, no give-up."""
+    from bigdl_tpu.resilience.elastic import EXIT_PREEMPTED
+    from bigdl_tpu.resilience.supervisor import Supervisor
+    from bigdl_tpu.sim.clock import VirtualClock
+
+    clock = VirtualClock()
+    seen = {"launches": 0}
+
+    def runner(cmd, env):
+        seen["launches"] += 1
+        clock.advance(30.0)  # the child "ran" half a virtual minute
+        return EXIT_PREEMPTED if seen["launches"] <= int(flaps) else 0
+
+    sup = Supervisor(["sim-flapping-child"], max_retries=max_retries,
+                     runner=runner, sleep=clock.sleep)
+    rc = sup.run()
+    spent = sup.policy.attempts
+    ok = rc == 0 and spent == 0 and sup.preemptions == int(flaps)
+    return _result(
+        "supervisor_retry_budget", ok,
+        f"{flaps} flap(s) restarted free (rc {rc}, retry budget spent "
+        f"{spent}/{max_retries}, preemptions {sup.preemptions}, "
+        f"virtual wall {clock.now():.0f}s)")
+
+
+def check_watchdog(fleet, stalled_id: int, partitioned_id: int,
+                   timeout_s: float = 10.0,
+                   hang_age_s: float = 60.0) -> InvariantResult:
+    """The hang watchdog must flag a host whose step stamp stopped
+    (positive evidence) and read an unreachable one as 'cannot tell',
+    never as hung."""
+    from bigdl_tpu.resilience.supervisor import HangWatchdog
+
+    fleet.tick(1.0)  # make sure a first step stamp exists
+    stalled_host = fleet.hosts[stalled_id]
+    stalled_host.stalled = True
+    fleet.clock.advance(hang_age_s)
+    fleet.tick(0.0)
+    fleet.hosts[partitioned_id].partitioned = True
+    wd_stalled = HangWatchdog(timeout_s, port=9000,
+                              fetch=fleet.watchdog_fetch(stalled_id))
+    wd_part = HangWatchdog(timeout_s, port=9000,
+                           fetch=fleet.watchdog_fetch(partitioned_id))
+    saw_stall = wd_stalled.stalled()
+    saw_part = wd_part.stalled()
+    stalled_host.stalled = False
+    fleet.hosts[partitioned_id].partitioned = False
+    ok = saw_stall and not saw_part
+    return _result(
+        "watchdog_classification", ok,
+        f"stalled host flagged={saw_stall} (age "
+        f"{stalled_host.step_age_s()}s > {timeout_s:g}s), partitioned "
+        f"host conservatively not-hung={not saw_part}")

@@ -1,0 +1,190 @@
+#!/usr/bin/env python
+"""Fleet-scale control-plane simulator smoke — the chaos matrix at 200
+hosts, for real.
+
+Driven by ``scripts/run-tests.sh --fleet``.  Stands up hundreds of
+synthetic ``/metrics`` + ``/healthz`` hosts in THIS process
+(``bigdl_tpu/sim``) and runs the REAL control plane against them — the
+real :class:`AutoscaleController` fed by the real
+:class:`EndpointScraper`/:class:`FleetAggregator` bounded-pool scrape,
+a real per-host :class:`AlertEngine` — through the builtin chaos
+scenario matrix on a virtual clock:
+
+* ``diurnal`` — a traffic wave the autoscaler must ride up and back
+  down without one flap inside a cooldown window;
+* ``stragglers`` — correlated 6x stragglers; the slowest host gates
+  the fleet step-time signal, one alert episode per slow host;
+* ``partition`` — 30% of peers time out (with real wall-clock stalls):
+  absent signals never breach a rule, and the concurrent scrape keeps
+  the cycle wall bounded where a serial scrape would pay N × timeout;
+* ``preemptions`` — a cascading preemption of a quarter of the fleet;
+  survivors inherit the load, the controller buys exactly one
+  doubling, each survivor alerts exactly once;
+* ``flapping`` — flapping hosts + a poisoned alert sink; the world
+  never thrashes, sink failures are counted (never wedging), and the
+  real Supervisor rides the flapping child without spending one unit
+  of retry budget;
+* ``latency_wave`` — a fleet-wide p99 wave through the serving
+  latency-histogram signal path.
+
+Every scenario's invariants must PASS; on top the smoke asserts the
+O(hosts) aggregation budget at 200 hosts, renders the report's fleet
+section (text + ``--json``), and banks ``FLEET_SIM.json`` (bench.py
+folds it into BENCH ``extras.fleet``) — the artifact every future
+policy PR regresses against.
+"""
+
+import argparse
+import json
+import logging
+import os
+import sys
+import time
+
+# the atexit obs flush imports jax (device memory stats) — pin CPU or
+# this container's TPU plugin probes the GCP metadata service forever
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+DEFAULT_SCENARIOS = ("diurnal", "stragglers", "partition",
+                     "preemptions", "flapping", "latency_wave")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        prog="scripts/fleet_sim.py",
+        description="Run the chaos scenario matrix against the real "
+                    "control plane at fleet scale (BIGDL_FLEET_* knobs "
+                    "are the env spelling of these flags).")
+    ap.add_argument("--hosts", type=int, default=None,
+                    help="synthetic host count (default "
+                         "BIGDL_FLEET_HOSTS = 200)")
+    ap.add_argument("--scenarios", default=None,
+                    help="comma-separated builtin names / JSON / paths "
+                         "(default BIGDL_FLEET_SCENARIO or the full "
+                         "matrix)")
+    ap.add_argument("--seed", type=int, default=None,
+                    help="default BIGDL_FLEET_SEED")
+    ap.add_argument("--compression", type=float, default=None,
+                    help="time-compression factor (default "
+                         "BIGDL_FLEET_TIME_COMPRESSION)")
+    ap.add_argument("--budget-s", type=float, default=90.0,
+                    help="per-scenario wall-clock budget (default 90)")
+    ap.add_argument("--agg-budget-s", type=float, default=1.5,
+                    help="200-host aggregation snapshot budget "
+                         "(default 1.5)")
+    ap.add_argument("--partition-stall-s", type=float, default=0.02,
+                    help="real wall stall a partitioned fetch costs "
+                         "(default 0.02)")
+    args = ap.parse_args()
+
+    import tempfile
+
+    smoke_dir = tempfile.mkdtemp(prefix="bigdl_fleet_sim_")
+    obs_dir = os.path.join(smoke_dir, "obs")
+    os.environ["BIGDL_TRACE_DIR"] = obs_dir
+    os.environ["BIGDL_METRICS_DIR"] = obs_dir
+
+    from bigdl_tpu import obs
+    from bigdl_tpu.config import refresh_from_env
+    from bigdl_tpu.sim import run_scenario
+    from bigdl_tpu.sim.invariants import check_aggregation_scaling
+
+    # the poisoned-sink scenario logs one warning per failed delivery
+    # (hundreds at 200 hosts); the invariant counts them — keep the
+    # smoke output readable
+    logging.getLogger("bigdl_tpu.obs").setLevel(logging.ERROR)
+
+    fcfg = refresh_from_env().fleet
+    hosts = args.hosts if args.hosts is not None else fcfg.hosts
+    seed = args.seed if args.seed is not None else fcfg.seed
+    compression = (args.compression if args.compression is not None
+                   else fcfg.time_compression)
+    spec = args.scenarios if args.scenarios is not None else \
+        fcfg.scenario
+    scenarios = ([s.strip() for s in spec.split(",") if s.strip()]
+                 if spec and not spec.lstrip().startswith(("{", "["))
+                 else ([spec] if spec else list(DEFAULT_SCENARIOS)))
+    assert len(scenarios) >= 3 or spec, \
+        "the smoke needs >= 3 scenarios to mean anything"
+    assert hosts >= 200 or args.hosts is not None, \
+        f"fleet smoke runs at >= 200 hosts, got {hosts}"
+
+    print(f"FLEET SIM: {len(scenarios)} scenario(s) at {hosts} hosts "
+          f"(seed {seed}, compression {compression:g}x, per-scenario "
+          f"budget {args.budget_s:.0f}s)")
+    results = []
+    failed = []
+    t_total0 = time.monotonic()
+    for name in scenarios:
+        res = run_scenario(name, hosts=hosts, seed=seed,
+                           time_compression=compression,
+                           partition_stall_s=args.partition_stall_s)
+        results.append(res)
+        print("SMOKE " + res.summary())
+        for inv in res.invariants:
+            print("   ", inv)
+        if not res.ok:
+            failed.append(res.name)
+        assert res.wall_s <= args.budget_s, \
+            (f"scenario {res.name} took {res.wall_s:.1f}s — over the "
+             f"{args.budget_s:.0f}s budget")
+    total_wall = time.monotonic() - t_total0
+    assert not failed, f"scenario invariants FAILED: {failed}"
+    decided = sum(len(r.decisions) for r in results)
+    episodes = sum(r.episodes for r in results)
+    if spec is None:
+        # the default matrix must exercise both policy surfaces; a
+        # user-supplied scenario is allowed to target just one (its
+        # own expect block carries the real assertions)
+        assert decided > 0, "no scenario produced an autoscale decision"
+        assert episodes > 0, "no scenario produced an alert episode"
+    print(f"SMOKE scenarios: {len(results)} PASS in {total_wall:.1f}s "
+          f"({decided} decisions, {episodes} alert episodes)")
+
+    # --- O(hosts) aggregation budget at fleet scale -------------------
+    agg = check_aggregation_scaling(hosts, args.agg_budget_s, seed=seed)
+    print("SMOKE", agg)
+    assert agg.ok, agg.detail
+
+    # --- the report's fleet section, text + --json --------------------
+    obs.flush()
+    from bigdl_tpu.obs.report import build_report, render_text
+
+    rep = build_report(obs_dir, obs_dir)
+    assert rep.get("fleet"), "report grew no fleet section"
+    scen_names = {e.get("scenario") for e in rep["fleet"]["scenarios"]}
+    assert scen_names >= set(r.name for r in results), scen_names
+    text = render_text(rep)
+    assert "-- fleet simulation --" in text
+    for r in results:
+        assert f"{r.name:14s} PASS" in text, \
+            f"{r.name} verdict missing from report text:\n{text}"
+    assert "scrape cycle:" in text, text
+    print("SMOKE report: fleet section renders all "
+          f"{len(results)} scenario verdicts + scrape latency")
+
+    # --- bank ---------------------------------------------------------
+    bank = {
+        "hosts": hosts,
+        "seed": seed,
+        "time_compression": compression,
+        "partition_stall_s": args.partition_stall_s,
+        "total_wall_s": round(total_wall, 2),
+        "scenarios": [r.to_dict() for r in results],
+        "aggregation": {"ok": agg.ok, "detail": agg.detail},
+        "decisions": decided,
+        "episodes": episodes,
+    }
+    with open(os.path.join(REPO, "FLEET_SIM.json"), "w",
+              encoding="utf-8") as fh:
+        json.dump(bank, fh, indent=2, sort_keys=True, default=str)
+    print("FLEET SIM PASS (banked FLEET_SIM.json)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

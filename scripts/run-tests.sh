@@ -125,6 +125,28 @@
 #                                                # (also runs in tier-1 via
 #                                                # tests/test_lint.py::
 #                                                # test_repo_is_clean)
+#   scripts/run-tests.sh --fleet                 # fleet-scale control-plane
+#                                                # simulator: the chaos
+#                                                # scenario matrix (diurnal
+#                                                # wave, stragglers,
+#                                                # partition, cascading
+#                                                # preemptions, flapping +
+#                                                # poisoned sink, latency
+#                                                # wave) at 200 synthetic
+#                                                # hosts against the REAL
+#                                                # autoscaler / alert engine
+#                                                # / fleet aggregator on a
+#                                                # virtual clock; all
+#                                                # invariants must pass
+#                                                # (no-flap convergence,
+#                                                # exactly-once alert
+#                                                # episodes, O(hosts)
+#                                                # aggregation, conservative
+#                                                # scrape degradation, free
+#                                                # preemption restarts);
+#                                                # banks FLEET_SIM.json for
+#                                                # BENCH extras.fleet
+#                                                # (no pytest)
 #   scripts/run-tests.sh --live                  # live-telemetry smoke: a
 #                                                # 2-host run with /metrics +
 #                                                # /healthz servers on
@@ -172,6 +194,9 @@ elif [[ "${1:-}" == "--lint" ]]; then
 elif [[ "${1:-}" == "--live" ]]; then
   shift
   exec python scripts/live_smoke.py "$@"
+elif [[ "${1:-}" == "--fleet" ]]; then
+  shift
+  exec python scripts/fleet_sim.py "$@"
 elif [[ "${1:-}" == "--autoscale" ]]; then
   shift
   exec python scripts/autoscale_smoke.py "$@"

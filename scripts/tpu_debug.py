@@ -110,6 +110,21 @@ burn, non-finite spikes, stragglers, checkpoint failures and stale
 heartbeats — see MIGRATION.md "Live telemetry & alerting" and
 ``scripts/run-tests.sh --live`` for the end-to-end smoke.
 
+A FLEET POLICY CHANGE (autoscale bands, alert rules, scrape or
+watchdog behavior) is validated BEFORE it meets real traffic by the
+control-plane simulator: ``scripts/run-tests.sh --fleet`` runs the
+chaos scenario matrix (diurnal wave, correlated stragglers, network
+partition, cascading preemptions, flapping hosts + poisoned alert
+sink, latency wave) at 200 synthetic hosts against the REAL
+controller/alert engine/aggregator on a virtual clock, and the
+invariants (no-flap convergence, exactly-once alert episodes,
+O(hosts) aggregation, conservative degradation, free preemption
+restarts) tell you precisely which property the change broke — read
+the report's "fleet simulation" section and FLEET_SIM.json.  Author a
+targeted scenario (BIGDL_FLEET_SCENARIO=<file.json>) reproducing the
+incident you are chasing; see MIGRATION.md "Fleet simulation & chaos
+scenarios".
+
 A LINT FAILURE (``scripts/run-tests.sh --lint`` /
 ``tests/test_lint.py::test_repo_is_clean``) is triaged from the
 finding line itself — ``path:line: RULE message``.  JX* findings are

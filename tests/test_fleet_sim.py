@@ -1,0 +1,511 @@
+"""Fleet-scale control-plane simulator specs (bigdl_tpu/sim) + the
+satellites that ride the ISSUE: bounded-pool concurrent peer scrapes,
+the alert-episode exactly-once fix, and 200-host signal derivation
+with mixed stale/partitioned/healthy peers.
+
+The heavy scenario matrix lives in ``scripts/fleet_sim.py``
+(``run-tests.sh --fleet``); tier-1 runs one fast compressed scenario
+plus the unit surface — the full matrix at 200 hosts is ``-m slow``.
+"""
+
+import json
+import time
+
+import pytest
+
+from bigdl_tpu import obs
+from bigdl_tpu.config import AutoscaleConfig
+from bigdl_tpu.obs import alerts
+from bigdl_tpu.obs import names
+from bigdl_tpu.obs.aggregate import FleetAggregator
+from bigdl_tpu.obs.metrics import (
+    MetricsRegistry,
+    parse_prometheus,
+    sample_value,
+)
+from bigdl_tpu.resilience.autoscale import (
+    AutoscaleController,
+    EndpointScraper,
+    derive_signals,
+)
+from bigdl_tpu.sim import (
+    BUILTIN_SCENARIOS,
+    SimFleet,
+    VirtualClock,
+    load_scenario,
+    run_scenario,
+)
+from bigdl_tpu.sim import invariants as inv
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs(monkeypatch):
+    for var in ("BIGDL_OBS", "BIGDL_TRACE_DIR", "BIGDL_METRICS_DIR",
+                "BIGDL_OBS_PORT", "BIGDL_FLEET_HOSTS",
+                "BIGDL_FLEET_SCENARIO", "BIGDL_FLEET_TIME_COMPRESSION",
+                "BIGDL_FLEET_SEED", "BIGDL_ALERT_RULES",
+                "BIGDL_ALERT_SINK"):
+        monkeypatch.delenv(var, raising=False)
+    obs.reset()
+    alerts.reset_engine()
+    yield
+    obs.reset()
+    alerts.reset_engine()
+
+
+# ------------------------------------------------------------ clock
+class TestVirtualClock:
+    def test_advance_and_call(self):
+        vc = VirtualClock(10.0)
+        assert vc() == vc.now() == 10.0
+        vc.advance(2.5)
+        assert vc.now() == 12.5
+        vc.sleep(1.0)
+        assert vc.now() == 13.5
+
+    def test_time_never_rewinds(self):
+        with pytest.raises(ValueError, match="advances"):
+            VirtualClock().advance(-1.0)
+
+
+# ------------------------------------------------------------- host
+class TestSimHost:
+    def _host(self, **kw):
+        clock = VirtualClock()
+        fleet = SimFleet(1, clock, jitter=0.0, **kw)
+        return fleet.hosts[0], fleet, clock
+
+    def test_healthz_speaks_the_real_contract(self):
+        """Key-for-key the payload obs/server.health_payload serves —
+        the scrape contract the controller and watchdog consume."""
+        from bigdl_tpu.obs.server import health_payload
+
+        host, _fleet, _clock = self._host()
+        assert set(host.health()) == set(health_payload())
+
+    def test_metrics_is_real_exposition(self):
+        host, fleet, clock = self._host()
+        host.queue_depth = 37.0
+        host.goodput_ratio = 0.75
+        fleet.tick(1.0)
+        parsed = parse_prometheus(host.metrics_text())
+        assert sample_value(parsed, names.SERVE_QUEUE_DEPTH) == 37.0
+        assert sample_value(parsed, names.GOODPUT_RATIO) == 0.75
+        # the e2e latency histogram carries real cumulative buckets
+        assert any(s["name"] == "bigdl_request_latency_seconds_bucket"
+                   and s["labels"].get("kind") == "e2e"
+                   for s in parsed["samples"])
+
+    def test_step_stamp_and_stall(self):
+        host, fleet, clock = self._host()
+        fleet.tick(5.0)
+        clock.advance(5.0)
+        fleet.tick(5.0)
+        first = host.step()
+        assert first and first >= 90  # 10s at 0.1s/step
+        assert host.health()["status"] == "ok"
+        host.stalled = True
+        clock.advance(30.0)
+        fleet.tick(5.0)
+        assert host.step() == first  # frozen
+        h = host.health()
+        assert h["status"] == "stalled" and h["step_age_s"] >= 30.0
+
+    def test_restart_resets_counters(self):
+        host, fleet, clock = self._host()
+        fleet.tick(5.0)
+        host.up = False
+        host.restart()
+        assert host.attempt == 1 and host.step() is None
+
+
+# --------------------------------------------------------- scenarios
+class TestScenario:
+    def test_builtins_load_and_bind(self):
+        for name in BUILTIN_SCENARIOS:
+            sc = load_scenario(name, hosts=16)
+            assert sc.n_ticks() > 0
+            for ev in sc.events:
+                assert ev["_ids"], f"{name} event #{ev['_index']} "
+
+    def test_unknown_name_is_loud(self):
+        with pytest.raises(ValueError, match="unknown scenario"):
+            load_scenario("clear_skies", hosts=8)
+
+    @pytest.mark.parametrize("raw,msg", [
+        ({"duration_s": 10}, "missing a name"),
+        ({"name": "x", "duration_s": 0}, "must be > 0"),
+        ({"name": "x", "duration_s": 10,
+          "events": [{"kind": "tornado"}]}, "unknown kind"),
+        ({"name": "x", "duration_s": 10,
+          "events": [{"kind": "preempt"}]}, "missing 'down_s'"),
+        ({"name": "x", "duration_s": 10,
+          "events": [{"kind": "stall", "at_s": 9, "until_s": 3}]},
+         "at_s < until_s"),
+        ({"name": "x", "duration_s": 10,
+          "events": [{"kind": "stall", "hosts": {"pct": 10}}]},
+         "selector"),
+        ({"name": "x", "duration_s": 10, "expect": {"max_decide": 1}},
+         "unknown expect"),
+        ({"name": "x", "duration_s": 10,
+          "autoscale": {"queue_hi": 3}}, "unknown autoscale"),
+    ])
+    def test_validation_is_loud(self, raw, msg):
+        with pytest.raises(ValueError, match=msg):
+            load_scenario(raw, hosts=8)
+
+    def test_time_compression_preserves_tick(self):
+        sc = load_scenario("diurnal", hosts=8, time_compression=2.0)
+        full = load_scenario("diurnal", hosts=8)
+        assert sc.duration_s == full.duration_s / 2
+        assert sc.tick_s == full.tick_s  # NOT compressed
+        assert sc.autoscale["cooldown_s"] == \
+            full.autoscale["cooldown_s"] / 2
+        # alert debounce counts are evaluations, not seconds
+        assert sc.alert_rules[0]["for"] == full.alert_rules[0]["for"]
+
+    def test_selector_is_seed_deterministic(self):
+        a = load_scenario("stragglers", hosts=64, seed=7)
+        b = load_scenario("stragglers", hosts=64, seed=7)
+        c = load_scenario("stragglers", hosts=64, seed=8)
+        ids = [ev["_ids"] for ev in a.events if ev["kind"] == "straggler"]
+        assert ids == [ev["_ids"] for ev in b.events
+                       if ev["kind"] == "straggler"]
+        assert ids != [ev["_ids"] for ev in c.events
+                       if ev["kind"] == "straggler"]
+
+    def test_offered_wave_shape(self):
+        sc = load_scenario({
+            "name": "w", "duration_s": 100, "tick_s": 5,
+            "events": [{"kind": "traffic", "base": 10,
+                        "amplitude": 40, "period_s": 100}]}, hosts=4)
+        assert sc.offered(0.0) == pytest.approx(10.0)
+        assert sc.offered(50.0) == pytest.approx(50.0)
+        assert sc.offered(100.0) is None  # window closed
+
+    def test_inline_json_and_file(self, tmp_path):
+        raw = {"name": "j", "duration_s": 10, "tick_s": 5}
+        assert load_scenario(json.dumps(raw), hosts=4).name == "j"
+        p = tmp_path / "sc.json"
+        p.write_text(json.dumps(raw))
+        assert load_scenario(str(p), hosts=4).name == "j"
+
+
+# ---------------------- 200-host signal derivation (ISSUE satellite)
+class TestDeriveSignalsFleetScale:
+    def _scrape(self, fleet):
+        return EndpointScraper(peers=fleet.addrs, fetch=fleet.fetch)()
+
+    def test_200_hosts_mixed_health(self):
+        """200 synthetic hosts through the REAL scrape + derivation:
+        120 healthy, 40 partitioned, 40 stalled — worst-host gating on
+        every signal, absent peers contributing nothing."""
+        clock = VirtualClock()
+        fleet = SimFleet(200, clock, jitter=0.0)
+        fleet.tick(5.0)  # every host resolves its first steps
+        for h in fleet.hosts[120:160]:
+            h.partitioned = True
+        for h in fleet.hosts[160:200]:
+            h.stalled = True
+        fleet.hosts[7].slow_factor = 4.0      # the straggler that gates
+        fleet.hosts[11].queue_depth = 99.0    # the deepest queue
+        fleet.hosts[13].goodput_ratio = 0.31  # the worst goodput
+        fleet.hosts[17].latency_e2e_s = 0.6   # the worst p99
+        fleet.tick(0.0)  # republish the mutated gauges
+        prev: dict = {}
+        derive_signals(self._scrape(fleet), prev, world=2)
+        clock.advance(5.0)
+        fleet.tick(5.0)
+        scraped = self._scrape(fleet)
+        ok = [p for p in scraped if p["ok"]]
+        assert len(scraped) == 200 and len(ok) == 160
+        sig = derive_signals(scraped, prev, world=2)
+        # slowest healthy host gates the fleet step time (0.1 * 4)
+        assert sig["step_time_s"] == pytest.approx(0.4, rel=0.3)
+        assert sig["queue_depth"] == 99.0
+        assert sig["goodput_ratio"] == pytest.approx(0.31)
+        assert sig["p99_latency_s"] == pytest.approx(1.0)  # bucket le
+        assert sig["world"] == 2
+        # every stalled host flagged as a straggler, by host id
+        assert sorted(sig["stragglers"]) == list(range(160, 200))
+        # partitioned peers contribute nothing — steps only from the
+        # 160 reachable hosts
+        assert len(prev) == 160
+
+    def test_fully_partitioned_fleet_is_conservative(self):
+        clock = VirtualClock()
+        fleet = SimFleet(16, clock, jitter=0.0)
+        fleet.tick(5.0)
+        for h in fleet.hosts:
+            h.partitioned = True
+        scraped = self._scrape(fleet)
+        assert not any(p["ok"] for p in scraped)
+        # the controller's tick refuses to decide on an all-down scrape
+        cfg = AutoscaleConfig(enabled=True, interval_s=0.0,
+                              warmup_s=0.0, queue_low=5.0, hysteresis=1)
+        ctl = AutoscaleController(
+            cfg=cfg, world=4, clock=clock,
+            scrape=lambda: self._scrape(fleet))
+        assert ctl.tick() is None
+        # partial scrape: absent signals never breach (queue_low would
+        # otherwise scale down on "no queue data")
+        for h in fleet.hosts[:4]:
+            h.partitioned = False
+            h.queue_depth = 50.0  # inside the band
+        fleet.tick(0.0)  # republish
+        sig = derive_signals(self._scrape(fleet), {}, world=4)
+        assert sig["queue_depth"] == 50.0
+
+    def test_restarted_host_never_fakes_a_step_time(self):
+        clock = VirtualClock()
+        fleet = SimFleet(2, clock, jitter=0.0)
+        fleet.tick(5.0)
+        prev: dict = {}
+        derive_signals(self._scrape(fleet), prev, world=1)
+        fleet.hosts[0].up = False
+        fleet.hosts[0].restart()  # counters reset to zero
+        clock.advance(5.0)
+        fleet.tick(5.0)
+        sig = derive_signals(self._scrape(fleet), prev, world=1)
+        # host 1's honest delta gates; host 0's reset is skipped
+        assert sig["step_time_s"] == pytest.approx(0.1, rel=0.2)
+
+
+# ----------------------- concurrent peer scrape (ISSUE satellite)
+class TestParallelScrape:
+    def test_partitioned_peers_cost_pool_rounds_not_n_timeouts(self):
+        stall = 0.05
+        peers = [f"p{i}:1" for i in range(32)]
+
+        def sleepy_fetch(url):
+            time.sleep(stall)
+            raise TimeoutError("partitioned")
+
+        agg = FleetAggregator(peers=peers, fetch=sleepy_fetch)
+        t0 = time.perf_counter()
+        out = agg.scrape_peers(peers)
+        wall = time.perf_counter() - t0
+        assert len(out) == 32 and not any(p["ok"] for p in out)
+        # serial would be 32 * 0.05 = 1.6s; the 16-wide pool pays ~2
+        # rounds.  Generous bound for a loaded CI box:
+        assert wall < 0.8, f"scrape cycle took {wall:.2f}s — serial?"
+        assert agg.last_scrape_s == pytest.approx(wall, abs=0.05)
+
+    def test_cycle_latency_gauge_published(self):
+        agg = FleetAggregator(peers=["a:1", "b:1"],
+                              fetch=lambda url: (_ for _ in ()).throw(
+                                  ConnectionRefusedError()))
+        agg.scrape_peers(["a:1", "b:1"])
+        fams = {f.name: f for f in obs.get_registry().families()}
+        fam = fams[names.FLEET_SCRAPE_SECONDS]
+        (_key, child), = fam.child_items()
+        assert child.value >= 0.0 and fam.kind == "gauge"
+
+    def test_order_preserved_and_results_correct(self):
+        clock = VirtualClock()
+        fleet = SimFleet(24, clock, jitter=0.0)
+        fleet.hosts[5].up = False
+        fleet.tick(1.0)
+        agg = FleetAggregator(peers=fleet.addrs, fetch=fleet.fetch)
+        out = agg.scrape_peers(fleet.addrs)
+        assert [p["addr"] for p in out] == fleet.addrs
+        assert not out[5]["ok"] and out[6]["ok"]
+        assert out[6]["health"]["host"] == 6
+
+    def test_snapshot_rides_the_pool(self):
+        clock = VirtualClock()
+        fleet = SimFleet(12, clock, jitter=0.0)
+        fleet.hosts[2].up = False
+        fleet.tick(1.0)
+        snap = FleetAggregator(peers=fleet.addrs,
+                               fetch=fleet.fetch).snapshot()
+        assert len(snap["hosts"]) == 11
+        assert list(snap["errors"]) == ["sim2:9000"]
+
+
+# -------------------- alert episodes exactly-once (ISSUE satellite)
+class TestAlertEpisodes:
+    def _engine(self, resolve_for):
+        reg = MetricsRegistry()
+        g = reg.gauge(names.GOODPUT_RATIO, "r")
+        rules = alerts.load_rules(json.dumps([{
+            "name": "dip", "metric": names.GOODPUT_RATIO, "op": "<",
+            "value": 0.5, "for": 1, "resolve_for": resolve_for}]))
+        return alerts.AlertEngine(rules, registry=reg,
+                                  clock=lambda: 1.0), g
+
+    def test_one_eval_blip_cannot_split_an_episode(self):
+        """The double-fire fix: with resolve_for=2 a gauge that dips
+        across two evaluation windows stays ONE episode."""
+        eng, g = self._engine(resolve_for=2)
+        states = []
+        for v in (0.2, 0.9, 0.2, 0.9, 0.9):
+            g.set(v)
+            states.extend((t["state"], t["episode"])
+                          for t in eng.evaluate())
+        assert states == [("firing", 1), ("resolved", 1)]
+
+    def test_legacy_resolve_for_1_splits(self):
+        """...whereas the pre-fix behavior (resolve_for=1) pages twice
+        for the same dip — the bug the sim invariant pins."""
+        eng, g = self._engine(resolve_for=1)
+        states = []
+        for v in (0.2, 0.9, 0.2, 0.9):
+            g.set(v)
+            states.extend((t["state"], t["episode"])
+                          for t in eng.evaluate())
+        assert states == [("firing", 1), ("resolved", 1),
+                          ("firing", 2), ("resolved", 2)]
+
+    def test_episode_ids_ride_active_and_transitions(self):
+        eng, g = self._engine(resolve_for=1)
+        g.set(0.1)
+        (t,) = eng.evaluate()
+        assert t["episode"] == 1
+        assert eng.active()[0]["episode"] == 1
+
+    def test_resolve_for_validated_loudly(self):
+        with pytest.raises(ValueError, match="resolve_for"):
+            alerts.load_rules(json.dumps([{
+                "name": "x", "metric": "m", "op": ">", "value": 1,
+                "resolve_for": 0}]))
+
+    def test_poisoned_sink_counts_failures_never_wedges(self, tmp_path):
+        eng, g = self._engine(resolve_for=1)
+        eng.sink = str(tmp_path / "no-such-dir" / "sink.jsonl")
+        g.set(0.1)
+        assert [t["state"] for t in eng.evaluate()] == ["firing"]
+        g.set(0.9)
+        assert [t["state"] for t in eng.evaluate()] == ["resolved"]
+        fams = {f.name: f for f in obs.get_registry().families()}
+        fam = fams[names.ALERT_SINK_FAILURES_TOTAL]
+        assert sum(c.value for _k, c in fam.child_items()) == 2
+
+
+# --------------------------------------------------------- invariants
+class TestInvariants:
+    def test_no_flap_catches_reverse_inside_cooldown(self):
+        ds = [{"t": 0.0, "direction": "up", "reason": "q"},
+              {"t": 30.0, "direction": "down", "reason": "g"}]
+        assert not inv.check_no_flap(ds, 60.0, {}).ok
+        assert inv.check_no_flap(ds, 20.0, {}).ok
+
+    def test_no_flap_bounds_and_reasons(self):
+        ds = [{"t": 0.0, "direction": "up", "reason": "q"}]
+        assert not inv.check_no_flap(ds, 1.0, {"max_decisions": 0}).ok
+        assert not inv.check_no_flap(ds, 1.0, {"min_decisions": 2}).ok
+        assert not inv.check_no_flap(ds, 1.0, {"reasons": ["zz"]}).ok
+        assert inv.check_no_flap(ds, 1.0, {"reasons": ["q"]}).ok
+
+    def test_exactly_once_catches_double_fire(self):
+        bad = [{"host": 0, "rule": "r", "state": "firing", "episode": 1},
+               {"host": 0, "rule": "r", "state": "resolved",
+                "episode": 1},
+               {"host": 0, "rule": "r", "state": "firing",
+                "episode": 1}]  # the same episode fired twice
+        res = inv.check_exactly_once_episodes(bad, {})
+        assert not res.ok and "episode" in res.detail
+
+    def test_exactly_once_catches_alternation_break(self):
+        bad = [{"host": 0, "rule": "r", "state": "firing", "episode": 1},
+               {"host": 0, "rule": "r", "state": "firing", "episode": 2}]
+        assert not inv.check_exactly_once_episodes(bad, {}).ok
+
+    def test_exactly_once_episode_bounds_and_required(self):
+        good = [{"host": 0, "rule": "r", "state": "firing",
+                 "episode": 1},
+                {"host": 0, "rule": "r", "state": "resolved",
+                 "episode": 1}]
+        assert inv.check_exactly_once_episodes(
+            good, {"alert_episodes": {"r": [1, 1]},
+                   "alerts_required": ["r"], "all_resolved": True}).ok
+        assert not inv.check_exactly_once_episodes(
+            good, {"alert_episodes": {"r": [2, 2]}}).ok
+        assert not inv.check_exactly_once_episodes(
+            good, {"alerts_required": ["other"]}).ok
+
+    def test_conservative_windows(self):
+        ds = [{"t": 200.0, "direction": "down", "reason": "q"}]
+        bad = inv.check_conservative(
+            ds, {"no_decisions_during_s": [[150.0, 400.0]]})
+        assert not bad.ok
+        assert inv.check_conservative(
+            ds, {"no_decisions_during_s": [[300.0, 400.0]]}).ok
+
+    def test_scrape_budget(self):
+        cyc = [{"t": 0, "wall_s": 0.2, "ok": 3, "down": 1}]
+        assert inv.check_scrape_budget(
+            cyc, {"max_scrape_cycle_s": 0.5}).ok
+        assert not inv.check_scrape_budget(
+            cyc, {"max_scrape_cycle_s": 0.1}).ok
+
+    def test_aggregation_scaling_probe(self):
+        res = inv.check_aggregation_scaling(32, budget_s=5.0)
+        assert res.ok, res.detail
+
+    def test_supervisor_flap_probe_spends_no_budget(self):
+        res = inv.check_supervisor_flap(flaps=4, max_retries=2)
+        assert res.ok, res.detail
+
+    def test_watchdog_probe(self):
+        clock = VirtualClock()
+        fleet = SimFleet(4, clock, jitter=0.0)
+        res = inv.check_watchdog(fleet, 0, 1, timeout_s=10.0)
+        assert res.ok, res.detail
+
+
+# ------------------------------------------------------- end to end
+class TestScenarioEndToEnd:
+    def test_preemptions_compressed(self):
+        """Cascading preemptions at 40 hosts, 2x compressed: survivors
+        inherit the load, the real controller buys exactly one
+        doubling, each firing host alerts exactly once."""
+        res = run_scenario("preemptions", hosts=40, seed=0,
+                           time_compression=2.0)
+        assert res.ok, res.summary()
+        assert [d["reason"] for d in res.decisions] == ["queue_high"]
+        assert res.final_world == 2
+        assert res.episodes >= 10  # most survivors paged once
+
+    def test_flapping_compressed_with_probes(self):
+        res = run_scenario("flapping", hosts=24, seed=0,
+                           time_compression=2.0)
+        assert res.ok, res.summary()
+        assert res.decisions == []  # flapping never thrashes the world
+        assert res.sink_failures >= 1
+        by_name = {r.name: r for r in res.invariants}
+        assert "supervisor_retry_budget" in by_name
+        assert "watchdog_classification" in by_name
+
+    def test_scenario_banks_report_fleet_section(self, tmp_path,
+                                                 monkeypatch):
+        monkeypatch.setenv("BIGDL_TRACE_DIR", str(tmp_path))
+        monkeypatch.setenv("BIGDL_METRICS_DIR", str(tmp_path))
+        obs.reset()
+        tiny = {
+            "name": "tiny", "duration_s": 60.0, "tick_s": 5.0,
+            "autoscale": {"queue_high": 50.0, "warmup_s": 5.0,
+                          "interval_s": 5.0, "cooldown_s": 20.0,
+                          "hysteresis": 2, "max_world": 2},
+            "events": [{"kind": "traffic", "base": 100.0}],
+            "expect": {"min_decisions": 1, "reasons": ["queue_high"]},
+        }
+        res = run_scenario(tiny, hosts=8, seed=0)
+        assert res.ok, res.summary()
+        obs.flush()
+        from bigdl_tpu.obs.report import build_report, render_text
+
+        rep = build_report(str(tmp_path), str(tmp_path))
+        assert rep["fleet"]["scenarios"][-1]["scenario"] == "tiny"
+        text = render_text(rep)
+        assert "-- fleet simulation --" in text
+        assert "tiny" in text and "PASS" in text
+
+    @pytest.mark.slow
+    def test_full_matrix_at_200_hosts(self):
+        """The smoke's matrix, in-suite (slow): every builtin scenario
+        at 200 hosts with every invariant green."""
+        for name in BUILTIN_SCENARIOS:
+            res = run_scenario(name, hosts=200, seed=0,
+                               partition_stall_s=0.01)
+            assert res.ok, res.summary()
