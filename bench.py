@@ -662,6 +662,24 @@ def _fleet_extras():
         return None
 
 
+def _router_extras():
+    """Serving-router evidence for the BENCH JSON: the newest
+    ``ROUTER_SMOKE.json`` banked by scripts/router_smoke.py (the three
+    data-plane chaos scenarios' invariant verdicts — conservation,
+    retry amplification, SLO stability — plus the real-engine
+    bit-equality / drain-handoff / HTTP-topology segment).  None when
+    the smoke has never been run."""
+    try:
+        smoke = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "ROUTER_SMOKE.json")
+        if not os.path.exists(smoke):
+            return None
+        with open(smoke, "r", encoding="utf-8") as fh:
+            return {"smoke": json.load(fh)}
+    except Exception:
+        return None
+
+
 def _tuner_extras():
     """Auto-tuner evidence for the BENCH JSON (ops/autotune.py): the
     cache stats and every decision with its static baseline, measured
@@ -1026,6 +1044,9 @@ def _run_child(platform: str):
     fleet = _fleet_extras()
     if fleet is not None:
         ex["fleet"] = fleet
+    router = _router_extras()
+    if router is not None:
+        ex["router"] = router
     print(PARTIAL_MARK + json.dumps(result), flush=True)
 
 

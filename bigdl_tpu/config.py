@@ -407,6 +407,76 @@ class ServeConfig:
 
 
 @dataclasses.dataclass
+class RouterConfig:
+    """Multi-replica serving router (``bigdl_tpu/serving/router.py``).
+
+    The data-plane tier above N :class:`~bigdl_tpu.serving.LMEngine`
+    replicas: session-affine, KV-pressure-aware placement, a shared
+    retry *budget* (token bucket) so a browning-out replica cannot
+    amplify load, and graceful drain/handoff.  Constructor arguments on
+    :class:`~bigdl_tpu.serving.router.Router` win; these are the
+    process-wide fallbacks.
+    """
+
+    # comma-separated replica endpoints ("host:port,host:port") the
+    # router front-end load-balances over; unset = replicas are passed
+    # programmatically [BIGDL_ROUTER_REPLICAS]
+    replicas: Optional[str] = None
+    # router HTTP port (0 = ephemeral); unset = constructor default
+    # [BIGDL_ROUTER_PORT]
+    port: Optional[int] = None
+    # session-affinity binding TTL in seconds — a session re-placed
+    # within the TTL lands on the replica holding its KV prefix;
+    # <= 0 disables affinity [BIGDL_ROUTER_AFFINITY_TTL]
+    affinity_ttl_s: float = 300.0
+    # retry budget: tokens deposited per admitted request (the token
+    # bucket is capped at `retry_budget_burst`), one spent per retry —
+    # fleet-wide retries are capped at ~ratio x the request rate
+    # [BIGDL_ROUTER_RETRY_BUDGET]
+    retry_budget_ratio: float = 0.2
+    # token-bucket cap (also the cold-start allowance)
+    # [BIGDL_ROUTER_RETRY_BURST]
+    retry_budget_burst: float = 8.0
+    # per-request placement attempts past the first (a request is tried
+    # on at most 1 + max_retries replicas) [BIGDL_ROUTER_MAX_RETRIES]
+    max_retries: int = 2
+    # per-attempt replica timeout in seconds [BIGDL_ROUTER_TIMEOUT]
+    request_timeout_s: float = 30.0
+    # drain deadline: a draining replica gets this long to finish its
+    # in-flight decodes before the rest are checkpointed and handed
+    # off [BIGDL_ROUTER_DRAIN_DEADLINE]
+    drain_deadline_s: float = 10.0
+    # weight of KV-page pressure (pages_in_use / pool) against queue
+    # depth + in-flight count in the placement score
+    # [BIGDL_ROUTER_KV_WEIGHT]
+    kv_weight: float = 4.0
+    # jittered-backoff base between placement retries (seconds)
+    # [BIGDL_ROUTER_BACKOFF_BASE]
+    backoff_base_s: float = 0.05
+    # Retry-After seconds stamped on shed (503) responses
+    # [BIGDL_ROUTER_RETRY_AFTER]
+    retry_after_s: float = 1.0
+
+    @classmethod
+    def from_env(cls) -> "RouterConfig":
+        return cls(
+            replicas=_env_str("BIGDL_ROUTER_REPLICAS", None),
+            port=_env_opt_int("BIGDL_ROUTER_PORT", None),
+            affinity_ttl_s=_env_float("BIGDL_ROUTER_AFFINITY_TTL", 300.0),
+            retry_budget_ratio=_env_float("BIGDL_ROUTER_RETRY_BUDGET",
+                                          0.2),
+            retry_budget_burst=_env_float("BIGDL_ROUTER_RETRY_BURST", 8.0),
+            max_retries=_env_int("BIGDL_ROUTER_MAX_RETRIES", 2),
+            request_timeout_s=_env_float("BIGDL_ROUTER_TIMEOUT", 30.0),
+            drain_deadline_s=_env_float("BIGDL_ROUTER_DRAIN_DEADLINE",
+                                        10.0),
+            kv_weight=_env_float("BIGDL_ROUTER_KV_WEIGHT", 4.0),
+            backoff_base_s=_env_float("BIGDL_ROUTER_BACKOFF_BASE", 0.05),
+            retry_after_s=_env_float("BIGDL_ROUTER_RETRY_AFTER", 1.0),
+        )
+
+
+@dataclasses.dataclass
 class FleetSimConfig:
     """Fleet-scale control-plane simulator (``bigdl_tpu/sim``).
 
@@ -585,6 +655,12 @@ class BigDLConfig:
     #  _SLO_MS / _ADMISSION / _PORT]
     serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
 
+    # --- multi-replica serving router (serving/router.py) ---------------
+    # [BIGDL_ROUTER_REPLICAS / _PORT / _AFFINITY_TTL / _RETRY_BUDGET /
+    #  _RETRY_BURST / _MAX_RETRIES / _TIMEOUT / _DRAIN_DEADLINE /
+    #  _KV_WEIGHT / _BACKOFF_BASE / _RETRY_AFTER]
+    router: RouterConfig = dataclasses.field(default_factory=RouterConfig)
+
     # --- fleet-scale control-plane simulator (sim/ package) -------------
     # [BIGDL_FLEET_HOSTS / _SCENARIO / _TIME_COMPRESSION / _SEED]
     fleet: FleetSimConfig = dataclasses.field(
@@ -629,6 +705,7 @@ class BigDLConfig:
             tuner=TunerConfig.from_env(),
             wire=WireConfig.from_env(),
             serve=ServeConfig.from_env(),
+            router=RouterConfig.from_env(),
             fleet=FleetSimConfig.from_env(),
         )
 

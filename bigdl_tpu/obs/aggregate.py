@@ -39,6 +39,7 @@ import os
 import time
 from typing import List, Optional
 from bigdl_tpu.obs import names
+from bigdl_tpu.resilience.retry import RetryBudget, backoff_delay
 
 # the alignment anchor Engine.init emits after multi-host bring-up
 BARRIER_EVENT = "engine.init_barrier"
@@ -374,7 +375,8 @@ class FleetAggregator:
 
     def __init__(self, peers=None, metrics_dir: Optional[str] = None,
                  fetch=None, timeout_s: float = 2.0,
-                 max_workers: int = 16):
+                 max_workers: int = 16,
+                 retry_budget: Optional[RetryBudget] = None):
         if isinstance(peers, str):
             peers = [p.strip() for p in peers.split(",") if p.strip()]
         self.peers = list(peers or [])
@@ -383,6 +385,13 @@ class FleetAggregator:
         self.max_workers = max(1, int(max_workers))
         self.last_scrape_s: Optional[float] = None
         self._fetch = fetch or self._http_fetch
+        # the serving router's shared token bucket, reused here: one
+        # flaky peer gets a second chance, a partitioned fleet does NOT
+        # double the scrape cycle (the bucket drains after ~burst
+        # retries and every further down peer costs one timeout, same
+        # as before retries existed)
+        self.retry_budget = retry_budget or RetryBudget(
+            ratio=0.1, burst=4.0)
         self._tailer = (ShardTailer(metrics_dir)
                         if metrics_dir and not self.peers else None)
 
@@ -401,20 +410,37 @@ class FleetAggregator:
             return r.read().decode("utf-8")
 
     # ------------------------------------------------------ peer scrape
+    def _scrape_once(self, base: str, out: dict) -> None:
+        out["health"] = json.loads(self._fetch(base + "/healthz"))
+        from bigdl_tpu.obs.metrics import parse_prometheus
+
+        out["metrics"] = parse_prometheus(self._fetch(base + "/metrics"))
+        out["ok"] = True
+
     def scrape_peer(self, addr: str) -> dict:
         """One peer's ``/healthz`` + ``/metrics`` (metrics parse errors
         are loud per the parse_prometheus contract; transport errors
-        mark the peer down, they never raise)."""
+        mark the peer down, they never raise).  A transport failure
+        gets ONE more attempt after a jittered backoff while the shared
+        :class:`~bigdl_tpu.resilience.retry.RetryBudget` grants a token
+        — so a single flaky peer doesn't flap the fleet snapshot, but a
+        partition (every peer failing) drains the bucket and degrades
+        to single attempts instead of doubling the cycle."""
         base = addr if addr.startswith("http") else f"http://{addr}"
         out = {"addr": addr, "ok": False, "health": None, "metrics": None}
+        self.retry_budget.record_request()
         try:
-            out["health"] = json.loads(self._fetch(base + "/healthz"))
-            from bigdl_tpu.obs.metrics import parse_prometheus
-
-            out["metrics"] = parse_prometheus(self._fetch(base + "/metrics"))
-            out["ok"] = True
+            self._scrape_once(base, out)
+            return out
         except Exception as e:  # noqa: BLE001 — a dead peer is data
             out["error"] = f"{type(e).__name__}: {e}"
+        if self.retry_budget.try_spend():
+            time.sleep(backoff_delay(1, base=0.02, cap=0.2))
+            try:
+                self._scrape_once(base, out)
+                out.pop("error", None)
+            except Exception as e:  # noqa: BLE001 — still down
+                out["error"] = f"{type(e).__name__}: {e}"
         return out
 
     def scrape_peers(self, addrs) -> List[dict]:

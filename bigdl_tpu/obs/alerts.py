@@ -373,11 +373,14 @@ def _count_sink_failure():
 def _sink_write(sink: str, record: dict, timeout: Optional[float] = None):
     """Deliver one transition to the sink — JSONL append, or webhook
     POST for http(s):// targets.  Best-effort but BOUNDED: the POST
-    carries a connect/read timeout (``BIGDL_ALERT_SINK_TIMEOUT``, one
-    immediate retry on any failure), so a dead or wedged receiver costs
-    the goodput window tick at most two timeouts — and the loss is
-    visible in ``bigdl_alert_sink_failures_total``, never only a log
-    line."""
+    carries a connect/read timeout (``BIGDL_ALERT_SINK_TIMEOUT``) and
+    one retry after the shared jittered backoff
+    (:func:`~bigdl_tpu.resilience.retry.backoff_delay` — the immediate
+    hot re-POST this used to do just hit the same wedged receiver
+    inside the same failure window), so a dead receiver costs the
+    goodput window tick at most two timeouts + a sub-second backoff —
+    and the loss is visible in ``bigdl_alert_sink_failures_total``,
+    never only a log line."""
     payload = json.dumps(record, default=str)
     if sink.startswith(("http://", "https://")):
         if timeout is None:
@@ -386,8 +389,10 @@ def _sink_write(sink: str, record: dict, timeout: Optional[float] = None):
             timeout = config.obs.alert_sink_timeout
         import urllib.request
 
+        from bigdl_tpu.resilience.retry import backoff_delay
+
         last = None
-        for attempt in range(2):  # one immediate retry
+        for attempt in range(1, 3):  # one retry, jittered backoff
             req = urllib.request.Request(
                 sink, data=payload.encode("utf-8"),
                 headers={"Content-Type": "application/json"})
@@ -396,6 +401,8 @@ def _sink_write(sink: str, record: dict, timeout: Optional[float] = None):
                 return
             except Exception as e:  # noqa: BLE001 — counted below
                 last = e
+                if attempt < 2:
+                    time.sleep(backoff_delay(attempt, base=0.1, cap=0.5))
         _count_sink_failure()
         log.warning("alert sink %s failed twice (timeout %.1fs): %s",
                     sink, timeout, last)

@@ -296,6 +296,40 @@ SERVE_DECODE_ATTN_MS = _m(
 SERVE_DECODE_HBM_BYTES_PER_TOKEN = _m(
     "bigdl_serve_decode_hbm_bytes_per_token", "gauge",
     doc="Modeled HBM traffic per decoded token")
+SERVE_REJECTS_TOTAL = _m(
+    "bigdl_serve_rejects_total", "counter",
+    doc="Admissions rejected 503 + Retry-After (queue full past the "
+        "admission timeout, or the engine is draining)")
+
+# --------------------------------------------------------------- router
+ROUTER_REQUESTS_TOTAL = _m(
+    "bigdl_router_requests_total", "counter", ("outcome",), 8,
+    "Routed requests by final outcome (ok / shed / failed)")
+ROUTER_RETRIES_TOTAL = _m(
+    "bigdl_router_retries_total", "counter",
+    doc="Re-placements after a transient replica failure (each one "
+        "spent a retry-budget token)")
+ROUTER_SHED_TOTAL = _m(
+    "bigdl_router_shed_total", "counter",
+    doc="Requests shed 503 + Retry-After on an exhausted retry budget "
+        "or no eligible replica")
+ROUTER_HANDOFFS_TOTAL = _m(
+    "bigdl_router_handoffs_total", "counter",
+    doc="Checkpointed decodes replayed exactly-once off a draining "
+        "replica")
+ROUTER_DRAINS_TOTAL = _m(
+    "bigdl_router_drains_total", "counter",
+    doc="Replica drain cycles the router completed")
+ROUTER_AFFINITY_HITS_TOTAL = _m(
+    "bigdl_router_affinity_hits_total", "counter",
+    doc="Placements that landed on the session's bound replica (the "
+        "multi-turn KV prefix stayed resident)")
+ROUTER_REPLICAS = _m(
+    "bigdl_router_replicas", "gauge", ("state",), 4,
+    "Replicas by router-observed state (up / draining / down)")
+ROUTER_RETRY_BUDGET_TOKENS = _m(
+    "bigdl_router_retry_budget_tokens", "gauge",
+    doc="Tokens left in the router's shared retry-budget bucket")
 
 #: ``bigdl_``-prefixed spellings that are NOT metric families — process
 #: names, trace categories, logger names — so the RD003 "every bigdl_*

@@ -19,11 +19,22 @@ This module gives ``DistriOptimizer.optimize`` the classified policy:
 * :class:`RetryPolicy` — exponential backoff with deterministic jitter,
   a per-run attempt cap, and a sliding-window budget so a flapping
   failure that *keeps* recovering cannot retry forever.
+* :func:`backoff_delay` — the one jittered-exponential-backoff formula,
+  shared by :class:`RetryPolicy` and every caller that used to hand-roll
+  an immediate-retry loop or a bare ``time.sleep``.
+* :class:`RetryBudget` — a *shared* token-bucket budget across many
+  concurrent requests (the serving router, the fleet scraper): each
+  admitted request deposits ``ratio`` tokens, each retry spends one, so
+  fleet-wide retry traffic is capped at ``~ratio x`` the request rate no
+  matter how many individual requests see failures.  This is what stops
+  a browning-out replica from turning N slow requests into N x retries
+  of amplified load.
 """
 
 from __future__ import annotations
 
 import random
+import threading
 import time
 from collections import deque
 from typing import Optional
@@ -81,6 +92,74 @@ def classify(exc: BaseException) -> str:
     return "transient"
 
 
+def backoff_delay(attempt: int, base: float = 0.5, cap: float = 30.0,
+                  jitter: float = 0.1,
+                  rng: Optional[random.Random] = None) -> float:
+    """Jittered exponential backoff for attempt ``attempt`` (1-based):
+    ``min(cap, base * 2^(attempt-1)) * (1 + jitter * U[0,1))``.  The
+    jitter term decorrelates a thundering herd of callers that failed
+    at the same instant; pass a seeded ``rng`` for reproducible chaos
+    tests (no rng = module-level randomness)."""
+    delay = min(float(cap), float(base) * (2.0 ** (max(1, int(attempt)) - 1)))
+    u = (rng.random() if rng is not None else random.random())
+    return delay * (1.0 + float(jitter) * u)
+
+
+class RetryBudget:
+    """Shared token-bucket retry budget across concurrent requests.
+
+    Deliberately *count*-based, not clock-based: every admitted request
+    deposits ``ratio`` tokens (the bucket is capped at ``burst``), and
+    every retry anywhere in the process spends one.  Total retries are
+    therefore bounded by ``burst + ratio * requests`` regardless of how
+    failures are distributed — the retry-amplification cap the serving
+    chaos scenarios assert — and the arithmetic is identical under a
+    virtual clock and a wall clock.  Thread-safe; ``try_spend`` never
+    blocks (an exhausted budget is a *shed load now* signal, never a
+    queue)."""
+
+    def __init__(self, ratio: float = 0.2, burst: float = 8.0,
+                 initial: Optional[float] = None):
+        if ratio < 0:
+            raise ValueError(f"retry budget ratio must be >= 0, got {ratio}")
+        self.ratio = float(ratio)
+        self.burst = max(0.0, float(burst))
+        self._tokens = self.burst if initial is None \
+            else min(self.burst, float(initial))
+        self._lock = threading.Lock()
+        self.requests = 0
+        self.spent = 0
+        self.denied = 0
+
+    def record_request(self) -> None:
+        """One admitted request: deposit ``ratio`` tokens (capped)."""
+        with self._lock:
+            self.requests += 1
+            self._tokens = min(self.burst, self._tokens + self.ratio)
+
+    def try_spend(self, cost: float = 1.0) -> bool:
+        """Spend ``cost`` tokens for one retry; False = budget
+        exhausted, the caller must shed (503 + Retry-After), not wait."""
+        with self._lock:
+            if self._tokens >= cost:
+                self._tokens -= cost
+                self.spent += 1
+                return True
+            self.denied += 1
+            return False
+
+    def tokens(self) -> float:
+        with self._lock:
+            return self._tokens
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"tokens": self._tokens, "burst": self.burst,
+                    "ratio": self.ratio, "requests": self.requests,
+                    "retries_granted": self.spent,
+                    "retries_denied": self.denied}
+
+
 class RetryPolicy:
     """Backoff + budget for transient training failures.
 
@@ -132,6 +211,6 @@ class RetryPolicy:
             return None
         if len(self._window) > self.window_budget:
             return None
-        delay = min(self.backoff_max,
-                    self.backoff_base * (2.0 ** (self.attempts - 1)))
-        return delay * (1.0 + self.jitter * self._rng.random())
+        return backoff_delay(self.attempts, base=self.backoff_base,
+                             cap=self.backoff_max, jitter=self.jitter,
+                             rng=self._rng)

@@ -10,6 +10,13 @@ existing int8 ``quantize()`` path (serving/classifier.py), optional
 TP-sharded decode over the compressed-collective wire (serving/tp.py),
 and a stdlib HTTP front-end (serving/server.py).
 
+The fault-tolerant data plane (ISSUE 16) stacks a router tier on top:
+session-affine, KV-pressure-aware placement over N replicas
+(serving/placement.py), graceful drain with exactly-once handoff
+(serving/drain.py), and the router + its stdlib HTTP front-end with
+budget-gated retries and explicit 503 + Retry-After load shedding
+(serving/router.py).
+
 The loop closes through the observability planes: request-latency
 histograms + SLO burn-rate alerting (obs/alerts.py), a "serving"
 report section (obs/report.py), and request-driven autoscaling signals
@@ -19,15 +26,36 @@ report section (obs/report.py), and request-driven autoscaling signals
 from bigdl_tpu.serving.batcher import RequestQueue, ServeRequest
 from bigdl_tpu.serving.cache import PagedKVCache, gather_pages
 from bigdl_tpu.serving.classifier import ClassifierEngine
+from bigdl_tpu.serving.drain import (HANDOFF_ERROR, HandoffLedger,
+                                     HandoffRecord, drain_engine)
 from bigdl_tpu.serving.engine import LMEngine
+from bigdl_tpu.serving.placement import (NoReplicaAvailable,
+                                         PlacementPolicy, ReplicaView)
+from bigdl_tpu.serving.router import (EngineReplica, HTTPReplica,
+                                      ReplicaDraining, ReplicaUnavailable,
+                                      Router, RouterServer, RouterShed)
 from bigdl_tpu.serving.server import ServingServer
 
 __all__ = [
     "ClassifierEngine",
+    "EngineReplica",
+    "HANDOFF_ERROR",
+    "HTTPReplica",
+    "HandoffLedger",
+    "HandoffRecord",
     "LMEngine",
+    "NoReplicaAvailable",
     "PagedKVCache",
+    "PlacementPolicy",
+    "ReplicaDraining",
+    "ReplicaUnavailable",
+    "ReplicaView",
     "RequestQueue",
+    "Router",
+    "RouterServer",
+    "RouterShed",
     "ServeRequest",
     "ServingServer",
+    "drain_engine",
     "gather_pages",
 ]

@@ -1,0 +1,164 @@
+"""Graceful drain + exactly-once handoff for the serving data plane.
+
+Draining a replica must never lose or duplicate a request.  The
+machinery here is three small pieces the router and the engine share:
+
+* :data:`HANDOFF_ERROR` — the sentinel ``ServeRequest.error`` value a
+  draining engine finishes unfinished requests with.  A client blocked
+  in ``req.wait`` unblocks, sees the sentinel, and knows the request
+  was *checkpointed*, not failed: the generated-so-far tokens are in
+  ``req.tokens`` and the refolded prompt (original prompt + generated
+  prefix, the same fold the KV-page preemption path uses) is in
+  ``req.payload`` — replaying that prompt elsewhere at temperature 0
+  continues the decode bit-exactly;
+* :class:`HandoffRecord` — the checkpoint itself, transport-agnostic
+  (rides a JSON body between ServingServer and the router's HTTP
+  replica client, or a plain object in-process / in the simulator);
+* :class:`HandoffLedger` — the exactly-once gate.  Replays are *claim
+  then replay*: ``claim(request_id)`` succeeds once, so when a replica
+  dies mid-handoff and the same request surfaces on two recovery paths
+  (the drain coordinator's orphan sweep AND the per-request retry
+  loop), exactly one path replays it.  Deliveries are *deliver once*:
+  ``deliver(request_id)`` returns False on a second completion, which
+  the router counts as a duplicate (the invariant the drain chaos
+  scenario pins at zero).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, List, Optional
+
+#: ServeRequest.error sentinel: "checkpointed by a drain, replay me"
+HANDOFF_ERROR = "__drain_handoff__"
+
+
+@dataclasses.dataclass
+class HandoffRecord:
+    """One checkpointed request, ready to replay on another replica."""
+
+    prompt: List[int]            # original prompt + generated prefix
+    max_new_tokens: int          # tokens still owed
+    temperature: float = 0.0
+    tokens_done: List[int] = dataclasses.field(default_factory=list)
+    request_id: Optional[str] = None   # router id when router-placed
+    source: Optional[str] = None       # replica the checkpoint left
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "HandoffRecord":
+        return cls(prompt=[int(t) for t in d["prompt"]],
+                   max_new_tokens=int(d["max_new_tokens"]),
+                   temperature=float(d.get("temperature", 0.0)),
+                   tokens_done=[int(t) for t in
+                                d.get("tokens_done") or []],
+                   request_id=d.get("request_id"),
+                   source=d.get("source"))
+
+
+class HandoffLedger:
+    """Exactly-once accounting for replays and deliveries."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._claimed: Dict[str, int] = {}    # request id -> claim count
+        self._delivered: set = set()
+        self.duplicates = 0
+
+    def claim(self, request_id: str) -> bool:
+        """Claim the right to replay ``request_id``.  True exactly once
+        per id; a second claimant (the race when a replica dies mid-
+        handoff) is refused and must stand down."""
+        rid = str(request_id)
+        with self._lock:
+            if rid in self._delivered:
+                return False
+            n = self._claimed.get(rid, 0)
+            self._claimed[rid] = n + 1
+            return n == 0
+
+    def release(self, request_id: str) -> None:
+        """Undo a claim whose replay could not start (the claimant's
+        chosen replica refused) so another path may pick the request
+        up; never called after the replay was actually submitted."""
+        with self._lock:
+            rid = str(request_id)
+            if self._claimed.get(rid, 0) > 0:
+                self._claimed[rid] -= 1
+
+    def deliver(self, request_id: str) -> bool:
+        """Record the request's single completion.  False = this id was
+        already delivered — the caller found a duplicate."""
+        rid = str(request_id)
+        with self._lock:
+            if rid in self._delivered:
+                self.duplicates += 1
+                return False
+            self._delivered.add(rid)
+            return True
+
+    def delivered(self, request_id: str) -> bool:
+        with self._lock:
+            return str(request_id) in self._delivered
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"claimed": len(self._claimed),
+                    "delivered": len(self._delivered),
+                    "duplicates": self.duplicates}
+
+
+def drain_engine(engine, deadline_s: float = 10.0,
+                 poll_s: float = 0.005) -> List[HandoffRecord]:
+    """Drain one :class:`~bigdl_tpu.serving.LMEngine` in place.
+
+    Admissions stop immediately (``engine.draining`` — ``submit``
+    refuses with a RuntimeError the HTTP tier maps to 503 +
+    Retry-After).  In-flight decodes get ``deadline_s`` to finish; at
+    the deadline every still-active slot is preempted through the
+    engine's own KV-preemption fold (generated tokens -> prompt) and
+    everything left over — preempted, stashed, or still queued — is
+    checkpointed into :class:`HandoffRecord`s.  Each checkpointed
+    request is finished with :data:`HANDOFF_ERROR` so a blocked client
+    unblocks and learns to replay."""
+    engine.draining = True
+    deadline = time.monotonic() + max(0.0, float(deadline_s))
+    while time.monotonic() < deadline:
+        with engine._lock:
+            busy = (engine.active_count() or engine._stash
+                    or engine.queue.depth() > 0)
+        if not busy:
+            break
+        if engine._thread is None:
+            engine.pump(wait_s=poll_s)
+        else:
+            time.sleep(poll_s)
+    handoffs: List[HandoffRecord] = []
+    with engine._lock:
+        while engine.active_count():
+            if engine._preempt_youngest() is None:
+                break
+        leftovers = list(engine._stash)
+        engine._stash.clear()
+        while engine.queue.depth() > 0:
+            batch = engine.queue.take(engine.max_batch, timeout=0.0)
+            if not batch:
+                break
+            leftovers.extend(batch)
+        for req in leftovers:
+            handoffs.append(HandoffRecord(
+                prompt=[int(t) for t in req.payload],
+                max_new_tokens=int(req.max_new_tokens),
+                temperature=float(req.temperature),
+                tokens_done=[int(t) for t in req.tokens],
+                request_id=getattr(req, "router_id", None)))
+            req.finish(error=HANDOFF_ERROR)
+    return handoffs
+
+
+__all__ = ["HANDOFF_ERROR", "HandoffLedger", "HandoffRecord",
+           "drain_engine"]

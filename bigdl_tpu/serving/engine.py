@@ -259,6 +259,7 @@ class LMEngine:
         self._t_last_done: Optional[float] = None
         self.completed: List[dict] = []
         self._slo_window: collections.deque = collections.deque(maxlen=256)
+        self.draining = False
         self._stop = False
         self._thread: Optional[threading.Thread] = None
         self._lock = threading.RLock()
@@ -440,6 +441,8 @@ class LMEngine:
     def submit(self, prompt, max_new_tokens: int, *,
                temperature: float = 0.0,
                timeout: Optional[float] = None) -> ServeRequest:
+        if self.draining:
+            raise RuntimeError("engine is draining — admissions closed")
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         if not prompt:
             raise ValueError("empty prompt")
@@ -705,6 +708,15 @@ class LMEngine:
         self._thread.start()
         return self
 
+    def drain(self, deadline_s: float = 10.0):
+        """Stop admissions, finish in-flight decodes within the
+        deadline, checkpoint the rest (serving/drain.py).  Returns the
+        :class:`~bigdl_tpu.serving.drain.HandoffRecord` list a router
+        replays elsewhere exactly once."""
+        from bigdl_tpu.serving.drain import drain_engine
+
+        return drain_engine(self, deadline_s=deadline_s)
+
     def close(self):
         self._stop = True
         if self._thread is not None:
@@ -734,6 +746,9 @@ class LMEngine:
             "occupancy_mean": (self._occ_sum / self._steps
                                if self._steps else None),
             "queue_depth": self.queue.depth(),
+            "kv_pages_in_use": self.cache.pages_in_use(),
+            "kv_pages_total": self.cache.num_pages - 1,
+            "draining": self.draining,
             "preemptions": int(self._preempt_counter._solo().value),
             "e2e_p50_s": pct(e2e, 50), "e2e_p99_s": pct(e2e, 99),
             "ttft_p50_s": pct(ttft, 50), "ttft_p99_s": pct(ttft, 99),

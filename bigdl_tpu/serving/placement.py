@@ -1,0 +1,150 @@
+"""Replica placement policy for the serving router.
+
+Pure host-side policy, no I/O and no clocks of its own — the router
+(or the serving simulator) feeds it :class:`ReplicaView` snapshots
+built from the signals every replica already exports
+(``bigdl_serve_queue_depth``, ``bigdl_serve_kv_pages_in_use``) and an
+injectable ``clock``, so the same object places requests on a wall
+clock behind HTTP and on a virtual clock inside a chaos scenario.
+
+Two concerns, in priority order:
+
+* **session affinity** — a multi-turn conversation's KV prefix lives in
+  ONE replica's paged cache; re-placing turn N+1 anywhere else pays a
+  full re-prefill.  ``choose(session=...)`` therefore sticks to the
+  session's bound replica while it stays eligible and the binding is
+  inside ``affinity_ttl_s``.  A binding to a drained/dead replica is
+  dropped (the KV prefix is gone — affinity to a corpse is worthless)
+  and the session rebinds wherever the request lands next;
+* **load- and KV-pressure-aware spread** — among eligible replicas the
+  cheapest by ``queue_depth + in_flight + kv_weight * kv_frac`` wins
+  (deterministic name tie-break).  ``kv_frac`` is page-pool occupancy:
+  a replica whose pool is nearly exhausted will preempt whatever it
+  admits next, which costs far more than a deeper queue — hence its
+  own weight.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+
+class NoReplicaAvailable(RuntimeError):
+    """Every replica is down or draining — the caller must shed."""
+
+
+@dataclasses.dataclass
+class ReplicaView:
+    """One replica's placement-relevant state, as the router sees it."""
+
+    name: str
+    up: bool = True
+    draining: bool = False
+    queue_depth: float = 0.0
+    in_flight: int = 0          # router-side: placed, not yet completed
+    kv_frac: float = 0.0        # pages_in_use / pool size, 0..1
+
+    @property
+    def eligible(self) -> bool:
+        return self.up and not self.draining
+
+
+class PlacementPolicy:
+    """Session-affine, least-loaded placement over replica views."""
+
+    def __init__(self, affinity_ttl_s: float = 300.0,
+                 kv_weight: float = 4.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.affinity_ttl_s = float(affinity_ttl_s)
+        self.kv_weight = float(kv_weight)
+        self._clock = clock
+        self._lock = threading.Lock()
+        # session -> (replica name, binding expiry on self._clock)
+        self._bind: Dict[str, tuple] = {}
+        self.affinity_hits = 0
+        self.rebinds = 0
+
+    # ------------------------------------------------------------ affinity
+    def lookup(self, session: Optional[str]) -> Optional[str]:
+        """The session's bound replica, or None (no/expired binding)."""
+        if not session or self.affinity_ttl_s <= 0:
+            return None
+        with self._lock:
+            bound = self._bind.get(session)
+            if bound is None:
+                return None
+            name, expires = bound
+            if self._clock() >= expires:
+                del self._bind[session]
+                return None
+            return name
+
+    def bind(self, session: Optional[str], name: str) -> None:
+        if not session or self.affinity_ttl_s <= 0:
+            return
+        with self._lock:
+            prev = self._bind.get(session)
+            if prev is not None and prev[0] != name:
+                self.rebinds += 1
+            self._bind[session] = (name, self._clock()
+                                   + self.affinity_ttl_s)
+
+    def unbind_replica(self, name: str) -> List[str]:
+        """Drop every session bound to ``name`` (drained or dead — its
+        KV prefixes are gone); returns the affected sessions."""
+        with self._lock:
+            gone = [s for s, (n, _) in self._bind.items() if n == name]
+            for s in gone:
+                del self._bind[s]
+            return gone
+
+    def bindings(self) -> Dict[str, str]:
+        with self._lock:
+            now = self._clock()
+            return {s: n for s, (n, exp) in self._bind.items()
+                    if now < exp}
+
+    # ------------------------------------------------------------- scoring
+    def score(self, view: ReplicaView) -> float:
+        return (float(view.queue_depth) + float(view.in_flight)
+                + self.kv_weight * float(view.kv_frac))
+
+    def choose(self, views: Dict[str, ReplicaView],
+               session: Optional[str] = None,
+               exclude: Optional[set] = None) -> str:
+        """Pick a replica for one request.  Affinity wins while the
+        bound replica is eligible; otherwise least-loaded (score, then
+        name).  ``exclude`` removes replicas already tried by this
+        request's retry loop.  Binds/rebinds the session to whatever is
+        returned.  Raises :class:`NoReplicaAvailable` when nothing is
+        eligible — shedding is the caller's job (it owns the 503)."""
+        exclude = exclude or set()
+        bound = self.lookup(session)
+        if bound is not None and bound not in exclude:
+            view = views.get(bound)
+            if view is not None and view.eligible:
+                with self._lock:
+                    self.affinity_hits += 1
+                self.bind(session, bound)   # refresh the TTL
+                return bound
+        candidates = [v for n, v in views.items()
+                      if v.eligible and n not in exclude]
+        if not candidates:
+            raise NoReplicaAvailable(
+                f"no eligible replica among {sorted(views)} "
+                f"(excluded {sorted(exclude)})")
+        best = min(candidates, key=lambda v: (self.score(v), v.name))
+        self.bind(session, best.name)
+        return best.name
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"bindings": len(self._bind),
+                    "affinity_hits": self.affinity_hits,
+                    "rebinds": self.rebinds}
+
+
+__all__ = ["NoReplicaAvailable", "PlacementPolicy", "ReplicaView"]

@@ -10,9 +10,19 @@ One :class:`ThreadingHTTPServer` fronting an :class:`LMEngine` and/or a
   clients shape the serve smoke drives);
 * ``POST /v1/classify`` ``{"inputs": [[...], ...]}`` ->
   ``{"outputs": [[...]], "classes": [...]}``;
+* ``POST /admin/drain`` ``{"deadline_s": s}`` -> graceful drain: stops
+  admissions, finishes what fits in the deadline, returns the
+  checkpointed leftovers as ``{"handoffs": [...]}`` for the router to
+  replay elsewhere;
 * ``GET /stats`` -> both engines' stats dicts;
 * ``GET /healthz`` -> liveness (the *metrics* endpoint stays obs/server
   — one telemetry plane, not two).
+
+Backpressure is explicit: a queue that stays full past the admission
+timeout — or an engine that is draining — answers **503 +
+``Retry-After``** (and stamps ``bigdl_serve_rejects_total``), never a
+4xx/5xx that a client would misread as "my request was bad" or "the
+server is broken".  Only a malformed payload gets a 400.
 
 Port 0 binds an ephemeral port (``.port`` has the real one).
 """
@@ -27,6 +37,8 @@ from typing import Optional
 
 import numpy as np
 
+from bigdl_tpu.obs import names
+
 log = logging.getLogger("bigdl_tpu.serving")
 
 
@@ -36,25 +48,41 @@ class ServingServer:
                  request_timeout_s: float = 60.0):
         from bigdl_tpu.config import refresh_from_env
 
+        from bigdl_tpu import obs
+
         cfg = refresh_from_env().serve
         if port is None:
             port = cfg.port if cfg.port is not None else 0
         self.lm = lm
         self.classifier = classifier
         self.request_timeout_s = float(request_timeout_s)
+        self.retry_after_s = float(refresh_from_env().router.retry_after_s)
+        self._rejects = obs.get_registry().counter(
+            names.SERVE_REJECTS_TOTAL,
+            "Admissions rejected 503 + Retry-After (queue full past "
+            "the admission timeout, or the engine is draining)")
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, fmt, *args):  # noqa: A003
                 log.debug("serving: " + fmt, *args)
 
-            def _send(self, obj, code=200):
+            def _send(self, obj, code=200, headers=None):
                 body = json.dumps(obj).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _reject(self, reason):
+                outer._rejects.inc()
+                return self._send(
+                    {"error": reason}, 503,
+                    headers={"Retry-After":
+                             f"{max(1, round(outer.retry_after_s))}"})
 
             def do_GET(self):  # noqa: N802
                 if self.path == "/healthz":
@@ -77,26 +105,64 @@ class ServingServer:
                         return self._generate(payload)
                     if self.path == "/v1/classify":
                         return self._classify(payload)
+                    if self.path == "/admin/drain":
+                        return self._drain(payload)
                     return self._send({"error": "not found"}, 404)
-                except Exception as e:  # noqa: BLE001 — client error surface
+                except TimeoutError as e:
+                    # queue full past the admission timeout: overload,
+                    # not a client error — tell the client to back off
+                    return self._reject(f"overloaded: {e}")
+                except RuntimeError as e:
+                    # draining / closed queue: admissions are refused
+                    return self._reject(str(e))
+                except (KeyError, TypeError, ValueError) as e:
                     return self._send(
                         {"error": f"{type(e).__name__}: {e}"}, 400)
+                except Exception as e:  # noqa: BLE001 — server bug
+                    return self._send(
+                        {"error": f"{type(e).__name__}: {e}"}, 500)
 
             def _generate(self, payload):
+                from bigdl_tpu.serving.drain import HANDOFF_ERROR
+
                 if outer.lm is None:
-                    return self._send({"error": "no LM engine"}, 503)
+                    return self._reject("no LM engine")
                 req = outer.lm.submit(
                     payload["prompt"],
                     int(payload.get("max_new_tokens", 16)),
                     temperature=float(payload.get("temperature", 0.0)),
                     timeout=outer.request_timeout_s)
+                req.router_id = payload.get("request_id")
                 req.wait(outer.request_timeout_s)
+                if req.error == HANDOFF_ERROR:
+                    # checkpointed mid-drain: hand the resume point back
+                    # so the router replays it elsewhere exactly once
+                    outer._rejects.inc()
+                    return self._send(
+                        {"error": "draining", "handoff": {
+                            "prompt": [int(t) for t in req.payload],
+                            "max_new_tokens": int(req.max_new_tokens),
+                            "temperature": float(req.temperature),
+                            "tokens_done": [int(t) for t in req.tokens],
+                            "request_id": req.router_id}},
+                        503,
+                        headers={"Retry-After":
+                                 f"{max(1, round(outer.retry_after_s))}"})
                 if req.error:
                     return self._send({"error": req.error}, 500)
                 return self._send({
                     "id": req.id, "tokens": [int(t) for t in req.tokens],
                     "prompt_len": len(payload["prompt"]),
                     "ttft_s": req.ttft_s, "e2e_s": req.e2e_s})
+
+            def _drain(self, payload):
+                if outer.lm is None:
+                    return self._send({"error": "no LM engine"}, 503)
+                records = outer.lm.drain(
+                    float(payload.get("deadline_s", 10.0)))
+                return self._send(
+                    {"handoffs": [hd.to_dict() for hd in records],
+                     "draining": True})
 
             def _classify(self, payload):
                 if outer.classifier is None:

@@ -58,9 +58,19 @@ from bigdl_tpu.sim.scenario import (
     Scenario,
     load_scenario,
 )
+from bigdl_tpu.sim.serve import (
+    SERVE_SCENARIOS,
+    ServeScenario,
+    ServeScenarioResult,
+    SimServeReplica,
+    load_serve_scenario,
+    run_serve_scenario,
+)
 
 __all__ = [
     "VirtualClock", "SimHost", "SimFleet", "Scenario",
     "BUILTIN_SCENARIOS", "load_scenario", "InvariantResult",
     "ScenarioResult", "run_scenario",
+    "SERVE_SCENARIOS", "ServeScenario", "ServeScenarioResult",
+    "SimServeReplica", "load_serve_scenario", "run_serve_scenario",
 ]

@@ -41,6 +41,20 @@ Standalone probes for the properties a tick loop cannot express:
 * :func:`check_watchdog` — the real :class:`~bigdl_tpu.resilience.
   supervisor.HangWatchdog` flags a genuinely stalled host and stays
   conservative on a partitioned (unreachable) one.
+
+Serving data-plane invariants (the router chaos scenarios in
+:mod:`bigdl_tpu.sim.serve` — :func:`check_serve_scenario` composes):
+
+* **request_conservation** — every admitted request is answered
+  exactly once (completed or an explicit shed): zero lost, zero
+  duplicated across preemption dumps, drains, and handoff replays;
+* **retry_amplification** — the shared retry budget's arithmetic
+  bound holds (retries granted <= burst + ratio x requests) and
+  end-to-end backend amplification stays <= 1 + ratio + slack — a
+  brownout cannot turn into a retry storm;
+* **slo_stability** — the backlog-driven SLO-burn alert fires at most
+  the declared number of times and is resolved by scenario end:
+  absorbing a preemption storm must not flap the alert.
 """
 
 from __future__ import annotations
@@ -238,6 +252,111 @@ def check_scenario(observed: dict, expect: dict,
         check_conservative(observed["decisions"], expect),
         check_scrape_budget(observed["scrape_cycles"], expect),
         check_sink(observed.get("sink_failures", 0.0), expect),
+    ]
+
+
+# --------------------------------------- serving data-plane invariants
+def check_request_conservation(observed: dict,
+                               expect: dict) -> InvariantResult:
+    """Zero lost, zero duplicated — and every request accounted for:
+    completed + shed == unique answers == requests."""
+    problems = []
+    lost = int(observed.get("lost", 0))
+    dup = int(observed.get("duplicates", 0))
+    if lost > int(expect.get("max_lost", 0)):
+        problems.append(f"{lost} request(s) LOST (never answered)")
+    if dup > int(expect.get("max_duplicates", 0)):
+        problems.append(f"{dup} request(s) answered more than once")
+    answered = observed["completed"] + observed["shed"]
+    if answered + lost != observed["requests"]:
+        problems.append(
+            f"conservation broke: {observed['completed']} completed + "
+            f"{observed['shed']} shed + {lost} lost != "
+            f"{observed['requests']} requests")
+    max_shed = expect.get("max_shed")
+    if max_shed is not None and observed["shed"] > int(max_shed):
+        problems.append(f"{observed['shed']} shed > allowed {max_shed}")
+    max_late = expect.get("max_late_discarded")
+    if max_late is not None and \
+            observed.get("late_discarded", 0) > int(max_late):
+        problems.append(f"{observed['late_discarded']} late zombie "
+                        f"completion(s), allowed {max_late}")
+    for key, label in (("min_handoff_replays", "handoff_replays"),
+                       ("min_drains", "drains"),
+                       ("min_retries", "retries")):
+        need = expect.get(key)
+        if need is not None and observed.get(label, 0) < int(need):
+            problems.append(f"only {observed.get(label, 0)} {label}, "
+                            f"scenario needs >= {need} to mean "
+                            "anything")
+    ledger = observed.get("ledger") or {}
+    return _result(
+        "request_conservation", not problems,
+        "; ".join(problems) or
+        f"{observed['requests']} requests -> "
+        f"{observed['completed']} completed + {observed['shed']} shed, "
+        f"0 lost, 0 duplicated ({observed.get('handoff_replays', 0)} "
+        f"claim-gated replay(s), ledger dedup "
+        f"{ledger.get('duplicates', 0)})")
+
+
+def check_retry_amplification(observed: dict,
+                              expect: dict) -> InvariantResult:
+    """The budget's hard arithmetic (granted <= burst + ratio x
+    requests) AND the end-to-end bound: backend attempts per client
+    request <= 1 + ratio + slack."""
+    problems = []
+    b = observed["budget"]
+    granted = int(b["retries_granted"])
+    ceiling = float(b["burst"]) + float(b["ratio"]) * int(b["requests"])
+    if granted > ceiling + 1e-9:
+        problems.append(f"budget arithmetic broke: {granted} retries "
+                        f"granted > burst {b['burst']:g} + "
+                        f"{b['ratio']:g} x {b['requests']} requests "
+                        f"= {ceiling:g}")
+    slack = float(expect.get("amplification_slack", 0.05))
+    bound = 1.0 + float(b["ratio"]) + slack
+    amp = float(observed["amplification"])
+    if amp > bound:
+        problems.append(f"amplification {amp:.3f} > 1 + ratio "
+                        f"{b['ratio']:g} + slack {slack:g} = "
+                        f"{bound:.3f}")
+    return _result(
+        "retry_amplification", not problems,
+        "; ".join(problems) or
+        f"amplification {amp:.3f} <= {bound:.3f} "
+        f"({granted} retries granted, {b['retries_denied']} denied, "
+        f"ceiling {ceiling:.0f})")
+
+
+def check_slo_stability(observed: dict,
+                        expect: dict) -> InvariantResult:
+    """The SLO-burn alert fires at most the declared number of times
+    (default 1 — once for the incident) and is quiet by the end."""
+    problems = []
+    flaps = int(observed.get("slo_flaps", 0))
+    max_flaps = int(expect.get("max_slo_flaps", 1))
+    if flaps > max_flaps:
+        problems.append(f"SLO-burn alert fired {flaps}x "
+                        f"(allowed {max_flaps}) — flapping")
+    if expect.get("slo_resolved", True) and \
+            observed.get("slo_firing_at_end"):
+        problems.append("SLO-burn alert still firing at scenario end")
+    return _result(
+        "slo_stability", not problems,
+        "; ".join(problems) or
+        f"{flaps} firing(s), resolved by scenario end")
+
+
+def check_serve_scenario(observed: dict,
+                         expect: dict) -> List[InvariantResult]:
+    """All serving data-plane invariants over one scenario's
+    observation bundle (:func:`bigdl_tpu.sim.serve.run_serve_scenario`
+    builds ``observed``)."""
+    return [
+        check_request_conservation(observed, expect),
+        check_retry_amplification(observed, expect),
+        check_slo_stability(observed, expect),
     ]
 
 

@@ -115,6 +115,32 @@
 #                                                # SERVE_SMOKE.json for
 #                                                # BENCH extras.serve
 #                                                # (no pytest)
+#   scripts/run-tests.sh --router                # serving router smoke: the
+#                                                # three data-plane chaos
+#                                                # scenarios (preemption
+#                                                # storm, brownout, drain
+#                                                # wave) at 8 replicas on the
+#                                                # virtual clock with the
+#                                                # REAL placement / retry-
+#                                                # budget / handoff-ledger
+#                                                # policies in the loop (zero
+#                                                # lost, zero duplicated,
+#                                                # amplification <= the
+#                                                # budget factor, SLO-burn
+#                                                # never flaps), then the
+#                                                # real-engine segment:
+#                                                # temperature-0 routed
+#                                                # output bit-equal to direct
+#                                                # generate(), a mid-decode
+#                                                # drain replayed exactly
+#                                                # once on the survivor, the
+#                                                # full RouterServer ->
+#                                                # ServingServer HTTP
+#                                                # topology, and queue-full
+#                                                # 503 + Retry-After; banks
+#                                                # ROUTER_SMOKE.json for
+#                                                # BENCH extras.router
+#                                                # (no pytest)
 #   scripts/run-tests.sh --lint                  # graftlint static analysis:
 #                                                # JAX hazards (JX*), lock
 #                                                # discipline (CC*), config/
@@ -209,6 +235,9 @@ elif [[ "${1:-}" == "--overlap" ]]; then
 elif [[ "${1:-}" == "--serve" ]]; then
   shift
   exec python scripts/serve_smoke.py "$@"
+elif [[ "${1:-}" == "--router" ]]; then
+  shift
+  exec python scripts/router_smoke.py "$@"
 fi
 
 # tier-1 wall clock is budgeted (ROADMAP: 870s) — print where the suite
