@@ -680,6 +680,24 @@ def _router_extras():
         return None
 
 
+def _reqtrace_extras():
+    """Request-tracing evidence for the BENCH JSON: the newest
+    ``REQTRACE_SMOKE.json`` banked by scripts/reqtrace_smoke.py (the
+    rigged slow-replica topology's p99 attribution — the slowest
+    decile blamed on the queue hop, per-hop coverage of measured e2e,
+    token parity with tracing on, and the tail sampler's keep/drop
+    counts).  None when the smoke has never been run."""
+    try:
+        smoke = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "REQTRACE_SMOKE.json")
+        if not os.path.exists(smoke):
+            return None
+        with open(smoke, "r", encoding="utf-8") as fh:
+            return {"smoke": json.load(fh)}
+    except Exception:
+        return None
+
+
 def _tuner_extras():
     """Auto-tuner evidence for the BENCH JSON (ops/autotune.py): the
     cache stats and every decision with its static baseline, measured
@@ -1047,6 +1065,9 @@ def _run_child(platform: str):
     router = _router_extras()
     if router is not None:
         ex["router"] = router
+    reqtrace = _reqtrace_extras()
+    if reqtrace is not None:
+        ex["reqtrace"] = reqtrace
     print(PARTIAL_MARK + json.dumps(result), flush=True)
 
 

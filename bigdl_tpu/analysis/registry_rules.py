@@ -24,6 +24,12 @@ pin both registries closed:
   neither rendered by ``obs/report.py`` nor documented in its spec.
 * **RD005 metric-shape-mismatch** — a mint site disagrees with the
   declared kind or label set of the metric it mints.
+* **RD006 span-name-literal** — a ``.span(...)`` / ``.event(...)`` /
+  ``.complete(...)`` call in ``bigdl_tpu/serving/`` (or in any module
+  importing ``bigdl_tpu.serving.spans``) names its span with a string
+  literal instead of a ``serving/spans.py`` constant; a typo'd literal
+  silently forks the request-trace timeline the same way a typo'd
+  metric name forks a dashboard.
 
 Env var *writes* are exempt everywhere: exporting ``BIGDL_*`` into a
 child's environment is the supervisor/harness contract.
@@ -46,6 +52,8 @@ RULES = {
     "RD003": "bigdl_* metric name not declared in obs/names.py",
     "RD004": "declared metric neither rendered by report.py nor documented",
     "RD005": "mint site disagrees with the declared metric kind/labels",
+    "RD006": "serving span/event named by a string literal "
+             "(use bigdl_tpu/serving/spans.py constants)",
 }
 core.ALL_RULES.update(RULES)
 
@@ -56,6 +64,8 @@ _ENV_HELPERS = {"_env_bool", "_env_int", "_env_opt_int", "_env_float",
                 "_env_str"}
 _MINT_METHODS = {"counter", "gauge", "histogram"}
 _HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+_SPAN_METHODS = {"span", "event", "complete"}
+_SPANS_MODULE = "bigdl_tpu.serving.spans"
 
 
 def _pkg_root() -> str:
@@ -204,6 +214,47 @@ class RegistryRules:
         findings.extend(self._check_env_reads(mod))
         if not is_names_file:
             findings.extend(self._check_metric_names(mod))
+        findings.extend(self._check_span_literals(mod))
+        return findings
+
+    # ---------------------------------------------------- span literals
+    def _imports_spans(self, tree) -> bool:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                if any(a.name == _SPANS_MODULE for a in node.names):
+                    return True
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == _SPANS_MODULE:
+                    return True
+                if node.module == "bigdl_tpu.serving" and any(
+                        a.name == "spans" for a in node.names):
+                    return True
+        return False
+
+    def _check_span_literals(self, mod: ModuleInfo) -> List[Finding]:
+        """RD006: span-name registry drift — the serving tier (and any
+        module that opted into ``serving/spans.py`` by importing it)
+        must name its tracer spans/events from the constants."""
+        rel = mod.relpath.replace(os.sep, "/")
+        in_serving = "bigdl_tpu/serving/" in rel or rel.startswith(
+            "serving/")
+        if not in_serving and not self._imports_spans(mod.tree):
+            return []
+        findings = []
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _SPAN_METHODS and node.args):
+                continue
+            name = str_const(node.args[0])
+            if name is None:
+                continue
+            findings.append(mod.finding(
+                "RD006", node,
+                f"span/event {name!r} named by a string literal — name "
+                "it in bigdl_tpu/serving/spans.py and reference the "
+                "constant (a typo'd literal forks the request-trace "
+                "timeline silently)"))
         return findings
 
     # -------------------------------------------------------- env reads

@@ -140,6 +140,17 @@ class ObsConfig:
     # transition and can never wedge the goodput window tick)
     # [BIGDL_ALERT_SINK_TIMEOUT]
     alert_sink_timeout: float = 1.0
+    # request-scoped distributed tracing for the serving data plane
+    # (obs/reqtrace.py): tail-sampling probability in [0, 1] for clean
+    # requests — errored / retried / preempted / handed-off /
+    # SLO-violating requests are always kept.  0 (the default)
+    # disables the subsystem entirely: no contexts, no span buffering,
+    # zero work on the decode hot path [BIGDL_REQTRACE_SAMPLE]
+    reqtrace_sample: float = 0.0
+    # bounded ring of kept completed request traces held in memory for
+    # /trace?request=<id> lookups and postmortems
+    # [BIGDL_REQTRACE_RING]
+    reqtrace_ring: int = 256
     # strict metric registry: reject any bigdl_* metric registration
     # not declared in obs/names.py (or whose kind/labels disagree) and
     # enforce each family's label-cardinality ceiling.  CI and the
@@ -175,6 +186,8 @@ class ObsConfig:
             alert_rules=_env_str("BIGDL_ALERT_RULES", None),
             alert_sink=_env_str("BIGDL_ALERT_SINK", None),
             alert_sink_timeout=_env_float("BIGDL_ALERT_SINK_TIMEOUT", 1.0),
+            reqtrace_sample=_env_float("BIGDL_REQTRACE_SAMPLE", 0.0),
+            reqtrace_ring=_env_int("BIGDL_REQTRACE_RING", 256),
             strict=_env_bool("BIGDL_OBS_STRICT", False),
         )
 

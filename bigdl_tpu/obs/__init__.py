@@ -267,9 +267,10 @@ def reset():
         _tracer_dir = None
         _registry = MetricsRegistry()
         _runtime = None
-    from bigdl_tpu.obs import alerts, goodput, server
+    from bigdl_tpu.obs import alerts, goodput, reqtrace, server
 
     goodput.reset_ledger()
     server.stop_server()
     server.clear_step()
     alerts.reset_engine()
+    reqtrace.reset_collector()

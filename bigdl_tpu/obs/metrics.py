@@ -136,15 +136,28 @@ class Histogram:
         self.bucket_counts = [0] * (len(self.bounds) + 1)  # last = +Inf
         self.count = 0
         self.sum = 0.0
+        # bucket index -> (labels, value, unix ts): the most recent
+        # exemplar per bucket, exposed in OpenMetrics ``# {...} v ts``
+        # syntax so a histogram sample links back to a concrete trace
+        self.exemplars: Dict[int, tuple] = {}
 
-    def observe(self, value: float):
+    def observe(self, value: float, exemplar: Optional[dict] = None):
+        """Record ``value``; ``exemplar`` (a small ``{label: value}``
+        dict, e.g. ``{"trace_id": ...}``) attaches to the bucket the
+        observation lands in, newest-wins."""
         v = float(value)
         i = bisect.bisect_left(self.bounds, v)
         with self._lock:
             self.bucket_counts[i] += 1
             self.count += 1
             self.sum += v
+            if exemplar:
+                self.exemplars[i] = (dict(exemplar), v, time.time())
         return self
+
+    def exemplar_items(self) -> Dict[int, tuple]:
+        with self._lock:
+            return dict(self.exemplars)
 
     @property
     def mean(self) -> float:
@@ -175,6 +188,7 @@ class Histogram:
         self.bucket_counts = [0] * (len(self.bounds) + 1)
         self.count = 0
         self.sum = 0.0
+        self.exemplars = {}
 
 
 _KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
@@ -238,8 +252,8 @@ class _Family:
     def set(self, value: float):
         return self._solo().set(value)
 
-    def observe(self, value: float):
-        return self._solo().observe(value)
+    def observe(self, value: float, exemplar: Optional[dict] = None):
+        return self._solo().observe(value, exemplar=exemplar)
 
     def child_items(self):
         with self._lock:
@@ -330,11 +344,22 @@ class MetricsRegistry:
                     # concurrent add() can otherwise land between the
                     # bucket copy and the sum/count reads)
                     counts, count, total = child.snapshot_state()
-                    for bound, acc in child._cumulative_from(counts):
+                    exs = child.exemplar_items()
+                    for i, (bound, acc) in enumerate(
+                            child._cumulative_from(counts)):
                         le = "+Inf" if bound == float("inf") else _fmt(bound)
                         bpairs = pairs + [f'le="{le}"']
-                        lines.append(
-                            f"{fam.name}_bucket{{{','.join(bpairs)}}} {acc}")
+                        line = (f"{fam.name}_bucket"
+                                f"{{{','.join(bpairs)}}} {acc}")
+                        ex = exs.get(i)
+                        if ex is not None:
+                            # OpenMetrics exemplar: ``# {labels} v ts``
+                            exl, exv, exts = ex
+                            body = ",".join(f'{k}="{_escape(v)}"'
+                                            for k, v in exl.items())
+                            line += (f" # {{{body}}} {_fmt(exv)} "
+                                     f"{exts:.3f}")
+                        lines.append(line)
                     lines.append(f"{fam.name}_sum{base} {_fmt(total)}")
                     lines.append(f"{fam.name}_count{base} {count}")
                 else:
@@ -424,6 +449,23 @@ def _parse_value(v: str) -> float:
     return float(v)
 
 
+_EXEMPLAR_RE = re.compile(r'^\{(.*)\}\s+(\S+)(?:\s+(\S+))?$')
+
+
+def _parse_exemplar(tail: str) -> dict:
+    """Parse the OpenMetrics exemplar tail ``{labels} value [ts]``."""
+    m = _EXEMPLAR_RE.match(tail.strip())
+    if not m:
+        raise ValueError(f"bad exemplar: {tail!r}")
+    labelbody, value, ts = m.groups()
+    out = {"labels": {k: _unescape_label(v)
+                      for k, v in _LABEL_RE.findall(labelbody or "")},
+           "value": _parse_value(value)}
+    if ts:
+        out["ts"] = float(ts)
+    return out
+
+
 def parse_prometheus(text: str) -> dict:
     """Parse text exposition back into
     ``{"families": {name: {"type", "help"}}, "samples": [{"name",
@@ -454,13 +496,24 @@ def parse_prometheus(text: str) -> dict:
         if line.startswith("#"):
             continue
         m = _SAMPLE_RE.match(line)
+        exemplar = None
+        if not m and " # " in line:
+            # OpenMetrics exemplar syntax: the sample proper, then
+            # `` # {labels} value [ts]`` — split it off and parse both
+            body, _, tail = line.partition(" # ")
+            m = _SAMPLE_RE.match(body.strip())
+            if m:
+                exemplar = _parse_exemplar(tail)
         if not m:
             raise ValueError(f"bad exposition line: {line!r}")
         name, labelbody, value = m.groups()
         labels = {k: _unescape_label(v)
                   for k, v in _LABEL_RE.findall(labelbody or "")}
-        samples.append({"name": name, "labels": labels,
-                        "value": _parse_value(value)})
+        entry = {"name": name, "labels": labels,
+                 "value": _parse_value(value)}
+        if exemplar is not None:
+            entry["exemplar"] = exemplar
+        samples.append(entry)
     return {"families": families, "samples": samples}
 
 

@@ -331,6 +331,25 @@ ROUTER_RETRY_BUDGET_TOKENS = _m(
     "bigdl_router_retry_budget_tokens", "gauge",
     doc="Tokens left in the router's shared retry-budget bucket")
 
+# --------------------------------------------------------------- reqtrace
+REQTRACE_SAMPLED_TOTAL = _m(
+    "bigdl_reqtrace_sampled_total", "counter", ("reason",), 8,
+    "Request traces kept by the tail sampler, by keep reason "
+    "(error/retry/preempt/slo/handoff/forced always keep; 'sampled' "
+    "is the probabilistic BIGDL_REQTRACE_SAMPLE tail)")
+REQTRACE_DROPPED_TOTAL = _m(
+    "bigdl_reqtrace_dropped_total", "counter",
+    doc="Completed request traces dropped by the tail sampler "
+        "(clean requests past the sampling probability)")
+REQTRACE_RING_EVICTED_TOTAL = _m(
+    "bigdl_reqtrace_ring_evicted_total", "counter",
+    doc="Kept request traces evicted from the bounded completed-trace "
+        "ring (BIGDL_REQTRACE_RING)")
+REQTRACE_ACTIVE_TRACES = _m(
+    "bigdl_reqtrace_active_traces", "gauge",
+    doc="Request traces currently open — begun, not yet through the "
+        "tail sampler")
+
 #: ``bigdl_``-prefixed spellings that are NOT metric families — process
 #: names, trace categories, logger names — so the RD003 "every bigdl_*
 #: literal must be declared" rule knows they are deliberate.

@@ -112,6 +112,7 @@ def build_report(trace_dir: str, metrics_dir: Optional[str] = None) -> dict:
     alert_events: list = []
     autoscale_events: list = []
     fleet_events: list = []
+    reqtrace_spans: dict = {}
     for sh in shards:
         key = f"host{sh.host}/pid{sh.pid}"
         h = hosts.setdefault(key, {
@@ -122,8 +123,20 @@ def build_report(trace_dir: str, metrics_dir: Optional[str] = None) -> dict:
             name = rec.get("name", "")
             if rec.get("kind") == "span":
                 dur = float(rec.get("dur_s", 0.0))
-                h["spans"].append((name, dur,
-                                   (rec.get("attrs") or {}).get("step")))
+                attrs = rec.get("attrs") or {}
+                h["spans"].append((name, dur, attrs.get("step")))
+                # request-trace hop spans (obs/reqtrace.py) carry a
+                # `trace` attr — group them per trace id so the
+                # cross-host flow of one request reassembles here
+                tid = attrs.get("trace")
+                if tid and name.startswith("req."):
+                    e = reqtrace_spans.setdefault(
+                        tid, {"request": None, "spans": [],
+                              "hosts": set()})
+                    if e["request"] is None:
+                        e["request"] = attrs.get("request")
+                    e["spans"].append((name, dur))
+                    e["hosts"].add(sh.host)
                 if name == "computing":
                     h["step_times"].append(dur)
                 if name == "checkpoint.write_async":
@@ -427,6 +440,62 @@ def build_report(trace_dir: str, metrics_dir: Optional[str] = None) -> dict:
                 names.SERVE_DECODE_HBM_BYTES_PER_TOKEN),
         }
 
+    # ---- request traces (obs/reqtrace.py) ----------------------------
+    # per-hop p99 attribution: group each kept trace's req.* spans by
+    # hop key, then average the hop times over the slowest e2e decile —
+    # that is the "where did the p99 go" answer
+    reqtrace = None
+    if reqtrace_spans:
+        from bigdl_tpu.serving.spans import HOP_ORDER, hop_key
+
+        traces = []
+        for tid, e in reqtrace_spans.items():
+            hops: dict = {}
+            route = 0.0
+            for name, dur in e["spans"]:
+                k = hop_key(name)
+                if k == "route":
+                    # the router's whole-request envelope IS the
+                    # measured e2e; the other hops partition it
+                    route = max(route, dur)
+                else:
+                    hops[k] = hops.get(k, 0.0) + dur
+            hop_sum = sum(hops.values())
+            e2e = route if route > 0 else hop_sum
+            traces.append({
+                "trace": tid, "request": e["request"],
+                "hosts": len(e["hosts"]), "e2e_s": e2e, "hops": hops,
+                "coverage": (hop_sum / e2e) if e2e > 0 else None})
+        traces.sort(key=lambda t: -t["e2e_s"])
+        n_slow = max(1, len(traces) // 10)
+        slow = traces[:n_slow]
+        hop_means = {}
+        for k in HOP_ORDER:
+            if k == "route":
+                continue
+            vals = [t["hops"].get(k, 0.0) for t in slow]
+            if any(vals):
+                hop_means[k] = sum(vals) / len(vals)
+        cov = [t["coverage"] for t in slow
+               if t["coverage"] is not None]
+        reqtrace = {
+            "traces": len(traces),
+            "cross_host": sum(1 for t in traces if t["hosts"] > 1),
+            "slow_decile": {
+                "count": n_slow,
+                "e2e_mean_s": sum(t["e2e_s"] for t in slow) / n_slow,
+                "hop_mean_s": {k: round(v, 6)
+                               for k, v in hop_means.items()},
+                "coverage": (sum(cov) / len(cov)) if cov else None,
+            },
+            "slowest": [
+                {"trace": t["trace"], "request": t["request"],
+                 "e2e_s": round(t["e2e_s"], 6),
+                 "hops": {k: round(v, 6) for k, v in sorted(
+                     t["hops"].items(), key=lambda kv: -kv[1])}}
+                for t in traces[:5]],
+        }
+
     # ---- overlapped step (ISSUE 11: bucketed exchange, async
     # checkpointing, double-buffered input) ----------------------------
     buckets = _metric_max(names.OVERLAP_BUCKETS)
@@ -480,6 +549,7 @@ def build_report(trace_dir: str, metrics_dir: Optional[str] = None) -> dict:
         "slow_steps": slow_steps,
         "alerts": alerts,
         "serving": serving,
+        "reqtrace": reqtrace,
         "autoscale": autoscale,
         "fleet": fleet,
         "overlap": overlap,
@@ -612,6 +682,34 @@ def render_text(rep: dict) -> str:
                 f"  decode: {dms:.2f}ms/step"
                 + (f", {bpt / 1e6:.2f} MB/token (HBM)"
                    if bpt is not None else ""))
+    lines.append("")
+    lines.append("-- request traces --")
+    rt = rep.get("reqtrace")
+    if not rt:
+        lines.append("  (none kept — set BIGDL_REQTRACE_SAMPLE>0, "
+                     "anomalies are always kept)")
+    else:
+        lines.append(
+            f"  kept traces: {rt['traces']}"
+            + (f" ({rt['cross_host']} cross-host)"
+               if rt.get("cross_host") else ""))
+        sd = rt["slow_decile"]
+        cov = sd.get("coverage")
+        lines.append(
+            f"  slowest decile (n={sd['count']}): "
+            f"e2e mean {sd['e2e_mean_s'] * 1000:.1f}ms"
+            + (f", hop coverage {cov * 100:.0f}%"
+               if cov is not None else ""))
+        total = sum(sd["hop_mean_s"].values()) or 1.0
+        for hop, v in sorted(sd["hop_mean_s"].items(),
+                             key=lambda kv: -kv[1]):
+            lines.append(f"    {hop:10s} {v * 1000:9.2f}ms  "
+                         f"{v / total * 100:5.1f}%")
+        for t in rt.get("slowest", [])[:3]:
+            worst = next(iter(t["hops"]), "-")
+            lines.append(
+                f"  trace {t['trace']} (request {t['request']}): "
+                f"{t['e2e_s'] * 1000:.1f}ms, worst hop {worst}")
     lines.append("")
     lines.append("-- autoscaling & stream --")
     asc = rep.get("autoscale") or {}

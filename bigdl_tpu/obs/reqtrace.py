@@ -1,0 +1,389 @@
+"""Request-scoped distributed tracing with tail-based sampling.
+
+The serving data plane's only latency signal used to be aggregate
+histograms — when p99 regressed, nothing said whether a request lost
+its time in the router queue, a retry after a 503 shed, a drain-handoff
+replay, a preemption refold, prefill bucketing, or decode itself.  This
+module is the Dapper-style fix, sized for the existing obs stack:
+
+* :class:`RequestTraceContext` — one trace id (+ optional parent span
+  and a force-keep flag) created at the first hop (router ``route()``,
+  ``RouterServer``, or ``LMEngine.submit`` for in-process callers) and
+  propagated across HTTP hops in the ``X-Bigdl-Trace`` header as
+  ``<trace_id>:<parent>:<flags>``;
+* :class:`ReqTraceCollector` — per-process buffer of lifecycle hop
+  spans keyed by trace id.  Spans are **buffered, not emitted**, until
+  the request completes; the completion point then makes the
+  tail-sampling decision:
+
+  - **keep always** when the request errored, retried, was preempted,
+    was handed off, violated its SLO, or carries the forced-keep
+    header flag (anomalies are exactly what tail sampling exists to
+    catch);
+  - otherwise **keep probabilistically** at ``BIGDL_REQTRACE_SAMPLE``,
+    decided by a deterministic hash of the trace id so every host in a
+    distributed topology keeps or drops the *same* traces without
+    coordination.
+
+  Kept spans are emitted through the ordinary ``obs/trace.py`` tracer
+  (so ``obs/aggregate.py``'s clock-aligned Perfetto merge shows the
+  cross-host request flow) and the completed trace is retained in a
+  bounded ring served by ``/trace?request=<id>`` on the obs server.
+
+``BIGDL_REQTRACE_SAMPLE=0`` (the default) disables the subsystem
+entirely: no contexts are created, no buffers touched, and the decode
+hot path (`LMEngine._step`) is byte-for-byte the untraced code — the
+engine only marks admission/prefill/preemption/completion boundaries,
+and only when a request actually carries a context.
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional, Tuple
+
+#: the HTTP propagation header: ``<trace_id>:<parent_span>:<flags>``
+TRACE_HEADER = "X-Bigdl-Trace"
+
+#: sampling reasons a kept trace may carry (the label set of
+#: ``bigdl_reqtrace_sampled_total``)
+KEEP_REASONS = ("error", "retry", "preempt", "slo", "handoff", "forced",
+                "sampled")
+
+
+class RequestTraceContext:
+    """One request's trace identity, cheap enough to ride every hop."""
+
+    __slots__ = ("trace_id", "parent", "keep")
+
+    def __init__(self, trace_id: str, parent: Optional[int] = None,
+                 keep: bool = False):
+        self.trace_id = str(trace_id)
+        self.parent = parent
+        self.keep = bool(keep)
+
+    def to_header(self) -> str:
+        parent = "" if self.parent is None else str(self.parent)
+        flags = "k" if self.keep else ""
+        return f"{self.trace_id}:{parent}:{flags}"
+
+    @classmethod
+    def from_header(cls, value: Optional[str]
+                    ) -> Optional["RequestTraceContext"]:
+        """Tolerant parse of the ``X-Bigdl-Trace`` header (None / a
+        malformed value -> None — a bad trace header must never fail a
+        request)."""
+        if not value:
+            return None
+        parts = str(value).strip().split(":")
+        tid = parts[0].strip()
+        if not tid:
+            return None
+        parent = None
+        if len(parts) > 1 and parts[1].strip():
+            try:
+                parent = int(parts[1])
+            except ValueError:
+                parent = None
+        flags = parts[2] if len(parts) > 2 else ""
+        return cls(tid, parent=parent, keep="k" in flags)
+
+    def __repr__(self):
+        return (f"RequestTraceContext({self.trace_id!r}, "
+                f"parent={self.parent}, keep={self.keep})")
+
+
+def _hash01(trace_id: str) -> float:
+    """Deterministic uniform-[0,1) hash of a trace id — every process
+    in the topology maps the same id to the same number, so the
+    probabilistic keep/drop agrees fleet-wide without coordination."""
+    h = hashlib.sha256(trace_id.encode("utf-8")).digest()
+    return int.from_bytes(h[:8], "big") / float(1 << 64)
+
+
+class ReqTraceCollector:
+    """Per-process span buffer + tail sampler + completed-trace ring."""
+
+    def __init__(self, sample: float = 0.0, ring_size: int = 256):
+        self.sample = max(0.0, min(1.0, float(sample)))
+        self.ring_size = max(1, int(ring_size))
+        self.enabled = self.sample > 0.0
+        self._lock = threading.Lock()
+        self._buffers: Dict[str, List[tuple]] = {}
+        self._ring: "collections.OrderedDict[str, dict]" = \
+            collections.OrderedDict()
+        # first-finish keep/drop decisions, memoized per trace so the
+        # router's flush and the engine's flush of the SAME trace agree
+        # (and count the sampler metrics once)
+        self._decided: "collections.OrderedDict[str, Tuple[bool, str]]" \
+            = collections.OrderedDict()
+        from bigdl_tpu import obs
+        from bigdl_tpu.obs import names
+
+        reg = obs.get_registry()
+        self._sampled = reg.counter(
+            names.REQTRACE_SAMPLED_TOTAL,
+            "Request traces kept by the tail sampler, by keep reason",
+            labels=("reason",))
+        self._dropped = reg.counter(
+            names.REQTRACE_DROPPED_TOTAL,
+            "Completed request traces dropped by the tail sampler")
+        self._evicted = reg.counter(
+            names.REQTRACE_RING_EVICTED_TOTAL,
+            "Kept request traces evicted from the bounded ring")
+        self._active = reg.gauge(
+            names.REQTRACE_ACTIVE_TRACES,
+            "Request traces currently open (begun, not yet sampled)")
+
+    # ----------------------------------------------------------- lifecycle
+    def new_context(self) -> RequestTraceContext:
+        return RequestTraceContext(uuid.uuid4().hex[:16])
+
+    def _open(self, trace_id: str) -> Optional[list]:
+        """(Re)open the span buffer for a trace — callers hold the
+        lock.  A trace the sampler already DROPPED stays dropped
+        (returns None); a KEPT trace may re-open so an in-process
+        drain-handoff replay's spans merge into the same ring entry."""
+        buf = self._buffers.get(trace_id)
+        if buf is None:
+            decided = self._decided.get(trace_id)
+            if decided is not None and not decided[0]:
+                return None
+            buf = self._buffers[trace_id] = []
+            self._active.inc()
+        return buf
+
+    def begin(self, ctx: RequestTraceContext) -> None:
+        """Open a span buffer for ``ctx`` (idempotent per trace)."""
+        if not self.enabled or ctx is None:
+            return
+        with self._lock:
+            self._open(ctx.trace_id)
+
+    def span(self, ctx: Optional[RequestTraceContext], name: str,
+             start_mono: float, dur_s: float, **attrs) -> None:
+        """Buffer one lifecycle hop span (``start_mono`` on the
+        ``time.monotonic()`` clock the serving tier stamps with)."""
+        if not self.enabled or ctx is None:
+            return
+        with self._lock:
+            buf = self._open(ctx.trace_id)
+            if buf is not None:
+                buf.append((str(name), float(start_mono),
+                            max(0.0, float(dur_s)), attrs))
+
+    def peek(self, ctx: RequestTraceContext) -> List[dict]:
+        """The still-buffered spans of an *unfinished* trace (the sim's
+        lost-request dump; an already-sampled trace answers from the
+        ring instead)."""
+        if ctx is None:
+            return []
+        with self._lock:
+            buf = self._buffers.get(ctx.trace_id)
+            if buf is not None:
+                return [dict(name=n, start=s, dur_s=d, **a)
+                        for n, s, d, a in buf]
+            entry = self._ring.get(ctx.trace_id)
+            return list(entry["spans"]) if entry else []
+
+    # ------------------------------------------------------------ sampling
+    def _reason(self, ctx, error, retries, preempted, slo_violation,
+                handoff) -> Optional[str]:
+        if error:
+            return "error"
+        if handoff:
+            return "handoff"
+        if preempted:
+            return "preempt"
+        if retries:
+            return "retry"
+        if slo_violation:
+            return "slo"
+        if ctx.keep:
+            return "forced"
+        if _hash01(ctx.trace_id) < self.sample:
+            return "sampled"
+        return None
+
+    def finish(self, ctx: Optional[RequestTraceContext], *,
+               request: Optional[str] = None,
+               error: Optional[str] = None, retries: int = 0,
+               preempted: bool = False, slo_violation: bool = False,
+               handoff: bool = False, e2e_s: Optional[float] = None
+               ) -> Tuple[bool, Optional[str]]:
+        """One completion point flushing its buffered spans through the
+        tail sampler.  Returns ``(kept, reason)``.
+
+        A trace may finish more than once in one process (the engine's
+        ``_complete`` and the router's ``route()`` both flush their own
+        hops) — the first finish decides keep/drop and counts the
+        sampler metrics; later finishes reuse the decision and merge
+        their spans into the same ring entry."""
+        if not self.enabled or ctx is None:
+            return False, None
+        with self._lock:
+            buf = self._buffers.pop(ctx.trace_id, None)
+            if buf is not None:
+                self._active.inc(-1.0)
+            decided = self._decided.get(ctx.trace_id)
+            first = decided is None
+            if first:
+                reason = self._reason(ctx, error, retries, preempted,
+                                      slo_violation, handoff)
+                decided = (reason is not None, reason)
+                self._decided[ctx.trace_id] = decided
+                while len(self._decided) > 4 * self.ring_size:
+                    self._decided.popitem(last=False)
+            kept, reason = decided
+            if first:
+                if kept:
+                    self._sampled.labels(reason=reason).inc()
+                else:
+                    self._dropped.inc()
+            if not kept:
+                return False, reason
+            ctx.keep = True      # later hops/hosts inherit the decision
+            spans = self._emit(ctx, buf or [], request)
+            entry = self._ring.get(ctx.trace_id)
+            if entry is None:
+                entry = {"trace": ctx.trace_id, "request": request,
+                         "reason": reason, "error": error,
+                         "retries": int(retries), "e2e_s": e2e_s,
+                         "spans": []}
+                self._ring[ctx.trace_id] = entry
+                while len(self._ring) > self.ring_size:
+                    self._ring.popitem(last=False)
+                    self._evicted.inc()
+            else:
+                entry["request"] = entry["request"] or request
+                entry["error"] = entry["error"] or error
+                entry["retries"] = max(entry["retries"], int(retries))
+                if e2e_s is not None:
+                    entry["e2e_s"] = e2e_s
+            entry["spans"].extend(spans)
+            return True, reason
+
+    def _emit(self, ctx, buf, request) -> List[dict]:
+        """Emit buffered spans through the process tracer (monotonic ->
+        perf_counter conversion happens here, once) and return their
+        ring-entry dicts."""
+        from bigdl_tpu import obs
+
+        tracer = obs.get_tracer()
+        off_perf = time.perf_counter() - time.monotonic()
+        off_wall = time.time() - time.monotonic()
+        out = []
+        for name, start_mono, dur_s, attrs in buf:
+            if tracer.enabled:
+                tracer.complete(name, start_mono + off_perf, dur_s,
+                                trace=ctx.trace_id, request=request,
+                                **attrs)
+            out.append(dict(name=name,
+                            start=round(start_mono + off_wall, 6),
+                            dur_s=round(dur_s, 9), **attrs))
+        return out
+
+    # ------------------------------------------------------------- lookup
+    def find(self, key: str) -> Optional[dict]:
+        """A kept completed trace by trace id or request id (newest
+        match wins), for ``/trace?request=<id>``."""
+        key = str(key)
+        with self._lock:
+            entry = self._ring.get(key)
+            if entry is not None:
+                return dict(entry)
+            for e in reversed(self._ring.values()):
+                if str(e.get("request")) == key:
+                    return dict(e)
+        return None
+
+    def completed(self) -> List[dict]:
+        """Every kept completed trace in the ring, oldest first."""
+        with self._lock:
+            return [dict(e) for e in self._ring.values()]
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"enabled": self.enabled, "sample": self.sample,
+                    "ring_size": self.ring_size,
+                    "open": len(self._buffers),
+                    "kept": len(self._ring),
+                    "sampled": {
+                        r: int(self._sampled.labels(reason=r).value)
+                        for r in KEEP_REASONS
+                        if self._sampled.labels(reason=r).value},
+                    "dropped": int(self._dropped._solo().value)}
+
+
+#: the shared disabled collector (no metrics minted, nothing buffered)
+class _NullCollector:
+    enabled = False
+    sample = 0.0
+
+    def new_context(self):
+        return RequestTraceContext(uuid.uuid4().hex[:16])
+
+    def begin(self, ctx):
+        pass
+
+    def span(self, ctx, name, start_mono, dur_s, **attrs):
+        pass
+
+    def peek(self, ctx):
+        return []
+
+    def finish(self, ctx, **kw):
+        return False, None
+
+    def find(self, key):
+        return None
+
+    def completed(self):
+        return []
+
+    def stats(self):
+        return {"enabled": False, "sample": 0.0}
+
+
+NULL_COLLECTOR = _NullCollector()
+
+_lock = threading.Lock()
+_collector = NULL_COLLECTOR
+_collector_key = None
+
+
+def get_collector():
+    """The process collector, rebuilt when ``BIGDL_REQTRACE_SAMPLE`` /
+    ``BIGDL_REQTRACE_RING`` change; the shared :data:`NULL_COLLECTOR`
+    while sampling is off (no state, no metrics)."""
+    global _collector, _collector_key
+    from bigdl_tpu.config import refresh_from_env
+
+    cfg = refresh_from_env().obs
+    key = (cfg.reqtrace_sample, cfg.reqtrace_ring)
+    with _lock:
+        if key != _collector_key:
+            _collector_key = key
+            _collector = (ReqTraceCollector(cfg.reqtrace_sample,
+                                            cfg.reqtrace_ring)
+                          if cfg.reqtrace_sample > 0.0
+                          else NULL_COLLECTOR)
+        return _collector
+
+
+def reset_collector():
+    """Test hook (wired into ``obs.reset()``): drop the collector so
+    the next accessor rebuilds from live config."""
+    global _collector, _collector_key
+    with _lock:
+        _collector = NULL_COLLECTOR
+        _collector_key = None
+
+
+__all__ = ["TRACE_HEADER", "KEEP_REASONS", "RequestTraceContext",
+           "ReqTraceCollector", "NULL_COLLECTOR", "get_collector",
+           "reset_collector"]

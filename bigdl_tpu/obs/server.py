@@ -194,12 +194,29 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                 self._send_json(health_payload())
             elif url.path == "/trace":
                 q = urllib.parse.parse_qs(url.query)
-                last = int(q.get("last", ["64"])[0])
-                self._send_json(trace_tail(last))
+                key = q.get("request", [None])[0]
+                if key is not None:
+                    # one kept request trace by trace id / request id,
+                    # straight out of the tail sampler's bounded ring
+                    from bigdl_tpu.obs import reqtrace
+
+                    entry = reqtrace.get_collector().find(key)
+                    if entry is None:
+                        self._send_json(
+                            {"error": f"no kept trace for {key!r} "
+                                      "(dropped by the tail sampler, "
+                                      "evicted from the ring, or never "
+                                      "seen)"}, 404)
+                    else:
+                        self._send_json(entry)
+                else:
+                    last = int(q.get("last", ["64"])[0])
+                    self._send_json(trace_tail(last))
             elif url.path == "/":
                 self._send_json(
                     {"endpoints": ["/metrics", "/healthz",
-                                   "/trace?last=K"]})
+                                   "/trace?last=K",
+                                   "/trace?request=ID"]})
             else:
                 self._send_json({"error": f"no route {url.path}"}, 404)
         except (BrokenPipeError, ConnectionResetError):

@@ -17,12 +17,21 @@ session-affine, KV-pressure-aware placement over N replicas
 budget-gated retries and explicit 503 + Retry-After load shedding
 (serving/router.py).
 
+End-to-end request tracing (ISSUE 17) threads one trace_id through
+every hop of that stack — router placement/retry/handoff, engine
+queue/prefill/preempt/decode — across process boundaries on the
+``X-Bigdl-Trace`` header, with tail-based sampling that always keeps
+anomalous requests (obs/reqtrace.py; span names are the constants in
+serving/spans.py, enforced by graftlint RD006).
+
 The loop closes through the observability planes: request-latency
-histograms + SLO burn-rate alerting (obs/alerts.py), a "serving"
-report section (obs/report.py), and request-driven autoscaling signals
-— queue depth and p99 — in resilience/autoscale.py.
+histograms with trace exemplars + SLO burn-rate alerting
+(obs/alerts.py), "serving" and "request traces" report sections
+(obs/report.py), and request-driven autoscaling signals — queue depth
+and p99 — in resilience/autoscale.py.
 """
 
+from bigdl_tpu.serving import spans
 from bigdl_tpu.serving.batcher import RequestQueue, ServeRequest
 from bigdl_tpu.serving.cache import PagedKVCache, gather_pages
 from bigdl_tpu.serving.classifier import ClassifierEngine
@@ -58,4 +67,5 @@ __all__ = [
     "ServingServer",
     "drain_engine",
     "gather_pages",
+    "spans",
 ]

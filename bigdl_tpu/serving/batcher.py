@@ -52,6 +52,10 @@ class ServeRequest:
     tokens: List[int] = dataclasses.field(default_factory=list)
     result: Optional[np.ndarray] = None  # classifier output row(s)
     error: Optional[str] = None
+    # request-trace context (obs.reqtrace.RequestTraceContext) when the
+    # distributed tracing collector is on; None = untraced, and the
+    # engine does zero trace work for this request
+    trace: Optional[Any] = None
     _event: threading.Event = dataclasses.field(
         default_factory=threading.Event, repr=False)
 

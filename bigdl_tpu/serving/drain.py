@@ -45,6 +45,9 @@ class HandoffRecord:
     tokens_done: List[int] = dataclasses.field(default_factory=list)
     request_id: Optional[str] = None   # router id when router-placed
     source: Optional[str] = None       # replica the checkpoint left
+    # serialized request-trace context (X-Bigdl-Trace header form) so a
+    # replay continues under the SAME trace_id on the absorbing replica
+    trace: Optional[str] = None
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -57,7 +60,8 @@ class HandoffRecord:
                    tokens_done=[int(t) for t in
                                 d.get("tokens_done") or []],
                    request_id=d.get("request_id"),
-                   source=d.get("source"))
+                   source=d.get("source"),
+                   trace=d.get("trace"))
 
 
 class HandoffLedger:
@@ -150,12 +154,34 @@ def drain_engine(engine, deadline_s: float = 10.0,
                 break
             leftovers.extend(batch)
         for req in leftovers:
+            ctx = getattr(req, "trace", None)
+            if ctx is not None:
+                # the checkpointed request's engine-side trace ends
+                # here, force-kept (handoff): the replay re-begins the
+                # SAME trace_id on the absorbing replica.  finish()
+                # runs BEFORE the record serializes the context so the
+                # checkpoint header carries the force-keep flag across
+                # the process boundary
+                from bigdl_tpu.obs import reqtrace
+                from bigdl_tpu.serving import spans
+                col = reqtrace.get_collector()
+                now = time.monotonic()
+                col.span(ctx, spans.SPAN_HANDOFF, now, 0.0,
+                         tokens_done=len(req.tokens),
+                         owed=int(req.max_new_tokens), side="drain")
+                col.finish(
+                    ctx,
+                    request=str(getattr(req, "router_id", None)
+                                or req.id),
+                    handoff=True,
+                    e2e_s=max(0.0, now - req.t_submit))
             handoffs.append(HandoffRecord(
                 prompt=[int(t) for t in req.payload],
                 max_new_tokens=int(req.max_new_tokens),
                 temperature=float(req.temperature),
                 tokens_done=[int(t) for t in req.tokens],
-                request_id=getattr(req, "router_id", None)))
+                request_id=getattr(req, "router_id", None),
+                trace=ctx.to_header() if ctx is not None else None))
             req.finish(error=HANDOFF_ERROR)
     return handoffs
 

@@ -48,6 +48,8 @@ import numpy as np
 
 from bigdl_tpu.serving.batcher import RequestQueue, ServeRequest
 from bigdl_tpu.serving.cache import PagedKVCache
+from bigdl_tpu.serving.drain import HANDOFF_ERROR
+from bigdl_tpu.serving import spans
 from bigdl_tpu.obs import names
 
 LAT_META = (names.REQUEST_LATENCY_SECONDS,
@@ -440,7 +442,8 @@ class LMEngine:
     # ------------------------------------------------------------- clients
     def submit(self, prompt, max_new_tokens: int, *,
                temperature: float = 0.0,
-               timeout: Optional[float] = None) -> ServeRequest:
+               timeout: Optional[float] = None,
+               trace=None) -> ServeRequest:
         if self.draining:
             raise RuntimeError("engine is draining — admissions closed")
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
@@ -459,9 +462,25 @@ class LMEngine:
             raise ValueError(
                 f"request needs {worst} KV pages but the pool has "
                 f"{self.cache.num_pages - 1}")
+        # request tracing: attach (or mint) a context only when the
+        # collector is on — with BIGDL_REQTRACE_SAMPLE=0 this whole
+        # branch is two attribute loads and the engine carries no
+        # trace state at all
+        from bigdl_tpu.obs import reqtrace
+        col = reqtrace.get_collector()
+        if col.enabled:
+            if trace is None:
+                trace = col.new_context()
+            col.begin(trace)
+        else:
+            trace = None
         req = ServeRequest(payload=prompt,
                            max_new_tokens=int(max_new_tokens),
-                           temperature=float(temperature))
+                           temperature=float(temperature),
+                           trace=trace)
+        if trace is not None:
+            req._tr_admits = []    # [{t, dur, bucket, prompt_len, slot}]
+            req._tr_preempts = []  # [t_preempted]
         return self.queue.submit(req, timeout=timeout)
 
     # ----------------------------------------------------------- admission
@@ -503,6 +522,7 @@ class LMEngine:
         import jax
         import jax.numpy as jnp
 
+        t_admit = time.monotonic()
         t0 = len(req.payload)
         pages = self.cache.alloc(slot, t0)
         page_arg = np.zeros((bucket // self.page_size,), np.int32)
@@ -517,6 +537,10 @@ class LMEngine:
         self.cache.kp, self.cache.vp = kp, vp
         self.cache.lengths[slot] = t0
         tok = int(first)
+        if req.trace is not None:
+            req._tr_admits.append(
+                {"t": t_admit, "dur": time.monotonic() - t_admit,
+                 "bucket": bucket, "prompt_len": t0, "slot": slot})
         if req.t_first is None:
             req.t_first = time.monotonic()
             self._lat.labels(engine="lm", kind="ttft").observe(
@@ -531,8 +555,9 @@ class LMEngine:
         self._slots[slot] = act
         from bigdl_tpu import obs
 
-        obs.get_tracer().event("serve.admit", slot=slot, request=req.id,
-                               prompt_len=t0, bucket=bucket)
+        obs.get_tracer().event(spans.EVENT_ADMIT, slot=slot,
+                               request=req.id, prompt_len=t0,
+                               bucket=bucket)
         if act.remaining <= 0 or tok == self.eos_id:
             self._complete(slot)
 
@@ -557,9 +582,11 @@ class LMEngine:
         self._slots[slot] = None
         self._stash.appendleft(req)
         self._preempt_counter.inc()
+        if req.trace is not None:
+            req._tr_preempts.append(time.monotonic())
         from bigdl_tpu import obs
 
-        obs.get_tracer().event("serve.preempt", slot=slot,
+        obs.get_tracer().event(spans.EVENT_PREEMPT, slot=slot,
                                request=req.id, owed=act.remaining)
         return slot
 
@@ -569,11 +596,20 @@ class LMEngine:
         self.cache.release(slot)
         self._slots[slot] = None
         req = act.req
-        req.finish(error)
         now = time.monotonic()
+        exemplar = None
+        if req.trace is not None:
+            # finalize BEFORE finish() wakes the client thread, so the
+            # engine's spans reach the collector before a same-process
+            # router can race the tail-sampling decision
+            kept = self._finalize_trace(req, error, now)
+            if kept:
+                exemplar = {"trace_id": req.trace.trace_id}
+        req.finish(error)
         self._t_last_done = now
         e2e = req.e2e_s
-        self._lat.labels(engine="lm", kind="e2e").observe(e2e)
+        self._lat.labels(engine="lm", kind="e2e").observe(
+            e2e, exemplar=exemplar)
         n_tok = len(req.tokens)
         if n_tok > 1:
             self._lat.labels(engine="lm", kind="per_token").observe(
@@ -590,6 +626,48 @@ class LMEngine:
         if self._t_first_work is not None and now > self._t_first_work:
             self._tps_gauge.set(
                 self._tokens_total / (now - self._t_first_work))
+
+    def _finalize_trace(self, req: ServeRequest, error: Optional[str],
+                        now: float) -> bool:
+        """Partition the request's engine-side e2e into lifecycle spans
+        and push them through the tail sampler.  The partition is EXACT:
+        queue + prefill + preempt + decode == e2e by construction
+        (decode is the remainder), which is what makes the report's
+        per-hop attribution sum to the measured end-to-end time.
+        Returns whether the tail sampler kept the trace."""
+        from bigdl_tpu.obs import reqtrace
+        col = reqtrace.get_collector()
+        ctx = req.trace
+        e2e = max(0.0, now - req.t_submit)
+        admits = getattr(req, "_tr_admits", [])
+        preempts = getattr(req, "_tr_preempts", [])
+        queue = (max(0.0, admits[0]["t"] - req.t_submit)
+                 if admits else e2e)
+        prefill = sum(a["dur"] for a in admits)
+        col.span(ctx, spans.SPAN_QUEUE, req.t_submit, queue, engine="lm")
+        for a in admits:
+            col.span(ctx, spans.SPAN_PREFILL, a["t"], a["dur"],
+                     slot=a["slot"], bucket=a["bucket"],
+                     prompt_len=a["prompt_len"], engine="lm")
+        # each preemption pairs with the NEXT admission: the gap is the
+        # refold + re-queue wait the preemption cost this request
+        preempt_wait = 0.0
+        for i, tp in enumerate(preempts):
+            if i + 1 < len(admits):
+                gap = max(0.0, admits[i + 1]["t"] - tp)
+                preempt_wait += gap
+                col.span(ctx, spans.SPAN_PREEMPT, tp, gap, engine="lm")
+        decode = max(0.0, e2e - queue - prefill - preempt_wait)
+        t_dec = req.t_first if req.t_first is not None else now
+        col.span(ctx, spans.SPAN_DECODE, t_dec, decode,
+                 tokens=len(req.tokens), engine="lm")
+        kept, _ = col.finish(
+            ctx,
+            request=str(getattr(req, "router_id", None) or req.id),
+            error=error, preempted=bool(preempts),
+            slo_violation=(self.slo_s > 0 and e2e > self.slo_s),
+            handoff=(error == HANDOFF_ERROR), e2e_s=e2e)
+        return kept
 
     def _step(self):
         import jax

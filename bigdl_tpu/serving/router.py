@@ -40,8 +40,9 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 
-from bigdl_tpu.obs import names
+from bigdl_tpu.obs import names, reqtrace
 from bigdl_tpu.resilience.retry import RetryBudget, backoff_delay
+from bigdl_tpu.serving import spans
 from bigdl_tpu.serving.drain import (HANDOFF_ERROR, HandoffLedger,
                                      HandoffRecord)
 from bigdl_tpu.serving.placement import (NoReplicaAvailable,
@@ -68,11 +69,15 @@ class ReplicaDraining(RuntimeError):
 
 class RouterShed(RuntimeError):
     """Load shed: retry budget exhausted or no eligible replica.  The
-    HTTP tier maps this to 503 + ``Retry-After``."""
+    HTTP tier maps this to 503 + ``Retry-After``; ``budget`` (the
+    shared retry budget's stats snapshot, when the router had one)
+    rides the 503 body so clients can see *why* they were shed."""
 
-    def __init__(self, reason: str, retry_after_s: float = 1.0):
+    def __init__(self, reason: str, retry_after_s: float = 1.0,
+                 budget: Optional[dict] = None):
         super().__init__(reason)
         self.retry_after_s = float(retry_after_s)
+        self.budget = budget
 
 
 def _claim_key(hd: HandoffRecord) -> str:
@@ -93,11 +98,12 @@ class EngineReplica:
 
     def generate(self, prompt, max_new_tokens: int, *,
                  temperature: float = 0.0, timeout_s: float = 30.0,
-                 request_id: Optional[str] = None) -> dict:
+                 request_id: Optional[str] = None,
+                 trace=None) -> dict:
         try:
             req = self.engine.submit(prompt, max_new_tokens,
                                      temperature=temperature,
-                                     timeout=timeout_s)
+                                     timeout=timeout_s, trace=trace)
         except TimeoutError as e:       # queue full past the timeout
             raise ReplicaUnavailable(f"{self.name}: {e}") from e
         except RuntimeError as e:       # draining / closed queue
@@ -108,12 +114,14 @@ class EngineReplica:
         except TimeoutError as e:
             raise ReplicaUnavailable(f"{self.name}: {e}") from e
         if req.error == HANDOFF_ERROR:
+            ctx = getattr(req, "trace", None)
             raise ReplicaDraining(HandoffRecord(
                 prompt=[int(t) for t in req.payload],
                 max_new_tokens=int(req.max_new_tokens),
                 temperature=float(req.temperature),
                 tokens_done=[int(t) for t in req.tokens],
-                request_id=request_id, source=self.name))
+                request_id=request_id, source=self.name,
+                trace=ctx.to_header() if ctx is not None else None))
         if req.error:
             raise ReplicaUnavailable(f"{self.name}: {req.error}")
         return {"tokens": [int(t) for t in req.tokens],
@@ -148,14 +156,15 @@ class HTTPReplica:
         self._fetch = fetch or self._http_fetch
 
     def _http_fetch(self, url: str, body: Optional[dict] = None,
-                    timeout_s: float = 30.0):
+                    timeout_s: float = 30.0,
+                    headers: Optional[dict] = None):
         import urllib.error
         import urllib.request
 
         data = None if body is None else json.dumps(body).encode()
-        req = urllib.request.Request(
-            url, data=data,
-            headers={"Content-Type": "application/json"} if data else {})
+        hdrs = {"Content-Type": "application/json"} if data else {}
+        hdrs.update(headers or {})
+        req = urllib.request.Request(url, data=data, headers=hdrs)
         try:
             with urllib.request.urlopen(req, timeout=timeout_s) as r:
                 return r.status, json.loads(r.read() or b"{}")
@@ -171,14 +180,21 @@ class HTTPReplica:
 
     def generate(self, prompt, max_new_tokens: int, *,
                  temperature: float = 0.0, timeout_s: float = 30.0,
-                 request_id: Optional[str] = None) -> dict:
+                 request_id: Optional[str] = None,
+                 trace=None) -> dict:
+        # the trace context crosses the hop as the X-Bigdl-Trace
+        # header; the kwarg reaches the injectable fetch seam only when
+        # a context exists, so untraced runs hit test fakes unchanged
+        kw = {"timeout_s": timeout_s}
+        if trace is not None:
+            kw["headers"] = {reqtrace.TRACE_HEADER: trace.to_header()}
         status, out = self._fetch(
             self.base + "/v1/generate",
             {"prompt": [int(t) for t in prompt],
              "max_new_tokens": int(max_new_tokens),
              "temperature": float(temperature),
              "request_id": request_id},
-            timeout_s=timeout_s)
+            **kw)
         if status == 200:
             return {"tokens": [int(t) for t in out["tokens"]],
                     "ttft_s": out.get("ttft_s"),
@@ -353,18 +369,31 @@ class Router:
         return views
 
     # ------------------------------------------------------------ routing
-    def _shed(self, rid: str, reason: str):
+    def _shed(self, rid: str, reason: str, ctx=None):
         self._shed_counter.inc()
         self._req_counter.labels(outcome="shed").inc()
-        raise RouterShed(reason, retry_after_s=self.retry_after_s)
+        if ctx is not None:
+            reqtrace.get_collector().finish(
+                ctx, request=rid, error=f"shed: {reason}")
+        raise RouterShed(reason, retry_after_s=self.retry_after_s,
+                         budget=self.budget.stats())
 
     def route(self, prompt, max_new_tokens: int, *,
               temperature: float = 0.0, session: Optional[str] = None,
-              request_id: Optional[str] = None) -> dict:
+              request_id: Optional[str] = None, trace=None) -> dict:
         """Route one request to completion.  Returns ``{id, tokens,
         replica, retries, handoffs}``; raises :class:`RouterShed` when
         load must be shed, ValueError on a fatal client error."""
         rid = request_id or f"r{next(_rids)}"
+        col = reqtrace.get_collector()
+        ctx = trace
+        if col.enabled:
+            if ctx is None:
+                ctx = col.new_context()
+            col.begin(ctx)
+        else:
+            ctx = None
+        t_route = time.monotonic()
         self.budget.record_request()
         self._budget_gauge.set(self.budget.tokens())
         prompt_cur = [int(t) for t in prompt]
@@ -375,11 +404,15 @@ class Router:
         handoffs = 0
         affinity0 = self.placement.affinity_hits
         while True:
+            t_place = time.monotonic()
             try:
                 name = self.placement.choose(self.views(), session,
                                              exclude=tried)
             except NoReplicaAvailable as e:
-                self._shed(rid, str(e))
+                self._shed(rid, str(e), ctx)
+            col.span(ctx, spans.SPAN_PLACEMENT, t_place,
+                     time.monotonic() - t_place, replica=name,
+                     attempt=retries + handoffs)
             if self.placement.affinity_hits > affinity0:
                 affinity0 = self.placement.affinity_hits
                 self._affinity_counter.inc()
@@ -390,9 +423,11 @@ class Router:
                 continue
             self._note(name, +1)
             try:
+                kw = {} if ctx is None else {"trace": ctx}
                 out = replica.generate(
                     prompt_cur, owed, temperature=temperature,
-                    timeout_s=self.request_timeout_s, request_id=rid)
+                    timeout_s=self.request_timeout_s, request_id=rid,
+                    **kw)
             except ReplicaDraining as e:
                 hd = e.handoff
                 if not self.ledger.claim(_claim_key(hd)):
@@ -400,9 +435,24 @@ class Router:
                     # checkpoint — standing down is what keeps the
                     # request landing exactly once
                     self._req_counter.labels(outcome="failed").inc()
+                    if ctx is not None:
+                        col.finish(ctx, request=rid,
+                                   error=f"shed: request {rid} already "
+                                         f"replayed elsewhere",
+                                   handoff=True)
                     raise RouterShed(
                         f"request {rid} already replayed elsewhere",
-                        retry_after_s=self.retry_after_s) from e
+                        retry_after_s=self.retry_after_s,
+                        budget=self.budget.stats()) from e
+                if ctx is not None:
+                    # handoffs are exactly what tail sampling must
+                    # keep — force the decision before the replay hop
+                    ctx.keep = True
+                    col.span(ctx, spans.SPAN_HANDOFF,
+                             time.monotonic(), 0.0, source=name,
+                             tokens_done=len(hd.tokens_done),
+                             owed=int(hd.max_new_tokens),
+                             side="router")
                 prefix.extend(hd.tokens_done)
                 prompt_cur = list(hd.prompt)
                 owed = int(hd.max_new_tokens)
@@ -420,27 +470,46 @@ class Router:
                 if retries >= self.max_retries:
                     self._req_counter.labels(outcome="failed").inc()
                     self._shed(rid, f"request {rid}: "
-                                    f"{retries + 1} attempts failed")
+                                    f"{retries + 1} attempts failed",
+                               ctx)
                 if not self.budget.try_spend():
                     self._budget_gauge.set(self.budget.tokens())
                     self._shed(rid, "retry budget exhausted — fleet is "
-                                    "browning out")
+                                    "browning out", ctx)
                 retries += 1
                 self._retry_counter.inc()
                 self._budget_gauge.set(self.budget.tokens())
-                self._sleep(backoff_delay(
+                t_retry = time.monotonic()
+                delay = backoff_delay(
                     retries, base=self.backoff_base_s, cap=1.0,
-                    rng=self._rng))
+                    rng=self._rng)
+                self._sleep(delay)
+                if ctx is not None:
+                    # a retried request is an anomaly: keep its trace
+                    ctx.keep = True
+                    col.span(ctx, spans.SPAN_RETRY, t_retry, delay,
+                             replica=name, attempt=retries,
+                             budget_tokens=round(
+                                 self.budget.tokens(), 2))
                 continue
             finally:
                 self._note(name, -1)
             tokens = prefix + out["tokens"]
             self.ledger.deliver(rid)
             self._req_counter.labels(outcome="ok").inc()
-            return {"id": rid, "tokens": tokens, "replica": name,
+            resp = {"id": rid, "tokens": tokens, "replica": name,
                     "retries": retries, "handoffs": handoffs,
                     "ttft_s": out.get("ttft_s"),
                     "e2e_s": out.get("e2e_s")}
+            if ctx is not None:
+                col.span(ctx, spans.SPAN_ROUTE, t_route,
+                         time.monotonic() - t_route, replica=name,
+                         retries=retries, handoffs=handoffs)
+                col.finish(ctx, request=rid, retries=retries,
+                           handoff=handoffs > 0,
+                           e2e_s=time.monotonic() - t_route)
+                resp["trace"] = ctx.trace_id
+            return resp
 
     # -------------------------------------------------------------- drain
     def begin_drain(self, name: str,
@@ -544,12 +613,21 @@ class RouterServer:
                 try:
                     payload = json.loads(self.rfile.read(n) or b"{}")
                     if self.path == "/v1/generate":
+                        # a traced upstream hands us its context in the
+                        # X-Bigdl-Trace header; otherwise route() mints
+                        # one itself when the collector is on
+                        ctx = None
+                        if reqtrace.get_collector().enabled:
+                            ctx = reqtrace.RequestTraceContext \
+                                .from_header(self.headers.get(
+                                    reqtrace.TRACE_HEADER))
                         out = outer.router.route(
                             payload["prompt"],
                             int(payload.get("max_new_tokens", 16)),
                             temperature=float(
                                 payload.get("temperature", 0.0)),
-                            session=payload.get("session"))
+                            session=payload.get("session"),
+                            trace=ctx)
                         return self._send(out)
                     if self.path == "/admin/drain":
                         return self._send(outer.router.begin_drain(
@@ -557,8 +635,15 @@ class RouterServer:
                             deadline_s=payload.get("deadline_s")))
                     return self._send({"error": "not found"}, 404)
                 except RouterShed as e:
+                    # the shed body carries the retry-budget snapshot
+                    # so a shed client can tell "replica brownout"
+                    # from "I personally retried too much"
+                    body = {"error": str(e),
+                            "retry_after_s": e.retry_after_s}
+                    if e.budget is not None:
+                        body["retry_budget"] = e.budget
                     return self._send(
-                        {"error": str(e)}, 503,
+                        body, 503,
                         headers={"Retry-After":
                                  f"{max(1, round(e.retry_after_s))}"})
                 except KeyError as e:

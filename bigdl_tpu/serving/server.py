@@ -37,7 +37,7 @@ from typing import Optional
 
 import numpy as np
 
-from bigdl_tpu.obs import names
+from bigdl_tpu.obs import names, reqtrace
 
 log = logging.getLogger("bigdl_tpu.serving")
 
@@ -79,8 +79,20 @@ class ServingServer:
 
             def _reject(self, reason):
                 outer._rejects.inc()
+                # shed with *state*: the Retry-After basis plus the
+                # engine's live admission picture, so a shed client
+                # (or the router's logs) can see what it hit
+                body = {"error": reason,
+                        "retry_after_s": outer.retry_after_s}
+                if outer.lm is not None:
+                    try:
+                        body["engine"] = {
+                            "queue_depth": outer.lm.queue.depth(),
+                            "draining": bool(outer.lm.draining)}
+                    except Exception:  # noqa: BLE001 — shed anyway
+                        pass
                 return self._send(
-                    {"error": reason}, 503,
+                    body, 503,
                     headers={"Retry-After":
                              f"{max(1, round(outer.retry_after_s))}"})
 
@@ -127,11 +139,16 @@ class ServingServer:
 
                 if outer.lm is None:
                     return self._reject("no LM engine")
+                # a traced caller propagates its context in the
+                # X-Bigdl-Trace header; from_header is tolerant and the
+                # engine ignores the context unless its collector is on
+                ctx = reqtrace.RequestTraceContext.from_header(
+                    self.headers.get(reqtrace.TRACE_HEADER))
                 req = outer.lm.submit(
                     payload["prompt"],
                     int(payload.get("max_new_tokens", 16)),
                     temperature=float(payload.get("temperature", 0.0)),
-                    timeout=outer.request_timeout_s)
+                    timeout=outer.request_timeout_s, trace=ctx)
                 req.router_id = payload.get("request_id")
                 req.wait(outer.request_timeout_s)
                 if req.error == HANDOFF_ERROR:
@@ -144,7 +161,10 @@ class ServingServer:
                             "max_new_tokens": int(req.max_new_tokens),
                             "temperature": float(req.temperature),
                             "tokens_done": [int(t) for t in req.tokens],
-                            "request_id": req.router_id}},
+                            "request_id": req.router_id,
+                            "trace": (req.trace.to_header()
+                                      if req.trace is not None
+                                      else None)}},
                         503,
                         headers={"Retry-After":
                                  f"{max(1, round(outer.retry_after_s))}"})

@@ -1,0 +1,66 @@
+"""Span-name constants for the serving data plane.
+
+Every span or event the serving tier emits through the tracer is named
+HERE, once — mint sites reference these constants instead of string
+literals, exactly like metric names live in ``obs/names.py``.  A typo'd
+span name is then an AttributeError, not a silently-forked timeline,
+and graftlint rule RD006 (``bigdl_tpu/analysis/registry_rules.py``)
+flags any ``tracer.span(...)`` / ``.event(...)`` / ``.complete(...)``
+call in ``bigdl_tpu/serving/`` (or in a module importing this one)
+whose first argument is a string literal.
+
+Two families:
+
+* ``SPAN_*`` — the per-request lifecycle hops of the distributed
+  request trace (``obs/reqtrace.py``).  Each kept request trace is one
+  set of these spans sharing a ``trace`` attribute; ``report.py``'s
+  "request traces" section groups them by the hop key (the part after
+  ``req.``) for p99 attribution.
+* ``EVENT_*`` — point events the engine/simulator stamp regardless of
+  request tracing.
+"""
+
+from __future__ import annotations
+
+# ---------------------------------------------------- request-trace hops
+#: whole routed request, router-side (placement -> final answer)
+SPAN_ROUTE = "req.route"
+#: one placement decision (PlacementPolicy.choose + signals snapshot)
+SPAN_PLACEMENT = "req.placement"
+#: one budget-gated retry: the backoff wait before re-placement
+SPAN_RETRY = "req.retry"
+#: a drain-handoff replay being absorbed (claim + prompt refold)
+SPAN_HANDOFF = "req.handoff"
+#: submit -> first slot admission (queue wait in batcher.py)
+SPAN_QUEUE = "req.queue"
+#: one batched prefill forward (per admission, attrs carry the bucket)
+SPAN_PREFILL = "req.prefill"
+#: preemption refold: pages lost -> re-admitted (KV-pressure eviction)
+SPAN_PREEMPT = "req.preempt"
+#: aggregated per-token decode time (everything not queue/prefill/
+#: preempt inside the engine's e2e — exact partition, see engine.py)
+SPAN_DECODE = "req.decode"
+
+#: the hop keys the report attributes, in render order
+HOP_ORDER = ("queue", "placement", "retry", "prefill", "decode",
+             "preempt", "handoff", "route")
+
+# ------------------------------------------------------------ point events
+#: a request entered a decode slot (engine admission)
+EVENT_ADMIT = "serve.admit"
+#: a request was preempted off its slot (pages reclaimed)
+EVENT_PREEMPT = "serve.preempt"
+#: one chaos-scenario verdict (sim/serve.py)
+EVENT_SCENARIO = "serve.scenario"
+
+
+def hop_key(span_name: str) -> str:
+    """The attribution key of one request-trace span name
+    (``"req.prefill"`` -> ``"prefill"``; foreign names pass through)."""
+    return span_name[4:] if span_name.startswith("req.") else span_name
+
+
+__all__ = ["SPAN_ROUTE", "SPAN_PLACEMENT", "SPAN_RETRY", "SPAN_HANDOFF",
+           "SPAN_QUEUE", "SPAN_PREFILL", "SPAN_PREEMPT", "SPAN_DECODE",
+           "HOP_ORDER", "EVENT_ADMIT", "EVENT_PREEMPT", "EVENT_SCENARIO",
+           "hop_key"]

@@ -49,7 +49,9 @@ import random
 import time
 from typing import Dict, List, Optional
 
+from bigdl_tpu.obs import reqtrace
 from bigdl_tpu.resilience.retry import RetryBudget, backoff_delay
+from bigdl_tpu.serving import spans
 from bigdl_tpu.serving.drain import HandoffLedger
 from bigdl_tpu.serving.placement import (NoReplicaAvailable,
                                          PlacementPolicy, ReplicaView)
@@ -339,6 +341,11 @@ class ServeScenarioResult:
     p99_latency_s: Optional[float]
     budget: dict
     invariants: List[InvariantResult]
+    # buffered request traces of the requests that broke an invariant
+    # (lost / duplicated), dumped when tracing is on — the postmortem
+    # is IN the verdict, not a separate archaeology dig
+    offending_traces: List[dict] = dataclasses.field(
+        default_factory=list)
 
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)
@@ -397,6 +404,11 @@ def run_serve_scenario(spec, replicas: Optional[int] = None,
                              time_compression=time_compression)
     rng = random.Random(int(seed))
     clock = VirtualClock()
+    # request tracing (obs/reqtrace.py): span starts are VIRTUAL-clock
+    # stamps here — the value of a sim trace is its hop *sequence and
+    # durations* for invariant postmortems, not wall alignment
+    col = reqtrace.get_collector()
+    ctxs: Dict[str, object] = {}         # rid -> RequestTraceContext
     placement = PlacementPolicy(affinity_ttl_s=sc.affinity_ttl_s,
                                 kv_weight=sc.kv_weight, clock=clock)
     budget = RetryBudget(ratio=sc.budget_ratio, burst=sc.budget_burst)
@@ -439,27 +451,42 @@ def run_serve_scenario(spec, replicas: Optional[int] = None,
                 kv_frac=sig["kv_frac"])
         return out
 
-    def answer(req: _ClientReq):
+    def answer(req: _ClientReq, t: float):
         answers[req.rid] = answers.get(req.rid, 0) + 1
         live.pop(req.rid, None)
+        c = ctxs.pop(req.rid, None)
+        if c is not None:
+            e2e = max(0.0, t - req.arrival_t)
+            col.span(c, spans.SPAN_ROUTE, req.arrival_t, e2e,
+                     retries=req.attempts, replays=req.replayed)
+            col.finish(c, request=req.rid, retries=req.attempts,
+                       handoff=req.replayed > 0, e2e_s=e2e)
 
-    def shed(req: _ClientReq):
+    def shed(req: _ClientReq, t: float):
         counts["shed"] += 1
-        answer(req)
+        c = ctxs.pop(req.rid, None)
+        if c is not None:
+            col.finish(c, request=req.rid, error="shed",
+                       retries=req.attempts,
+                       e2e_s=max(0.0, t - req.arrival_t))
+        answer(req, t)
 
     def fail_attempt(req: _ClientReq, t: float):
         """One placement/attempt failed: budget-gated retry or shed."""
         if req.attempts >= sc.max_retries:
-            shed(req)
+            shed(req, t)
             return
         if not budget.try_spend():
-            shed(req)
+            shed(req, t)
             return
         counts["retries"] += 1
         req.attempts += 1
-        req.ready_t = t + backoff_delay(req.attempts,
-                                        base=sc.backoff_base_s,
-                                        cap=1.0, rng=rng)
+        delay = backoff_delay(req.attempts, base=sc.backoff_base_s,
+                              cap=1.0, rng=rng)
+        col.span(ctxs.get(req.rid), spans.SPAN_RETRY, t, delay,
+                 attempt=req.attempts,
+                 budget_tokens=round(budget.tokens(), 2))
+        req.ready_t = t + delay
         pending.append(req)
 
     def replay(rid: str, remaining_s: float, source: str, t: float):
@@ -473,6 +500,8 @@ def run_serve_scenario(spec, replicas: Optional[int] = None,
         if req is None:     # already answered (late checkpoint)
             return
         counts["handoff_replays"] += 1
+        col.span(ctxs.get(rid), spans.SPAN_HANDOFF, t, 0.0,
+                 source=source, remaining_s=round(remaining_s, 6))
         req.remaining_s = remaining_s
         req.replayed += 1
         req.tried = set()
@@ -523,6 +552,10 @@ def run_serve_scenario(spec, replicas: Optional[int] = None,
                 live[rid] = req
                 counts["requests"] += 1
                 budget.record_request()
+                if col.enabled:
+                    c = col.new_context()
+                    col.begin(c)
+                    ctxs[rid] = c
                 pending.append(req)
         # 3. placement pass over everything due
         due = [r for r in pending if r.ready_t <= t]
@@ -535,6 +568,8 @@ def run_serve_scenario(spec, replicas: Optional[int] = None,
             except NoReplicaAvailable:
                 fail_attempt(req, t)
                 continue
+            col.span(ctxs.get(req.rid), spans.SPAN_PLACEMENT, t, 0.0,
+                     replica=name, attempt=req.attempts)
             if fleet[name].admit(req.rid, req.remaining_s):
                 counts["backend_attempts"] += 1
                 outstanding[req.rid] = (name, t + sc.request_timeout_s)
@@ -552,7 +587,7 @@ def run_serve_scenario(spec, replicas: Optional[int] = None,
                 if req is not None:
                     latencies.append(t + dt - req.arrival_t)
                     counts["completed"] += 1
-                    answer(req)
+                    answer(req, t + dt)
         # 5. router-side timeouts: abandon the attempt, retry elsewhere
         #    (the zombie copy keeps grinding — its late completion is
         #    discarded by the ledger, never double-answered)
@@ -608,6 +643,22 @@ def run_serve_scenario(spec, replicas: Optional[int] = None,
         "slo_firing_at_end": slo["firing"],
     }
     invariants = check_serve_scenario(observed, sc.expect)
+    # invariant postmortem: when tracing is on and a conservation
+    # invariant broke, dump the buffered hop traces of the offending
+    # requests right into the verdict (lost = still live, never
+    # answered; duplicated = answered more than once)
+    offending: List[dict] = []
+    if col.enabled and not all(r.ok for r in invariants):
+        for rid in sorted(live)[:8]:
+            offending.append({
+                "request": rid, "state": "lost",
+                "spans": col.peek(ctxs.get(rid))})
+        for rid, n in sorted(answers.items()):
+            if n > 1 and len(offending) < 24:
+                entry = col.find(rid)
+                offending.append({
+                    "request": rid, "state": "duplicate", "answers": n,
+                    "spans": (entry or {}).get("spans", [])})
     lat = sorted(latencies)
 
     def pct(p):
@@ -640,11 +691,12 @@ def run_serve_scenario(spec, replicas: Optional[int] = None,
         p99_latency_s=pct(0.99),
         budget=budget.stats(),
         invariants=invariants,
+        offending_traces=offending,
     )
     from bigdl_tpu import obs
 
     obs.get_tracer().event(
-        "serve.scenario", scenario=result.name, ok=result.ok,
+        spans.EVENT_SCENARIO, scenario=result.name, ok=result.ok,
         replicas=result.replicas, requests=result.requests,
         completed=result.completed, shed=result.shed, lost=result.lost,
         duplicates=result.duplicates, retries=result.retries,

@@ -141,6 +141,20 @@
 #                                                # ROUTER_SMOKE.json for
 #                                                # BENCH extras.router
 #                                                # (no pytest)
+#   scripts/run-tests.sh --reqtrace              # request-tracing smoke: a
+#                                                # router over two live
+#                                                # engines with one rigged
+#                                                # slow replica, every trace
+#                                                # kept; routed tokens must
+#                                                # bit-match generate() and
+#                                                # the report's request-
+#                                                # traces section must blame
+#                                                # the slow decile on the
+#                                                # queue hop with >= 90%
+#                                                # attribution coverage;
+#                                                # banks REQTRACE_SMOKE.json
+#                                                # for BENCH extras.reqtrace
+#                                                # (no pytest)
 #   scripts/run-tests.sh --lint                  # graftlint static analysis:
 #                                                # JAX hazards (JX*), lock
 #                                                # discipline (CC*), config/
@@ -238,6 +252,9 @@ elif [[ "${1:-}" == "--serve" ]]; then
 elif [[ "${1:-}" == "--router" ]]; then
   shift
   exec python scripts/router_smoke.py "$@"
+elif [[ "${1:-}" == "--reqtrace" ]]; then
+  shift
+  exec python scripts/reqtrace_smoke.py "$@"
 fi
 
 # tier-1 wall clock is budgeted (ROADMAP: 870s) — print where the suite

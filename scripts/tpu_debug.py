@@ -93,8 +93,17 @@ BIGDL_SERVE_DECODE_BUCKET off or decode_attn pinned to "dense" means
 you are paying the full-pool gather tax — enable BIGDL_TUNER=1 so the
 cached decode_attn site dispatches the fused/Pallas flash-decode path
 (pre-warm with autotune.prewarm_decode_attn; MIGRATION.md "Decode
-kernels").  See MIGRATION.md "Inference serving" and
-``scripts/run-tests.sh --serve`` for the end-to-end smoke.
+kernels").  A P99 REGRESSION you cannot place from aggregates alone
+reads the report's "request traces" section next (run with
+BIGDL_REQTRACE_SAMPLE > 0): the slowest decile's per-hop breakdown
+(queue / prefill / preempt / decode / placement / retry / handoff)
+names the guilty hop, latency-histogram exemplars link a bucket spike
+to a kept trace_id, and ``GET /trace?request=<id>`` on the obs server
+returns that request's full span list (anomalous requests — errored,
+retried, preempted, handed off, SLO-violating — are always kept; see
+MIGRATION.md "Request tracing").  See MIGRATION.md "Inference
+serving" and ``scripts/run-tests.sh --serve`` for the end-to-end
+smoke.
 
 A run you need to watch RIGHT NOW (not post-mortem) has the live
 telemetry plane: export ``BIGDL_OBS_PORT`` and curl the host's

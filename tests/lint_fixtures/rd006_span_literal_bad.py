@@ -1,0 +1,10 @@
+"""Seeded RD006: span/event names minted from string literals in a
+module that opted into the serving span-name registry (imports
+``bigdl_tpu.serving.spans``)."""
+from bigdl_tpu.serving import spans  # noqa: F401 — opts into RD006
+
+
+def route(col, ctx, tracer, t):
+    col.span(ctx, "req.placement", t, 0.0, replica="r0")    # RD006
+    tracer.event("serve.admit", slot=1)                     # RD006
+    tracer.complete("req.route", t, 0.5)                    # RD006

@@ -1,0 +1,17 @@
+"""Clean twin of rd006: every span/event named from the
+``serving/spans.py`` constants; unrelated ``.span()`` calls (regex
+match objects) with non-string arguments stay out of scope."""
+import re
+
+from bigdl_tpu.serving import spans
+
+
+def route(col, ctx, tracer, t):
+    col.span(ctx, spans.SPAN_PLACEMENT, t, 0.0, replica="r0")
+    tracer.event(spans.EVENT_ADMIT, slot=1)
+    tracer.complete(spans.SPAN_ROUTE, t, 0.5)
+
+
+def unrelated(text):
+    m = re.search(r"\d+", text)
+    return m.span(0) if m else None

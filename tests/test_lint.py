@@ -37,6 +37,7 @@ PAIRS = [
     ("rd002_raw_env_read", "RD002", True),  # library context
     ("rd003_metric_drift", "RD003", "auto"),
     ("rd005_shape_mismatch", "RD005", "auto"),
+    ("rd006_span_literal", "RD006", "auto"),
 ]
 
 
