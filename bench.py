@@ -662,6 +662,24 @@ def _fleet_extras():
         return None
 
 
+def _fleetobs_extras():
+    """Fleet-metrics-pipeline evidence for the BENCH JSON: the newest
+    ``FLEETOBS_SMOKE.json`` banked by scripts/fleetobs_smoke.py (the
+    hierarchical-vs-flat exactness, cardinality/memory-bound and
+    staleness-exclusion invariant verdicts at 1000 simulated hosts,
+    plus the bounded scrape-pool wall and retention-store replay
+    counts).  None when the smoke has never been run."""
+    try:
+        smoke = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "FLEETOBS_SMOKE.json")
+        if not os.path.exists(smoke):
+            return None
+        with open(smoke, "r", encoding="utf-8") as fh:
+            return {"smoke": json.load(fh)}
+    except Exception:
+        return None
+
+
 def _router_extras():
     """Serving-router evidence for the BENCH JSON: the newest
     ``ROUTER_SMOKE.json`` banked by scripts/router_smoke.py (the three
@@ -1062,6 +1080,9 @@ def _run_child(platform: str):
     fleet = _fleet_extras()
     if fleet is not None:
         ex["fleet"] = fleet
+    fleetobs = _fleetobs_extras()
+    if fleetobs is not None:
+        ex["fleetobs"] = fleetobs
     router = _router_extras()
     if router is not None:
         ex["router"] = router

@@ -30,6 +30,15 @@ pin both registries closed:
   literal instead of a ``serving/spans.py`` constant; a typo'd literal
   silently forks the request-trace timeline the same way a typo'd
   metric name forks a dashboard.
+* **RD007 missing-or-illegal-fleet-policy** — every family in
+  ``obs/names.py`` must carry a legal fleet aggregation policy for the
+  hierarchical rollup tier (``obs/rollup.py``): counters and
+  histograms are additive (``sum`` only — declaring anything else is
+  flagged), while a gauge must *explicitly* pick ``max``/``min``/
+  ``last``.  A ``sum`` gauge is almost always a unit error (summing
+  ratios, summing per-host clocks); the rare legitimate one — a count
+  published as a gauge — opts in with an inline
+  ``# graftlint: disable=RD007``.
 
 Env var *writes* are exempt everywhere: exporting ``BIGDL_*`` into a
 child's environment is the supervisor/harness contract.
@@ -54,8 +63,15 @@ RULES = {
     "RD005": "mint site disagrees with the declared metric kind/labels",
     "RD006": "serving span/event named by a string literal "
              "(use bigdl_tpu/serving/spans.py constants)",
+    "RD007": "metric family missing a legal fleet aggregation policy "
+             "(gauges must declare max/min/last; sum gauges opt in)",
 }
 core.ALL_RULES.update(RULES)
+
+#: the fleet-policy vocabulary (mirrors obs/names.py POLICIES) and the
+#: subset a gauge may declare without an explicit RD007 opt-in
+_POLICIES = ("sum", "max", "min", "last")
+_GAUGE_POLICIES = ("max", "min", "last")
 
 # metric-name shape: no trailing/double underscore (tempdir prefixes
 # like "bigdl_serve_smoke_" are spellings, not families)
@@ -73,13 +89,15 @@ def _pkg_root() -> str:
 
 
 class _DeclaredMetric:
-    def __init__(self, name, kind, labels, const, line, doc):
+    def __init__(self, name, kind, labels, const, line, doc,
+                 policy=None):
         self.name = name
         self.kind = kind
         self.labels = labels
         self.const = const
         self.line = line
         self.doc = doc
+        self.policy = policy
 
 
 def parse_config_declarations(path: str) -> Tuple[Set[str], Set[str]]:
@@ -138,11 +156,14 @@ def parse_names_registry(path: str) -> Tuple[Dict[str, _DeclaredMetric],
         kind = str_const(call.args[1]) if len(call.args) > 1 else None
         labels: Tuple[str, ...] = ()
         doc = ""
+        policy = None
         if len(call.args) > 2 and isinstance(call.args[2],
                                              (ast.Tuple, ast.List)):
             labels = tuple(str_const(e) or "" for e in call.args[2].elts)
         if len(call.args) > 4:
             doc = str_const(call.args[4]) or ""
+        if len(call.args) > 5:
+            policy = str_const(call.args[5])
         for kw in call.keywords:
             if kw.arg == "labels" and isinstance(kw.value,
                                                  (ast.Tuple, ast.List)):
@@ -151,8 +172,10 @@ def parse_names_registry(path: str) -> Tuple[Dict[str, _DeclaredMetric],
                 doc = str_const(kw.value) or ""
             elif kw.arg == "kind":
                 kind = str_const(kw.value)
+            elif kw.arg == "policy":
+                policy = str_const(kw.value)
         declared[name] = _DeclaredMetric(name, kind, labels, const,
-                                         node.lineno, doc)
+                                         node.lineno, doc, policy)
     return declared, known
 
 
@@ -175,6 +198,7 @@ class RegistryRules:
             self.config_path)
         self.metrics, self.known_strings = parse_names_registry(
             self.names_path)
+        self._names_lines: Optional[List[str]] = None
 
     # --------------------------------------------------------- helpers
     def _metric_declared(self, name: str) -> bool:
@@ -414,17 +438,26 @@ class RegistryRules:
         return findings
 
     # -------------------------------------------------------- finalize
+    def _names_rel(self) -> str:
+        """The registry's path as findings (and inline suppressions)
+        see it: cut at the ``bigdl_tpu`` package component, else
+        repo-root-relative (fixture registries under ``tests/``)."""
+        names_rel = self.names_path.replace(os.sep, "/")
+        parts = names_rel.split("/")
+        for i, part in enumerate(parts):
+            if part == "bigdl_tpu":
+                return "/".join(parts[i:])
+        return os.path.relpath(
+            self.names_path,
+            os.path.dirname(_pkg_root())).replace(os.sep, "/")
+
     def finalize(self) -> List[Finding]:
         findings = []
         report_text = ""
         if os.path.exists(self.report_path):
             with open(self.report_path, encoding="utf-8") as fh:
                 report_text = fh.read()
-        names_rel = self.names_path.replace(os.sep, "/")
-        for i, part in enumerate(names_rel.split("/")):
-            if part == "bigdl_tpu":
-                names_rel = "/".join(names_rel.split("/")[i:])
-                break
+        names_rel = self._names_rel()
         for spec in sorted(self.metrics.values(), key=lambda s: s.line):
             rendered = (spec.name in report_text
                         or spec.const in report_text)
@@ -434,4 +467,64 @@ class RegistryRules:
                     f"{spec.name} is declared but neither rendered by "
                     "obs/report.py nor documented (doc=...) — an "
                     "operator can't discover what it means"))
+            findings.extend(self._check_policy(spec, names_rel))
         return findings
+
+    def _rd007_suppressed(self, line: int) -> bool:
+        """Inline ``# graftlint: disable=RD007`` on the declaration (or
+        the line above) — honored here because the registry file is
+        usually *not* among the linted modules, so the core suppression
+        pass never sees its comments."""
+        if self._names_lines is None:
+            try:
+                with open(self.names_path, encoding="utf-8") as fh:
+                    self._names_lines = fh.read().splitlines()
+            except OSError:
+                self._names_lines = []
+        for ln in (line, line - 1):
+            if not 1 <= ln <= len(self._names_lines):
+                continue
+            m = core._DIRECTIVE_RE.search(self._names_lines[ln - 1])
+            if m and m.group(1) == "disable":
+                rules = core._directive_rules(m)
+                if rules is None or "RD007" in rules:
+                    return True
+        return False
+
+    def _check_policy(self, spec, names_rel: str) -> List[Finding]:
+        """RD007: the fleet aggregation policy contract every family
+        must satisfy before the rollup tier may merge it."""
+        if spec.kind not in ("counter", "gauge", "histogram"):
+            return []  # kind errors are names.py's own ValueError
+        if self._rd007_suppressed(spec.line):
+            return []
+        p = spec.policy
+        if spec.kind in ("counter", "histogram"):
+            if p is not None and p != "sum":
+                return [Finding(
+                    "RD007", names_rel, spec.line,
+                    f"{spec.name}: a {spec.kind} merges additively "
+                    f"across the fleet — policy {p!r} is illegal "
+                    "(omit it or declare 'sum')")]
+            return []
+        # gauges: an explicit, legal policy is the whole point
+        if p is None:
+            return [Finding(
+                "RD007", names_rel, spec.line,
+                f"{spec.name}: gauge declares no fleet aggregation "
+                "policy — the rollup tier cannot guess whether the "
+                "fleet value is the max, min or newest host; declare "
+                "policy='max'|'min'|'last'")]
+        if p not in _POLICIES:
+            return [Finding(
+                "RD007", names_rel, spec.line,
+                f"{spec.name}: unknown fleet policy {p!r} "
+                f"(legal: {', '.join(_POLICIES)})")]
+        if p not in _GAUGE_POLICIES:
+            return [Finding(
+                "RD007", names_rel, spec.line,
+                f"{spec.name}: policy='sum' on a gauge is almost "
+                "always a unit error (summing ratios or clocks); if "
+                "this gauge really is an additive count, opt in with "
+                "an inline '# graftlint: disable=RD007'")]
+        return []

@@ -158,6 +158,33 @@ class ObsConfig:
     # counter can never crash a serving fleet [BIGDL_OBS_STRICT]
     strict: bool = False
 
+    # ---- fleet-scale metrics pipeline (obs/rollup.py, obs/retain.py)
+    # report --watch host table cap: render only the worst-K hosts by
+    # gating signal (queue depth / step age / status), with a trailing
+    # "... and N more hosts" line [BIGDL_WATCH_HOSTS]
+    watch_hosts: int = 16
+    # hosts per leaf RollupAggregator when assembling a tiered
+    # pipeline (rollup.build_tiers); ~sqrt(fleet) keeps root and leaf
+    # fan-in balanced [BIGDL_ROLLUP_SHARD]
+    rollup_shard: int = 32
+    # per-family label-cardinality bound on a rollup's merged
+    # exposition: keep the top-K series by value, fold the rest into
+    # an 'other' bucket (counted in
+    # bigdl_rollup_series_dropped_total); <= 0 disables the bound
+    # [BIGDL_ROLLUP_TOP_K]
+    rollup_top_k: int = 64
+    # staleness threshold: an ok peer whose /healthz clock skews from
+    # the scraper's clock by more than this is excluded from fleet
+    # merges and accounted in bigdl_fleet_stale_hosts; <= 0 disables
+    # skew-based staleness [BIGDL_STALE_AFTER_S]
+    stale_after_s: float = 30.0
+    # retention store (obs/retain.py): points kept per downsampling
+    # ring (raw / 10s / 1m) per series [BIGDL_RETAIN_POINTS]
+    retain_points: int = 240
+    # retention store hard series budget: past it, new series are
+    # rejected (memory stays fixed) [BIGDL_RETAIN_SERIES]
+    retain_series: int = 512
+
     @property
     def active(self) -> bool:
         return bool(self.enabled or self.trace_dir or self.metrics_dir
@@ -189,6 +216,12 @@ class ObsConfig:
             reqtrace_sample=_env_float("BIGDL_REQTRACE_SAMPLE", 0.0),
             reqtrace_ring=_env_int("BIGDL_REQTRACE_RING", 256),
             strict=_env_bool("BIGDL_OBS_STRICT", False),
+            watch_hosts=_env_int("BIGDL_WATCH_HOSTS", 16),
+            rollup_shard=_env_int("BIGDL_ROLLUP_SHARD", 32),
+            rollup_top_k=_env_int("BIGDL_ROLLUP_TOP_K", 64),
+            stale_after_s=_env_float("BIGDL_STALE_AFTER_S", 30.0),
+            retain_points=_env_int("BIGDL_RETAIN_POINTS", 240),
+            retain_series=_env_int("BIGDL_RETAIN_SERIES", 512),
         )
 
 

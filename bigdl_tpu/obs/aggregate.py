@@ -369,14 +369,24 @@ class FleetAggregator:
     ``snapshot()`` returns ``{mode, hosts: {host: {status, step,
     step_age_s, goodput_ratio, alerts, source}}, alerts: [...],
     metrics: {name: [{labels, value, source}]}, errors: {source:
-    reason}}`` — what ``report --watch`` renders and the ROADMAP's
-    autoscaling policy loop will read.  ``fetch`` is injectable for
-    tests (no sockets)."""
+    reason}, stale: {source: reason}}`` — what ``report --watch``
+    renders and the autoscaling policy loop reads.  ``fetch`` is
+    injectable for tests (no sockets); ``clock`` is injectable so the
+    sims run staleness detection on virtual time.
+
+    Staleness contract: every ok peer's ``/healthz`` ``time`` is
+    compared against this scraper's clock; a skew past
+    ``stale_after_s`` (``BIGDL_STALE_AFTER_S``) flags the host stale —
+    its metrics are *excluded* from ``snapshot()``/rollup merges and
+    *accounted* in ``bigdl_fleet_stale_hosts``, never silently folded
+    into fleet percentiles.  Failed scrapes count stale the same way."""
 
     def __init__(self, peers=None, metrics_dir: Optional[str] = None,
                  fetch=None, timeout_s: float = 2.0,
                  max_workers: int = 16,
-                 retry_budget: Optional[RetryBudget] = None):
+                 retry_budget: Optional[RetryBudget] = None,
+                 stale_after_s: Optional[float] = None,
+                 clock=None):
         if isinstance(peers, str):
             peers = [p.strip() for p in peers.split(",") if p.strip()]
         self.peers = list(peers or [])
@@ -384,6 +394,16 @@ class FleetAggregator:
         self.timeout_s = float(timeout_s)
         self.max_workers = max(1, int(max_workers))
         self.last_scrape_s: Optional[float] = None
+        self.last_stale: dict = {}
+        self._clock = clock or time.time
+        if stale_after_s is None:
+            try:
+                from bigdl_tpu.config import refresh_from_env
+
+                stale_after_s = refresh_from_env().obs.stale_after_s
+            except Exception:  # noqa: BLE001 — config must not sink this
+                stale_after_s = 30.0
+        self.stale_after_s = float(stale_after_s)
         self._fetch = fetch or self._http_fetch
         # the serving router's shared token bucket, reused here: one
         # flaky peer gets a second chance, a partitioned fleet does NOT
@@ -428,9 +448,11 @@ class FleetAggregator:
         to single attempts instead of doubling the cycle."""
         base = addr if addr.startswith("http") else f"http://{addr}"
         out = {"addr": addr, "ok": False, "health": None, "metrics": None}
+        t0 = time.perf_counter()
         self.retry_budget.record_request()
         try:
             self._scrape_once(base, out)
+            out["latency_s"] = time.perf_counter() - t0
             return out
         except Exception as e:  # noqa: BLE001 — a dead peer is data
             out["error"] = f"{type(e).__name__}: {e}"
@@ -441,7 +463,71 @@ class FleetAggregator:
                 out.pop("error", None)
             except Exception as e:  # noqa: BLE001 — still down
                 out["error"] = f"{type(e).__name__}: {e}"
+        out["latency_s"] = time.perf_counter() - t0
         return out
+
+    @staticmethod
+    def _error_reason(error: Optional[str]) -> str:
+        """Fold a scrape error string into the bounded ``reason`` label
+        of ``bigdl_fleet_scrape_errors_total``."""
+        e = (error or "").lower()
+        if "timeout" in e:
+            return "timeout"
+        if "refused" in e or "connection" in e:
+            return "refused"
+        if "valueerror" in e or "jsondecode" in e or "exposition" in e:
+            return "protocol"
+        return "error"
+
+    def _classify_stale(self, scraped: List[dict]) -> None:
+        """Annotate each scrape result with ``stale``/``stale_reason``
+        (skewed clock past ``stale_after_s``, or a failed scrape) and
+        publish the pipeline's meta-observability: per-host scrape
+        latency and staleness gauges, error-reason counters, the
+        excluded-host count."""
+        from bigdl_tpu import obs
+
+        reg = obs.get_registry()
+        lat = reg.gauge(names.FLEET_SCRAPE_LATENCY_SECONDS,
+                        names.spec(
+                            names.FLEET_SCRAPE_LATENCY_SECONDS).doc,
+                        labels=("host",))
+        skew_g = reg.gauge(names.FLEET_HOST_STALENESS_SECONDS,
+                           names.spec(
+                               names.FLEET_HOST_STALENESS_SECONDS).doc,
+                           labels=("host",))
+        errs = reg.counter(names.FLEET_SCRAPE_ERRORS_TOTAL,
+                           names.spec(
+                               names.FLEET_SCRAPE_ERRORS_TOTAL).doc,
+                           labels=("reason",))
+        now = self._clock()
+        stale: dict = {}
+        for peer in scraped:
+            addr = peer.get("addr", "?")
+            if peer.get("latency_s") is not None:
+                lat.labels(host=addr).set(peer["latency_s"])
+            if not peer.get("ok"):
+                reason = self._error_reason(peer.get("error"))
+                errs.labels(reason=reason).inc()
+                peer["stale"] = True
+                peer["stale_reason"] = reason
+                stale[addr] = peer.get("error") or reason
+                continue
+            peer["stale"] = False
+            h = peer.get("health") or {}
+            t_host = h.get("time")
+            if t_host is None:
+                continue
+            skew = abs(now - float(t_host))
+            skew_g.labels(host=addr).set(skew)
+            if self.stale_after_s > 0 and skew > self.stale_after_s:
+                peer["stale"] = True
+                peer["stale_reason"] = f"clock skew {skew:.1f}s"
+                stale[addr] = peer["stale_reason"]
+        self.last_stale = stale
+        reg.gauge(names.FLEET_STALE_HOSTS,
+                  names.spec(names.FLEET_STALE_HOSTS).doc).set(
+            len(stale))
 
     def scrape_peers(self, addrs) -> List[dict]:
         """One scrape cycle over ``addrs``, concurrently on a bounded
@@ -474,17 +560,21 @@ class FleetAggregator:
             names.FLEET_SCRAPE_SECONDS,
             "Wall seconds of the last full fleet peer-scrape cycle"
         ).set(self.last_scrape_s)
+        self._classify_stale(out)
         return out
 
     # --------------------------------------------------------- snapshot
     def snapshot(self) -> dict:
         fleet = {"mode": "peers" if self.peers else "shards",
-                 "hosts": {}, "alerts": [], "metrics": {}, "errors": {}}
+                 "hosts": {}, "alerts": [], "metrics": {}, "errors": {},
+                 "stale": {}}
         if self.peers:
             for scraped in self.scrape_peers(self.peers):
                 addr = scraped["addr"]
                 if not scraped["ok"]:
                     fleet["errors"][addr] = scraped.get("error", "down")
+                    fleet["stale"][addr] = scraped.get(
+                        "stale_reason", "down")
                     continue
                 h = scraped["health"] or {}
                 host = h.get("host", addr)
@@ -496,6 +586,15 @@ class FleetAggregator:
                     "alerts": h.get("alerts") or [],
                     "heartbeat": h.get("heartbeat"), "source": addr}
                 fleet["hosts"][str(host)] = entry
+                if scraped.get("stale"):
+                    # skewed clock: the host row stays visible (flagged)
+                    # but its samples never reach the fleet merge — a
+                    # stale host pollutes no percentile
+                    entry["status"] = "stale"
+                    entry["stale"] = True
+                    fleet["stale"][addr] = scraped.get(
+                        "stale_reason", "stale")
+                    continue
                 for a in h.get("alerts") or []:
                     fleet["alerts"].append(dict(a, host=host))
                 for s in scraped["metrics"]["samples"]:
@@ -532,6 +631,8 @@ class FleetAggregator:
                             entry["alerts"].append({"rule": rule})
                             fleet["alerts"].append(
                                 {"rule": rule, "host": host})
+        fleet["n_hosts"] = len(fleet["hosts"])
+        fleet["scrape_s"] = self.last_scrape_s
         return fleet
 
 

@@ -517,6 +517,66 @@ def parse_prometheus(text: str) -> dict:
     return {"families": families, "samples": samples}
 
 
+def _base_family(name: str, families: dict) -> str:
+    """The family a sample line belongs to for HELP/TYPE grouping —
+    histogram ``_bucket``/``_sum``/``_count`` samples group under the
+    declared histogram family, everything else under itself."""
+    for suffix in ("_bucket", "_sum", "_count"):
+        if name.endswith(suffix):
+            base = name[: -len(suffix)]
+            if families.get(base, {}).get("type") == "histogram":
+                return base
+    return name
+
+
+def render_exposition(parsed: dict) -> str:
+    """Render :func:`parse_prometheus` output (or anything of the same
+    shape) back to text exposition format — the writer half of the
+    reader, used by the rollup tier to re-expose a policy-merged fleet
+    of parsed scrapes as ONE ``/metrics`` body.
+
+    Round-trip contract: ``parse_prometheus(render_exposition(p))``
+    preserves every family type/help, every sample (name, labels,
+    value) and every exemplar — so a root aggregator scraping a leaf
+    rollup sees exactly what the leaf merged, bit for bit through
+    :func:`_fmt`."""
+    families = parsed.get("families") or {}
+    by_family: Dict[str, list] = {}
+    order: list = []
+    for s in parsed.get("samples") or []:
+        base = _base_family(s["name"], families)
+        if base not in by_family:
+            by_family[base] = []
+            order.append(base)
+        by_family[base].append(s)
+    # families with declared type/help but no samples still expose
+    # their header lines (a scraper learns the family exists)
+    for name in families:
+        if name not in by_family:
+            by_family[name] = []
+            order.append(name)
+    lines = []
+    for base in sorted(order):
+        meta = families.get(base) or {}
+        lines.append(
+            f"# HELP {base} {_escape_help(meta.get('help') or base)}")
+        lines.append(f"# TYPE {base} {meta.get('type') or 'untyped'}")
+        for s in by_family[base]:
+            pairs = [f'{k}="{_escape(v)}"'
+                     for k, v in (s.get("labels") or {}).items()]
+            body = "{" + ",".join(pairs) + "}" if pairs else ""
+            line = f"{s['name']}{body} {_fmt(s['value'])}"
+            ex = s.get("exemplar")
+            if ex is not None:
+                exl = ",".join(f'{k}="{_escape(v)}"'
+                               for k, v in (ex.get("labels") or {}).items())
+                line += f" # {{{exl}}} {_fmt(ex['value'])}"
+                if ex.get("ts") is not None:
+                    line += f" {float(ex['ts']):.3f}"
+            lines.append(line)
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
 def sample_value(parsed: dict, name: str, **labels) -> Optional[float]:
     """First sample named ``name`` whose labels contain ``labels`` (a
     convenience over :func:`parse_prometheus` output)."""

@@ -2,9 +2,10 @@
 
 Every metric family the framework mints — counters, gauges, histograms,
 across obs/serving/resilience/optim/ops/dataset — is declared HERE,
-once, with its kind, label names, a label-cardinality ceiling and a
-one-line doc.  Mint sites reference these constants instead of string
-literals, which buys three guarantees:
+once, with its kind, label names, a label-cardinality ceiling, a
+one-line doc and a **fleet aggregation policy**.  Mint sites reference
+these constants instead of string literals, which buys three
+guarantees:
 
 * a typo'd or ad-hoc metric name is an ImportError / lint failure, not
   a silently-forked time series;
@@ -14,20 +15,38 @@ literals, which buys three guarantees:
   its declared label cardinality — the runtime enforcement of the same
   contract;
 * ``graftlint`` rule RD003/RD005 (``bigdl_tpu/analysis``) statically
-  pins every mint site in the tree to this registry, and RD004 requires
-  each declared name to be rendered by ``obs/report.py`` or documented.
+  pins every mint site in the tree to this registry, RD004 requires
+  each declared name to be rendered by ``obs/report.py`` or documented,
+  and RD007 requires each family's fleet aggregation policy to be a
+  legal policy/kind pair.
 
 The ``cardinality`` ceiling is the maximum number of label-value
 combinations (children) the family may grow: a scrape surface is only
 as cheap as its widest family, and an unbounded label (request id,
 float bucket, raw exception text) is the classic way a registry eats
 the host.  Label-less families have ceiling 1.
+
+The ``policy`` is how a fleet tier (``obs/rollup.py``) folds one family
+across hosts into a single merged sample per label set:
+
+* ``sum`` — counters and histogram buckets, always (cumulative bucket
+  counts sum exactly, so a fleet quantile derived from merged buckets
+  is bit-identical to the flat merge — the rollup correctness
+  invariant).  A ``sum`` **gauge** is legal only as an explicit opt-in
+  (an additive level like a queue depth or a replica count), marked
+  with an inline ``# graftlint: disable=RD007`` — by default a summed
+  gauge is the classic fleet-dashboard lie (a "p99" that is really a
+  sum of p99s).
+* ``max`` / ``min`` — worst-host semantics (ages, norms, depths /
+  floors like goodput and SLO ratios).
+* ``last`` — whole-fleet constants where any live host's value is the
+  fleet value (static per-step byte footprints, plan shapes).
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 
 @dataclasses.dataclass(frozen=True)
@@ -39,6 +58,7 @@ class MetricSpec:
     labels: Tuple[str, ...]      # declared label names, order-free
     cardinality: int             # max label-value combinations
     doc: str                     # one-line purpose (RD004 contract)
+    policy: str = "sum"          # fleet aggregation policy (RD007)
 
 
 #: name -> :class:`MetricSpec` for every declared family
@@ -46,45 +66,65 @@ REGISTRY: Dict[str, MetricSpec] = {}
 
 _KINDS = ("counter", "gauge", "histogram")
 
+#: legal fleet aggregation policies (RD007 contract)
+POLICIES = ("sum", "max", "min", "last")
+
+#: policies a gauge may declare without a lint opt-in
+GAUGE_POLICIES = ("max", "min", "last")
+
 
 def _m(name: str, kind: str, labels: Tuple[str, ...] = (),
-       cardinality: int = 1, doc: str = "") -> str:
+       cardinality: int = 1, doc: str = "",
+       policy: Optional[str] = None) -> str:
     if kind not in _KINDS:
         raise ValueError(f"{name}: bad kind {kind!r}")
     if name in REGISTRY:
         raise ValueError(f"duplicate metric declaration {name!r}")
     if labels and cardinality <= 1:
         raise ValueError(f"{name}: labeled metric needs a ceiling > 1")
+    if policy is None:
+        # counters and histogram buckets merge additively by
+        # definition; a gauge has no defensible default
+        if kind == "gauge":
+            raise ValueError(f"{name}: gauge needs an explicit fleet "
+                             f"aggregation policy (one of {POLICIES})")
+        policy = "sum"
+    if policy not in POLICIES:
+        raise ValueError(f"{name}: bad policy {policy!r} "
+                         f"(one of {POLICIES})")
+    if kind in ("counter", "histogram") and policy != "sum":
+        raise ValueError(f"{name}: {kind} families merge by 'sum' "
+                         f"only, got policy {policy!r}")
     REGISTRY[name] = MetricSpec(name, kind, tuple(labels),
-                                int(cardinality), doc)
+                                int(cardinality), doc, policy)
     return name
 
 
 # --------------------------------------------------------------- runtime
 STEP_TIME_SECONDS = _m(
     "bigdl_step_time_seconds", "gauge", ("quantile",), 4,
-    "Observed train-step completion time percentiles")
+    "Observed train-step completion time percentiles", policy="max")
 JIT_COMPILE_COUNT = _m(
-    "bigdl_jit_compile_count", "gauge",
+    "bigdl_jit_compile_count", "gauge", policy="max",
     doc="Distinct jit compile events (new arg signatures)")
 JIT_COMPILE_SECONDS_TOTAL = _m(
-    "bigdl_jit_compile_seconds_total", "gauge",
+    "bigdl_jit_compile_seconds_total", "gauge", policy="max",
     doc="Wall seconds spent blocked on jit trace+compile")
 STEP_FLOPS = _m(
-    "bigdl_step_flops", "gauge",
+    "bigdl_step_flops", "gauge", policy="last",
     doc="HLO cost-analysis FLOPs of one compiled train step")
 MFU = _m(
-    "bigdl_mfu", "gauge",
+    "bigdl_mfu", "gauge", policy="min",
     doc="Model FLOPs utilization vs the chip's peak")
 HOST_RSS_BYTES = _m(
-    "bigdl_host_rss_bytes", "gauge",
+    "bigdl_host_rss_bytes", "gauge", policy="max",
     doc="Driver-process resident set size")
 DEVICE_MEMORY_BYTES = _m(
     "bigdl_device_memory_bytes", "gauge", ("stat",), 16,
-    "Device 0 memory stats, per allocator stat")
+    "Device 0 memory stats, per allocator stat", policy="max")
 HBM_PEAK_BYTES = _m(
     "bigdl_hbm_peak_bytes", "gauge", ("device",), 64,
-    "Peak HBM bytes in use, per local device")
+    "Peak HBM bytes in use, per local device", policy="max")
 ENGINE_INITS_TOTAL = _m(
     "bigdl_engine_inits_total", "counter",
     doc="Engine.init calls in this process")
@@ -94,13 +134,13 @@ PHASE_SECONDS = _m(
     "bigdl_phase_seconds", "histogram", ("phase",), 24,
     "Driver phase timers (the reference's optim.Metrics)")
 OVERLAP_BUCKETS = _m(
-    "bigdl_overlap_buckets", "gauge",
+    "bigdl_overlap_buckets", "gauge", policy="last",
     doc="Gradient-exchange buckets in the overlap plan")
 OVERLAP_EXPOSED_COMM_FRACTION = _m(
-    "bigdl_overlap_exposed_comm_fraction", "gauge",
+    "bigdl_overlap_exposed_comm_fraction", "gauge", policy="max",
     doc="Exposed (non-overlapped) comm seconds / step seconds")
 OVERLAP_EXPOSED_COMM_SECONDS = _m(
-    "bigdl_overlap_exposed_comm_seconds", "gauge",
+    "bigdl_overlap_exposed_comm_seconds", "gauge", policy="max",
     doc="Exposed comm seconds per step after overlap")
 RETRY_ATTEMPTS_TOTAL = _m(
     "bigdl_retry_attempts_total", "counter",
@@ -142,24 +182,26 @@ COLLECTIVE_BYTES_TOTAL = _m(
     "Wire bytes programmed into collectives, from static shapes")
 COLLECTIVE_BYTES_PER_STEP = _m(
     "bigdl_collective_bytes_per_step", "gauge", ("op", "dtype"), 64,
-    "Static per-train-step wire bytes of the collective footprint")
+    "Static per-train-step wire bytes of the collective footprint",
+    policy="last")
 COLLECTIVE_WIRE_SAVINGS_RATIO = _m(
     "bigdl_collective_wire_savings_ratio", "gauge", ("path",), 8,
-    "Uncompressed exchange bytes over what the wire actually ships")
+    "Uncompressed exchange bytes over what the wire actually ships",
+    policy="min")
 
 # --------------------------------------------------------------- goodput
 GOODPUT_RATIO = _m(
-    "bigdl_goodput_ratio", "gauge",
+    "bigdl_goodput_ratio", "gauge", policy="min",
     doc="Productive step seconds over total accounted wall seconds")
 GOODPUT_WINDOW_RATIO = _m(
-    "bigdl_goodput_window_ratio", "gauge",
+    "bigdl_goodput_window_ratio", "gauge", policy="min",
     doc="Good share of the last classifier window's wall clock")
 BADPUT_SECONDS_TOTAL = _m(
     "bigdl_badput_seconds_total", "counter", ("cause",), 16,
     "Non-productive wall seconds, by cause (goodput ledger)")
 BOTTLENECK = _m(
     "bigdl_bottleneck", "gauge", ("class",), 8,
-    "One-hot per-window bottleneck classification")
+    "One-hot per-window bottleneck classification", policy="max")
 REWORK_STEPS_TOTAL = _m(
     "bigdl_rework_steps_total", "counter",
     doc="Steps re-executed after a restart")
@@ -170,13 +212,13 @@ STRAGGLER_STEPS_TOTAL = _m(
 # --------------------------------------------------------------- health
 GRAD_NORM = _m(
     "bigdl_grad_norm", "gauge", ("layer",), 4096,
-    "Per-layer gradient norm (BIGDL_HEALTH_EVERY)")
+    "Per-layer gradient norm (BIGDL_HEALTH_EVERY)", policy="max")
 PARAM_NORM = _m(
     "bigdl_param_norm", "gauge", ("layer",), 4096,
-    "Per-layer parameter norm")
+    "Per-layer parameter norm", policy="max")
 UPDATE_RATIO = _m(
     "bigdl_update_ratio", "gauge", ("layer",), 4096,
-    "Per-layer update-to-param norm ratio")
+    "Per-layer update-to-param norm ratio", policy="max")
 GLOBAL_GRAD_NORM = _m(
     "bigdl_global_grad_norm", "histogram",
     doc="Global gradient norm distribution")
@@ -196,7 +238,7 @@ ALERTS_RESOLVED_TOTAL = _m(
     "Alert resolved transitions, by rule")
 ALERT_ACTIVE = _m(
     "bigdl_alert_active", "gauge", ("rule",), 64,
-    "1 while the rule is firing, 0 otherwise")
+    "1 while the rule is firing, 0 otherwise", policy="max")
 ALERT_SINK_FAILURES_TOTAL = _m(
     "bigdl_alert_sink_failures_total", "counter",
     doc="Alert sink deliveries that failed after retry")
@@ -204,7 +246,7 @@ ALERT_SINK_FAILURES_TOTAL = _m(
 # --------------------------------------------------------------- resilience
 HEARTBEAT_AGE_SECONDS = _m(
     "bigdl_heartbeat_age_seconds", "gauge", ("host",), 1024,
-    "Seconds since each peer's last heartbeat touch")
+    "Seconds since each peer's last heartbeat touch", policy="max")
 PEER_LOST_TOTAL = _m(
     "bigdl_peer_lost_total", "counter",
     doc="PeerLostError raised for silent heartbeat peers")
@@ -221,16 +263,62 @@ AUTOSCALE_DECISIONS_TOTAL = _m(
 
 # --------------------------------------------------------------- fleet
 FLEET_SCRAPE_SECONDS = _m(
-    "bigdl_fleet_scrape_seconds", "gauge",
+    "bigdl_fleet_scrape_seconds", "gauge", policy="max",
     doc="Wall seconds of the last full fleet peer-scrape cycle "
         "(bounded-pool concurrent scrape, FleetAggregator.scrape_peers)")
+FLEET_SCRAPE_LATENCY_SECONDS = _m(
+    "bigdl_fleet_scrape_latency_seconds", "gauge", ("host",), 1024,
+    "Per-host wall seconds of the last scrape round trip "
+    "(/healthz + /metrics, including the retry when one was spent)",
+    policy="max")
+FLEET_HOST_STALENESS_SECONDS = _m(
+    "bigdl_fleet_host_staleness_seconds", "gauge", ("host",), 1024,
+    "Per-host |scraper clock - host /healthz clock| skew; hosts past "
+    "BIGDL_STALE_AFTER_S are excluded from fleet merges", policy="max")
+# additive level across the fleet tiers — an explicit sum-gauge opt-in
+FLEET_STALE_HOSTS = _m(  # graftlint: disable=RD007
+    "bigdl_fleet_stale_hosts", "gauge", policy="sum",
+    doc="Hosts excluded from the last fleet merge as stale "
+        "(skewed clock or staleness past BIGDL_STALE_AFTER_S) — "
+        "never silently folded into fleet percentiles")
+FLEET_SCRAPE_ERRORS_TOTAL = _m(
+    "bigdl_fleet_scrape_errors_total", "counter", ("reason",), 8,
+    "Failed per-host scrapes by reason (timeout/refused/protocol), "
+    "surfaced without failing the round")
+
+# --------------------------------------------------------------- rollup
+# tracked-series level sums across rollup tiers — explicit opt-in
+ROLLUP_SERIES_TRACKED = _m(  # graftlint: disable=RD007
+    "bigdl_rollup_series_tracked", "gauge", policy="sum",
+    doc="Distinct (family, label-set) series the rollup tier is "
+        "currently carrying in its merged exposition")
+ROLLUP_SERIES_DROPPED_TOTAL = _m(
+    "bigdl_rollup_series_dropped_total", "counter", ("family",), 128,
+    "Series folded into the 'other' bucket by the top-K cardinality "
+    "bound, by family — the fleet-p99-looks-wrong triage counter")
+ROLLUP_MEMORY_BYTES = _m(
+    "bigdl_rollup_memory_bytes", "gauge", policy="max",
+    doc="Approximate bytes the rollup tier holds for its merged "
+        "series state (self-scrape of the aggregator)")
+
+# --------------------------------------------------------------- retain
+RETAIN_POINTS_TOTAL = _m(
+    "bigdl_retain_points_total", "counter",
+    doc="Samples ingested by the downsampling retention store")
+RETAIN_EVICTIONS_TOTAL = _m(
+    "bigdl_retain_evictions_total", "counter", ("ring",), 4,
+    "Points evicted from a retention ring (raw/10s/1m) at capacity")
+RETAIN_SERIES = _m(
+    "bigdl_retain_series", "gauge", policy="max",
+    doc="Distinct series the retention store currently tracks "
+        "(bounded by BIGDL_RETAIN_SERIES)")
 
 # --------------------------------------------------------------- checkpoint
 CHECKPOINT_SNAPSHOT_SECONDS = _m(
-    "bigdl_checkpoint_snapshot_seconds", "gauge",
+    "bigdl_checkpoint_snapshot_seconds", "gauge", policy="max",
     doc="Blocking device-to-host snapshot span of the last checkpoint")
 CHECKPOINT_WRITE_SECONDS = _m(
-    "bigdl_checkpoint_write_seconds", "gauge",
+    "bigdl_checkpoint_write_seconds", "gauge", policy="max",
     doc="Serialize+fsync span of the last checkpoint write")
 CHECKPOINT_WRITES_TOTAL = _m(
     "bigdl_checkpoint_writes_total", "counter",
@@ -240,20 +328,21 @@ CHECKPOINT_VERIFY_FAILURES_TOTAL = _m(
     doc="Checkpoint read-back verifications that failed")
 
 # --------------------------------------------------------------- streaming
-STREAM_BUFFER_DEPTH = _m(
-    "bigdl_stream_buffer_depth", "gauge",
+# fleet-wide buffered-records level is additive — explicit opt-in
+STREAM_BUFFER_DEPTH = _m(  # graftlint: disable=RD007
+    "bigdl_stream_buffer_depth", "gauge", policy="sum",
     doc="Records buffered between the stream producer and the trainer")
 STREAM_BACKPRESSURE_WAITS_TOTAL = _m(
     "bigdl_stream_backpressure_waits_total", "counter",
     doc="Producer blocks on a full stream buffer")
 STREAM_OFFSET = _m(
-    "bigdl_stream_offset", "gauge",
+    "bigdl_stream_offset", "gauge", policy="min",
     doc="Last source offset handed to the trainer")
 STREAM_WATERMARK = _m(
-    "bigdl_stream_watermark", "gauge",
+    "bigdl_stream_watermark", "gauge", policy="max",
     doc="Highest source offset the producer has ingested")
 STREAM_LAG_RECORDS = _m(
-    "bigdl_stream_lag_records", "gauge",
+    "bigdl_stream_lag_records", "gauge", policy="max",
     doc="Producer watermark minus trainer offset")
 STREAM_RECORDS_TOTAL = _m(
     "bigdl_stream_records_total", "counter",
@@ -269,17 +358,19 @@ REQUEST_LATENCY_SECONDS = _m(
 SERVE_TOKENS_TOTAL = _m(
     "bigdl_serve_tokens_total", "counter",
     doc="Tokens decoded by the LM engine")
-SERVE_TOKENS_PER_SECOND = _m(
-    "bigdl_serve_tokens_per_second", "gauge",
+# fleet decode throughput is additive across engines — explicit opt-in
+SERVE_TOKENS_PER_SECOND = _m(  # graftlint: disable=RD007
+    "bigdl_serve_tokens_per_second", "gauge", policy="sum",
     doc="Rolling decode throughput")
 SERVE_BATCH_OCCUPANCY = _m(
-    "bigdl_serve_batch_occupancy", "gauge",
+    "bigdl_serve_batch_occupancy", "gauge", policy="max",
     doc="Fraction of decode slots / micro-batch rows in use")
-SERVE_QUEUE_DEPTH = _m(
-    "bigdl_serve_queue_depth", "gauge",
+# fleet queue pressure is additive across replicas — explicit opt-in
+SERVE_QUEUE_DEPTH = _m(  # graftlint: disable=RD007
+    "bigdl_serve_queue_depth", "gauge", policy="sum",
     doc="Requests waiting in the bounded admission queue")
 SERVE_KV_PAGES_IN_USE = _m(
-    "bigdl_serve_kv_pages_in_use", "gauge",
+    "bigdl_serve_kv_pages_in_use", "gauge", policy="max",
     doc="Pages allocated from the paged KV cache pool")
 SERVE_ADMISSION_WAITS_TOTAL = _m(
     "bigdl_serve_admission_waits_total", "counter",
@@ -288,13 +379,13 @@ SERVE_PREEMPTIONS_TOTAL = _m(
     "bigdl_serve_preemptions_total", "counter",
     doc="In-flight sequences evicted to free KV pages")
 SERVE_LATENCY_SLO_RATIO = _m(
-    "bigdl_serve_latency_slo_ratio", "gauge",
+    "bigdl_serve_latency_slo_ratio", "gauge", policy="min",
     doc="Share of recent requests inside the e2e latency SLO")
 SERVE_DECODE_ATTN_MS = _m(
-    "bigdl_serve_decode_attn_ms", "gauge",
+    "bigdl_serve_decode_attn_ms", "gauge", policy="max",
     doc="Mean decode-attention kernel milliseconds per step")
 SERVE_DECODE_HBM_BYTES_PER_TOKEN = _m(
-    "bigdl_serve_decode_hbm_bytes_per_token", "gauge",
+    "bigdl_serve_decode_hbm_bytes_per_token", "gauge", policy="max",
     doc="Modeled HBM traffic per decoded token")
 SERVE_REJECTS_TOTAL = _m(
     "bigdl_serve_rejects_total", "counter",
@@ -324,11 +415,13 @@ ROUTER_AFFINITY_HITS_TOTAL = _m(
     "bigdl_router_affinity_hits_total", "counter",
     doc="Placements that landed on the session's bound replica (the "
         "multi-turn KV prefix stayed resident)")
-ROUTER_REPLICAS = _m(
+# replica counts sum across routers in a multi-router fleet — opt-in
+ROUTER_REPLICAS = _m(  # graftlint: disable=RD007
     "bigdl_router_replicas", "gauge", ("state",), 4,
-    "Replicas by router-observed state (up / draining / down)")
+    "Replicas by router-observed state (up / draining / down)",
+    policy="sum")
 ROUTER_RETRY_BUDGET_TOKENS = _m(
-    "bigdl_router_retry_budget_tokens", "gauge",
+    "bigdl_router_retry_budget_tokens", "gauge", policy="min",
     doc="Tokens left in the router's shared retry-budget bucket")
 
 # --------------------------------------------------------------- reqtrace
@@ -346,7 +439,7 @@ REQTRACE_RING_EVICTED_TOTAL = _m(
     doc="Kept request traces evicted from the bounded completed-trace "
         "ring (BIGDL_REQTRACE_RING)")
 REQTRACE_ACTIVE_TRACES = _m(
-    "bigdl_reqtrace_active_traces", "gauge",
+    "bigdl_reqtrace_active_traces", "gauge", policy="max",
     doc="Request traces currently open — begun, not yet through the "
         "tail sampler")
 
@@ -378,3 +471,20 @@ def is_declared(name: str) -> bool:
             if s is not None and s.kind == "histogram":
                 return True
     return False
+
+
+def fleet_policy(name: str) -> Optional[str]:
+    """The fleet aggregation policy for a sample name as it appears on
+    the wire — histogram-derived ``_bucket``/``_sum``/``_count``
+    samples merge by ``sum`` like their family; ``None`` for
+    undeclared names (the rollup tier passes those through with
+    ``last`` semantics rather than inventing a merge)."""
+    s = REGISTRY.get(name)
+    if s is not None:
+        return s.policy
+    for suffix in ("_bucket", "_sum", "_count"):
+        if name.endswith(suffix):
+            base = REGISTRY.get(name[: -len(suffix)])
+            if base is not None and base.kind == "histogram":
+                return "sum"
+    return None

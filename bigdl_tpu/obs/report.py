@@ -926,13 +926,40 @@ def render_text(rep: dict) -> str:
     return "\n".join(lines) + "\n"
 
 
-def render_fleet(fleet: dict) -> str:
-    """The live-fleet header ``--watch`` puts above the report body."""
-    lines = [f"-- live fleet ({fleet.get('mode')}) --"]
+def _host_badness(h: dict) -> tuple:
+    """Gating-signal rank for the --watch host table: the host an
+    operator must look at first sorts highest (bad/stale status, firing
+    alerts, deep queue, old step stamp, poor goodput)."""
+    status = str(h.get("status") or "?")
+    rank = {"ok": 0, "idle": 1}.get(status, 3)
+    gr = h.get("goodput_ratio")
+    return (rank, len(h.get("alerts") or []),
+            float(h.get("queue_depth") or 0.0),
+            float(h.get("step_age_s") or 0.0),
+            1.0 - (float(gr) if gr is not None else 1.0))
+
+
+def render_fleet(fleet: dict, max_hosts: Optional[int] = None) -> str:
+    """The live-fleet header ``--watch`` puts above the report body.
+
+    The host table is capped to the worst ``max_hosts`` hosts by gating
+    signal (default ``BIGDL_WATCH_HOSTS``) — at 1000 hosts the frame
+    shows the 16 an operator must look at and accounts for the rest
+    with one "... and N more" line; the full count always rides
+    ``fleet['n_hosts']`` for ``--json`` consumers."""
+    if max_hosts is None:
+        from bigdl_tpu.config import refresh_from_env
+
+        max_hosts = refresh_from_env().obs.watch_hosts
     hosts = fleet.get("hosts") or {}
+    n_total = int(fleet.get("n_hosts") or len(hosts))
+    lines = [f"-- live fleet ({fleet.get('mode')}) --"]
     if not hosts:
         lines.append("  (no hosts visible yet)")
-    for host, h in sorted(hosts.items()):
+    ranked = sorted(hosts.items(), key=lambda kv: str(kv[0]))
+    ranked.sort(key=lambda kv: _host_badness(kv[1]), reverse=True)
+    shown = ranked if int(max_hosts) <= 0 else ranked[:int(max_hosts)]
+    for host, h in shown:
         gr = h.get("goodput_ratio")
         age = h.get("step_age_s")
         qd = h.get("queue_depth")
@@ -947,9 +974,46 @@ def render_fleet(fleet: dict) -> str:
             lines.append(f"    FIRING {a.get('rule')}"
                          + (f" [{a.get('severity')}]"
                             if a.get("severity") else ""))
-    for src, err in sorted((fleet.get("errors") or {}).items()):
+    hidden = len(ranked) - len(shown)
+    if hidden > 0:
+        lines.append(f"  ... and {hidden} more host(s) "
+                     f"(worst {len(shown)} of {n_total} shown — "
+                     "raise BIGDL_WATCH_HOSTS)")
+    errors = fleet.get("errors") or {}
+    for src, err in sorted(errors.items()):
         lines.append(f"  DOWN {src}: {err}")
+    # skew-stale hosts: scraped fine but excluded from fleet merges
+    # (failed peers above already carry their error as the reason)
+    for src, why in sorted((fleet.get("stale") or {}).items()):
+        if src not in errors:
+            lines.append(f"  STALE {src}: {why}")
     return "\n".join(lines) + "\n"
+
+
+#: the fleet-trend series ``--watch`` sparklines out of the retention
+#: store (label, metric family) — what ``ingest_snapshot`` retains
+_TREND_SERIES = (
+    ("queue", names.SERVE_QUEUE_DEPTH),
+    ("goodput", names.GOODPUT_RATIO),
+    ("scrape_s", names.FLEET_SCRAPE_SECONDS),
+    ("stale", names.FLEET_STALE_HOSTS),
+)
+
+
+def render_trends(store, ring: str = "raw", width: int = 32) -> str:
+    """Sparkline block for the --watch header: one line per retained
+    fleet-trend series (empty string until the store has points)."""
+    lines = []
+    for label, name in _TREND_SERIES:
+        pts = store.series(name, ring=ring)
+        if not pts:
+            continue
+        lines.append(f"  {label:9s} "
+                     f"{store.spark(name, ring=ring, width=width)}  "
+                     f"{pts[-1][1]:g}")
+    if not lines:
+        return ""
+    return "-- trends (retention store) --\n" + "\n".join(lines) + "\n"
 
 
 def main(argv=None) -> int:
@@ -983,6 +1047,7 @@ def main(argv=None) -> int:
 
     if args.watch:
         from bigdl_tpu.obs.aggregate import FleetAggregator
+        from bigdl_tpu.obs.retain import RetentionStore
 
         from bigdl_tpu.config import refresh_from_env
 
@@ -991,14 +1056,20 @@ def main(argv=None) -> int:
         agg = FleetAggregator(
             peers=peers,
             metrics_dir=args.metrics_dir or args.trace_dir)
+        store = RetentionStore(
+            directory=args.metrics_dir or args.trace_dir)
+        store.load()  # prior frames' trends survive a watch restart
         while True:
             fleet = agg.snapshot()
+            store.ingest_snapshot(_time.time(), fleet)
             rep = build_report(args.trace_dir, args.metrics_dir)
             rep["fleet"] = fleet
+            rep["trends"] = store.summary()
             if args.json:
                 print(json.dumps(rep, default=str), flush=True)
             else:
-                frame = render_fleet(fleet) + "\n" + render_text(rep)
+                frame = render_fleet(fleet) + render_trends(store) \
+                    + "\n" + render_text(rep)
                 if not args.once:
                     # ANSI clear+home: a refreshing view, not a scroll
                     print("\x1b[2J\x1b[H", end="")

@@ -80,8 +80,13 @@ def clear_step():
 
 
 def register_registry(registry):
-    """Expose an extra :class:`MetricsRegistry` on ``/metrics`` (held
-    by weakref — a dead optimizer never pins its registry here)."""
+    """Expose an extra ``/metrics`` provider (held by weakref — a dead
+    optimizer never pins its registry here).  Anything duck-typed to
+    ``to_prometheus() -> str`` registers: a :class:`MetricsRegistry`,
+    or a :class:`~bigdl_tpu.obs.rollup.RollupAggregator` — registering
+    a rollup turns this host's endpoint into an aggregation tier (an
+    upstream scrape transparently drives the downstream shard
+    scrape)."""
     with _lock:
         _extras[:] = [r for r in _extras if r() is not None]
         if not any(r() is registry for r in _extras):
@@ -96,11 +101,19 @@ def _extra_registries():
 # ----------------------------------------------------------- payloads
 def metrics_text() -> str:
     """The full Prometheus exposition ``/metrics`` serves (process
-    registry + registered extras)."""
+    registry + registered extras).  One failing extra provider — a
+    registered rollup whose downstream shard scrape blows up — costs
+    its own section only, never the process registry's exposition."""
     from bigdl_tpu import obs
 
-    return obs.get_registry().to_prometheus() + "".join(
-        r.to_prometheus() for r in _extra_registries())
+    parts = [obs.get_registry().to_prometheus()]
+    for r in _extra_registries():
+        try:
+            parts.append(r.to_prometheus())
+        except Exception:  # noqa: BLE001 — isolate provider failures
+            log.exception("obs.server: extra /metrics provider %r "
+                          "failed; serving without it", r)
+    return "".join(parts)
 
 
 def trace_tail(last: int = 64) -> list:

@@ -22,7 +22,12 @@ The pieces:
   no histogram parsing, works on any child), ``queue_depth`` (the
   streaming tier's buffer depth / consumer lag gauges),
   ``goodput_ratio`` (worst host), ``alerts`` (active rule names),
-  ``stragglers`` (hosts whose ``/healthz`` reads stalled).
+  ``stragglers`` (hosts whose ``/healthz`` reads stalled),
+  ``router_replicas`` (live backends from the
+  ``bigdl_router_replicas{state="up"}`` gauge) and
+  ``router_shed_rate`` (sheds/s from ``bigdl_router_shed_total``
+  deltas between cycles — the serving data plane's load-pressure
+  signal).
 * declarative **rules** (:func:`load_rules`) — the same
   validated-loudly contract as the alert engine: each rule names a
   signal, a comparison, an action (``up``/``down``) and a ``for``
@@ -67,7 +72,8 @@ _OPS = {
 }
 _ACTIONS = ("up", "down")
 SIGNALS = ("step_time_s", "queue_depth", "goodput_ratio", "alerts",
-           "stragglers", "step", "world", "p99_latency_s")
+           "stragglers", "step", "world", "p99_latency_s",
+           "router_replicas", "router_shed_rate")
 
 # queue gauges: the streaming tier's buffer/lag (dataset/stream.py)
 # AND the serving tier's request queue (serving/batcher.py) — the
@@ -244,19 +250,27 @@ class EndpointScraper:
 
 
 def derive_signals(scraped: List[dict], prev_steps: dict,
-                   world: int) -> dict:
+                   world: int,
+                   prev_counters: Optional[dict] = None) -> dict:
     """One scrape cycle -> the policy signal dict.  ``prev_steps``
     ({addr: (step, wall_time)}) is the controller's memory between
     cycles — step time derives from the stamp deltas, so any child that
     stamps ``note_step`` is measurable without histogram parsing.
-    Conservative: a signal that cannot be derived is absent, and an
-    absent signal never breaches a rule."""
+    ``prev_counters`` ({addr: (shed_total, wall_time)}) is the same
+    memory for counter deltas: ``router_shed_rate`` (sheds/s summed
+    across routers) derives from ``bigdl_router_shed_total`` between
+    cycles, and ``router_replicas`` counts the fleet's live backends
+    from the ``bigdl_router_replicas{state="up"}`` gauge.  Conservative:
+    a signal that cannot be derived is absent, and an absent signal
+    never breaches a rule."""
     sig = {"world": world, "alerts": [], "stragglers": []}
     step_times, depths, ratios, steps, p99s = [], [], [], [], []
+    replicas_up, shed_rates = [], []
     for peer in scraped:
         if not peer.get("ok"):
             continue
         lat_buckets: dict = {}
+        shed_total = None
         h = peer.get("health") or {}
         addr = peer.get("addr", "?")
         step, now = h.get("step"), h.get("time")
@@ -279,6 +293,12 @@ def derive_signals(scraped: List[dict], prev_steps: dict,
         for s in (peer.get("metrics") or {}).get("samples", []):
             if s.get("name") in _QUEUE_METRICS:
                 depths.append(float(s.get("value", 0.0)))
+            elif s.get("name") == names.ROUTER_REPLICAS and \
+                    (s.get("labels") or {}).get("state") == "up":
+                replicas_up.append(float(s.get("value", 0.0)))
+            elif s.get("name") == names.ROUTER_SHED_TOTAL:
+                shed_total = (shed_total or 0.0) + float(
+                    s.get("value", 0.0))
             elif s.get("name") == _LATENCY_BUCKET and \
                     (s.get("labels") or {}).get("kind") == "e2e":
                 try:
@@ -290,6 +310,15 @@ def derive_signals(scraped: List[dict], prev_steps: dict,
         p99 = _hist_p99(lat_buckets)
         if p99 is not None:
             p99s.append(p99)
+        if shed_total is not None and now is not None \
+                and prev_counters is not None:
+            last = prev_counters.get(addr)
+            prev_counters[addr] = (shed_total, float(now))
+            if last is not None and float(now) > last[1]:
+                # max(0, Δ): a restarted router rewinds its counter —
+                # that must read as quiet, not as a negative shed storm
+                shed_rates.append(max(0.0, shed_total - last[0])
+                                  / (float(now) - last[1]))
     if step_times:
         # the slowest host gates every synchronous collective
         sig["step_time_s"] = max(step_times)
@@ -302,6 +331,10 @@ def derive_signals(scraped: List[dict], prev_steps: dict,
     if p99s:
         # the worst host's tail gates the user-facing SLO
         sig["p99_latency_s"] = max(p99s)
+    if replicas_up:
+        sig["router_replicas"] = sum(replicas_up)
+    if shed_rates:
+        sig["router_shed_rate"] = sum(shed_rates)
     return sig
 
 
@@ -336,6 +369,7 @@ class AutoscaleController:
         self._clock = clock
         self._streaks = {r["name"]: 0 for r in self.rules}
         self._prev_steps: dict = {}
+        self._prev_counters: dict = {}
         self._launch_t = clock()
         self._last_eval: Optional[float] = None
         self._last_decision_t: Optional[float] = None
@@ -362,6 +396,7 @@ class AutoscaleController:
         every breach streak."""
         self._launch_t = self._clock()
         self._prev_steps.clear()
+        self._prev_counters.clear()
         for k in self._streaks:
             self._streaks[k] = 0
 
@@ -467,5 +502,6 @@ class AutoscaleController:
             return None
         if not scraped or not any(p.get("ok") for p in scraped):
             return None
-        signals = derive_signals(scraped, self._prev_steps, self.world)
+        signals = derive_signals(scraped, self._prev_steps, self.world,
+                                 self._prev_counters)
         return self.evaluate(signals, now)

@@ -102,6 +102,19 @@ class SimFleet:
                                      "/healthz")
         return fetch
 
+    # --------------------------------------------------------- scenario
+    def skew_clock(self, host_id: int, offset_s: float):
+        """Skew one host's reported wall clock (multi-region NTP drift,
+        a wedged timesync daemon): its ``/healthz`` ``time`` shifts by
+        ``offset_s`` while the host otherwise behaves — exactly the
+        insidious case the aggregator's staleness detection exists to
+        exclude-and-account instead of folding into fleet percentiles."""
+        self.hosts[int(host_id)].clock_skew_s = float(offset_s)
+
+    def partition(self, host_id: int, on: bool = True):
+        """Partition (or heal) one host — its fetches time out."""
+        self.hosts[int(host_id)].partitioned = bool(on)
+
     # ------------------------------------------------------- lifecycle
     def tick(self, dt: float):
         for h in self.hosts:

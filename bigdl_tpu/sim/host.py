@@ -66,6 +66,7 @@ class SimHost:
         self.up = True               # down => connection refused
         self.partitioned = False     # => fetch times out (wall cost)
         self.stalled = False         # step stamp stops advancing
+        self.clock_skew_s = 0.0      # /healthz clock offset (staleness)
         self.slow_factor = 1.0       # straggler multiplier on step time
         self.queue_depth = 0.0
         self.goodput_ratio = 0.95
@@ -173,7 +174,9 @@ class SimHost:
             "host": self.host_id,
             "pid": 40000 + self.host_id,
             "attempt": self.attempt,
-            "time": now,
+            # a skewed host reports a skewed wall clock — the surface
+            # the aggregator's staleness detection keys on
+            "time": now + self.clock_skew_s,
             "port": 9000,
             "uptime_s": round(now - self.started_at, 6),
             "step": step,

@@ -40,7 +40,18 @@ Standalone probes for the properties a tick loop cannot express:
   transient retry budget;
 * :func:`check_watchdog` — the real :class:`~bigdl_tpu.resilience.
   supervisor.HangWatchdog` flags a genuinely stalled host and stays
-  conservative on a partitioned (unreachable) one.
+  conservative on a partitioned (unreachable) one;
+* :func:`check_rollup_exactness` — the two-tier leaf->root
+  :class:`~bigdl_tpu.obs.rollup.RollupAggregator` merge is bit-equal
+  to the flat single-tier merge (``_sum`` alone gets ulp slack) and
+  derives the identical fleet p99 — the hierarchical-exactness
+  invariant this PR pins;
+* :func:`check_rollup_bounds` — with top-K active, no family exceeds
+  ``top_k + 1`` logical series, drops are counted, the node's memory
+  self-gauge tracks the bound (not N), and scrape walls stay budgeted;
+* :func:`check_staleness_exclusion` — skewed-clock and partitioned
+  hosts are flagged stale, excluded from fleet percentiles, and
+  accounted in ``bigdl_fleet_stale_hosts``.
 
 Serving data-plane invariants (the router chaos scenarios in
 :mod:`bigdl_tpu.sim.serve` — :func:`check_serve_scenario` composes):
@@ -399,6 +410,243 @@ def check_aggregation_scaling(n_hosts: int, budget_s: float,
         f"{budget_s * 1000:.0f}ms); vs {n_small} hosts "
         f"{small * 1000:.1f}ms -> grew {grew:.1f}x for {host_ratio:.1f}x "
         f"hosts (slack {ratio_slack:g}x)")
+
+
+_ROLLUP_SELF = ("bigdl_rollup_", "bigdl_fleet_")
+
+
+def _flat_merge(fleet, stale_after_s: float):
+    """The single-tier reference: one flat ``FleetAggregator`` scrape
+    over every host, live (ok and not stale) expositions policy-merged
+    in one step.  Returns ``(merged_doc, stale_map, wall_s)``."""
+    from bigdl_tpu.obs.aggregate import FleetAggregator
+    from bigdl_tpu.obs.rollup import merge_parsed
+
+    agg = FleetAggregator(peers=fleet.addrs, fetch=fleet.fetch,
+                          stale_after_s=stale_after_s,
+                          clock=fleet.clock.now)
+    scraped = agg.scrape_peers(agg.peers)
+    live = [p.get("metrics") for p in scraped
+            if p.get("ok") and not p.get("stale")]
+    return merge_parsed(live), dict(agg.last_stale), agg.last_scrape_s
+
+
+def _comparable(doc: dict) -> dict:
+    """Merged samples keyed ``(name, sorted labels)``, with the rollup
+    pipeline's own self-metrics (``bigdl_rollup_*``/``bigdl_fleet_*``)
+    filtered out — those exist only in the hierarchical plane."""
+    out = {}
+    for s in doc.get("samples") or []:
+        if s["name"].startswith(_ROLLUP_SELF):
+            continue
+        out[(s["name"], tuple(sorted((s.get("labels") or {}).items())))] \
+            = float(s["value"])
+    return out
+
+
+def check_rollup_exactness(n_hosts: int = 40, shard_size: int = 8,
+                           seed: int = 0,
+                           stale_after_s: float = 30.0
+                           ) -> InvariantResult:
+    """Hierarchical merge == flat merge, **bit-equal**: the two-tier
+    leaf->root pipeline over the same live hosts must reproduce every
+    counter, gauge, ``_bucket`` and ``_count`` sample of the flat
+    single-tier merge exactly, and the fleet p99 derived from merged
+    cumulative buckets must be identical.  The float ``_sum`` sample
+    alone is allowed its last ulp (float addition is not associative
+    across tiers; quantiles never read it)."""
+    from bigdl_tpu.obs import names
+    from bigdl_tpu.obs.rollup import build_tiers, fleet_quantile
+    from bigdl_tpu.sim.clock import VirtualClock
+    from bigdl_tpu.sim.fleet import SimFleet
+
+    clock = VirtualClock()
+    fleet = SimFleet(int(n_hosts), clock, seed=seed)
+    fleet.tick(1.0)
+    flat_doc, _, _ = _flat_merge(fleet, stale_after_s)
+    root, leaves = build_tiers(
+        fleet.addrs, fleet.fetch, shard_size=int(shard_size),
+        top_k=0, stale_after_s=stale_after_s, clock=clock.now)
+    hier_doc = root.refresh()
+
+    flat, hier = _comparable(flat_doc), _comparable(hier_doc)
+    problems = []
+    if set(flat) != set(hier):
+        only_flat = sorted(set(flat) - set(hier))[:3]
+        only_hier = sorted(set(hier) - set(flat))[:3]
+        problems.append(f"series sets differ: flat-only {only_flat}, "
+                        f"hier-only {only_hier}")
+    mismatched = 0
+    for key in sorted(set(flat) & set(hier)):
+        a, b = flat[key], hier[key]
+        if key[0].endswith("_sum"):
+            if abs(a - b) > 1e-9 * max(1.0, abs(a)):
+                mismatched += 1
+                problems.append(f"{key[0]}{dict(key[1])}: flat {a!r} "
+                                f"vs hier {b!r} beyond _sum ulp slack")
+        elif a != b:
+            mismatched += 1
+            problems.append(f"{key[0]}{dict(key[1])}: flat {a!r} != "
+                            f"hier {b!r} (bit-equality required)")
+        if mismatched >= 3:
+            break
+    p99_flat = fleet_quantile(flat_doc, names.REQUEST_LATENCY_SECONDS,
+                              0.99, kind="e2e")
+    p99_hier = fleet_quantile(hier_doc, names.REQUEST_LATENCY_SECONDS,
+                              0.99, kind="e2e")
+    if p99_flat is None or p99_flat != p99_hier:
+        problems.append(f"fleet p99 diverged: flat {p99_flat} vs "
+                        f"hier {p99_hier}")
+    return _result(
+        "rollup_exactness", not problems,
+        "; ".join(problems[:4]) or
+        f"{len(flat)} series bit-equal across {len(leaves)} leaf "
+        f"shard(s) of {shard_size} (fleet p99 {p99_flat}s both ways)")
+
+
+def check_rollup_bounds(n_hosts: int = 64, shard_size: int = 8,
+                        top_k: int = 8, budget_s: float = 30.0,
+                        seed: int = 0) -> InvariantResult:
+    """The cardinality bound holds under load: with ``top_k`` active,
+    no family in the root merge tracks more than ``top_k + 1`` logical
+    series (the +1 is the ``other`` fold bucket), every drop is counted
+    in ``bigdl_rollup_series_dropped_total``, the node's self-scraped
+    memory estimate stays proportional to the bound (not to N hosts),
+    and the scrape wall stays inside ``budget_s``."""
+    from bigdl_tpu.obs import names
+    from bigdl_tpu.obs.metrics import _base_family, parse_prometheus
+    from bigdl_tpu.obs.rollup import build_tiers
+    from bigdl_tpu.sim.clock import VirtualClock
+    from bigdl_tpu.sim.fleet import SimFleet
+
+    clock = VirtualClock()
+    fleet = SimFleet(int(n_hosts), clock, seed=seed)
+    fleet.tick(1.0)
+    root, leaves = build_tiers(
+        fleet.addrs, fleet.fetch, shard_size=int(shard_size),
+        top_k=int(top_k), clock=clock.now)
+    merged = root.refresh()
+
+    problems = []
+    families = merged.get("families") or {}
+    per_family: Dict[str, set] = {}
+    for s in merged.get("samples") or []:
+        base = _base_family(s["name"], families)
+        skey = tuple(sorted((k, v) for k, v in
+                            (s.get("labels") or {}).items() if k != "le"))
+        per_family.setdefault(base, set()).add(skey)
+    worst_fam, worst_n = "", 0
+    for fam, series in per_family.items():
+        if len(series) > worst_n:
+            worst_fam, worst_n = fam, len(series)
+        if len(series) > int(top_k) + 1:
+            problems.append(f"{fam} tracks {len(series)} logical "
+                            f"series > top_k {top_k} + other")
+    self_doc = parse_prometheus(root.registry.to_prometheus())
+    by_name: Dict[str, float] = {}
+    for s in self_doc["samples"]:
+        by_name[s["name"]] = by_name.get(s["name"], 0.0) + s["value"]
+    dropped = by_name.get(names.ROLLUP_SERIES_DROPPED_TOTAL, 0.0)
+    leaf_dropped = sum(
+        v for leaf in leaves
+        for s in parse_prometheus(leaf.registry.to_prometheus())["samples"]
+        if s["name"] == names.ROLLUP_SERIES_DROPPED_TOTAL
+        for v in [s["value"]])
+    if int(n_hosts) > int(top_k) and dropped + leaf_dropped <= 0:
+        problems.append("per-host cardinality exceeded top_k but "
+                        "bigdl_rollup_series_dropped_total never moved")
+    tracked = by_name.get(names.ROLLUP_SERIES_TRACKED)
+    if tracked != len(merged["samples"]):
+        problems.append(f"self-scrape tracked {tracked} != merged "
+                        f"{len(merged['samples'])} samples")
+    mem = by_name.get(names.ROLLUP_MEMORY_BYTES, 0.0)
+    mem_cap = 512.0 * max(1, len(merged["samples"]))
+    if not 0 < mem <= mem_cap:
+        problems.append(f"memory self-gauge {mem:.0f}B outside "
+                        f"(0, {mem_cap:.0f}B]")
+    walls = [leaf.last_scrape_s or 0.0 for leaf in leaves] + \
+        [root.last_scrape_s or 0.0]
+    if max(walls) > float(budget_s):
+        problems.append(f"scrape wall {max(walls):.2f}s > budget "
+                        f"{budget_s:g}s")
+    return _result(
+        "rollup_bounds", not problems,
+        "; ".join(problems[:4]) or
+        f"{n_hosts} hosts -> {len(merged['samples'])} tracked samples "
+        f"(worst family {worst_fam} at {worst_n} <= top_k {top_k}+1, "
+        f"{int(dropped + leaf_dropped)} drop(s) counted, "
+        f"mem {mem:.0f}B, worst wall {max(walls) * 1000:.1f}ms)")
+
+
+def check_staleness_exclusion(n_hosts: int = 16, skew_id: int = 3,
+                              partition_id: int = 5, seed: int = 0,
+                              stale_after_s: float = 30.0
+                              ) -> InvariantResult:
+    """A skewed-clock host and a partitioned host are flagged stale,
+    **excluded** from the merge (their series never fold into fleet
+    percentiles) and **accounted** (the stale map and the
+    ``bigdl_fleet_stale_hosts`` gauge both carry them), while the fleet
+    p99 still derives from the live remainder."""
+    from bigdl_tpu.obs import names
+    from bigdl_tpu.obs.metrics import parse_prometheus
+    from bigdl_tpu.obs.rollup import build_tiers, fleet_quantile
+    from bigdl_tpu.sim.clock import VirtualClock
+    from bigdl_tpu.sim.fleet import SimFleet
+
+    clock = VirtualClock()
+    fleet = SimFleet(int(n_hosts), clock, seed=seed)
+    fleet.tick(1.0)
+    fleet.skew_clock(skew_id, 10.0 * float(stale_after_s))
+    fleet.partition(partition_id)
+    skew_addr = f"sim{int(skew_id)}:9000"
+    part_addr = f"sim{int(partition_id)}:9000"
+
+    flat_doc, stale, _ = _flat_merge(fleet, stale_after_s)
+    root, leaves = build_tiers(
+        fleet.addrs, fleet.fetch, top_k=0,
+        stale_after_s=stale_after_s, clock=clock.now)
+    root.refresh()
+    fleet.partition(partition_id, on=False)
+
+    problems = []
+    if "skew" not in str(stale.get(skew_addr, "")):
+        problems.append(f"skewed host {skew_addr} not flagged stale "
+                        f"(stale map: {stale})")
+    if part_addr not in stale:
+        problems.append(f"partitioned host {part_addr} not flagged "
+                        f"stale (stale map: {stale})")
+    leaf_stale = {}
+    for leaf in leaves:
+        leaf_stale.update(leaf.stale)
+    for addr in (skew_addr, part_addr):
+        if addr not in leaf_stale:
+            problems.append(f"hierarchical tier missed stale {addr}")
+    # exclusion: the skewed host's per-host series must not appear
+    host_key = str(int(skew_id))
+    leaked = [s for s in flat_doc.get("samples") or []
+              if (s.get("labels") or {}).get("host") == host_key]
+    if leaked:
+        problems.append(f"{len(leaked)} series from stale {skew_addr} "
+                        "leaked into the merge")
+    # accounting: the gauge on the root node carries the leaf counts
+    gauge = sum(
+        s["value"]
+        for leaf in leaves
+        for s in parse_prometheus(leaf.registry.to_prometheus())["samples"]
+        if s["name"] == names.FLEET_STALE_HOSTS)
+    if int(gauge) != len(leaf_stale):
+        problems.append(f"bigdl_fleet_stale_hosts sums to {gauge:g}, "
+                        f"stale map has {len(leaf_stale)}")
+    p99 = fleet_quantile(flat_doc, names.REQUEST_LATENCY_SECONDS,
+                         0.99, kind="e2e")
+    if p99 is None:
+        problems.append("fleet p99 vanished — live remainder lost")
+    return _result(
+        "staleness_exclusion", not problems,
+        "; ".join(problems[:4]) or
+        f"{len(stale)}/{n_hosts} host(s) stale "
+        f"({', '.join(sorted(stale))}), excluded and accounted; fleet "
+        f"p99 {p99}s from the {n_hosts - len(stale)} live host(s)")
 
 
 def check_supervisor_flap(flaps: int = 6,

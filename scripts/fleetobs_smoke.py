@@ -1,0 +1,177 @@
+#!/usr/bin/env python
+"""Fleet-scale metrics pipeline smoke — the pinned invariants at 1000
+simulated hosts, for real.
+
+Driven by ``scripts/run-tests.sh --fleetobs``.  Stands up 1000
+synthetic hosts in THIS process (``bigdl_tpu/sim`` — each a genuine
+``MetricsRegistry`` exposition and ``health_payload`` surface) on a
+virtual clock and runs the REAL metrics pipeline over them:
+
+* **exactness** — a two-tier leaf→root rollup
+  (``obs/rollup.py::build_tiers``, ~√N fan-in) must reproduce the flat
+  single-tier merge **bit-equally** (counters, gauges, cumulative
+  ``_bucket``/``_count`` samples; the float ``_sum`` alone gets its
+  last ulp) and derive the identical fleet p99 from merged buckets;
+* **bounds** — with the top-K cardinality cap active no family tracks
+  more than K+1 logical series (the +1 is the ``other`` fold), every
+  drop is counted, the node's self-scraped memory stays proportional
+  to the bound (not to N hosts), and the scrape wall stays inside its
+  budget;
+* **staleness** — a skewed-clock host and a partitioned host are
+  excluded from every merge and accounted in the stale map +
+  ``bigdl_fleet_stale_hosts``, while the fleet p99 still derives from
+  the live remainder;
+* the **scrape pool** — one bounded-pool round over all 1000
+  addresses with a rigged dead minority must land inside
+  ``ceil(N / workers) × timeout`` and surface per-host errors without
+  failing the round;
+* the **retention store** — the fleet trend signals ingested per
+  cycle downsample into the 10s/1m rings and replay losslessly from
+  the torn-write-safe JSONL.
+
+Banks ``FLEETOBS_SMOKE.json`` (bench.py folds it into BENCH
+``extras.fleetobs``) — the artifact every future metrics-plane PR
+regresses against.
+"""
+
+import argparse
+import dataclasses
+import json
+import math
+import os
+import sys
+import tempfile
+import time
+
+# the atexit obs flush imports jax (device memory stats) — pin CPU or
+# this container's TPU plugin probes the GCP metadata service forever
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        prog="scripts/fleetobs_smoke.py",
+        description="Prove the fleet metrics pipeline (hierarchical "
+                    "rollup, cardinality bounds, staleness exclusion, "
+                    "bounded scrape pool, retention store) at scale.")
+    ap.add_argument("--hosts", type=int,
+                    default=int(os.environ.get("BIGDL_FLEET_HOSTS",
+                                               "1000")),
+                    help="simulated fleet size (default 1000)")
+    ap.add_argument("--top-k", type=int, default=8,
+                    help="rollup cardinality bound for the bounds probe")
+    ap.add_argument("--budget-s", type=float, default=60.0,
+                    help="scrape wall budget for the bounds probe")
+    args = ap.parse_args()
+    n = int(args.hosts)
+    # √N-balanced shards: 1000 hosts -> ~32 leaves of ~32
+    shard = max(2, int(round(math.sqrt(n))))
+
+    from bigdl_tpu import obs
+    from bigdl_tpu.obs.aggregate import FleetAggregator
+    from bigdl_tpu.obs.retain import RetentionStore
+    from bigdl_tpu.sim import invariants as inv
+
+    t0 = time.perf_counter()
+    results = []
+
+    obs.reset()
+    res = inv.check_rollup_exactness(n_hosts=n, shard_size=shard)
+    print(f"SMOKE {res}")
+    assert res.ok, res.detail
+    results.append(res)
+
+    obs.reset()
+    res = inv.check_rollup_bounds(n_hosts=n, shard_size=shard,
+                                  top_k=int(args.top_k),
+                                  budget_s=float(args.budget_s))
+    print(f"SMOKE {res}")
+    assert res.ok, res.detail
+    results.append(res)
+
+    obs.reset()
+    res = inv.check_staleness_exclusion(
+        n_hosts=n, skew_id=n // 3, partition_id=(2 * n) // 3)
+    print(f"SMOKE {res}")
+    assert res.ok, res.detail
+    results.append(res)
+
+    # --- the bounded scrape pool over every address, one round --------
+    obs.reset()
+    from bigdl_tpu.sim import SimFleet, VirtualClock
+
+    clock = VirtualClock()
+    fleet = SimFleet(n, clock, seed=0)
+    fleet.tick(1.0)
+    dead = list(range(0, n, max(1, n // 10)))[:10]
+    for h in dead:
+        fleet.hosts[h].up = False
+    workers, timeout_s = 64, 2.0
+    agg = FleetAggregator(peers=fleet.addrs, fetch=fleet.fetch,
+                          timeout_s=timeout_s, max_workers=workers,
+                          clock=clock.now)
+    scraped = agg.scrape_peers(agg.peers)
+    bound = math.ceil(n / workers) * timeout_s
+    assert agg.last_scrape_s <= bound, \
+        f"scrape wall {agg.last_scrape_s:.2f}s > bound {bound:.2f}s"
+    errors = {p["addr"]: p["error"] for p in scraped if not p["ok"]}
+    assert len(scraped) == n and len(errors) == len(dead), \
+        f"round lost peers: {len(scraped)}/{n}, {len(errors)} errors"
+    print(f"SMOKE scrape pool: {n} addresses in "
+          f"{agg.last_scrape_s * 1000:.0f}ms (bound {bound:.0f}s), "
+          f"{len(errors)} dead peer(s) surfaced, round intact")
+
+    # --- retention: ingest a few cycles, downsample, replay -----------
+    with tempfile.TemporaryDirectory(prefix="bigdl-fleetobs-") as d:
+        store = RetentionStore(directory=d)
+        cycles = 30
+        for i in range(cycles):
+            fleet.tick(5.0)
+            snap = agg.snapshot()
+            store.ingest_snapshot(clock.now(), snap)
+        summary = store.summary()
+        assert summary, "retention store retained nothing"
+        downsampled = any(v["n_10s"] < v["n"] for v in summary.values())
+        assert downsampled, f"10s ring never downsampled: {summary}"
+        replay = RetentionStore(directory=d)
+        n_replayed = store_points = replay.load()
+        assert replay.summary() == summary, "replay diverged from live"
+    print(f"SMOKE retention: {cycles} cycles -> "
+          f"{len(summary)} series, {n_replayed} point(s) replayed "
+          "bit-equal from JSONL")
+
+    total_wall = time.perf_counter() - t0
+    bank = {
+        "hosts": n,
+        "shard_size": shard,
+        "top_k": int(args.top_k),
+        "total_wall_s": round(total_wall, 2),
+        "invariants": [dataclasses.asdict(r) for r in results],
+        "scrape_pool": {
+            "addresses": n,
+            "workers": workers,
+            "wall_s": round(agg.last_scrape_s, 4),
+            "bound_s": bound,
+            "dead_surfaced": len(errors),
+        },
+        "retention": {
+            "cycles": cycles,
+            "series": len(summary),
+            "replayed_points": store_points,
+            "summary": summary,
+        },
+    }
+    with open(os.path.join(REPO, "FLEETOBS_SMOKE.json"), "w",
+              encoding="utf-8") as fh:
+        json.dump(bank, fh, indent=2, sort_keys=True, default=str)
+    print(f"FLEETOBS PASS in {total_wall:.1f}s "
+          "(banked FLEETOBS_SMOKE.json)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

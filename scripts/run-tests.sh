@@ -187,6 +187,26 @@
 #                                                # banks FLEET_SIM.json for
 #                                                # BENCH extras.fleet
 #                                                # (no pytest)
+#   scripts/run-tests.sh --fleetobs              # fleet-scale metrics
+#                                                # pipeline smoke: the three
+#                                                # pinned invariants at 1000
+#                                                # simulated hosts on a
+#                                                # virtual clock with real
+#                                                # registries — hierarchical
+#                                                # rollup bit-equal to the
+#                                                # flat merge (fleet p99
+#                                                # identical), top-K
+#                                                # cardinality + memory +
+#                                                # scrape-wall bounds, and
+#                                                # skewed/partitioned hosts
+#                                                # excluded-and-accounted —
+#                                                # plus the 1000-address
+#                                                # bounded scrape pool and a
+#                                                # retention-store
+#                                                # downsample/replay pass;
+#                                                # banks FLEETOBS_SMOKE.json
+#                                                # for BENCH extras.fleetobs
+#                                                # (no pytest)
 #   scripts/run-tests.sh --live                  # live-telemetry smoke: a
 #                                                # 2-host run with /metrics +
 #                                                # /healthz servers on
@@ -237,6 +257,9 @@ elif [[ "${1:-}" == "--live" ]]; then
 elif [[ "${1:-}" == "--fleet" ]]; then
   shift
   exec python scripts/fleet_sim.py "$@"
+elif [[ "${1:-}" == "--fleetobs" ]]; then
+  shift
+  exec python scripts/fleetobs_smoke.py "$@"
 elif [[ "${1:-}" == "--autoscale" ]]; then
   shift
   exec python scripts/autoscale_smoke.py "$@"

@@ -134,6 +134,22 @@ targeted scenario (BIGDL_FLEET_SCENARIO=<file.json>) reproducing the
 incident you are chasing; see MIGRATION.md "Fleet simulation & chaos
 scenarios".
 
+A FLEET P99 (or any fleet-merged number) that LOOKS WRONG is a
+pipeline question before a workload one — check the metrics plane's
+own meta-metrics first: ``bigdl_fleet_stale_hosts`` and the report's
+``STALE`` lines say which hosts were *excluded* from the merge (clock
+skew past BIGDL_STALE_AFTER_S, or failed scrapes — their reasons are
+in ``bigdl_fleet_scrape_errors_total{reason}`` and the per-host
+``bigdl_fleet_host_staleness_seconds``/``_scrape_latency_seconds``
+gauges), and ``bigdl_rollup_series_dropped_total{family}`` says which
+families hit the BIGDL_ROLLUP_TOP_K cardinality bound and folded their
+tail into the ``other`` bucket (a fleet percentile is exact over what
+was merged — the drop counter tells you what wasn't).  A merged value
+that still disagrees with a flat scrape is the exactness invariant's
+territory: ``scripts/run-tests.sh --fleetobs`` re-proves
+hierarchical == flat at 1000 simulated hosts (FLEETOBS_SMOKE.json);
+see MIGRATION.md "Fleet-scale metrics".
+
 A LINT FAILURE (``scripts/run-tests.sh --lint`` /
 ``tests/test_lint.py::test_repo_is_clean``) is triaged from the
 finding line itself — ``path:line: RULE message``.  JX* findings are

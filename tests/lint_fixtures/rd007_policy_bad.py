@@ -1,0 +1,27 @@
+"""Seeded RD007: families whose fleet aggregation policy is missing or
+illegal.  Linted with ``RegistryRules(names_path=<this file>)`` — a
+mini registry, not the real obs/names.py."""
+
+REGISTRY = {}
+
+
+def _m(name, kind, labels=(), cardinality=1, doc="", policy=None):
+    return name
+
+
+# RD007: a gauge with no declared policy — the rollup tier cannot
+# guess whether the fleet value is the max, min or newest host
+NO_POLICY = _m("bigdl_fixture_depth", "gauge",
+               doc="queue depth, policy forgotten")
+
+# RD007: summing a ratio across hosts is a unit error
+SUM_RATIO = _m("bigdl_fixture_ratio", "gauge",
+               doc="a ratio summed across hosts", policy="sum")
+
+# RD007: counters are additive by definition — max is illegal
+MAX_COUNTER = _m("bigdl_fixture_total", "counter",
+                 doc="a counter declared max", policy="max")
+
+# RD007: not in the policy vocabulary at all
+AVG_GAUGE = _m("bigdl_fixture_load", "gauge",
+               doc="avg is not a fleet policy", policy="avg")

@@ -1,0 +1,34 @@
+"""Clean twin of rd007: every family carries a legal fleet aggregation
+policy — counters/histograms implicitly (or explicitly) sum, gauges
+declare max/min/last, and the one legitimate additive gauge opts in
+with the inline disable."""
+
+REGISTRY = {}
+
+
+def _m(name, kind, labels=(), cardinality=1, doc="", policy=None):
+    return name
+
+
+# counters and histograms are additive by kind — no policy needed ...
+STEPS = _m("bigdl_fixture_steps_total", "counter",
+           doc="resolved steps")
+LATENCY = _m("bigdl_fixture_latency_seconds", "histogram",
+             labels=("kind",), cardinality=4,
+             doc="request latency")
+# ... and spelling the implicit 'sum' out is equally fine
+BYTES = _m("bigdl_fixture_bytes_total", "counter",
+           doc="wire bytes", policy="sum")
+
+# gauges pick the fleet fold explicitly
+WORST_AGE = _m("bigdl_fixture_age_seconds", "gauge",
+               doc="worst step age across the fleet", policy="max")
+FLOOR_RATIO = _m("bigdl_fixture_goodput", "gauge",
+                 doc="fleet goodput floor", policy="min")
+NEWEST = _m("bigdl_fixture_flops", "gauge",
+            doc="newest per-step FLOPs estimate", policy="last")
+
+# a count published as a gauge really is additive — the opt-in path
+IN_FLIGHT = _m(  # graftlint: disable=RD007
+    "bigdl_fixture_in_flight", "gauge",
+    doc="in-flight requests, summed across hosts", policy="sum")

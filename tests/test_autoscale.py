@@ -153,6 +153,61 @@ class TestSignals:
         s = derive_signals([{"addr": "x", "ok": False}], {}, 1)
         assert "step_time_s" not in s and "queue_depth" not in s
 
+    def test_router_replicas_sums_up_state_only(self):
+        s = derive_signals([
+            _peer("r0:1", samples=[
+                {"name": "bigdl_router_replicas",
+                 "labels": {"state": "up"}, "value": 3.0},
+                {"name": "bigdl_router_replicas",
+                 "labels": {"state": "draining"}, "value": 1.0},
+                {"name": "bigdl_router_replicas",
+                 "labels": {"state": "down"}, "value": 2.0}]),
+            _peer("r1:1", samples=[
+                {"name": "bigdl_router_replicas",
+                 "labels": {"state": "up"}, "value": 2.0}]),
+        ], {}, 2, {})
+        assert s["router_replicas"] == 5.0
+
+    def test_shed_rate_from_counter_deltas(self):
+        prev = {}
+        shed = [{"name": "bigdl_router_shed_total", "labels": {},
+                 "value": 10.0}]
+        s1 = derive_signals([_peer("r0:1", t=100.0, samples=shed)],
+                            {}, 1, prev)
+        assert "router_shed_rate" not in s1  # one observation, no rate
+        shed2 = [{"name": "bigdl_router_shed_total", "labels": {},
+                  "value": 30.0}]
+        s2 = derive_signals([_peer("r0:1", t=104.0, samples=shed2)],
+                            {}, 1, prev)
+        assert s2["router_shed_rate"] == pytest.approx(5.0)
+
+    def test_shed_rate_counter_rewind_reads_quiet(self):
+        # a restarted router rewinds bigdl_router_shed_total to zero;
+        # the delta clamps at 0 instead of poisoning the signal
+        prev = {"r0:1": (500.0, 100.0)}
+        s = derive_signals([_peer("r0:1", t=110.0, samples=[
+            {"name": "bigdl_router_shed_total", "labels": {},
+             "value": 3.0}])], {}, 1, prev)
+        assert s["router_shed_rate"] == 0.0
+        assert prev["r0:1"] == (3.0, 110.0)  # memory re-anchors
+
+    def test_shed_rate_absent_without_memory_dict(self):
+        # backward-compatible: callers without a prev_counters dict
+        # simply never derive the rate (absent signal, no breach)
+        s = derive_signals([_peer("r0:1", t=100.0, samples=[
+            {"name": "bigdl_router_shed_total", "labels": {},
+             "value": 10.0}])], {}, 1)
+        assert "router_shed_rate" not in s
+
+    def test_router_rules_validate(self):
+        rules = load_rules(
+            '[{"name": "shed_storm", "signal": "router_shed_rate", '
+            '"op": ">", "value": 2.0, "action": "up"}, '
+            '{"name": "replica_floor", "signal": "router_replicas", '
+            '"op": "<", "value": 2, "action": "up"}]', _cfg())
+        assert [r["signal"] for r in rules] == [
+            "router_shed_rate", "router_replicas"]
+
 
 # ----------------------------------------------------------- controller
 class TestController:

@@ -1,0 +1,486 @@
+"""Fleet-scale metrics pipeline specs (ISSUE 18): the hierarchical
+rollup tier (policy merge, top-K cardinality bound, exactness vs the
+flat merge), the downsampling retention store, the bounded 1000-peer
+scrape pool with per-host meta-observability, and the worst-K
+``--watch`` host table.
+
+The 1000-host probes at full scale live in ``scripts/fleetobs_smoke.py``
+(``run-tests.sh --fleetobs``); tier-1 runs the scrape-pool bound at
+1000 *in-process* addresses (no sockets, instant fetches) plus the
+invariant probes at small N.
+"""
+
+import json
+import math
+import os
+import time
+
+import pytest
+
+from bigdl_tpu import obs
+from bigdl_tpu.obs import alerts, names
+from bigdl_tpu.obs.aggregate import FleetAggregator
+from bigdl_tpu.obs.metrics import (
+    MetricsRegistry,
+    parse_prometheus,
+    render_exposition,
+    sample_value,
+)
+from bigdl_tpu.obs.report import render_fleet, render_trends
+from bigdl_tpu.obs.retain import RetentionStore, sparkline
+from bigdl_tpu.obs.rollup import (
+    OTHER,
+    bound_cardinality,
+    build_tiers,
+    fleet_quantile,
+    merge_parsed,
+    shard_addrs,
+    tier_fetch,
+)
+from bigdl_tpu.sim import SimFleet, VirtualClock
+from bigdl_tpu.sim import invariants as inv
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs(monkeypatch):
+    for var in ("BIGDL_OBS", "BIGDL_TRACE_DIR", "BIGDL_METRICS_DIR",
+                "BIGDL_OBS_PEERS", "BIGDL_WATCH_HOSTS",
+                "BIGDL_ROLLUP_SHARD", "BIGDL_ROLLUP_TOP_K",
+                "BIGDL_STALE_AFTER_S", "BIGDL_RETAIN_POINTS",
+                "BIGDL_RETAIN_SERIES"):
+        monkeypatch.delenv(var, raising=False)
+    obs.reset()
+    alerts.reset_engine()
+    yield
+    obs.reset()
+    alerts.reset_engine()
+
+
+def _doc(*samples) -> dict:
+    """A parse_prometheus-shaped document from literal samples."""
+    return {"families": {}, "samples": [dict(s) for s in samples]}
+
+
+def _s(name, value, labels=None, **extra):
+    out = {"name": name, "labels": dict(labels or {}), "value": value}
+    out.update(extra)
+    return out
+
+
+# ------------------------------------------------------- scrape pool
+class TestScrapePool:
+    """The bounded concurrent scrape at fleet scale: 1000 addresses
+    with a rigged slow/dead minority must finish inside
+    ``ceil(N / max_workers) * timeout``, surface every per-host error
+    without failing the round, and publish the pipeline's own
+    latency/staleness/error meta-metrics."""
+
+    N = 1000
+    WORKERS = 64
+    TIMEOUT_S = 0.25
+
+    def _fetch(self, dead, slow, now=1000.0):
+        def fetch(url):
+            rest = url.split("//", 1)[-1]
+            host = rest.split("/", 1)[0]
+            i = int(host[1:].split(":", 1)[0])
+            if i in dead:
+                raise ConnectionRefusedError(f"sim down: {host}")
+            if i in slow:
+                time.sleep(0.02)
+            if url.endswith("/healthz"):
+                return json.dumps({"host": i, "status": "ok",
+                                   "time": now, "step": i,
+                                   "goodput_ratio": 1.0, "alerts": []})
+            return f"bigdl_supervisor_restarts_total{{kind=\"x\"}} {i}\n"
+        return fetch
+
+    def test_thousand_peer_round_is_bounded_and_loud(self):
+        addrs = [f"h{i}:9" for i in range(self.N)]
+        dead = set(range(0, self.N, 97))        # ~11 refusing peers
+        slow = set(range(13, self.N, 101))      # ~10 slow-but-alive
+        agg = FleetAggregator(
+            peers=addrs, fetch=self._fetch(dead, slow),
+            timeout_s=self.TIMEOUT_S, max_workers=self.WORKERS,
+            stale_after_s=30.0, clock=lambda: 1000.0)
+        out = agg.scrape_peers(addrs)
+        bound = math.ceil(self.N / self.WORKERS) * self.TIMEOUT_S
+        assert agg.last_scrape_s <= bound, (
+            f"scrape wall {agg.last_scrape_s:.2f}s blew the "
+            f"ceil(N/workers)*timeout bound {bound:.2f}s")
+        # the round never fails: every address answers, in input order
+        assert [o["addr"] for o in out] == addrs
+        for i in range(self.N):
+            if i in dead:
+                assert out[i]["ok"] is False
+                assert "ConnectionRefusedError" in out[i]["error"]
+            else:
+                assert out[i]["ok"] is True
+        # dead peers are the stale set (accounted, not raised)
+        assert set(agg.last_stale) == {f"h{i}:9" for i in dead}
+        doc = parse_prometheus(obs.get_registry().to_prometheus())
+        assert sample_value(doc, names.FLEET_SCRAPE_SECONDS) == \
+            pytest.approx(agg.last_scrape_s)
+        assert sample_value(doc, names.FLEET_STALE_HOSTS) == len(dead)
+        assert sample_value(doc, names.FLEET_SCRAPE_ERRORS_TOTAL,
+                            reason="refused") == len(dead)
+        lat = [s for s in doc["samples"]
+               if s["name"] == names.FLEET_SCRAPE_LATENCY_SECONDS]
+        assert len(lat) == self.N  # one latency gauge per scraped host
+        skew = [s for s in doc["samples"]
+                if s["name"] == names.FLEET_HOST_STALENESS_SECONDS]
+        assert len(skew) == self.N - len(dead)  # live hosts only
+
+    def test_skewed_clock_reads_stale_with_reason(self):
+        addrs = ["h0:9", "h1:9", "h2:9"]
+
+        def fetch(url):
+            host = url.split("//", 1)[-1].split("/", 1)[0]
+            i = int(host[1:].split(":", 1)[0])
+            if url.endswith("/healthz"):
+                t = 1000.0 if i != 1 else 1000.0 - 300.0
+                return json.dumps({"host": i, "status": "ok", "time": t})
+            return "bigdl_goodput_ratio 1.0\n"
+
+        agg = FleetAggregator(peers=addrs, fetch=fetch,
+                              stale_after_s=30.0, clock=lambda: 1000.0)
+        out = agg.scrape_peers(addrs)
+        assert out[1]["stale"] is True
+        assert "skew" in out[1]["stale_reason"]
+        assert not out[0]["stale"] and not out[2]["stale"]
+        assert set(agg.last_stale) == {"h1:9"}
+        doc = parse_prometheus(obs.get_registry().to_prometheus())
+        assert sample_value(doc, names.FLEET_HOST_STALENESS_SECONDS,
+                            host="h1:9") == pytest.approx(300.0)
+        assert sample_value(doc, names.FLEET_HOST_STALENESS_SECONDS,
+                            host="h0:9") == pytest.approx(0.0)
+
+
+# -------------------------------------------------- invariant probes
+class TestFleetObsInvariants:
+    """The pinned correctness probes at tier-1 N (the smoke re-runs
+    them at 1000 hosts)."""
+
+    def test_hierarchical_merge_bit_equals_flat(self):
+        res = inv.check_rollup_exactness(n_hosts=12, shard_size=4)
+        assert res.ok, res.detail
+
+    def test_cardinality_and_memory_stay_bounded(self):
+        res = inv.check_rollup_bounds(n_hosts=24, shard_size=6, top_k=4)
+        assert res.ok, res.detail
+
+    def test_stale_hosts_excluded_and_accounted(self):
+        res = inv.check_staleness_exclusion(n_hosts=8, skew_id=1,
+                                            partition_id=2)
+        assert res.ok, res.detail
+
+
+# ---------------------------------------------------- policy merging
+class TestMergePolicies:
+    def test_counters_sum(self):
+        m = merge_parsed([
+            _doc(_s(names.ALERT_SINK_FAILURES_TOTAL, 2.0)),
+            _doc(_s(names.ALERT_SINK_FAILURES_TOTAL, 3.0))])
+        assert sample_value(m, names.ALERT_SINK_FAILURES_TOTAL) == 5.0
+
+    def test_max_and_min_gauges_fold_to_worst(self):
+        m = merge_parsed([
+            _doc(_s(names.HEARTBEAT_AGE_SECONDS, 3.0, {"host": "0"}),
+                 _s(names.GOODPUT_RATIO, 0.9)),
+            _doc(_s(names.HEARTBEAT_AGE_SECONDS, 9.0, {"host": "0"}),
+                 _s(names.GOODPUT_RATIO, 0.4))])
+        assert sample_value(m, names.HEARTBEAT_AGE_SECONDS,
+                            host="0") == 9.0
+        assert sample_value(m, names.GOODPUT_RATIO) == 0.4
+
+    def test_undeclared_family_merges_last_not_sum(self):
+        # a foreign gauge must not get an invented additive meaning
+        m = merge_parsed([_doc(_s("foreign_gauge", 7.0)),
+                          _doc(_s("foreign_gauge", 2.0))])
+        assert sample_value(m, "foreign_gauge") == 2.0
+
+    def test_exemplar_newest_timestamp_wins(self):
+        old = {"labels": {"trace": "a"}, "value": 1.0, "ts": 10.0}
+        new = {"labels": {"trace": "b"}, "value": 2.0, "ts": 20.0}
+        m = merge_parsed([
+            _doc(_s("bigdl_request_latency_seconds_bucket", 1.0,
+                    {"le": "1.0"}, exemplar=new)),
+            _doc(_s("bigdl_request_latency_seconds_bucket", 2.0,
+                    {"le": "1.0"}, exemplar=old))])
+        assert m["samples"][0]["exemplar"]["labels"]["trace"] == "b"
+
+    def test_bucket_merge_stays_integral(self):
+        m = merge_parsed([
+            _doc(_s("bigdl_request_latency_seconds_bucket", 4.0,
+                    {"le": "0.1"})),
+            _doc(_s("bigdl_request_latency_seconds_bucket", 7.0,
+                    {"le": "0.1"}))])
+        assert m["samples"][0]["value"] == 11.0
+
+
+class TestCardinalityBound:
+    def test_top_k_folds_remainder_into_other(self):
+        doc = _doc(*[_s(names.HEARTBEAT_AGE_SECONDS, float(i),
+                        {"host": str(i)}) for i in range(1, 6)])
+        out, dropped = bound_cardinality(doc, top_k=2)
+        assert dropped == {names.HEARTBEAT_AGE_SECONDS: 3}
+        kept = {s["labels"]["host"] for s in out["samples"]}
+        assert kept == {"4", "5", OTHER}
+        # the other bucket folds under the family policy (max)
+        assert sample_value(out, names.HEARTBEAT_AGE_SECONDS,
+                            host=OTHER) == 3.0
+
+    def test_histogram_series_fold_as_one_logical_unit(self):
+        fam = "bigdl_request_latency_seconds"
+        families = {fam: {"type": "histogram", "help": "x"}}
+        samples = []
+        for kind, n in (("a", 10.0), ("b", 4.0), ("c", 2.0)):
+            samples += [
+                _s(fam + "_bucket", n / 2, {"kind": kind, "le": "0.1"}),
+                _s(fam + "_bucket", n, {"kind": kind, "le": "+Inf"}),
+                _s(fam + "_count", n, {"kind": kind}),
+                _s(fam + "_sum", n * 0.05, {"kind": kind})]
+        out, dropped = bound_cardinality(
+            {"families": families, "samples": samples}, top_k=1)
+        assert dropped == {fam: 2}
+        # the winner (largest _count) survives intact ...
+        assert sample_value(out, fam + "_count", kind="a") == 10.0
+        # ... and the two dropped histograms fold into ONE cumulative
+        # `other` histogram that is still exact over its members
+        assert sample_value(out, fam + "_count", kind=OTHER) == 6.0
+        assert sample_value(out, fam + "_bucket", kind=OTHER,
+                            le="0.1") == 3.0
+        assert sample_value(out, fam + "_bucket", kind=OTHER,
+                            le="+Inf") == 6.0
+
+    def test_zero_top_k_is_a_no_op(self):
+        doc = _doc(*[_s(names.HEARTBEAT_AGE_SECONDS, float(i),
+                        {"host": str(i)}) for i in range(20)])
+        out, dropped = bound_cardinality(doc, top_k=0)
+        assert out is doc and dropped == {}
+
+    def test_fleet_quantile_first_bucket_past_target(self):
+        doc = _doc(
+            _s("bigdl_request_latency_seconds_bucket", 5.0,
+               {"le": "0.1"}),
+            _s("bigdl_request_latency_seconds_bucket", 9.0,
+               {"le": "1.0"}),
+            _s("bigdl_request_latency_seconds_bucket", 10.0,
+               {"le": "+Inf"}))
+        assert fleet_quantile(doc, "bigdl_request_latency_seconds",
+                              0.5) == 0.1
+        assert fleet_quantile(doc, "bigdl_request_latency_seconds",
+                              0.9) == 1.0
+        # past every finite bucket: the honest answer is +Inf
+        assert fleet_quantile(doc, "bigdl_request_latency_seconds",
+                              0.99) == float("inf")
+        assert fleet_quantile(_doc(), "bigdl_request_latency_seconds",
+                              0.5) is None
+
+    def test_shard_addrs_preserves_order(self):
+        addrs = [f"h{i}" for i in range(10)]
+        shards = shard_addrs(addrs, 4)
+        assert [len(s) for s in shards] == [4, 4, 2]
+        assert [a for s in shards for a in s] == addrs
+
+
+# --------------------------------------------------------- tiering
+class TestRollupTiering:
+    def test_root_over_leaves_reexposes_one_parseable_body(self):
+        clock = VirtualClock()
+        fleet = SimFleet(8, clock, seed=0)
+        fleet.tick(1.0)
+        root, leaves = build_tiers(fleet.addrs, fleet.fetch,
+                                   shard_size=3, top_k=0,
+                                   clock=clock.now)
+        assert [len(leaf.peers) for leaf in leaves] == [3, 3, 2]
+        doc = parse_prometheus(root.to_prometheus())
+        # the merge and the node's self-metrics ride one body; the
+        # LAST tracked-series sample is the root's own (its registry
+        # renders after the merged leaf self-metrics)
+        tracked = [s["value"] for s in doc["samples"]
+                   if s["name"] == names.ROLLUP_SERIES_TRACKED]
+        assert tracked and tracked[-1] == root.tracked_series
+        assert any(s["name"] == names.HEARTBEAT_AGE_SECONDS
+                   for s in doc["samples"])
+        assert root.health()["role"] == "rollup"
+        assert root.n_live == len(leaves)
+
+    def test_tier_fetch_refuses_unknown_nodes(self):
+        clock = VirtualClock()
+        fleet = SimFleet(2, clock, seed=0)
+        fleet.tick(1.0)
+        _, leaves = build_tiers(fleet.addrs, fleet.fetch, shard_size=2,
+                                clock=clock.now)
+        fetch = tier_fetch(leaves)
+        with pytest.raises(ConnectionRefusedError):
+            fetch("http://rollup99:9100/metrics")
+        health = json.loads(fetch("http://rollup0:9100/healthz"))
+        assert health["role"] == "rollup"
+
+
+# -------------------------------------------------- retention store
+class TestRetentionStore:
+    def _store(self, **kw):
+        kw.setdefault("registry", MetricsRegistry())
+        kw.setdefault("max_series", 16)
+        kw.setdefault("points_per_ring", 64)
+        return RetentionStore(**kw)
+
+    def test_downsampling_folds_under_family_policy(self):
+        st = self._store()
+        for t, v in ((0.0, 1.0), (3.0, 9.0), (7.0, 2.0)):
+            st.ingest(t, names.HEARTBEAT_AGE_SECONDS, v,
+                      {"host": "0"}, persist=False)
+        labels = {"host": "0"}
+        assert len(st.series(names.HEARTBEAT_AGE_SECONDS, labels)) == 3
+        # max policy: the 10s bucket keeps the bucket's WORST point
+        assert st.series(names.HEARTBEAT_AGE_SECONDS, labels,
+                         ring="10s") == [(7.0, 9.0)]
+        for t, v in ((0.0, 0.9), (3.0, 0.2), (7.0, 0.5)):
+            st.ingest(t, names.GOODPUT_RATIO, v, persist=False)
+        assert st.series(names.GOODPUT_RATIO, ring="10s") == \
+            [(7.0, 0.2)]  # min policy keeps the floor
+        for t, v in ((0.0, 1.0), (3.0, 2.0), (7.0, 3.0)):
+            st.ingest(t, names.ALERT_SINK_FAILURES_TOTAL, v,
+                      persist=False)
+        # sum (cumulative counter): last-in-bucket IS the bucket value
+        assert st.series(names.ALERT_SINK_FAILURES_TOTAL,
+                         ring="10s") == [(7.0, 3.0)]
+
+    def test_series_budget_rejects_new_never_evicts_history(self):
+        st = self._store(max_series=2)
+        st.ingest(0.0, names.GOODPUT_RATIO, 0.5, persist=False)
+        st.ingest(0.0, names.FLEET_STALE_HOSTS, 1.0, persist=False)
+        st.ingest(0.0, names.SERVE_QUEUE_DEPTH, 9.0, persist=False)
+        assert st.n_series == 2
+        assert st.rejected_series == 1
+        assert st.series(names.SERVE_QUEUE_DEPTH) == []
+        assert st.series(names.GOODPUT_RATIO) == [(0.0, 0.5)]
+
+    def test_full_rings_evict_oldest_and_count_it(self):
+        reg = MetricsRegistry()
+        st = self._store(points_per_ring=4, registry=reg)
+        for i in range(10):  # 20s apart: a fresh 10s bucket every time
+            st.ingest(i * 20.0, names.GOODPUT_RATIO, float(i),
+                      persist=False)
+        raw = st.series(names.GOODPUT_RATIO)
+        assert len(raw) == 4 and raw[-1] == (180.0, 9.0)
+        doc = parse_prometheus(reg.to_prometheus())
+        assert sample_value(doc, names.RETAIN_EVICTIONS_TOTAL,
+                            ring="raw") == 6.0
+        assert sample_value(doc, names.RETAIN_EVICTIONS_TOTAL,
+                            ring="10s") == 6.0
+        assert sample_value(doc, names.RETAIN_POINTS_TOTAL) == 10.0
+
+    def test_persistence_replays_and_skips_torn_tail(self, tmp_path):
+        d = str(tmp_path)
+        st = self._store(directory=d)
+        st.ingest(1.0, names.GOODPUT_RATIO, 0.8)
+        st.ingest(2.0, names.GOODPUT_RATIO, 0.6)
+        st.flush()
+        path = os.path.join(d, "retain.jsonl")
+        with open(path, "a", encoding="utf-8") as fh:
+            fh.write('{"points": [[3.0, "bigdl_goodput_ratio"')  # torn
+        st2 = self._store(directory=d)
+        assert st2.load() == 2
+        assert st2.series(names.GOODPUT_RATIO) == [(1.0, 0.8),
+                                                   (2.0, 0.6)]
+
+    def test_ingest_snapshot_retains_fleet_trend_signals(self, tmp_path):
+        st = self._store(directory=str(tmp_path))
+        fleet = {"hosts": {"0": {"queue_depth": 2.0,
+                                 "goodput_ratio": 0.9},
+                           "1": {"queue_depth": 3.0,
+                                 "goodput_ratio": 0.5}},
+                 "scrape_s": 0.125, "stale": {"h9:1": "down"}}
+        st.ingest_snapshot(100.0, fleet)
+        assert st.series(names.SERVE_QUEUE_DEPTH) == [(100.0, 5.0)]
+        assert st.series(names.GOODPUT_RATIO) == [(100.0, 0.5)]
+        assert st.series(names.FLEET_SCRAPE_SECONDS) == [(100.0, 0.125)]
+        assert st.series(names.FLEET_STALE_HOSTS) == [(100.0, 1.0)]
+        assert os.path.isfile(os.path.join(str(tmp_path),
+                                           "retain.jsonl"))
+
+    def test_sparkline_shapes(self):
+        assert sparkline([]) == ""
+        assert sparkline([2.0, 2.0, 2.0]) == "▄▄▄"
+        ramp = sparkline([0.0, 1.0, 2.0, 3.0])
+        assert len(ramp) == 4 and ramp[0] == "▁" and ramp[-1] == "█"
+        assert len(sparkline(list(range(100)), width=8)) == 8
+
+
+# ------------------------------------------------------ watch table
+def _fleet_dict(n, mode="peers"):
+    hosts = {str(i): {"status": "ok", "step": i, "step_age_s": 0.5,
+                      "goodput_ratio": 1.0, "queue_depth": 0.0,
+                      "alerts": [], "source": f"h{i}:9"}
+             for i in range(n)}
+    return {"mode": mode, "hosts": hosts, "alerts": [], "metrics": {},
+            "errors": {}, "stale": {}, "n_hosts": n}
+
+
+class TestWatchHostTable:
+    def test_caps_to_worst_k_and_accounts_the_rest(self):
+        fleet = _fleet_dict(40)
+        fleet["hosts"]["7"]["alerts"] = [{"rule": "queue_deep"}]
+        fleet["hosts"]["9"]["status"] = "stalled"
+        out = render_fleet(fleet, max_hosts=5)
+        host_lines = [ln for ln in out.splitlines()
+                      if ln.startswith("  host")]
+        assert len(host_lines) == 5
+        # the gating hosts lead the table; a healthy one fell off
+        assert host_lines[0].startswith("  host9:")
+        assert host_lines[1].startswith("  host7:")
+        assert "... and 35 more host(s) (worst 5 of 40 shown" in out
+        assert "BIGDL_WATCH_HOSTS" in out
+        assert "FIRING queue_deep" in out
+
+    def test_env_knob_sets_the_default_cap(self, monkeypatch):
+        monkeypatch.setenv("BIGDL_WATCH_HOSTS", "3")
+        out = render_fleet(_fleet_dict(10))
+        assert len([ln for ln in out.splitlines()
+                    if ln.startswith("  host")]) == 3
+        assert "... and 7 more host(s)" in out
+
+    def test_zero_cap_shows_every_host(self):
+        out = render_fleet(_fleet_dict(30), max_hosts=0)
+        assert len([ln for ln in out.splitlines()
+                    if ln.startswith("  host")]) == 30
+        assert "more host(s)" not in out
+
+    def test_stale_hosts_get_their_own_lines(self):
+        fleet = _fleet_dict(2)
+        fleet["stale"] = {"h1:9": "clock skew 99.0s"}
+        out = render_fleet(fleet, max_hosts=16)
+        assert "STALE h1:9: clock skew 99.0s" in out
+
+    def test_trends_block_renders_from_the_store(self):
+        st = RetentionStore(max_series=8, points_per_ring=16,
+                            registry=MetricsRegistry())
+        assert render_trends(st) == ""  # no points yet: no block
+        for i in range(6):
+            st.ingest(float(i), names.SERVE_QUEUE_DEPTH, float(i),
+                      persist=False)
+        out = render_trends(st)
+        assert out.startswith("-- trends (retention store) --")
+        assert "queue" in out and out.strip().endswith("5")
+
+
+# --------------------------------------------------- exposition glue
+class TestExpositionRoundTrip:
+    def test_merged_doc_survives_render_and_reparse(self):
+        clock = VirtualClock()
+        fleet = SimFleet(4, clock, seed=0)
+        fleet.tick(1.0)
+        agg = FleetAggregator(peers=fleet.addrs, fetch=fleet.fetch,
+                              clock=clock.now)
+        scraped = agg.scrape_peers(agg.peers)
+        merged = merge_parsed([p["metrics"] for p in scraped
+                               if p["ok"]])
+        again = parse_prometheus(render_exposition(merged))
+        orig = {(s["name"], tuple(sorted(s["labels"].items()))):
+                s["value"] for s in merged["samples"]}
+        back = {(s["name"], tuple(sorted(s["labels"].items()))):
+                s["value"] for s in again["samples"]}
+        assert orig == back

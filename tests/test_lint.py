@@ -246,6 +246,67 @@ class TestRegistryUnits:
         assert names.is_declared("bigdl_request_latency_seconds_bucket")
         assert not names.is_declared("bigdl_serve_tokens_total_bucket")
 
+    def test_every_family_has_a_fleet_policy(self):
+        """The runtime half of RD007: the live registry resolves a
+        legal policy for every family and histogram derivation."""
+        from bigdl_tpu.obs import names
+
+        for spec in names.REGISTRY.values():
+            assert spec.policy in names.POLICIES, \
+                f"{spec.name} policy {spec.policy!r}"
+            if spec.kind in ("counter", "histogram"):
+                assert spec.policy == "sum", spec.name
+        assert names.fleet_policy(
+            "bigdl_request_latency_seconds_bucket") == "sum"
+        assert names.fleet_policy("bigdl_goodput_ratio") == "min"
+        assert names.fleet_policy("not_a_metric") is None
+        with pytest.raises(ValueError, match="policy"):
+            names._m("bigdl_tmp_no_policy", "gauge", doc="x")
+        with pytest.raises(ValueError, match="policy"):
+            names._m("bigdl_tmp_total", "counter", doc="x",
+                     policy="max")
+
+
+class TestFleetPolicyRule:
+    """RD007 over fixture mini-registries (packs-injected so the rule
+    reads the fixture as its names.py)."""
+
+    def _lint_fixture(self, stem):
+        path = os.path.join(FIX, f"{stem}.py")
+        pack = RegistryRules(names_path=path)
+        return Linter([path], root=REPO, packs=[pack]).run()
+
+    def test_bad_twin_fires_exactly_rd007(self):
+        findings = self._lint_fixture("rd007_policy_bad")
+        assert findings, "rd007_policy_bad.py produced no findings"
+        assert {f.rule for f in findings} == {"RD007"}, \
+            "\n".join(f.render() for f in findings)
+        # one finding per seeded family, each carrying a real location
+        assert len(findings) == 4
+        for f in findings:
+            assert f.path.endswith("rd007_policy_bad.py") and f.line > 0
+        msgs = "\n".join(f.message for f in findings)
+        assert "bigdl_fixture_depth" in msgs       # missing policy
+        assert "bigdl_fixture_ratio" in msgs       # sum gauge, no opt-in
+        assert "bigdl_fixture_total" in msgs       # non-sum counter
+        assert "bigdl_fixture_load" in msgs        # unknown policy
+
+    def test_clean_twin_is_silent(self):
+        findings = self._lint_fixture("rd007_policy_clean")
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+    def test_opt_in_requires_the_inline_disable(self, tmp_path):
+        src = open(os.path.join(
+            FIX, "rd007_policy_clean.py")).read()
+        src = src.replace("_m(  # graftlint: disable=RD007", "_m(")
+        p = tmp_path / "names_fixture.py"
+        p.write_text(src)
+        pack = RegistryRules(names_path=str(p))
+        findings = Linter([str(p)], root=str(tmp_path),
+                          packs=[pack]).run()
+        assert [f.rule for f in findings] == ["RD007"]
+        assert "bigdl_fixture_in_flight" in findings[0].message
+
 
 class TestStrictRegistry:
     """BIGDL_OBS_STRICT=1 — the runtime half of the RD003/RD005 pins."""
