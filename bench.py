@@ -716,6 +716,23 @@ def _reqtrace_extras():
         return None
 
 
+def _prof_extras():
+    """Continuous-profiling evidence for the BENCH JSON: the newest
+    ``PROF_SMOKE.json`` banked by scripts/prof_smoke.py (the rigged
+    hot-span attribution share, the measured sampling overhead vs the
+    <1% gate, and the alert-triggered debug bundle's manifest verdict).
+    None when the smoke has never been run."""
+    try:
+        smoke = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "PROF_SMOKE.json")
+        if not os.path.exists(smoke):
+            return None
+        with open(smoke, "r", encoding="utf-8") as fh:
+            return {"smoke": json.load(fh)}
+    except Exception:
+        return None
+
+
 def _tuner_extras():
     """Auto-tuner evidence for the BENCH JSON (ops/autotune.py): the
     cache stats and every decision with its static baseline, measured
@@ -1089,6 +1106,9 @@ def _run_child(platform: str):
     reqtrace = _reqtrace_extras()
     if reqtrace is not None:
         ex["reqtrace"] = reqtrace
+    prof = _prof_extras()
+    if prof is not None:
+        ex["prof"] = prof
     print(PARTIAL_MARK + json.dumps(result), flush=True)
 
 

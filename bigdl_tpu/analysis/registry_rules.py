@@ -40,6 +40,17 @@ pin both registries closed:
   published as a gauge — opts in with an inline
   ``# graftlint: disable=RD007``.
 
+* **RD008 implicit-selfobs-policy** — the observability plane's own
+  families (``bigdl_prof_*`` continuous-profiler self-metrics,
+  ``bigdl_bundle_*`` debug-bundle accounting) exist to be fleet-rolled
+  — a misconfigured high-rate profiler is only visible if its overhead
+  gauge rides the rollup tier — so every one must spell its fleet
+  policy *explicitly*: counters/histograms write ``policy='sum'``
+  (where ordinary families may rely on the additive default), gauges
+  declare theirs as usual (RD007 already forces that).  A new
+  ``bigdl_prof_*``/``bigdl_bundle_*`` family therefore cannot land
+  without a conscious rollup decision in ``obs/names.py``.
+
 Env var *writes* are exempt everywhere: exporting ``BIGDL_*`` into a
 child's environment is the supervisor/harness contract.
 """
@@ -65,6 +76,8 @@ RULES = {
              "(use bigdl_tpu/serving/spans.py constants)",
     "RD007": "metric family missing a legal fleet aggregation policy "
              "(gauges must declare max/min/last; sum gauges opt in)",
+    "RD008": "bigdl_prof_*/bigdl_bundle_* self-metric family relies on "
+             "an implicit fleet policy (spell policy='sum' out)",
 }
 core.ALL_RULES.update(RULES)
 
@@ -72,6 +85,9 @@ core.ALL_RULES.update(RULES)
 #: subset a gauge may declare without an explicit RD007 opt-in
 _POLICIES = ("sum", "max", "min", "last")
 _GAUGE_POLICIES = ("max", "min", "last")
+#: the self-observability families RD008 holds to an *explicit*-policy
+#: standard (the profiling + debug-bundle planes)
+_SELFOBS_PREFIXES = ("bigdl_prof_", "bigdl_bundle_")
 
 # metric-name shape: no trailing/double underscore (tempdir prefixes
 # like "bigdl_serve_smoke_" are spellings, not families)
@@ -470,8 +486,8 @@ class RegistryRules:
             findings.extend(self._check_policy(spec, names_rel))
         return findings
 
-    def _rd007_suppressed(self, line: int) -> bool:
-        """Inline ``# graftlint: disable=RD007`` on the declaration (or
+    def _rd007_suppressed(self, line: int, rule: str = "RD007") -> bool:
+        """Inline ``# graftlint: disable=<rule>`` on the declaration (or
         the line above) — honored here because the registry file is
         usually *not* among the linted modules, so the core suppression
         pass never sees its comments."""
@@ -487,7 +503,7 @@ class RegistryRules:
             m = core._DIRECTIVE_RE.search(self._names_lines[ln - 1])
             if m and m.group(1) == "disable":
                 rules = core._directive_rules(m)
-                if rules is None or "RD007" in rules:
+                if rules is None or rule in rules:
                     return True
         return False
 
@@ -506,6 +522,19 @@ class RegistryRules:
                     f"{spec.name}: a {spec.kind} merges additively "
                     f"across the fleet — policy {p!r} is illegal "
                     "(omit it or declare 'sum')")]
+            # RD008: the self-observability planes may not lean on the
+            # additive default — a new bigdl_prof_*/bigdl_bundle_*
+            # family lands with its rollup decision written down
+            if p is None and spec.name.startswith(_SELFOBS_PREFIXES) \
+                    and not self._rd007_suppressed(spec.line, "RD008"):
+                return [Finding(
+                    "RD008", names_rel, spec.line,
+                    f"{spec.name}: {spec.kind} in the "
+                    "profiling/debug-bundle plane relies on the "
+                    "implicit additive policy — these families feed "
+                    "the fleet rollup that makes a misconfigured "
+                    "profiler visible, so spell policy='sum' "
+                    "explicitly")]
             return []
         # gauges: an explicit, legal policy is the whole point
         if p is None:

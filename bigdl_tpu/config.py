@@ -185,6 +185,26 @@ class ObsConfig:
     # rejected (memory stays fixed) [BIGDL_RETAIN_SERIES]
     retain_series: int = 512
 
+    # ---- continuous profiling + debug bundles (obs/prof.py, bundle.py)
+    # always-on sampling profiler: samples/sec for the daemon thread
+    # walking sys._current_frames(); <= 0 (the default) disables — no
+    # thread, no clock reads, the off path is one config read
+    # [BIGDL_PROF_HZ]
+    prof_hz: float = 0.0
+    # profiler self-overhead budget as a fraction of wall time; when
+    # the cumulative sampling-work ratio exceeds this, samples are
+    # SKIPPED (and counted) until the ratio recovers — the hard cap
+    # behind bigdl_prof_overhead_ratio [BIGDL_PROF_BUDGET]
+    prof_budget: float = 0.01
+    # black-box debug bundles (obs/bundle.py) are written under this
+    # directory on alert firings / supervisor restarts / GET /debugz;
+    # unset disables every automatic trigger [BIGDL_BUNDLE_DIR]
+    bundle_dir: Optional[str] = None
+    # minimum seconds between two alert-triggered bundles for the SAME
+    # rule (an alert storm must not fill the disk); 0 disables the
+    # limit — every episode bundles [BIGDL_BUNDLE_RATE_LIMIT]
+    bundle_rate_limit: float = 300.0
+
     @property
     def active(self) -> bool:
         return bool(self.enabled or self.trace_dir or self.metrics_dir
@@ -222,6 +242,11 @@ class ObsConfig:
             stale_after_s=_env_float("BIGDL_STALE_AFTER_S", 30.0),
             retain_points=_env_int("BIGDL_RETAIN_POINTS", 240),
             retain_series=_env_int("BIGDL_RETAIN_SERIES", 512),
+            prof_hz=_env_float("BIGDL_PROF_HZ", 0.0),
+            prof_budget=_env_float("BIGDL_PROF_BUDGET", 0.01),
+            bundle_dir=_env_str("BIGDL_BUNDLE_DIR", None),
+            bundle_rate_limit=_env_float("BIGDL_BUNDLE_RATE_LIMIT",
+                                         300.0),
         )
 
 

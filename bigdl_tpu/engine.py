@@ -141,6 +141,12 @@ class Engine:
         from bigdl_tpu.obs import server as _obs_server
 
         _obs_server.ensure_server()
+        # continuous profiler (obs/prof.py): the sampler daemon starts
+        # with the engine when BIGDL_PROF_HZ > 0 (unset: one config
+        # read, no thread — the pinned off path)
+        from bigdl_tpu.obs import prof as _obs_prof
+
+        _obs_prof.get_profiler()
         return cls
 
     # singleton-ish accessors -------------------------------------------------

@@ -47,6 +47,8 @@ is rebuilt whenever the directory changes.
 from __future__ import annotations
 
 import atexit
+import json
+import os
 import threading
 
 from bigdl_tpu.obs.metrics import DEFAULT_BUCKETS, MetricsRegistry
@@ -240,6 +242,25 @@ def flush(extra_registries=()) -> dict:
     if out_dir:
         paths = _registry.write_snapshot(out_dir,
                                          extra_registries=extra_registries)
+        # the crash-flush gap, closed: the kept request-trace ring and
+        # the folded profile used to live only in memory — a SIGTERM'd
+        # run lost both.  This flush runs on the same atexit path as
+        # the metrics snapshot, so they land with it.
+        from bigdl_tpu.config import config as _cfg
+        from bigdl_tpu.obs import prof, reqtrace
+
+        stem = f"h{int(_cfg.process_id)}.{os.getpid()}"
+        kept = reqtrace.get_collector().completed()
+        if kept:
+            rt = os.path.join(out_dir, f"reqtraces.{stem}.json")
+            tmp = rt + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump(kept, fh, default=str)
+            os.replace(tmp, rt)
+            paths["reqtraces"] = rt
+        pp = prof.write_profile(out_dir, f"prof.{stem}")
+        if pp:
+            paths["profile"] = pp
     tracer = get_tracer()
     tracer.flush()
     if tracer is not NULL_TRACER:
@@ -267,10 +288,13 @@ def reset():
         _tracer_dir = None
         _registry = MetricsRegistry()
         _runtime = None
-    from bigdl_tpu.obs import alerts, goodput, reqtrace, server
+    from bigdl_tpu.obs import (alerts, bundle, goodput, prof, reqtrace,
+                               server)
 
     goodput.reset_ledger()
     server.stop_server()
     server.clear_step()
     alerts.reset_engine()
     reqtrace.reset_collector()
+    prof.reset_profiler()
+    bundle.reset()

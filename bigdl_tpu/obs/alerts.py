@@ -43,6 +43,7 @@ arithmetic over the registry, unit-testable with a synthetic clock.
 
 from __future__ import annotations
 
+import itertools
 import json
 import logging
 import operator
@@ -172,9 +173,15 @@ def load_rules(spec: Optional[str],
 class AlertEngine:
     """Evaluate a rule pack against a registry; track lifecycle."""
 
+    # per-engine identity for the bundle dedupe key: (rule, episode)
+    # alone collides across engines (the fleet sim runs one real
+    # engine per synthetic host in one process)
+    _UIDS = itertools.count()
+
     def __init__(self, rules: List[dict], registry=None,
                  sink: Optional[str] = None,
                  clock: Callable[[], float] = time.time):
+        self.uid = next(AlertEngine._UIDS)
         self.rules = list(rules)
         self._registry = registry
         self.sink = sink
@@ -341,6 +348,18 @@ class AlertEngine:
                                metric=t["metric"], value=t["value"],
                                labels=t["labels"],
                                episode=t["episode"])
+        if t["state"] == "firing":
+            # black-box capture at the moment of trouble: one debug
+            # bundle per (engine, rule, episode), rate-limited per
+            # rule, only when BIGDL_BUNDLE_DIR is set — and best
+            # effort: a full disk must not break the page itself
+            try:
+                from bigdl_tpu.obs import bundle
+
+                bundle.on_alert_firing(t, engine_uid=self.uid)
+            except Exception:  # noqa: BLE001 — bundling never blocks alerts
+                log.exception("alert bundle capture failed for %s",
+                              t["rule"])
         if self.sink:
             _sink_write(self.sink, t)
 

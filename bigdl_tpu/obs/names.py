@@ -443,6 +443,36 @@ REQTRACE_ACTIVE_TRACES = _m(
     doc="Request traces currently open — begun, not yet through the "
         "tail sampler")
 
+# ---------------------------------------------- profiling / debug bundles
+PROF_SAMPLES_TOTAL = _m(
+    "bigdl_prof_samples_total", "counter", policy="sum",
+    doc="Stack samples the continuous profiler folded into the "
+        "collapsed-stack table (BIGDL_PROF_HZ)")
+PROF_SKIPPED_TOTAL = _m(
+    "bigdl_prof_skipped_total", "counter", policy="sum",
+    doc="Profiler samples skipped because the self-overhead ratio "
+        "exceeded BIGDL_PROF_BUDGET (the hard overhead cap)")
+PROF_OVERHEAD_RATIO = _m(
+    "bigdl_prof_overhead_ratio", "gauge", policy="max",
+    doc="Profiler self-overhead: cumulative sampling-work seconds / "
+        "wall seconds since the profiler started")
+PROF_STACKS = _m(
+    "bigdl_prof_stacks", "gauge", policy="max",
+    doc="Distinct collapsed stacks held in the profiler's bounded "
+        "fold table (overflow folds into the 'other' stack)")
+BUNDLE_WRITES_TOTAL = _m(
+    "bigdl_bundle_writes_total", "counter", ("trigger",), 6,
+    "Debug bundles written, by trigger (alert / supervisor / http / "
+    "manual)", policy="sum")
+BUNDLE_ERRORS_TOTAL = _m(
+    "bigdl_bundle_errors_total", "counter", policy="sum",
+    doc="Debug-bundle builds that failed (the trigger path never "
+        "propagates — a bundle failure must not kill serving)")
+BUNDLE_LAST_WRITE_SECONDS = _m(
+    "bigdl_bundle_last_write_seconds", "gauge", policy="max",
+    doc="Wall-clock timestamp of the newest debug bundle this host "
+        "wrote (0 until the first bundle)")
+
 #: ``bigdl_``-prefixed spellings that are NOT metric families — process
 #: names, trace categories, logger names — so the RD003 "every bigdl_*
 #: literal must be declared" rule knows they are deliberate.

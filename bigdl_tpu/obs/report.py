@@ -96,7 +96,8 @@ def _metric_samples(snaps: List[dict], name: str) -> list:
     return out
 
 
-def build_report(trace_dir: str, metrics_dir: Optional[str] = None) -> dict:
+def build_report(trace_dir: str, metrics_dir: Optional[str] = None,
+                 bundle_dir: Optional[str] = None) -> dict:
     shards = read_shards(trace_dir)
     snaps = load_metric_snapshots(metrics_dir or trace_dir)
 
@@ -532,6 +533,67 @@ def build_report(trace_dir: str, metrics_dir: Optional[str] = None) -> dict:
         "mfu": max(mfu) if mfu else None,
     }
 
+    # ---- continuous profiles + debug bundles (obs/prof.py,
+    # obs/bundle.py) ----------------------------------------------------
+    # profile shards are the obs.flush() dumps (prof.*.profile.json);
+    # bundles come from the manifest-verified inventory, so a torn
+    # bundle shows up flagged instead of silently counted as good
+    prof_shards: list = []
+    prof_dirs = []
+    for d in (metrics_dir or trace_dir, trace_dir):
+        if d and d not in prof_dirs:
+            prof_dirs.append(d)
+    for d in prof_dirs:
+        if not os.path.isdir(d):
+            continue
+        for fn in sorted(os.listdir(d)):
+            if not fn.endswith(".profile.json"):
+                continue
+            try:
+                with open(os.path.join(d, fn), encoding="utf-8") as fh:
+                    prof_shards.append(json.load(fh))
+            except (OSError, ValueError):
+                continue
+    from bigdl_tpu.obs import bundle as _bundle
+    bdir = bundle_dir
+    if bdir is None:
+        from bigdl_tpu.config import refresh_from_env
+        bdir = refresh_from_env().obs.bundle_dir
+    if bdir is None:
+        cand = os.path.join(metrics_dir or trace_dir, "bundles")
+        bdir = cand if os.path.isdir(cand) else None
+    bundles = _bundle.inventory(bdir) if bdir else []
+    profiles = None
+    if prof_shards or bundles:
+        prof_phases: dict = {}
+        for sh in prof_shards:
+            for phase, p in (sh.get("phases") or {}).items():
+                cur = prof_phases.setdefault(
+                    phase, {"samples": 0, "frames": {}})
+                cur["samples"] += int(p.get("samples", 0))
+                for label, n in p.get("frames") or []:
+                    cur["frames"][label] = \
+                        cur["frames"].get(label, 0) + int(n)
+        for p in prof_phases.values():
+            p["frames"] = sorted(p["frames"].items(),
+                                 key=lambda kv: -kv[1])[:8]
+        oh_vals = [float(sh.get("overhead_ratio") or 0.0)
+                   for sh in prof_shards]
+        live_oh = _metric_max(names.PROF_OVERHEAD_RATIO)
+        if live_oh is not None:
+            oh_vals.append(float(live_oh))
+        profiles = {
+            "samples": sum(int(sh.get("samples") or 0)
+                           for sh in prof_shards),
+            "skipped": sum(int(sh.get("skipped") or 0)
+                           for sh in prof_shards),
+            "overhead_ratio": max(oh_vals) if oh_vals else None,
+            "phases": prof_phases,
+            "bundle_dir": bdir,
+            "bundles": bundles,
+            "bundles_valid": sum(1 for b in bundles if b.get("ok")),
+        }
+
     return {
         "trace_dir": trace_dir,
         "metrics_dir": metrics_dir or trace_dir,
@@ -558,6 +620,7 @@ def build_report(trace_dir: str, metrics_dir: Optional[str] = None) -> dict:
         "stragglers": stragglers,
         "hbm_peak_bytes": hbm,
         "tuner": tuner,
+        "profiles": profiles,
     }
 
 
@@ -710,6 +773,45 @@ def render_text(rep: dict) -> str:
             lines.append(
                 f"  trace {t['trace']} (request {t['request']}): "
                 f"{t['e2e_s'] * 1000:.1f}ms, worst hop {worst}")
+    lines.append("")
+    lines.append("-- profiles --")
+    pr = rep.get("profiles")
+    if not pr:
+        lines.append("  (no profiler activity — set BIGDL_PROF_HZ>0; "
+                     "bundles via BIGDL_BUNDLE_DIR)")
+    else:
+        oh = pr.get("overhead_ratio")
+        lines.append(
+            f"  samples: {int(pr.get('samples') or 0)}"
+            f" ({int(pr.get('skipped') or 0)} skipped by budget)"
+            + (f", overhead {oh * 100:.2f}%" if oh is not None else ""))
+        prof_phases = pr.get("phases") or {}
+        total_samples = sum(
+            int(p.get("samples") or 0)
+            for p in prof_phases.values()) or 1
+        for phase, p in sorted(prof_phases.items(),
+                               key=lambda kv: -kv[1]["samples"])[:6]:
+            n_ph = int(p.get("samples") or 0)
+            lines.append(f"  {phase:24s} {n_ph:6d} samples  "
+                         f"{n_ph / total_samples * 100:5.1f}%")
+            for label, n in (p.get("frames") or [])[:3]:
+                lines.append(
+                    f"    {label:40s} {int(n):6d}  "
+                    f"{int(n) / max(n_ph, 1) * 100:5.1f}%")
+        bundles = pr.get("bundles") or []
+        if bundles:
+            lines.append(
+                f"  bundles: {int(pr.get('bundles_valid') or 0)}/"
+                f"{len(bundles)} valid in {pr.get('bundle_dir')}")
+            for b in bundles[-4:]:
+                if b.get("ok"):
+                    lines.append(
+                        f"    {b['name']}: ok "
+                        f"({_fmt_bytes(float(b.get('bytes') or 0))}, "
+                        f"{b.get('trigger')})")
+                else:
+                    lines.append(f"    {b['name']}: "
+                                 f"SKIPPED ({b.get('reason')})")
     lines.append("")
     lines.append("-- autoscaling & stream --")
     asc = rep.get("autoscale") or {}
@@ -963,12 +1065,16 @@ def render_fleet(fleet: dict, max_hosts: Optional[int] = None) -> str:
         gr = h.get("goodput_ratio")
         age = h.get("step_age_s")
         qd = h.get("queue_depth")
+        po = h.get("prof_overhead")
+        nb = h.get("bundles")
         lines.append(
             f"  host{host}: status={h.get('status')} "
             f"step={h.get('step')}"
             + (f" age={age:.1f}s" if age is not None else "")
             + (f" goodput={gr:.3f}" if gr is not None else "")
             + (f" queue={qd:g}" if qd is not None else "")
+            + (f" prof={po * 100:.2f}%" if po is not None else "")
+            + (f" bundles={int(nb)}" if nb else "")
             + f"  [{h.get('source')}]")
         for a in h.get("alerts") or []:
             lines.append(f"    FIRING {a.get('rule')}"
@@ -1028,6 +1134,10 @@ def main(argv=None) -> int:
     ap.add_argument("trace_dir", help="BIGDL_TRACE_DIR of the run")
     ap.add_argument("--metrics-dir", default=None,
                     help="BIGDL_METRICS_DIR (default: trace_dir)")
+    ap.add_argument("--bundles", default=None,
+                    help="debug-bundle dir for the profiles section "
+                         "(default: BIGDL_BUNDLE_DIR, then "
+                         "<metrics_dir>/bundles when it exists)")
     ap.add_argument("--json", action="store_true",
                     help="emit the machine-readable report")
     ap.add_argument("--watch", action="store_true",
@@ -1062,7 +1172,8 @@ def main(argv=None) -> int:
         while True:
             fleet = agg.snapshot()
             store.ingest_snapshot(_time.time(), fleet)
-            rep = build_report(args.trace_dir, args.metrics_dir)
+            rep = build_report(args.trace_dir, args.metrics_dir,
+                               bundle_dir=args.bundles)
             rep["fleet"] = fleet
             rep["trends"] = store.summary()
             if args.json:
@@ -1081,7 +1192,8 @@ def main(argv=None) -> int:
             except KeyboardInterrupt:
                 return 0
 
-    rep = build_report(args.trace_dir, args.metrics_dir)
+    rep = build_report(args.trace_dir, args.metrics_dir,
+                       bundle_dir=args.bundles)
     if not rep["hosts"]:
         print(f"no trace shards under {args.trace_dir}", flush=True)
         return 1

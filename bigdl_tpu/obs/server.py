@@ -141,6 +141,19 @@ def _heartbeat_census() -> Optional[dict]:
     return None
 
 
+def _bundle_writes() -> int:
+    """Total debug bundles this process has written (summed across
+    triggers from the live counter; 0 before the first)."""
+    from bigdl_tpu import obs
+
+    total = 0.0
+    for fam in obs.get_registry().families():
+        if fam.name == names.BUNDLE_WRITES_TOTAL:
+            for _key, child in fam.child_items():
+                total += child.value
+    return int(total)
+
+
 def health_payload() -> dict:
     """The ``/healthz`` JSON body (also directly callable — the unit
     tests and an in-process supervisor skip the HTTP hop)."""
@@ -154,6 +167,9 @@ def health_payload() -> dict:
     from bigdl_tpu.obs import alerts
 
     active_alerts = alerts.get_engine().active()
+    from bigdl_tpu.obs import prof
+
+    prof_obj = prof.current()
     step_age = None if stamped is None else round(now - stamped, 3)
     status = "idle" if step is None else "ok"
     if step_age is not None and config.hang_timeout > 0 \
@@ -175,6 +191,12 @@ def health_payload() -> dict:
                           else round(min(1.0, ratio), 6)),
         "alerts": active_alerts,
         "heartbeat": _heartbeat_census(),
+        # continuous profiling plane: overhead ratio (None = profiler
+        # off) + bundles written — what report --watch surfaces so a
+        # misconfigured high-rate profiler is visible at fleet level
+        "prof_overhead": (round(prof_obj.overhead_ratio(), 6)
+                          if prof_obj.enabled else None),
+        "bundles": _bundle_writes(),
     }
 
 
@@ -225,11 +247,42 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                 else:
                     last = int(q.get("last", ["64"])[0])
                     self._send_json(trace_tail(last))
+            elif url.path == "/profilez":
+                # the continuous profiler's current state: folded
+                # collapsed stacks (?format=collapsed for the raw
+                # flamegraph text) or the JSON snapshot
+                from bigdl_tpu.obs import prof
+
+                q = urllib.parse.parse_qs(url.query)
+                if q.get("format", [None])[0] == "collapsed":
+                    self._send(200,
+                               prof.current().render_collapsed()
+                               .encode("utf-8"),
+                               "text/plain; charset=utf-8")
+                else:
+                    self._send_json(prof.current().snapshot())
+            elif url.path == "/debugz":
+                # on-demand black-box capture: build one bundle NOW
+                # and report it + the full inventory.  With no
+                # BIGDL_BUNDLE_DIR the build fails cleanly and the
+                # (empty) inventory still renders.
+                from bigdl_tpu.obs import bundle
+
+                body = {"bundle": None, "error": None}
+                try:
+                    body["bundle"] = bundle.build_bundle(
+                        reason="GET /debugz", trigger="http")
+                except Exception as e:  # noqa: BLE001 — report, don't 500
+                    body["error"] = f"{type(e).__name__}: {e}"
+                body["inventory"] = bundle.inventory()
+                self._send_json(body,
+                                200 if body["error"] is None else 503)
             elif url.path == "/":
                 self._send_json(
                     {"endpoints": ["/metrics", "/healthz",
                                    "/trace?last=K",
-                                   "/trace?request=ID"]})
+                                   "/trace?request=ID",
+                                   "/profilez", "/debugz"]})
             else:
                 self._send_json({"error": f"no route {url.path}"}, 404)
         except (BrokenPipeError, ConnectionResetError):

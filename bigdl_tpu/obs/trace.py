@@ -46,6 +46,42 @@ def _default_host_id() -> int:
 _CURRENT: contextvars.ContextVar = contextvars.ContextVar(
     "bigdl_obs_span", default=None)
 
+# live span NAMES per thread, innermost last — what the sampling
+# profiler (obs/prof.py) attributes its stacks to.  _CURRENT carries
+# only the span *id* (all the nesting logic needs), so the name stack
+# is kept separately: one dict keyed by thread ident holding a plain
+# list.  Push/pop are single list ops under the GIL; the profiler
+# thread reads racily (a sample landing inside a push/pop window lands
+# in the adjacent phase — one sample of noise, by design).
+_PHASES: dict = {}
+
+
+def current_phase(ident: int):
+    """Innermost live span name for thread ``ident`` (None when that
+    thread is not inside any recorded span) — the profiler's
+    attribution read.  Never raises: the stack may vanish between the
+    membership check and the index (thread exiting a span)."""
+    try:
+        return _PHASES[ident][-1]
+    except (KeyError, IndexError):
+        return None
+
+
+def _push_phase(name: str) -> int:
+    ident = threading.get_ident()
+    _PHASES.setdefault(ident, []).append(name)
+    return ident
+
+
+def _pop_phase(ident: int):
+    try:
+        stack = _PHASES[ident]
+        stack.pop()
+        if not stack:
+            del _PHASES[ident]
+    except (KeyError, IndexError):  # torn by a concurrent reset
+        pass
+
 
 class _NullSpan:
     """Reusable no-op context manager — the disabled fast path."""
@@ -185,12 +221,14 @@ class Tracer:
         sid = next(self._ids)
         parent = _CURRENT.get()
         token = _CURRENT.set(sid)
+        ident = _push_phase(name)
         tid = self._tid()
         t0 = time.perf_counter()
         try:
             yield sid
         finally:
             _CURRENT.reset(token)
+            _pop_phase(ident)
             dur = time.perf_counter() - t0
             self._record(
                 {"name": name, "ph": "X", "ts": self._ts_us(t0),

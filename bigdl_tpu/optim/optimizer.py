@@ -665,6 +665,11 @@ class LocalOptimizer(BaseOptimizer):
         from bigdl_tpu.obs import server as _obs_server
 
         self._obs_server = _obs_server.ensure_server()
+        # continuous profiler (obs/prof.py): starts sampling with the
+        # training loop when BIGDL_PROF_HZ > 0; off = one config read
+        from bigdl_tpu.obs import prof as _obs_prof
+
+        _obs_prof.get_profiler()
         if self._obs_server is not None:
             # the reference Metrics phase timers live in a private
             # registry; expose them on /metrics next to the process one

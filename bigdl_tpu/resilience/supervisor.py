@@ -124,6 +124,17 @@ class HangWatchdog:
         age = payload.get("step_age_s")
         return age is not None and float(age) > self.timeout_s
 
+    def collect_bundle(self) -> Optional[dict]:
+        """Ask the child to write its own debug bundle via ``GET
+        /debugz`` — a child wedged in a collective still answers: the
+        obs server's request threads are daemons independent of the
+        stuck main thread.  None when unreachable (a dead child's
+        postmortem is its atexit flush + the supervisor-side bundle)."""
+        port = self._resolve_port()
+        if not port:
+            return None
+        return self._fetch(f"http://127.0.0.1:{port}/debugz")
+
 
 class Supervisor:
     """Run ``cmd`` in a classify-and-restart loop.
@@ -264,6 +275,21 @@ class Supervisor:
                         "child", payload.get("step_age_s", -1.0),
                         payload.get("step"), self.hang_timeout)
                     self._hang_detected = True
+                    # black-box capture BEFORE the kill: the child's
+                    # own /debugz bundles what it was doing (its HTTP
+                    # daemon threads answer even with the main thread
+                    # wedged); gated on BIGDL_BUNDLE_DIR, best effort
+                    try:
+                        from bigdl_tpu.config import refresh_from_env
+
+                        if refresh_from_env().obs.bundle_dir:
+                            got = watchdog.collect_bundle()
+                            if got and got.get("bundle"):
+                                log.warning(
+                                    "supervisor: hung child wrote "
+                                    "debug bundle %s", got["bundle"])
+                    except Exception:  # noqa: BLE001 — never delay the kill
+                        pass
                     self._child.terminate()
                     try:
                         self._child.wait(timeout=5.0)
@@ -317,6 +343,29 @@ class Supervisor:
             names.SUPERVISOR_RESTARTS_TOTAL,
             "Child restarts, by exit classification",
             labels=("kind",)).labels(kind=kind).inc()
+
+    def _maybe_bundle(self, kind: str, rc: int):
+        """Supervisor-side debug bundle around a crash/hang restart:
+        the supervisor's own flight ring, registry (restart counters)
+        and alert state, stamped with the exit classification — the
+        half of the postmortem that survives the child.  Gated on
+        BIGDL_BUNDLE_DIR; best effort."""
+        try:
+            from bigdl_tpu.config import refresh_from_env
+
+            if not refresh_from_env().obs.bundle_dir:
+                return
+            from bigdl_tpu.obs import bundle
+
+            bundle.build_bundle(
+                reason=f"child {kind} rc={rc}",
+                trigger="supervisor",
+                context={"kind": kind, "rc": rc,
+                         "attempt": self.attempt,
+                         "hangs": self.hangs,
+                         "preemptions": self.preemptions})
+        except Exception:  # noqa: BLE001 — bundling never blocks restarts
+            log.exception("supervisor: debug bundle failed")
 
     def _backoff_sleep(self, kind: str, rc: int, delay: float):
         """Sleep a restart backoff, visibly: one ``supervisor.backoff``
@@ -430,6 +479,7 @@ class Supervisor:
             kind = "hang" if hung else "transient"
             if hung:
                 self.hangs += 1
+            self._maybe_bundle(kind, rc)
             delay = self.policy.record_failure()
             self._event("elastic.restart", kind=kind, rc=rc,
                         attempt=self.attempt,

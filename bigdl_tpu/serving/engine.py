@@ -46,6 +46,7 @@ from typing import List, Optional
 
 import numpy as np
 
+from bigdl_tpu import obs
 from bigdl_tpu.serving.batcher import RequestQueue, ServeRequest
 from bigdl_tpu.serving.cache import PagedKVCache
 from bigdl_tpu.serving.drain import HANDOFF_ERROR
@@ -285,7 +286,11 @@ class LMEngine:
                 is_leaf=lambda x: x is None or hasattr(x, "shape"))
         self._prefill_fns: dict = {}
         from bigdl_tpu import obs
+        from bigdl_tpu.obs import prof as _obs_prof
 
+        # continuous profiler: starts with the engine when
+        # BIGDL_PROF_HZ > 0 (unset: one config read, no thread)
+        _obs_prof.get_profiler()
         reg = obs.get_registry()
         self._lat = reg.histogram(*LAT_META, labels=("engine", "kind"))
         self._tokens_counter = reg.counter(
@@ -716,12 +721,18 @@ class LMEngine:
         tables, lengths = self.cache.device_tables(pages=bucket)
         self._key, sub = jax.random.split(self._key)
         t0 = time.perf_counter()
-        kp, vp, nxt = self._step_fn(
-            self.params, self.cache.kp, self.cache.vp, tables, lengths,
-            jnp.asarray(tokens), jnp.asarray(temps), jnp.asarray(active),
-            sub)
-        self.cache.kp, self.cache.vp = kp, vp
-        nxt = np.asarray(nxt)
+        # a LIVE span around the batched decode dispatch+resolve (not a
+        # retroactive reqtrace hop): the continuous profiler attributes
+        # samples landing here to the decode phase by name
+        with obs.get_tracer().span(spans.SPAN_STEP_DECODE,
+                                   bucket=bucket,
+                                   active=len(active_slots)):
+            kp, vp, nxt = self._step_fn(
+                self.params, self.cache.kp, self.cache.vp, tables,
+                lengths, jnp.asarray(tokens), jnp.asarray(temps),
+                jnp.asarray(active), sub)
+            self.cache.kp, self.cache.vp = kp, vp
+            nxt = np.asarray(nxt)
         step_ms = (time.perf_counter() - t0) * 1000.0
         self._steps += 1
         self._decode_ms_sum += step_ms

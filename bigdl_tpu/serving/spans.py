@@ -45,6 +45,13 @@ SPAN_DECODE = "req.decode"
 HOP_ORDER = ("queue", "placement", "retry", "prefill", "decode",
              "preempt", "handoff", "route")
 
+# ----------------------------------------------------------- live phases
+#: one live batched decode step (dispatch -> resolved next tokens) —
+#: stamped by Engine._step as a REAL tracer span (not a retroactive
+#: reqtrace hop) so the continuous profiler (obs/prof.py) attributes
+#: decode-time samples to it
+SPAN_STEP_DECODE = "serve.decode_step"
+
 # ------------------------------------------------------------ point events
 #: a request entered a decode slot (engine admission)
 EVENT_ADMIT = "serve.admit"
@@ -62,5 +69,5 @@ def hop_key(span_name: str) -> str:
 
 __all__ = ["SPAN_ROUTE", "SPAN_PLACEMENT", "SPAN_RETRY", "SPAN_HANDOFF",
            "SPAN_QUEUE", "SPAN_PREFILL", "SPAN_PREEMPT", "SPAN_DECODE",
-           "HOP_ORDER", "EVENT_ADMIT", "EVENT_PREEMPT", "EVENT_SCENARIO",
-           "hop_key"]
+           "SPAN_STEP_DECODE", "HOP_ORDER", "EVENT_ADMIT",
+           "EVENT_PREEMPT", "EVENT_SCENARIO", "hop_key"]

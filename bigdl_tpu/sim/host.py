@@ -185,6 +185,10 @@ class SimHost:
             "alerts": (self.engine.active() if self.engine is not None
                        else []),
             "heartbeat": None,
+            # continuous profiling plane: sim hosts run no sampler and
+            # cut no bundles, but the contract keys must be present
+            "prof_overhead": None,
+            "bundles": 0,
         }
 
     def metrics_text(self) -> str:

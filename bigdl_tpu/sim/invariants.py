@@ -26,7 +26,12 @@ a list of :class:`InvariantResult` verdicts:
   serial scrape fails this the moment peers time out);
 * **sink_failures** — a poisoned alert sink is *counted*, not wedging:
   at least the declared number of delivery failures landed while the
-  episode invariant above still held.
+  episode invariant above still held;
+* **bundle_per_episode** — with ``BIGDL_BUNDLE_DIR`` configured, every
+  alert ``firing`` transition produced exactly ONE manifest-valid
+  debug bundle (``obs/bundle.py``): none dropped, none duplicated,
+  none torn.  Unconfigured runs pass with an explicit "not exercised"
+  note so the scenario matrix stays runnable without a bundle dir.
 
 Standalone probes for the properties a tick loop cannot express:
 
@@ -250,6 +255,39 @@ def check_sink(sink_failures: float, expect: dict) -> InvariantResult:
         f"(needed >= {need}) while the episode invariant held")
 
 
+def check_bundles(observed: dict, expect: dict) -> InvariantResult:
+    """With a bundle dir configured, the alert->bundle path produced
+    exactly one manifest-valid debug bundle per firing transition."""
+    if not expect.get("bundles_per_episode"):
+        return _result("bundle_per_episode", True,
+                       "no bundle expectation")
+    if not observed.get("bundle_dir"):
+        return _result(
+            "bundle_per_episode", True,
+            "BIGDL_BUNDLE_DIR unset — bundle plane not exercised")
+    episodes = sum(1 for t in observed.get("transitions", [])
+                   if t.get("state") == "firing")
+    bundles = observed.get("bundles") or []
+    valid = [b for b in bundles if b.get("ok")]
+    torn = [b for b in bundles if not b.get("ok")]
+    problems = []
+    if torn:
+        problems.append(
+            f"{len(torn)} torn/invalid bundle(s): "
+            + ", ".join(f"{b['name']} ({b.get('reason')})"
+                        for b in torn[:3]))
+    if len(valid) != episodes:
+        problems.append(
+            f"{len(valid)} manifest-valid bundle(s) for {episodes} "
+            "firing transition(s) — the alert->bundle path dropped or "
+            "duplicated an episode (is BIGDL_BUNDLE_RATE_LIMIT=0?)")
+    return _result(
+        "bundle_per_episode", not problems,
+        "; ".join(problems) or
+        f"{len(valid)} bundle(s), one per firing transition, all "
+        "manifest-valid")
+
+
 def check_scenario(observed: dict, expect: dict,
                    cooldown_s: float) -> List[InvariantResult]:
     """All applicable invariant checks over one scenario's observation
@@ -263,6 +301,7 @@ def check_scenario(observed: dict, expect: dict,
         check_conservative(observed["decisions"], expect),
         check_scrape_budget(observed["scrape_cycles"], expect),
         check_sink(observed.get("sink_failures", 0.0), expect),
+        check_bundles(observed, expect),
     ]
 
 

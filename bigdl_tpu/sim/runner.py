@@ -77,6 +77,9 @@ class ScenarioResult:
     scrape_worst_s: Optional[float]
     scrape_mean_s: Optional[float]
     invariants: List[InvariantResult]
+    #: debug bundles written during the run (0 when BIGDL_BUNDLE_DIR
+    #: is unset — the bundle invariant then reports "not exercised")
+    bundles: int = 0
 
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)
@@ -146,7 +149,14 @@ def run_scenario(spec, hosts: Optional[int] = None,
         EndpointScraper,
     )
 
+    from bigdl_tpu.obs import bundle as bundle_mod
+
     fcfg = refresh_from_env().fleet
+    # debug bundles: snapshot the inventory before the run so the
+    # bundle invariant judges only THIS scenario's alert->bundle output
+    bundle_dir = refresh_from_env().obs.bundle_dir
+    pre_bundles = ({b["name"] for b in bundle_mod.inventory(bundle_dir)}
+                   if bundle_dir else set())
     n_hosts = int(hosts) if hosts else int(fcfg.hosts)
     seed = int(fcfg.seed) if seed is None else int(seed)
     compression = (float(fcfg.time_compression)
@@ -197,6 +207,9 @@ def run_scenario(spec, hosts: Optional[int] = None,
     wall_s = time.perf_counter() - t_wall0
 
     transitions = fleet.transitions
+    new_bundles = ([b for b in bundle_mod.inventory(bundle_dir)
+                    if b["name"] not in pre_bundles]
+                   if bundle_dir else [])
     observed = {
         "decisions": decisions,
         "transitions": transitions,
@@ -204,6 +217,8 @@ def run_scenario(spec, hosts: Optional[int] = None,
         "final_world": controller.world,
         "duration_s": sc.duration_s,
         "sink_failures": _sink_failures_total() - sink_failures0,
+        "bundle_dir": bundle_dir,
+        "bundles": new_bundles,
     }
     invariants = check_scenario(observed, sc.expect, cfg.cooldown_s)
     if extra_probes and any(ev["kind"] == "flap" for ev in sc.events):
@@ -230,6 +245,7 @@ def run_scenario(spec, hosts: Optional[int] = None,
         scrape_mean_s=(round(sum(c["wall_s"] for c in cycles)
                              / len(cycles), 6) if cycles else None),
         invariants=invariants,
+        bundles=len(new_bundles),
     )
     from bigdl_tpu import obs
 
@@ -238,7 +254,7 @@ def run_scenario(spec, hosts: Optional[int] = None,
         hosts=result.hosts, ticks=result.ticks,
         wall_s=result.wall_s, final_world=result.final_world,
         decisions=len(result.decisions), episodes=result.episodes,
-        sink_failures=result.sink_failures,
+        sink_failures=result.sink_failures, bundles=result.bundles,
         scrape_worst_s=result.scrape_worst_s,
         invariants={r.name: r.ok for r in result.invariants})
     return result

@@ -77,6 +77,7 @@ _EXPECT_KEYS = frozenset({
     "no_decisions_during_s", "quiet_tail_s", "final_world",
     "alert_episodes", "alerts_required", "all_resolved",
     "max_scrape_cycle_s", "min_sink_failures",
+    "bundles_per_episode",
 })
 
 _AUTOSCALE_KEYS = frozenset({
@@ -463,6 +464,35 @@ BUILTIN_SCENARIOS: Dict[str, dict] = {
             "alerts_required": ["goodput_below_target"],
             "all_resolved": True,
             "min_sink_failures": 1,
+        },
+    },
+    # alert storm: three separate fleet-wide goodput dips, so every
+    # host's goodput alert fires three distinct episodes — and with
+    # BIGDL_BUNDLE_DIR set the alert->bundle path must cut exactly ONE
+    # manifest-valid debug bundle per firing transition (none dropped,
+    # none duplicated across racing transitions, none torn)
+    "alert_storm": {
+        "name": "alert_storm",
+        "description": "three goodput-dip pulses; three alert episodes "
+                       "per host, one debug bundle per episode",
+        "duration_s": 600.0, "tick_s": 5.0, "start_world": 1,
+        "autoscale": _sim_autoscale(queue_high=64.0, queue_low=8.0),
+        "alert_rules": [_goodput_alert(0.5)],
+        "events": [
+            {"kind": "goodput", "at_s": 100.0, "until_s": 160.0,
+             "ratio": 0.3},
+            {"kind": "goodput", "at_s": 250.0, "until_s": 310.0,
+             "ratio": 0.3},
+            {"kind": "goodput", "at_s": 400.0, "until_s": 460.0,
+             "ratio": 0.3},
+        ],
+        "expect": {
+            "max_decisions": 0,
+            "final_world": [1, 1],
+            "alert_episodes": {"goodput_below_target": [3, 3]},
+            "alerts_required": ["goodput_below_target"],
+            "all_resolved": True,
+            "bundles_per_episode": True,
         },
     },
     # serving latency wave: fleet-wide e2e p99 rises past the band,

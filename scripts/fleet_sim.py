@@ -24,6 +24,10 @@ scenario matrix on a virtual clock:
   never thrashes, sink failures are counted (never wedging), and the
   real Supervisor rides the flapping child without spending one unit
   of retry budget;
+* ``alert_storm`` — three fleet-wide goodput dips with the debug-bundle
+  plane armed (``BIGDL_BUNDLE_DIR`` + rate limit off): every firing
+  transition must cut exactly ONE manifest-valid black-box bundle —
+  none dropped, none duplicated, none torn;
 * ``latency_wave`` — a fleet-wide p99 wave through the serving
   latency-histogram signal path.
 
@@ -50,7 +54,8 @@ if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
 DEFAULT_SCENARIOS = ("diurnal", "stragglers", "partition",
-                     "preemptions", "flapping", "latency_wave")
+                     "preemptions", "flapping", "alert_storm",
+                     "latency_wave")
 
 
 def main() -> int:
@@ -118,8 +123,17 @@ def main() -> int:
           f"budget {args.budget_s:.0f}s)")
     results = []
     failed = []
+    # the bundle plane is armed ONLY for alert_storm: with it global,
+    # every firing transition in every scenario would cut a bundle and
+    # the other scenarios' wall budgets would be paying for it
+    bundles_dir = os.path.join(obs_dir, "bundles")
     t_total0 = time.monotonic()
     for name in scenarios:
+        if name == "alert_storm":
+            os.environ["BIGDL_BUNDLE_DIR"] = bundles_dir
+            os.environ["BIGDL_BUNDLE_RATE_LIMIT"] = "0"
+        else:
+            os.environ.pop("BIGDL_BUNDLE_DIR", None)
         res = run_scenario(name, hosts=hosts, seed=seed,
                            time_compression=compression,
                            partition_stall_s=args.partition_stall_s)
@@ -133,9 +147,14 @@ def main() -> int:
             (f"scenario {res.name} took {res.wall_s:.1f}s — over the "
              f"{args.budget_s:.0f}s budget")
     total_wall = time.monotonic() - t_total0
+    os.environ.pop("BIGDL_BUNDLE_DIR", None)
     assert not failed, f"scenario invariants FAILED: {failed}"
     decided = sum(len(r.decisions) for r in results)
     episodes = sum(r.episodes for r in results)
+    bundled = sum(r.bundles for r in results)
+    if "alert_storm" in scenarios:
+        assert bundled > 0, \
+            "alert_storm ran but the bundle plane cut no bundles"
     if spec is None:
         # the default matrix must exercise both policy surfaces; a
         # user-supplied scenario is allowed to target just one (its
@@ -143,7 +162,8 @@ def main() -> int:
         assert decided > 0, "no scenario produced an autoscale decision"
         assert episodes > 0, "no scenario produced an alert episode"
     print(f"SMOKE scenarios: {len(results)} PASS in {total_wall:.1f}s "
-          f"({decided} decisions, {episodes} alert episodes)")
+          f"({decided} decisions, {episodes} alert episodes, "
+          f"{bundled} debug bundles)")
 
     # --- O(hosts) aggregation budget at fleet scale -------------------
     agg = check_aggregation_scaling(hosts, args.agg_budget_s, seed=seed)
@@ -166,6 +186,15 @@ def main() -> int:
     assert "scrape cycle:" in text, text
     print("SMOKE report: fleet section renders all "
           f"{len(results)} scenario verdicts + scrape latency")
+    if bundled:
+        # the bundles landed under <metrics_dir>/bundles, so the
+        # report's profiles section must inventory them unprompted
+        pr = rep.get("profiles") or {}
+        assert pr.get("bundles_valid"), \
+            f"report found no valid bundles: {pr}"
+        assert "-- profiles --" in text and "bundles:" in text, text
+        print(f"SMOKE report: profiles section inventories "
+              f"{pr['bundles_valid']} manifest-valid bundle(s)")
 
     # --- bank ---------------------------------------------------------
     bank = {
@@ -178,6 +207,7 @@ def main() -> int:
         "aggregation": {"ok": agg.ok, "detail": agg.detail},
         "decisions": decided,
         "episodes": episodes,
+        "bundles": bundled,
     }
     with open(os.path.join(REPO, "FLEET_SIM.json"), "w",
               encoding="utf-8") as fh:

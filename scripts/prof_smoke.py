@@ -1,0 +1,209 @@
+#!/usr/bin/env python
+"""Continuous-profiling + debug-bundle smoke — the rigged hot span.
+
+Driven by ``scripts/run-tests.sh --prof``.  The scenario: the sampling
+profiler (``obs/prof.py``) on at a real rate while one synthetically
+hot tracer span burns CPU and a cold span sleeps, with the black-box
+bundle plane (``obs/bundle.py``) armed and a live telemetry endpoint
+up.  The assertions are the tentpole's acceptance criteria:
+
+* **attribution** — the hot span owns >= 50% of the span-attributed
+  self-time samples (the per-thread phase stack really labels stacks);
+* **overhead** — the profiler's measured self-overhead ratio stays
+  under 1% of wall (the ``BIGDL_PROF_BUDGET`` cap is real headroom,
+  not the thing keeping the number down);
+* **exactly one bundle per alert episode** — a threshold alert fires
+  once and the alert->bundle path cuts exactly ONE manifest-valid
+  bundle carrying the folded profile, the kept request traces, the
+  metrics snapshot and the flight-recorder ring; a second evaluation
+  of the same (still-firing) episode must NOT cut another;
+* **live endpoints** — ``/profilez`` (JSON + ``?format=collapsed``)
+  and ``/debugz`` (builds an on-demand bundle) answer over real HTTP;
+* **report** — the profiles section renders the hot span and the
+  bundle inventory in text and survives ``--json``.
+
+Banks ``PROF_SMOKE.json`` at the repo root; bench.py folds it into
+BENCH ``extras.prof``.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        prog="scripts/prof_smoke.py",
+        description="Continuous-profiling smoke: rigged hot span "
+                    "attribution, <1% overhead, one alert -> exactly "
+                    "one debug bundle, /profilez + /debugz live.")
+    ap.add_argument("--hz", type=float, default=50.0,
+                    help="sampling rate for the smoke (default 50)")
+    ap.add_argument("--hot-s", type=float, default=3.0,
+                    help="seconds the hot span burns CPU (default 3)")
+    ap.add_argument("--cold-s", type=float, default=0.6,
+                    help="seconds the cold span sleeps (default 0.6)")
+    args = ap.parse_args()
+
+    import tempfile
+    import urllib.request
+
+    smoke_dir = tempfile.mkdtemp(prefix="bigdl_prof_smoke_")
+    obs_dir = os.path.join(smoke_dir, "obs")
+    bundle_dir = os.path.join(obs_dir, "bundles")
+    os.environ["BIGDL_TRACE_DIR"] = obs_dir
+    os.environ["BIGDL_METRICS_DIR"] = obs_dir
+    os.environ["BIGDL_BUNDLE_DIR"] = bundle_dir
+    os.environ["BIGDL_BUNDLE_RATE_LIMIT"] = "0"
+    os.environ["BIGDL_PROF_HZ"] = f"{args.hz:g}"
+    os.environ["BIGDL_PROF_BUDGET"] = "0.01"
+    os.environ["BIGDL_OBS_PORT"] = "0"  # ephemeral
+
+    from bigdl_tpu import obs
+    from bigdl_tpu.obs import alerts, bundle, names, prof, server
+    from bigdl_tpu.obs.report import build_report, render_text
+
+    t0 = time.monotonic()
+    profiler = prof.get_profiler()
+    assert profiler.enabled, "BIGDL_PROF_HZ set but profiler is off"
+    srv = server.ensure_server()
+    assert srv is not None, "BIGDL_OBS_PORT set but no server bound"
+    tracer = obs.get_tracer()
+
+    # --- the rigged workload: one hot span burning CPU, one cold span
+    # sleeping — attribution must split them, not blur them ----------
+    def _burn(until: float) -> int:
+        acc = 0
+        while time.monotonic() < until:
+            acc += sum(i * i for i in range(200))
+        return acc
+
+    wall0 = time.monotonic()
+    with tracer.span("smoke.hot"):
+        _burn(time.monotonic() + args.hot_s)
+    with tracer.span("smoke.cold"):
+        time.sleep(args.cold_s)
+    step_wall = time.monotonic() - wall0
+
+    snap = profiler.snapshot()
+    assert snap["samples"] >= 10, \
+        f"only {snap['samples']} samples in {step_wall:.1f}s " \
+        f"at {args.hz:g} Hz"
+    spanned = {ph: p["samples"] for ph, p in snap["phases"].items()
+               if ph != prof.NO_SPAN}
+    assert "smoke.cold" in spanned, \
+        f"cold span never sampled: {sorted(spanned)}"
+    hot = spanned.get("smoke.hot", 0)
+    share = hot / max(1, sum(spanned.values()))
+    assert share >= 0.5, \
+        (f"hot span got {share * 100:.1f}% of span-attributed "
+         f"self-time, expected >= 50%: {spanned}")
+    overhead = snap["overhead_ratio"]
+    assert overhead < 0.01, \
+        f"profiler overhead {overhead * 100:.2f}% >= the 1% gate"
+    print(f"SMOKE prof: {snap['samples']} samples at {args.hz:g} Hz "
+          f"over {step_wall:.1f}s; hot span {share * 100:.1f}% of "
+          f"span-attributed self-time, overhead "
+          f"{overhead * 100:.3f}% (< 1%)")
+
+    # --- /profilez over live HTTP ------------------------------------
+    with urllib.request.urlopen(srv.url("/profilez"), timeout=10) as r:
+        pz = json.loads(r.read())
+    assert pz["enabled"] and pz["samples"] > 0, pz
+    with urllib.request.urlopen(srv.url("/profilez?format=collapsed"),
+                                timeout=10) as r:
+        collapsed = r.read().decode("utf-8")
+    assert "smoke.hot;" in collapsed, \
+        "collapsed-stack render lost the hot phase root"
+    print("SMOKE prof: /profilez serves JSON + collapsed stacks "
+          f"({len(collapsed.splitlines())} folded stack(s))")
+
+    # --- one alert episode -> exactly one manifest-valid bundle ------
+    rule = {"name": "prof_smoke_hot", "type": "threshold",
+            "metric": names.PROF_SAMPLES_TOTAL, "op": ">",
+            "value": 5, "for": 1, "severity": "warning"}
+    engine = alerts.AlertEngine([rule])
+    fired = engine.evaluate()
+    assert [t["state"] for t in fired] == ["firing"], fired
+    inv = bundle.inventory(bundle_dir)
+    assert len(inv) == 1 and inv[0]["ok"], inv
+    assert inv[0]["trigger"] == "alert", inv[0]
+    # the same still-firing episode must NOT cut a second bundle
+    engine.evaluate()
+    assert len(bundle.inventory(bundle_dir)) == 1, \
+        "a still-firing episode cut a second bundle"
+    bpath = inv[0]["path"]
+    with open(os.path.join(bpath, bundle.MANIFEST),
+              encoding="utf-8") as fh:
+        manifest = json.load(fh)
+    for need in ("profile.json", "reqtraces.json", "metrics.json",
+                 "ring.json", "alerts.json", "runtime.json"):
+        assert need in manifest["files"], \
+            f"bundle manifest missing {need}: {sorted(manifest['files'])}"
+    with open(os.path.join(bpath, "profile.json"),
+              encoding="utf-8") as fh:
+        bundled_prof = json.load(fh)
+    assert "smoke.hot" in (bundled_prof.get("phases") or {}), \
+        "bundled profile lost the hot phase"
+    ok, why = bundle.verify_bundle(bpath)
+    assert ok, why
+    print(f"SMOKE bundle: one alert episode -> exactly one "
+          f"manifest-valid bundle ({why}; "
+          f"{len(manifest['files'])} files)")
+
+    # --- /debugz cuts an on-demand bundle over live HTTP -------------
+    with urllib.request.urlopen(srv.url("/debugz"), timeout=30) as r:
+        dz = json.loads(r.read())
+    assert dz.get("bundle") and not dz.get("error"), dz
+    inv2 = bundle.inventory(bundle_dir)
+    assert len(inv2) == 2 and all(b["ok"] for b in inv2), inv2
+    assert sum(1 for b in inv2 if b["trigger"] == "alert") == 1, inv2
+    print(f"SMOKE bundle: /debugz cut an on-demand bundle "
+          f"({os.path.basename(dz['bundle'])})")
+
+    # --- the report's profiles section, text + --json ----------------
+    obs.flush()
+    rep = build_report(obs_dir, obs_dir)
+    pr = rep.get("profiles")
+    assert pr and pr["samples"] > 0, pr
+    assert "smoke.hot" in pr["phases"], sorted(pr["phases"])
+    assert pr["bundles_valid"] == 2, pr
+    text = render_text(rep)
+    assert "-- profiles --" in text and "smoke.hot" in text, text
+    assert "bundles: 2/2 valid" in text, text
+    json.dumps(rep, default=str)  # --json path must survive
+    print("SMOKE report: profiles section renders the hot span + "
+          "bundle inventory (text + --json)")
+
+    total_wall = time.monotonic() - t0
+    bank = {
+        "hz": args.hz,
+        "total_wall_s": round(total_wall, 2),
+        "step_wall_s": round(step_wall, 2),
+        "samples": snap["samples"],
+        "skipped": snap["skipped"],
+        "hot_share": round(share, 4),
+        "overhead_ratio": round(overhead, 6),
+        "bundles": {"alert": 1, "http": 1, "valid": 2},
+        "profiles": {k: pr[k] for k in
+                     ("samples", "skipped", "overhead_ratio",
+                      "bundles_valid")},
+    }
+    with open(os.path.join(REPO, "PROF_SMOKE.json"), "w",
+              encoding="utf-8") as fh:
+        json.dump(bank, fh, indent=2, sort_keys=True, default=str)
+    print(f"PROF SMOKE PASS in {total_wall:.1f}s "
+          "(banked PROF_SMOKE.json)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

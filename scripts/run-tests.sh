@@ -207,6 +207,24 @@
 #                                                # banks FLEETOBS_SMOKE.json
 #                                                # for BENCH extras.fleetobs
 #                                                # (no pytest)
+#   scripts/run-tests.sh --prof                  # continuous-profiling +
+#                                                # debug-bundle smoke: a
+#                                                # rigged run with one
+#                                                # synthetically hot span
+#                                                # (must take >= 50% of the
+#                                                # profiler's self-time at
+#                                                # < 1% measured overhead),
+#                                                # one fired alert that must
+#                                                # cut exactly ONE manifest-
+#                                                # valid black-box bundle
+#                                                # (profile + traces +
+#                                                # metrics + ring inside),
+#                                                # /profilez + /debugz over
+#                                                # live HTTP, and the
+#                                                # report's profiles section
+#                                                # (text + --json); banks
+#                                                # PROF_SMOKE.json for BENCH
+#                                                # extras.prof (no pytest)
 #   scripts/run-tests.sh --live                  # live-telemetry smoke: a
 #                                                # 2-host run with /metrics +
 #                                                # /healthz servers on
@@ -251,6 +269,9 @@ elif [[ "${1:-}" == "--tune" ]]; then
 elif [[ "${1:-}" == "--lint" ]]; then
   shift
   exec python -m bigdl_tpu.analysis.lint "$@"
+elif [[ "${1:-}" == "--prof" ]]; then
+  shift
+  exec python scripts/prof_smoke.py "$@"
 elif [[ "${1:-}" == "--live" ]]; then
   shift
   exec python scripts/live_smoke.py "$@"

@@ -119,6 +119,25 @@ burn, non-finite spikes, stragglers, checkpoint failures and stale
 heartbeats — see MIGRATION.md "Live telemetry & alerting" and
 ``scripts/run-tests.sh --live`` for the end-to-end smoke.
 
+An incident that is GONE by the time anyone attaches tools (the 3am
+p99 spike, the once-a-week hang) is what the continuous profiling
+plane is for: with ``BIGDL_PROF_HZ`` set a sampling profiler is
+*always* on (span-attributed folded stacks, self-overhead capped hard
+at ``BIGDL_PROF_BUDGET`` — published as ``bigdl_prof_overhead_ratio``
+so a misconfigured rate is itself an alertable signal), served live at
+``GET /profilez`` (``?format=collapsed`` feeds any flamegraph tool)
+and folded into the report's "profiles" section.  With
+``BIGDL_BUNDLE_DIR`` set, every alert *firing* transition (exactly
+once per episode, per-rule rate-limited by
+``BIGDL_BUNDLE_RATE_LIMIT``), every supervisor crash/hang restart,
+and ``GET /debugz`` on demand cuts a black-box debug bundle — the
+profile, kept request traces, metrics snapshot, flight ring, runtime
+and alert state, sha256-manifested so a torn write is *detected*, not
+trusted; ``report`` inventories them and a SIGTERM'd process still
+lands its traces + profile through the atexit flush — see MIGRATION.md
+"Continuous profiling & debug bundles" and ``scripts/run-tests.sh
+--prof`` for the end-to-end smoke.
+
 A FLEET POLICY CHANGE (autoscale bands, alert rules, scrape or
 watchdog behavior) is validated BEFORE it meets real traffic by the
 control-plane simulator: ``scripts/run-tests.sh --fleet`` runs the

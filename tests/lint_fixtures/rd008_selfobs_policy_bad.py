@@ -1,0 +1,26 @@
+"""Seeded RD008: profiling/debug-bundle (``bigdl_prof_*`` /
+``bigdl_bundle_*``) counter families leaning on the implicit additive
+policy.  Linted with ``RegistryRules(names_path=<this file>)`` — a
+mini registry, not the real obs/names.py."""
+
+REGISTRY = {}
+
+
+def _m(name, kind, labels=(), cardinality=1, doc="", policy=None):
+    return name
+
+
+# RD008: a prof counter with no spelled-out policy — the selfobs plane
+# must not lean on the implicit fleet default
+SAMPLES = _m("bigdl_prof_samples_total", "counter",
+             doc="stack samples taken")
+
+# RD008: same for the bundle plane, labelled form
+WRITES = _m("bigdl_bundle_writes_total", "counter",
+            labels=("trigger",), cardinality=4,
+            doc="bundles written, by trigger")
+
+# RD008: histograms are additive-by-kind too, but selfobs ones still
+# spell it
+BUILD = _m("bigdl_bundle_build_seconds", "histogram",
+           doc="bundle build latency")

@@ -1,0 +1,35 @@
+"""Clean twin of rd008: every profiling/debug-bundle family spells its
+fleet policy out — counters/histograms say ``policy='sum'`` even though
+that is the kind's default, gauges pick their fold as RD007 already
+demands — and non-selfobs counters stay free to rely on the default."""
+
+REGISTRY = {}
+
+
+def _m(name, kind, labels=(), cardinality=1, doc="", policy=None):
+    return name
+
+
+# selfobs counters/histograms with the additive policy spelled out
+SAMPLES = _m("bigdl_prof_samples_total", "counter",
+             doc="stack samples taken", policy="sum")
+WRITES = _m("bigdl_bundle_writes_total", "counter",
+            labels=("trigger",), cardinality=4,
+            doc="bundles written, by trigger", policy="sum")
+BUILD = _m("bigdl_bundle_build_seconds", "histogram",
+           doc="bundle build latency", policy="sum")
+
+# selfobs gauges already pick a fold under RD007 — no RD008 overlap
+OVERHEAD = _m("bigdl_prof_overhead_ratio", "gauge",
+              doc="worst profiler overhead across the fleet",
+              policy="max")
+
+# a family OUTSIDE the selfobs prefixes may still lean on the implicit
+# additive default — RD008 is scoped, not a blanket rule
+STEPS = _m("bigdl_fixture_steps_total", "counter",
+           doc="resolved steps")
+
+# the opt-out spelling is honored for selfobs families too
+LEGACY = _m(  # graftlint: disable=RD008
+    "bigdl_prof_legacy_total", "counter",
+    doc="a grandfathered prof counter without the spelled policy")

@@ -308,6 +308,60 @@ class TestFleetPolicyRule:
         assert "bigdl_fixture_in_flight" in findings[0].message
 
 
+class TestSelfObsPolicyRule:
+    """RD008 over fixture mini-registries: bigdl_prof_*/bigdl_bundle_*
+    counters/histograms must spell ``policy='sum'`` out (packs-injected
+    so the rule reads the fixture as its names.py)."""
+
+    def _lint_fixture(self, stem):
+        path = os.path.join(FIX, f"{stem}.py")
+        pack = RegistryRules(names_path=path)
+        return Linter([path], root=REPO, packs=[pack]).run()
+
+    def test_bad_twin_fires_exactly_rd008(self):
+        findings = self._lint_fixture("rd008_selfobs_policy_bad")
+        assert findings, "rd008_selfobs_policy_bad.py produced no findings"
+        assert {f.rule for f in findings} == {"RD008"}, \
+            "\n".join(f.render() for f in findings)
+        # one finding per seeded family, each carrying a real location
+        assert len(findings) == 3
+        for f in findings:
+            assert f.path.endswith("rd008_selfobs_policy_bad.py") \
+                and f.line > 0
+        msgs = "\n".join(f.message for f in findings)
+        assert "bigdl_prof_samples_total" in msgs   # bare prof counter
+        assert "bigdl_bundle_writes_total" in msgs  # labelled counter
+        assert "bigdl_bundle_build_seconds" in msgs  # histogram
+
+    def test_clean_twin_is_silent(self):
+        findings = self._lint_fixture("rd008_selfobs_policy_clean")
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+    def test_opt_out_requires_the_inline_disable(self, tmp_path):
+        src = open(os.path.join(
+            FIX, "rd008_selfobs_policy_clean.py")).read()
+        src = src.replace("_m(  # graftlint: disable=RD008", "_m(")
+        p = tmp_path / "names_fixture.py"
+        p.write_text(src)
+        pack = RegistryRules(names_path=str(p))
+        findings = Linter([str(p)], root=str(tmp_path),
+                          packs=[pack]).run()
+        assert [f.rule for f in findings] == ["RD008"]
+        assert "bigdl_prof_legacy_total" in findings[0].message
+
+    def test_real_registry_spells_selfobs_policies(self):
+        # the rule's point: the shipped names.py never leans on the
+        # implicit default for the profiling/debug-bundle plane
+        from bigdl_tpu.obs import names
+
+        selfobs = [s for s in names.REGISTRY.values()
+                   if s.name.startswith(("bigdl_prof_", "bigdl_bundle_"))]
+        assert selfobs, "prof/bundle families vanished from names.py"
+        for spec in selfobs:
+            assert spec.policy is not None, \
+                f"{spec.name} relies on an implicit fleet policy"
+
+
 class TestStrictRegistry:
     """BIGDL_OBS_STRICT=1 — the runtime half of the RD003/RD005 pins."""
 

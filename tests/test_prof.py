@@ -1,0 +1,583 @@
+"""Continuous profiling plane + black-box debug bundles (ISSUE 19).
+
+The acceptance pins live here: the sampling profiler is OFF by default
+(the null object holds no thread), attributes stacks to the innermost
+live tracer span when on, and degrades to its ``BIGDL_PROF_BUDGET``
+hard cap instead of past it; debug bundles are torn-write-safe
+(manifest written last — a bundle either verifies whole or the
+inventory flags it), cut exactly once per alert episode under the
+per-rule rate limit, on supervisor crash restarts, and on demand over
+``GET /debugz``; the report grows a profiles section; and a SIGTERM'd
+process still lands its kept request traces + folded profile on disk
+through the atexit flush.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from bigdl_tpu import obs
+from bigdl_tpu.obs import alerts, bundle, names, prof, server
+
+pytestmark = pytest.mark.obs
+
+_PROF_VARS = (
+    "BIGDL_OBS", "BIGDL_TRACE_DIR", "BIGDL_METRICS_DIR",
+    "BIGDL_OBS_PORT", "BIGDL_OBS_PORT_FILE", "BIGDL_ALERT_RULES",
+    "BIGDL_PROF_HZ", "BIGDL_PROF_BUDGET", "BIGDL_BUNDLE_DIR",
+    "BIGDL_BUNDLE_RATE_LIMIT", "BIGDL_REQTRACE_SAMPLE",
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs(monkeypatch):
+    for var in _PROF_VARS:
+        monkeypatch.delenv(var, raising=False)
+    obs.reset()
+    yield
+    obs.reset()
+
+
+def _burn(seconds: float) -> int:
+    acc = 0
+    until = time.monotonic() + seconds
+    while time.monotonic() < until:
+        acc += sum(i * i for i in range(200))
+    return acc
+
+
+def _prof_threads():
+    return [t for t in threading.enumerate() if t.name == "bigdl-prof"]
+
+
+# ------------------------------------------------------------ profiler
+class TestProfilerOffPath:
+    def test_off_by_default_is_the_null_object(self):
+        p = prof.get_profiler()
+        assert p is prof.NULL_PROFILER
+        assert not p.enabled and p.hz == 0.0
+        assert _prof_threads() == [], \
+            "profiler off but a sampler thread is alive"
+
+    def test_null_snapshot_has_the_full_surface(self):
+        snap = prof.NULL_PROFILER.snapshot()
+        assert snap["enabled"] is False
+        assert snap["samples"] == 0 and snap["phases"] == {}
+        assert prof.NULL_PROFILER.render_collapsed() == ""
+        prof.NULL_PROFILER.close()  # must be a no-op, not an error
+
+    def test_current_never_builds_a_profiler(self, monkeypatch):
+        monkeypatch.setenv("BIGDL_PROF_HZ", "100")
+        # current() is the cheap-read path: health payloads and report
+        # columns must not start a sampler thread as a side effect
+        assert prof.current() is prof.NULL_PROFILER
+        assert _prof_threads() == []
+
+    def test_write_profile_none_when_off(self, tmp_path):
+        assert prof.write_profile(str(tmp_path), "x") is None
+        assert os.listdir(str(tmp_path)) == []
+
+
+class TestProfilerSampling:
+    def test_span_attribution(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("BIGDL_TRACE_DIR", str(tmp_path))
+        monkeypatch.setenv("BIGDL_PROF_HZ", "100")
+        obs.reset()
+        p = prof.get_profiler()
+        assert p.enabled and len(_prof_threads()) == 1
+        tracer = obs.get_tracer()
+        with tracer.span("tp.hot"):
+            _burn(0.8)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            snap = p.snapshot()
+            if snap["samples"] >= 5 and "tp.hot" in snap["phases"]:
+                break
+            time.sleep(0.05)
+        assert snap["samples"] >= 5, snap
+        assert "tp.hot" in snap["phases"], sorted(snap["phases"])
+        hot = snap["phases"]["tp.hot"]
+        assert hot["samples"] > 0 and hot["frames"], hot
+        # collapsed stacks fold root-first under the phase
+        collapsed = p.render_collapsed()
+        assert any(line.startswith("tp.hot;")
+                   for line in collapsed.splitlines()), collapsed
+
+    def test_nested_spans_attribute_to_the_innermost(self, monkeypatch,
+                                                     tmp_path):
+        monkeypatch.setenv("BIGDL_TRACE_DIR", str(tmp_path))
+        monkeypatch.setenv("BIGDL_PROF_HZ", "100")
+        obs.reset()
+        p = prof.get_profiler()
+        tracer = obs.get_tracer()
+        with tracer.span("tp.outer"):
+            with tracer.span("tp.inner"):
+                _burn(0.6)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            snap = p.snapshot()
+            if "tp.inner" in snap["phases"]:
+                break
+            time.sleep(0.05)
+        assert "tp.inner" in snap["phases"], sorted(snap["phases"])
+
+    def test_budget_cap_degrades_instead_of_past(self, monkeypatch):
+        # an absurd budget: after the first real sample the work ratio
+        # exceeds it forever, so sampling degrades to bookkeeping-only
+        monkeypatch.setenv("BIGDL_PROF_HZ", "200")
+        monkeypatch.setenv("BIGDL_PROF_BUDGET", "0.0000001")
+        obs.reset()
+        p = prof.get_profiler()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            snap = p.snapshot()
+            if snap["skipped"] >= 10:
+                break
+            time.sleep(0.05)
+        assert snap["skipped"] >= 10, snap
+        assert snap["samples"] <= 3, \
+            f"over-budget profiler kept sampling: {snap['samples']}"
+
+    def test_rebuilds_on_config_change_and_reset(self, monkeypatch):
+        monkeypatch.setenv("BIGDL_PROF_HZ", "50")
+        obs.reset()
+        p1 = prof.get_profiler()
+        assert p1.hz == 50.0
+        monkeypatch.setenv("BIGDL_PROF_HZ", "25")
+        p2 = prof.get_profiler()
+        assert p2 is not p1 and p2.hz == 25.0
+        monkeypatch.delenv("BIGDL_PROF_HZ")
+        assert prof.get_profiler() is prof.NULL_PROFILER
+        assert _prof_threads() == []
+
+    def test_write_profile_shard(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("BIGDL_TRACE_DIR", str(tmp_path))
+        monkeypatch.setenv("BIGDL_PROF_HZ", "100")
+        obs.reset()
+        p = prof.get_profiler()
+        with obs.get_tracer().span("tp.shard"):
+            _burn(0.5)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and p.snapshot()["samples"] < 3:
+            time.sleep(0.05)
+        path = prof.write_profile(str(tmp_path), "prof.h0")
+        assert path and os.path.isfile(path)
+        with open(path, encoding="utf-8") as fh:
+            shard = json.load(fh)
+        assert shard["samples"] >= 3 and shard["hz"] == 100.0
+
+
+# -------------------------------------------------------------- bundles
+class TestBundleIntegrity:
+    def _build(self, tmp_path, **kw):
+        return bundle.build_bundle(
+            reason="test", bundle_dir=str(tmp_path), **kw)
+
+    def test_build_and_verify(self, tmp_path):
+        path = self._build(tmp_path)
+        ok, why = bundle.verify_bundle(path)
+        assert ok, why
+        assert why == f"{len(bundle.BUNDLE_FILES)} files verified"
+        with open(os.path.join(path, bundle.MANIFEST),
+                  encoding="utf-8") as fh:
+            manifest = json.load(fh)
+        assert manifest["format"] == 1
+        assert set(manifest["files"]) == set(bundle.BUNDLE_FILES)
+        for fname, meta in manifest["files"].items():
+            fpath = os.path.join(path, fname)
+            assert os.path.getsize(fpath) == meta["size"]
+            assert bundle._sha256(fpath) == meta["sha256"]
+
+    def test_no_manifest_is_torn(self, tmp_path):
+        path = self._build(tmp_path)
+        os.unlink(os.path.join(path, bundle.MANIFEST))
+        ok, why = bundle.verify_bundle(path)
+        assert not ok and why == "no manifest"
+
+    def test_truncated_file_is_torn(self, tmp_path):
+        path = self._build(tmp_path)
+        victim = os.path.join(path, "metrics.json")
+        with open(victim, "w", encoding="utf-8") as fh:
+            fh.write("{}")
+        ok, why = bundle.verify_bundle(path)
+        assert not ok and "size" in why
+
+    def test_same_size_corruption_is_torn(self, tmp_path):
+        path = self._build(tmp_path)
+        victim = os.path.join(path, "ring.json")
+        size = os.path.getsize(victim)
+        with open(victim, "wb") as fh:
+            fh.write(b"X" * size)
+        ok, why = bundle.verify_bundle(path)
+        assert not ok and "sha256 mismatch" in why
+
+    def test_tmp_staging_dir_is_interrupted_by_construction(self,
+                                                            tmp_path):
+        staged = tmp_path / "bundle-xyz-1.tmp"
+        staged.mkdir()
+        ok, why = bundle.verify_bundle(str(staged))
+        assert not ok and "interrupted" in why
+
+    def test_inventory_flags_and_skips_torn(self, tmp_path):
+        good = self._build(tmp_path)
+        torn = self._build(tmp_path)
+        os.unlink(os.path.join(torn, bundle.MANIFEST))
+        inv = bundle.inventory(str(tmp_path))
+        assert len(inv) == 2
+        by_path = {b["path"]: b for b in inv}
+        assert by_path[good]["ok"] and by_path[good]["bytes"] > 0
+        assert by_path[good]["trigger"] == "manual"
+        assert not by_path[torn]["ok"]
+        assert by_path[torn]["reason"] == "no manifest"
+
+    def test_no_dir_is_loud(self):
+        with pytest.raises(ValueError, match="BIGDL_BUNDLE_DIR"):
+            bundle.build_bundle(reason="nowhere")
+
+    def test_unset_dir_inventory_is_empty(self):
+        assert bundle.inventory() == []
+
+    def test_writes_counter_by_trigger(self, tmp_path):
+        self._build(tmp_path, trigger="manual")
+        from bigdl_tpu.obs.server import _bundle_writes
+
+        assert _bundle_writes() == 1
+
+
+class TestAlertBundleTrigger:
+    def _fire(self, monkeypatch, tmp_path, rate_limit="0"):
+        monkeypatch.setenv("BIGDL_METRICS_DIR", str(tmp_path / "m"))
+        monkeypatch.setenv("BIGDL_BUNDLE_DIR", str(tmp_path / "b"))
+        monkeypatch.setenv("BIGDL_BUNDLE_RATE_LIMIT", rate_limit)
+        obs.reset()
+        obs.get_registry().counter(
+            names.PROF_SAMPLES_TOTAL, "x").inc(10)
+        rule = {"name": "tp_bundle", "type": "threshold",
+                "metric": names.PROF_SAMPLES_TOTAL, "op": ">",
+                "value": 5, "for": 1, "severity": "warning"}
+        return alerts.AlertEngine([rule]), str(tmp_path / "b")
+
+    def test_exactly_one_bundle_per_episode(self, monkeypatch, tmp_path):
+        engine, bdir = self._fire(monkeypatch, tmp_path)
+        fired = engine.evaluate()
+        assert [t["state"] for t in fired] == ["firing"]
+        inv = bundle.inventory(bdir)
+        assert len(inv) == 1 and inv[0]["ok"]
+        assert inv[0]["trigger"] == "alert"
+        # the same still-firing episode must not cut a second bundle
+        engine.evaluate()
+        engine.evaluate()
+        assert len(bundle.inventory(bdir)) == 1
+
+    def test_bundle_context_carries_the_transition(self, monkeypatch,
+                                                   tmp_path):
+        engine, bdir = self._fire(monkeypatch, tmp_path)
+        engine.evaluate()
+        (rec,) = bundle.inventory(bdir)
+        with open(os.path.join(rec["path"], "alerts.json"),
+                  encoding="utf-8") as fh:
+            payload = json.load(fh)
+        assert payload["trigger"] == "alert"
+        assert payload["transition"]["rule"] == "tp_bundle"
+        assert "episode" in payload["transition"]
+
+    def test_rate_limit_drops_the_second_episode(self, monkeypatch,
+                                                 tmp_path):
+        monkeypatch.setenv("BIGDL_BUNDLE_DIR", str(tmp_path))
+        monkeypatch.setenv("BIGDL_BUNDLE_RATE_LIMIT", "3600")
+        obs.reset()
+        t1 = {"rule": "r", "episode": 1, "state": "firing"}
+        t2 = {"rule": "r", "episode": 2, "state": "firing"}
+        assert bundle.on_alert_firing(t1, engine_uid=901) is not None
+        assert bundle.on_alert_firing(t2, engine_uid=901) is None
+        assert len(bundle.inventory(str(tmp_path))) == 1
+        # a different rule has its own rate-limit bucket
+        t3 = {"rule": "other", "episode": 1, "state": "firing"}
+        assert bundle.on_alert_firing(t3, engine_uid=901) is not None
+
+    def test_rate_limit_zero_means_off(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("BIGDL_BUNDLE_DIR", str(tmp_path))
+        monkeypatch.setenv("BIGDL_BUNDLE_RATE_LIMIT", "0")
+        obs.reset()
+        for ep in (1, 2, 3):
+            got = bundle.on_alert_firing(
+                {"rule": "r", "episode": ep, "state": "firing"},
+                engine_uid=902)
+            assert got is not None
+        assert len(bundle.inventory(str(tmp_path))) == 3
+
+    def test_unset_bundle_dir_gates_everything_off(self, tmp_path):
+        got = bundle.on_alert_firing(
+            {"rule": "r", "episode": 1, "state": "firing"},
+            engine_uid=903)
+        assert got is None
+        assert os.listdir(str(tmp_path)) == []
+
+
+class TestSupervisorBundle:
+    def test_crash_restart_cuts_supervisor_bundles(self, monkeypatch,
+                                                   tmp_path):
+        from bigdl_tpu.resilience.supervisor import Supervisor
+
+        monkeypatch.setenv("BIGDL_BUNDLE_DIR", str(tmp_path))
+        obs.reset()
+        sup = Supervisor(["false"], max_retries=1, hang_timeout=0,
+                         runner=lambda cmd, env: 1,
+                         sleep=lambda s: None)
+        assert sup.run() == 1
+        inv = bundle.inventory(str(tmp_path))
+        assert inv and all(b["ok"] for b in inv)
+        assert {b["trigger"] for b in inv} == {"supervisor"}
+        with open(os.path.join(inv[0]["path"], "alerts.json"),
+                  encoding="utf-8") as fh:
+            payload = json.load(fh)
+        assert payload["transition"]["kind"] == "transient"
+
+    def test_no_bundle_dir_no_bundle_no_crash(self, tmp_path):
+        from bigdl_tpu.resilience.supervisor import Supervisor
+
+        sup = Supervisor(["false"], max_retries=1, hang_timeout=0,
+                         runner=lambda cmd, env: 1,
+                         sleep=lambda s: None)
+        assert sup.run() == 1  # _maybe_bundle gated off, never raises
+
+
+# ------------------------------------------------------------ endpoints
+class TestLiveEndpoints:
+    def test_profilez_serves_snapshot_and_collapsed(self, monkeypatch,
+                                                    tmp_path):
+        monkeypatch.setenv("BIGDL_OBS_PORT", "0")
+        monkeypatch.setenv("BIGDL_TRACE_DIR", str(tmp_path))
+        monkeypatch.setenv("BIGDL_PROF_HZ", "100")
+        obs.reset()
+        p = prof.get_profiler()
+        srv = server.ensure_server()
+        assert srv is not None
+        with obs.get_tracer().span("tp.live"):
+            _burn(0.5)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and p.snapshot()["samples"] < 3:
+            time.sleep(0.05)
+        with urllib.request.urlopen(srv.url("/profilez"), timeout=10) as r:
+            pz = json.loads(r.read())
+        assert pz["enabled"] and pz["samples"] >= 3
+        with urllib.request.urlopen(
+                srv.url("/profilez?format=collapsed"), timeout=10) as r:
+            assert b";" in r.read()
+
+    def test_profilez_off_path_answers_disabled(self, monkeypatch):
+        monkeypatch.setenv("BIGDL_OBS_PORT", "0")
+        obs.reset()
+        srv = server.ensure_server()
+        with urllib.request.urlopen(srv.url("/profilez"), timeout=10) as r:
+            pz = json.loads(r.read())
+        assert pz["enabled"] is False and pz["samples"] == 0
+        assert _prof_threads() == []
+
+    def test_debugz_builds_an_on_demand_bundle(self, monkeypatch,
+                                               tmp_path):
+        monkeypatch.setenv("BIGDL_OBS_PORT", "0")
+        monkeypatch.setenv("BIGDL_BUNDLE_DIR", str(tmp_path))
+        obs.reset()
+        srv = server.ensure_server()
+        with urllib.request.urlopen(srv.url("/debugz"), timeout=30) as r:
+            dz = json.loads(r.read())
+        assert dz["error"] is None and dz["bundle"]
+        assert len(dz["inventory"]) == 1
+        assert dz["inventory"][0]["trigger"] == "http"
+        ok, why = bundle.verify_bundle(dz["bundle"])
+        assert ok, why
+
+    def test_debugz_without_dir_is_503_not_500(self, monkeypatch):
+        monkeypatch.setenv("BIGDL_OBS_PORT", "0")
+        obs.reset()
+        srv = server.ensure_server()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(srv.url("/debugz"), timeout=10)
+        assert ei.value.code == 503
+        dz = json.loads(ei.value.read())
+        assert "BIGDL_BUNDLE_DIR" in dz["error"]
+        assert dz["inventory"] == []
+
+    def test_healthz_carries_prof_overhead_and_bundles(self, monkeypatch,
+                                                       tmp_path):
+        monkeypatch.setenv("BIGDL_PROF_HZ", "100")
+        monkeypatch.setenv("BIGDL_BUNDLE_DIR", str(tmp_path))
+        obs.reset()
+        prof.get_profiler()
+        bundle.build_bundle(reason="hp", bundle_dir=str(tmp_path))
+        payload = server.health_payload()
+        assert payload["prof_overhead"] is not None
+        assert payload["bundles"] == 1
+
+    def test_healthz_prof_overhead_none_when_off(self):
+        payload = server.health_payload()
+        assert payload["prof_overhead"] is None
+        assert payload["bundles"] == 0
+
+
+# --------------------------------------------------------------- report
+class TestReportProfiles:
+    def test_profiles_section_from_shards_and_bundles(self, monkeypatch,
+                                                      tmp_path):
+        from bigdl_tpu.obs.report import build_report, render_text
+
+        monkeypatch.setenv("BIGDL_TRACE_DIR", str(tmp_path))
+        monkeypatch.setenv("BIGDL_METRICS_DIR", str(tmp_path))
+        monkeypatch.setenv("BIGDL_PROF_HZ", "100")
+        obs.reset()
+        p = prof.get_profiler()
+        with obs.get_tracer().span("tp.report"):
+            _burn(0.5)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and p.snapshot()["samples"] < 3:
+            time.sleep(0.05)
+        bdir = str(tmp_path / "bundles")
+        bundle.build_bundle(reason="rep", bundle_dir=bdir)
+        obs.flush()
+        rep = build_report(str(tmp_path), str(tmp_path),
+                           bundle_dir=bdir)
+        pr = rep["profiles"]
+        assert pr["samples"] >= 3
+        assert "tp.report" in pr["phases"]
+        assert pr["bundles_valid"] == 1
+        text = render_text(rep)
+        assert "-- profiles --" in text
+        assert "tp.report" in text
+        assert "bundles: 1/1 valid" in text
+        json.dumps(rep, default=str)
+
+    def test_bundles_dir_found_without_the_flag(self, monkeypatch,
+                                                tmp_path):
+        # <metrics_dir>/bundles is the conventional layout: the report
+        # must inventory it unprompted
+        from bigdl_tpu.obs.report import build_report
+
+        bundle.build_bundle(reason="conv",
+                            bundle_dir=str(tmp_path / "bundles"))
+        rep = build_report(str(tmp_path), str(tmp_path))
+        assert rep["profiles"]["bundles_valid"] == 1
+
+    def test_torn_bundle_shown_and_skipped(self, monkeypatch, tmp_path):
+        from bigdl_tpu.obs.report import build_report, render_text
+
+        bdir = str(tmp_path / "bundles")
+        good = bundle.build_bundle(reason="ok", bundle_dir=bdir)
+        torn = bundle.build_bundle(reason="torn", bundle_dir=bdir)
+        os.unlink(os.path.join(torn, bundle.MANIFEST))
+        rep = build_report(str(tmp_path), str(tmp_path),
+                           bundle_dir=bdir)
+        pr = rep["profiles"]
+        assert pr["bundles_valid"] == 1 and len(pr["bundles"]) == 2
+        text = render_text(rep)
+        assert "bundles: 1/2 valid" in text
+        assert "SKIPPED" in text and "no manifest" in text
+        assert os.path.basename(good) in text
+
+    def test_no_activity_renders_the_hint(self, tmp_path):
+        from bigdl_tpu.obs.report import build_report, render_text
+
+        rep = build_report(str(tmp_path), str(tmp_path))
+        assert rep["profiles"] is None
+        assert "BIGDL_PROF_HZ" in render_text(rep)
+
+
+# ------------------------------------------------------------------ sim
+class TestAlertStormScenario:
+    def test_alert_storm_cuts_one_bundle_per_episode(self, monkeypatch,
+                                                     tmp_path):
+        from bigdl_tpu.sim import run_scenario
+
+        monkeypatch.setenv("BIGDL_BUNDLE_DIR", str(tmp_path))
+        monkeypatch.setenv("BIGDL_BUNDLE_RATE_LIMIT", "0")
+        obs.reset()
+        res = run_scenario("alert_storm", hosts=6, seed=0,
+                           time_compression=2.0)
+        assert res.ok, res.summary()
+        assert res.episodes == 18  # 3 fleet-wide dips x 6 hosts
+        assert res.bundles == res.episodes
+        by_name = {r.name: r for r in res.invariants}
+        assert by_name["bundle_per_episode"].ok
+        inv = bundle.inventory(str(tmp_path))
+        assert sum(1 for b in inv if b["ok"]) == res.episodes
+
+    def test_invariant_notes_the_unarmed_plane(self, monkeypatch):
+        # the slow full-matrix run has no BIGDL_BUNDLE_DIR: the
+        # invariant must pass-with-note, not fail the scenario
+        from bigdl_tpu.sim.invariants import check_bundles
+
+        r = check_bundles({"transitions": [], "alerts": []},
+                          {"bundles_per_episode": True})
+        assert r.ok and "BIGDL_BUNDLE_DIR" in r.detail
+
+
+# ------------------------------------------------------------ crash path
+class TestCrashFlush:
+    def test_sigterm_lands_reqtraces_and_profile(self, tmp_path):
+        """A real SIGTERM'd process: the preemption handler turns the
+        signal into SystemExit, the atexit flush runs, and the kept
+        request traces + the folded profile land next to the metrics
+        snapshot — the black box survives the process."""
+        script = textwrap.dedent(f"""
+            import os, signal, sys, time
+            sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            os.environ["BIGDL_TRACE_DIR"] = {str(tmp_path)!r}
+            os.environ["BIGDL_METRICS_DIR"] = {str(tmp_path)!r}
+            os.environ["BIGDL_REQTRACE_SAMPLE"] = "1.0"
+            os.environ["BIGDL_PROF_HZ"] = "100"
+            from bigdl_tpu import obs
+            from bigdl_tpu.obs import prof, reqtrace
+            from bigdl_tpu.resilience import elastic
+            elastic.install_preemption_handler()
+            col = reqtrace.get_collector()
+            ctx = col.new_context()
+            col.begin(ctx)
+            col.span(ctx, "crash.step", time.perf_counter(), 0.01)
+            kept, reason = col.finish(ctx, request="crash-req",
+                                      error="boom")
+            assert kept, reason
+            p = prof.get_profiler()
+            tracer = obs.get_tracer()
+            with tracer.span("crash.hot"):
+                until = time.monotonic() + 1.0
+                while time.monotonic() < until \\
+                        and p.snapshot()["samples"] < 3:
+                    sum(i * i for i in range(500))
+            os.kill(os.getpid(), signal.SIGTERM)
+            time.sleep(10)
+            print("NOT_TERMINATED", flush=True)
+        """)
+        worker = tmp_path / "worker.py"
+        worker.write_text(script)
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        proc = subprocess.run([sys.executable, str(worker)],
+                              capture_output=True, text=True, env=env,
+                              timeout=180)
+        from bigdl_tpu.resilience.elastic import EXIT_PREEMPTED
+
+        assert proc.returncode == EXIT_PREEMPTED, (
+            f"rc={proc.returncode}\n{proc.stdout[-2000:]}"
+            f"\n{proc.stderr[-2000:]}")
+        assert "NOT_TERMINATED" not in proc.stdout
+        rts = [f for f in os.listdir(str(tmp_path))
+               if f.startswith("reqtraces.") and f.endswith(".json")]
+        assert rts, sorted(os.listdir(str(tmp_path)))
+        with open(str(tmp_path / rts[0]), encoding="utf-8") as fh:
+            kept = json.load(fh)
+        assert any(t.get("request") == "crash-req" for t in kept), kept
+        profs = [f for f in os.listdir(str(tmp_path))
+                 if f.endswith(".profile.json")]
+        assert profs, sorted(os.listdir(str(tmp_path)))
+        with open(str(tmp_path / profs[0]), encoding="utf-8") as fh:
+            shard = json.load(fh)
+        assert shard["samples"] >= 1
