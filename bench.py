@@ -716,6 +716,23 @@ def _reqtrace_extras():
         return None
 
 
+def _rollout_extras():
+    """Live-weight-rollout evidence for the BENCH JSON: the newest
+    ``ROLLOUT_SMOKE.json`` banked by scripts/rollout_smoke.py (the
+    checkpoint watcher's hot-swap + verify-gate segment, the canary
+    promote/rollback segment, and the weight_rollout chaos scenario's
+    invariant verdicts).  None when the smoke has never been run."""
+    try:
+        smoke = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "ROLLOUT_SMOKE.json")
+        if not os.path.exists(smoke):
+            return None
+        with open(smoke, "r", encoding="utf-8") as fh:
+            return {"smoke": json.load(fh)}
+    except Exception:
+        return None
+
+
 def _prof_extras():
     """Continuous-profiling evidence for the BENCH JSON: the newest
     ``PROF_SMOKE.json`` banked by scripts/prof_smoke.py (the rigged
@@ -1109,6 +1126,9 @@ def _run_child(platform: str):
     prof = _prof_extras()
     if prof is not None:
         ex["prof"] = prof
+    rollout = _rollout_extras()
+    if rollout is not None:
+        ex["rollout"] = rollout
     print(PARTIAL_MARK + json.dumps(result), flush=True)
 
 

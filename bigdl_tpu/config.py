@@ -527,6 +527,11 @@ class RouterConfig:
     # Retry-After seconds stamped on shed (503) responses
     # [BIGDL_ROUTER_RETRY_AFTER]
     retry_after_s: float = 1.0
+    # exclude replicas whose exported host-clock staleness
+    # (``staleness_s`` signal) exceeds BIGDL_STALE_AFTER_S from
+    # placement — a skewed host's SLO and handoff timestamps cannot be
+    # trusted [BIGDL_ROUTER_STALE_EXCLUDE]
+    stale_exclude: bool = True
 
     @classmethod
     def from_env(cls) -> "RouterConfig":
@@ -544,6 +549,65 @@ class RouterConfig:
             kv_weight=_env_float("BIGDL_ROUTER_KV_WEIGHT", 4.0),
             backoff_base_s=_env_float("BIGDL_ROUTER_BACKOFF_BASE", 0.05),
             retry_after_s=_env_float("BIGDL_ROUTER_RETRY_AFTER", 1.0),
+            stale_exclude=_env_bool("BIGDL_ROUTER_STALE_EXCLUDE", True),
+        )
+
+
+@dataclasses.dataclass
+class RolloutConfig:
+    """Live weight rollout (``bigdl_tpu/serving/rollout.py``).
+
+    The online training->serving pipe: a checkpoint watcher hot-swaps
+    manifest-verified weights into a live engine between decode steps,
+    and a router-level canary controller promotes a new version to a
+    fraction of replicas, auto-rolling back on SLO burn or output
+    divergence with autoscaler-style hysteresis.
+    """
+
+    # directory the engine-side watcher polls for published checkpoint
+    # prefixes (<version>.model.npz + <version>.manifest.json); unset =
+    # watcher built programmatically only [BIGDL_ROLLOUT_WATCH]
+    watch_dir: Optional[str] = None
+    # watcher poll period in seconds [BIGDL_ROLLOUT_POLL]
+    poll_s: float = 1.0
+    # fraction of replicas a new version canaries on before full
+    # promotion (at least one) [BIGDL_ROLLOUT_CANARY_FRACTION]
+    canary_fraction: float = 0.25
+    # canary replay divergence (fraction of mismatched tokens on the
+    # pinned prompt set) past which a rollback breach is counted
+    # [BIGDL_ROLLOUT_DIVERGENCE]
+    divergence_threshold: float = 0.05
+    # consecutive breached evaluations before a rollback fires (the
+    # autoscaler's "for" hysteresis — one noisy window cannot flap)
+    # [BIGDL_ROLLOUT_FOR]
+    for_count: int = 2
+    # consecutive CLEAN evaluations before the canary promotes to the
+    # whole fleet [BIGDL_ROLLOUT_HOLD]
+    hold_evals: int = 3
+    # cooldown after a rollback: the same version cannot re-canary (and
+    # no new offer is accepted) inside this window
+    # [BIGDL_ROLLOUT_COOLDOWN]
+    cooldown_s: float = 30.0
+    # pinned prompt set the canary replays for the divergence signal:
+    # count and per-prompt decode length [BIGDL_ROLLOUT_PROMPTS /
+    # BIGDL_ROLLOUT_PROMPT_TOKENS]
+    pinned_prompts: int = 4
+    pinned_tokens: int = 8
+
+    @classmethod
+    def from_env(cls) -> "RolloutConfig":
+        return cls(
+            watch_dir=_env_str("BIGDL_ROLLOUT_WATCH", None),
+            poll_s=_env_float("BIGDL_ROLLOUT_POLL", 1.0),
+            canary_fraction=_env_float("BIGDL_ROLLOUT_CANARY_FRACTION",
+                                       0.25),
+            divergence_threshold=_env_float("BIGDL_ROLLOUT_DIVERGENCE",
+                                            0.05),
+            for_count=_env_int("BIGDL_ROLLOUT_FOR", 2),
+            hold_evals=_env_int("BIGDL_ROLLOUT_HOLD", 3),
+            cooldown_s=_env_float("BIGDL_ROLLOUT_COOLDOWN", 30.0),
+            pinned_prompts=_env_int("BIGDL_ROLLOUT_PROMPTS", 4),
+            pinned_tokens=_env_int("BIGDL_ROLLOUT_PROMPT_TOKENS", 8),
         )
 
 
@@ -729,8 +793,14 @@ class BigDLConfig:
     # --- multi-replica serving router (serving/router.py) ---------------
     # [BIGDL_ROUTER_REPLICAS / _PORT / _AFFINITY_TTL / _RETRY_BUDGET /
     #  _RETRY_BURST / _MAX_RETRIES / _TIMEOUT / _DRAIN_DEADLINE /
-    #  _KV_WEIGHT / _BACKOFF_BASE / _RETRY_AFTER]
+    #  _KV_WEIGHT / _BACKOFF_BASE / _RETRY_AFTER / _STALE_EXCLUDE]
     router: RouterConfig = dataclasses.field(default_factory=RouterConfig)
+
+    # --- live weight rollout (serving/rollout.py) -----------------------
+    # [BIGDL_ROLLOUT_WATCH / _POLL / _CANARY_FRACTION / _DIVERGENCE /
+    #  _FOR / _HOLD / _COOLDOWN / _PROMPTS / _PROMPT_TOKENS]
+    rollout: RolloutConfig = dataclasses.field(
+        default_factory=RolloutConfig)
 
     # --- fleet-scale control-plane simulator (sim/ package) -------------
     # [BIGDL_FLEET_HOSTS / _SCENARIO / _TIME_COMPRESSION / _SEED]
@@ -777,6 +847,7 @@ class BigDLConfig:
             wire=WireConfig.from_env(),
             serve=ServeConfig.from_env(),
             router=RouterConfig.from_env(),
+            rollout=RolloutConfig.from_env(),
             fleet=FleetSimConfig.from_env(),
         )
 

@@ -423,6 +423,45 @@ ROUTER_REPLICAS = _m(  # graftlint: disable=RD007
 ROUTER_RETRY_BUDGET_TOKENS = _m(
     "bigdl_router_retry_budget_tokens", "gauge", policy="min",
     doc="Tokens left in the router's shared retry-budget bucket")
+ROUTER_STALE_EXCLUDED_TOTAL = _m(
+    "bigdl_router_stale_excluded_total", "counter",
+    doc="Placement snapshots that marked a replica ineligible because "
+        "its host clock skew (staleness_s signal) exceeded "
+        "BIGDL_STALE_AFTER_S — the skewed-clock half of fleet "
+        "staleness, applied to routing")
+
+# --------------------------------------------------------------- rollout
+SERVE_WEIGHT_SWAPS_TOTAL = _m(
+    "bigdl_serve_weight_swaps_total", "counter", ("version",), 64,
+    "Live weight hot-swaps the engine completed, by promoted version "
+    "(one device_put + pointer flip between decode steps — slots, "
+    "page tables and in-flight decodes survive)")
+ROLLOUT_REJECTED_TOTAL = _m(
+    "bigdl_rollout_rejected_total", "counter", ("reason",), 8,
+    "Published checkpoints the rollout watcher refused before touching "
+    "serving state (manifest verify failed: torn / corrupt / checksum "
+    "mismatch / missing pair) — counted and event-stamped, never "
+    "loaded")
+ROLLOUT_CANARY_DIVERGENCE = _m(
+    "bigdl_rollout_canary_divergence", "gauge", policy="max",
+    doc="Worst token-level divergence of the canary version's pinned-"
+        "prompt replay vs the incumbent (fraction of mismatched "
+        "tokens; the auto-rollback signal next to SLO burn)")
+ROLLOUT_CANARY_STATE = _m(
+    "bigdl_rollout_canary_state", "gauge", policy="max",
+    doc="CanaryController phase (0 = idle, 1 = canarying, 2 = rolling "
+        "back)")
+ROLLOUT_ROLLBACKS_TOTAL = _m(
+    "bigdl_rollout_rollbacks_total", "counter", ("reason",), 8,
+    "Canary auto-rollback episodes, by the signal that fired "
+    "(slo_burn / divergence) — hysteresis-gated, so one noisy window "
+    "cannot flap promote/rollback")
+ROLLOUT_VERSION_MISMATCH_TOTAL = _m(
+    "bigdl_rollout_version_mismatch_total", "counter",
+    doc="Drain-handoff replays refused because the absorbing replica "
+        "serves a different weight version than the checkpoint pinned "
+        "— the request re-queues toward a version-exact replica "
+        "instead of silently breaking the bit-equal replay contract")
 
 # --------------------------------------------------------------- reqtrace
 REQTRACE_SAMPLED_TOTAL = _m(

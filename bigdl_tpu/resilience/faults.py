@@ -22,6 +22,11 @@ injects failures at exact, reproducible points:
 * ``ckpt:K:delete``    — delete the K-th write's ``.model.npz``
 * ``ckpt:K:drop_optim``— delete the K-th write's ``.optim.npz`` (a
   checkpoint missing its optimizer pair is not intact)
+* ``publish:K:<action>`` — same four damage actions, applied to the
+  K-th checkpoint *publish* (``serving/rollout.publish_checkpoint`` —
+  the training->serving handover directory).  A mid-publish-corrupted
+  checkpoint is exactly what the rollout watcher's verify-before-swap
+  gate must refuse: never loaded, counted, event-stamped.
 
 Every fault fires exactly once per injector lifetime: the retry path
 replays the same ``neval`` range after reloading a checkpoint and must
@@ -52,9 +57,9 @@ class InjectedFault(RuntimeError):
 
 @dataclasses.dataclass
 class Fault:
-    site: str      # "step" | "ckpt"
-    index: int     # step: the neval it fires at; ckpt: 1-based write count
-    action: str
+    site: str      # "step" | "ckpt" | "publish"
+    index: int     # step: the neval it fires at; ckpt/publish: 1-based
+    action: str    # write (publish) count it fires on
     fired: bool = False
 
 
@@ -77,16 +82,17 @@ class FaultPlan:
                     f"bad fault spec {part!r}: want site:index:action, "
                     f"e.g. 'step:3:raise' (full plan: {spec!r})")
             site, idx, action = fields
-            if site not in ("step", "ckpt"):
+            if site not in ("step", "ckpt", "publish"):
                 raise ValueError(
                     f"bad fault site {site!r} in {part!r}: "
-                    "want 'step' or 'ckpt'")
+                    "want 'step', 'ckpt' or 'publish'")
             try:
                 index = int(idx)
             except ValueError:
                 raise ValueError(
                     f"bad fault index {idx!r} in {part!r}: want an int")
-            allowed = _STEP_ACTIONS if site == "step" else _CKPT_ACTIONS
+            allowed = (_STEP_ACTIONS if site == "step"
+                       else _CKPT_ACTIONS)
             if action not in allowed:
                 raise ValueError(
                     f"bad fault action {action!r} for site {site!r} in "
@@ -112,7 +118,10 @@ class FaultInjector:
         self.plan = plan
         self._step_faults = [f for f in plan.faults if f.site == "step"]
         self._ckpt_faults = [f for f in plan.faults if f.site == "ckpt"]
+        self._publish_faults = [f for f in plan.faults
+                                if f.site == "publish"]
         self.ckpt_writes = 0
+        self.publish_writes = 0
 
     @property
     def active(self) -> bool:
@@ -153,6 +162,20 @@ class FaultInjector:
                 f.fired = True
                 log.warning("fault injection: %s on checkpoint write #%d "
                             "(%s)", f.action, self.ckpt_writes, path_prefix)
+                self._apply_ckpt_fault(f.action, path_prefix)
+
+    def on_checkpoint_publish(self, path_prefix: str):
+        """Called after a checkpoint is published into a rollout watch
+        directory (files + manifest durable); applies any ``publish``
+        fault whose 1-based publish index matches — post-write damage
+        the watcher's verify-before-swap gate must catch."""
+        self.publish_writes += 1
+        for f in self._publish_faults:
+            if not f.fired and f.index == self.publish_writes:
+                f.fired = True
+                log.warning("fault injection: %s on checkpoint publish "
+                            "#%d (%s)", f.action, self.publish_writes,
+                            path_prefix)
                 self._apply_ckpt_fault(f.action, path_prefix)
 
     @staticmethod

@@ -29,6 +29,12 @@ histograms with trace exemplars + SLO burn-rate alerting
 (obs/alerts.py), "serving" and "request traces" report sections
 (obs/report.py), and request-driven autoscaling signals — queue depth
 and p99 — in resilience/autoscale.py.
+
+Live weight rollout (ISSUE 20) closes the training->serving pipe:
+a checkpoint watcher hot-swaps manifest-verified weights into a live
+engine between decode steps, and a canary controller promotes new
+versions to a fraction of replicas with SLO-burn/divergence
+auto-rollback (serving/rollout.py).
 """
 
 from bigdl_tpu.serving import spans
@@ -40,12 +46,16 @@ from bigdl_tpu.serving.drain import (HANDOFF_ERROR, HandoffLedger,
 from bigdl_tpu.serving.engine import LMEngine
 from bigdl_tpu.serving.placement import (NoReplicaAvailable,
                                          PlacementPolicy, ReplicaView)
+from bigdl_tpu.serving.rollout import (CanaryController, CheckpointWatcher,
+                                       publish_checkpoint, token_divergence)
 from bigdl_tpu.serving.router import (EngineReplica, HTTPReplica,
                                       ReplicaDraining, ReplicaUnavailable,
                                       Router, RouterServer, RouterShed)
 from bigdl_tpu.serving.server import ServingServer
 
 __all__ = [
+    "CanaryController",
+    "CheckpointWatcher",
     "ClassifierEngine",
     "EngineReplica",
     "HANDOFF_ERROR",
@@ -67,5 +77,7 @@ __all__ = [
     "ServingServer",
     "drain_engine",
     "gather_pages",
+    "publish_checkpoint",
     "spans",
+    "token_divergence",
 ]

@@ -48,6 +48,13 @@ class HandoffRecord:
     # serialized request-trace context (X-Bigdl-Trace header form) so a
     # replay continues under the SAME trace_id on the absorbing replica
     trace: Optional[str] = None
+    # weight version the generated-so-far prefix was decoded under —
+    # replaying on a replica serving a DIFFERENT version would continue
+    # the decode under different weights and silently break the
+    # temperature-0 bit-equal replay contract, so the absorber side
+    # refuses (re-queues) on mismatch.  None = pre-rollout checkpoint,
+    # accepted anywhere (backward compatible).
+    weight_version: Optional[str] = None
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -61,7 +68,8 @@ class HandoffRecord:
                                 d.get("tokens_done") or []],
                    request_id=d.get("request_id"),
                    source=d.get("source"),
-                   trace=d.get("trace"))
+                   trace=d.get("trace"),
+                   weight_version=d.get("weight_version"))
 
 
 class HandoffLedger:
@@ -181,7 +189,8 @@ def drain_engine(engine, deadline_s: float = 10.0,
                 temperature=float(req.temperature),
                 tokens_done=[int(t) for t in req.tokens],
                 request_id=getattr(req, "router_id", None),
-                trace=ctx.to_header() if ctx is not None else None))
+                trace=ctx.to_header() if ctx is not None else None,
+                weight_version=getattr(engine, "weight_version", None)))
             req.finish(error=HANDOFF_ERROR)
     return handoffs
 

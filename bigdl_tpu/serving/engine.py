@@ -203,7 +203,8 @@ class LMEngine:
                  slo_s: Optional[float] = None,
                  admission: Optional[str] = None,
                  decode_attn: Optional[str] = None,
-                 decode_bucket: Optional[bool] = None, seed: int = 0):
+                 decode_bucket: Optional[bool] = None, seed: int = 0,
+                 weight_version: str = "v0"):
         import jax
         import jax.numpy as jnp
 
@@ -262,6 +263,9 @@ class LMEngine:
         self._t_last_done: Optional[float] = None
         self.completed: List[dict] = []
         self._slo_window: collections.deque = collections.deque(maxlen=256)
+        self.weight_version = str(weight_version)
+        self.manifest_sha: Optional[str] = None
+        self.swaps = 0
         self.draining = False
         self._stop = False
         self._thread: Optional[threading.Thread] = None
@@ -323,6 +327,10 @@ class LMEngine:
             "Analytic HBM bytes streamed per generated token (decode "
             "weights + the KV pages the step's page-table bucket "
             "names)")
+        self._swap_counter = reg.counter(
+            names.SERVE_WEIGHT_SWAPS_TOTAL,
+            "Live weight hot-swaps completed, by promoted version",
+            labels=("version",))
 
     def _decode_weight_bytes(self) -> float:
         """Static per-step weight-stream bytes of the decode matmuls —
@@ -348,6 +356,51 @@ class LMEngine:
                 item = 1
             total += float(leaf.size) * item
         return total
+
+    # ------------------------------------------------------------ hot swap
+    def swap_weights(self, params, *, version: str,
+                     manifest_sha: Optional[str] = None) -> None:
+        """Hot-swap the served weights between decode steps.
+
+        All the expensive work — the host->device transfer of the new
+        tree and (int8) requantizing the per-channel twins — happens on
+        the CALLER's thread, outside the engine lock; the swap itself
+        is a pointer flip the decode loop observes at its next
+        ``pump`` cycle.  Page tables, slots and in-flight decodes
+        survive untouched: the step and prefill functions take the
+        params tree as an argument, so nothing recompiles on the float
+        path.  The int8 step closes over the quantized twins, so that
+        engine rebuilds its jitted step under the lock (retraced
+        lazily on the next step dispatch).
+
+        Requests already decoding keep their old-weights KV prefix and
+        continue on the new weights — they complete, on a mixed
+        trajectory; requests admitted after the swap decode bit-equal
+        to ``generate()`` on the new weights at temperature 0.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        if self.tp == 1:
+            params = jax.tree.map(
+                jnp.asarray, params,
+                is_leaf=lambda x: x is None or hasattr(x, "shape"))
+        qparams = (_quantize_tree(params, self.n_layer)
+                   if self.int8 else None)
+        with self._lock:
+            self.params = params
+            self._qparams = qparams
+            if self.int8:
+                self._step_fn = self._build_step()
+            self._weight_bytes = self._decode_weight_bytes()
+            self.weight_version = str(version)
+            self.manifest_sha = manifest_sha
+            self.swaps += 1
+        self._swap_counter.labels(version=str(version)).inc()
+        obs.get_tracer().event(spans.EVENT_WEIGHT_SWAP,
+                               version=str(version),
+                               sha=manifest_sha or "",
+                               swaps=self.swaps)
 
     # -------------------------------------------------------- jit builders
     def _build_step(self):
@@ -838,6 +891,9 @@ class LMEngine:
             "kv_pages_in_use": self.cache.pages_in_use(),
             "kv_pages_total": self.cache.num_pages - 1,
             "draining": self.draining,
+            "weight_version": self.weight_version,
+            "manifest_sha": self.manifest_sha,
+            "weight_swaps": self.swaps,
             "preemptions": int(self._preempt_counter._solo().value),
             "e2e_p50_s": pct(e2e, 50), "e2e_p99_s": pct(e2e, 99),
             "ttft_p50_s": pct(ttft, 50), "ttft_p99_s": pct(ttft, 99),

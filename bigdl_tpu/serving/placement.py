@@ -46,10 +46,16 @@ class ReplicaView:
     queue_depth: float = 0.0
     in_flight: int = 0          # router-side: placed, not yet completed
     kv_frac: float = 0.0        # pages_in_use / pool size, 0..1
+    # host-clock skew past BIGDL_STALE_AFTER_S — its SLO windows and
+    # handoff timestamps can't be trusted, so placement skips it
+    stale: bool = False
+    # weight version the replica serves (None = replica predates the
+    # rollout tier) — version-pinned handoff replays match on this
+    version: Optional[str] = None
 
     @property
     def eligible(self) -> bool:
-        return self.up and not self.draining
+        return self.up and not self.draining and not self.stale
 
 
 class PlacementPolicy:

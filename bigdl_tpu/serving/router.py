@@ -121,7 +121,9 @@ class EngineReplica:
                 temperature=float(req.temperature),
                 tokens_done=[int(t) for t in req.tokens],
                 request_id=request_id, source=self.name,
-                trace=ctx.to_header() if ctx is not None else None))
+                trace=ctx.to_header() if ctx is not None else None,
+                weight_version=getattr(self.engine, "weight_version",
+                                       None)))
         if req.error:
             raise ReplicaUnavailable(f"{self.name}: {req.error}")
         return {"tokens": [int(t) for t in req.tokens],
@@ -132,7 +134,8 @@ class EngineReplica:
         total = max(1, eng.cache.num_pages - 1)
         return {"up": True, "draining": bool(eng.draining),
                 "queue_depth": float(eng.queue.depth()),
-                "kv_frac": eng.cache.pages_in_use() / total}
+                "kv_frac": eng.cache.pages_in_use() / total,
+                "weight_version": getattr(eng, "weight_version", None)}
 
     def drain(self, deadline_s: float = 10.0) -> List[HandoffRecord]:
         records = self.engine.drain(deadline_s)
@@ -218,7 +221,8 @@ class HTTPReplica:
         return {"up": True, "draining": bool(lm.get("draining")),
                 "queue_depth": float(lm.get("queue_depth") or 0.0),
                 "kv_frac": float(lm.get("kv_pages_in_use") or 0.0)
-                / total}
+                / total,
+                "weight_version": lm.get("weight_version")}
 
     def drain(self, deadline_s: float = 10.0) -> List[HandoffRecord]:
         status, out = self._fetch(self.base + "/admin/drain",
@@ -250,8 +254,13 @@ class Router:
                  clock=time.monotonic, sleep=time.sleep, seed: int = 0):
         from bigdl_tpu.config import refresh_from_env
 
-        cfg = refresh_from_env().router
+        full = refresh_from_env()
+        cfg = full.router
         pick = lambda v, d: d if v is None else v  # noqa: E731
+        # skewed-clock routing: a replica whose exported host staleness
+        # exceeds the fleet threshold is excluded from placement
+        self.stale_exclude = bool(cfg.stale_exclude)
+        self.stale_after_s = float(full.obs.stale_after_s)
         self.max_retries = int(pick(max_retries, cfg.max_retries))
         self.request_timeout_s = float(
             pick(request_timeout_s, cfg.request_timeout_s))
@@ -307,6 +316,14 @@ class Router:
         self._budget_gauge = reg.gauge(
             names.ROUTER_RETRY_BUDGET_TOKENS,
             "Tokens left in the shared retry-budget bucket")
+        self._stale_counter = reg.counter(
+            names.ROUTER_STALE_EXCLUDED_TOTAL,
+            "Placement snapshots that excluded a replica for host-"
+            "clock staleness past the fleet threshold")
+        self._mismatch_counter = reg.counter(
+            names.ROLLOUT_VERSION_MISMATCH_TOTAL,
+            "Handoff replays refused on a weight-version mismatch "
+            "(re-queued toward a version-exact replica)")
 
     # -------------------------------------------------------- replica set
     def add_replica(self, replica) -> None:
@@ -354,16 +371,24 @@ class Router:
                 continue
             with self._lock:
                 self._down.discard(name)
+            stale = (self.stale_exclude and self.stale_after_s > 0
+                     and float(sig.get("staleness_s") or 0.0)
+                     > self.stale_after_s)
+            if stale:
+                self._stale_counter.inc()
             views[name] = ReplicaView(
                 name, up=bool(sig.get("up", True)) and name not in down,
                 draining=bool(sig.get("draining")) or name in draining,
                 queue_depth=float(sig.get("queue_depth") or 0.0),
                 in_flight=int(in_flight.get(name, 0)),
-                kv_frac=float(sig.get("kv_frac") or 0.0))
-        counts = {"up": 0, "draining": 0, "down": 0}
+                kv_frac=float(sig.get("kv_frac") or 0.0),
+                stale=stale,
+                version=sig.get("weight_version"))
+        counts = {"up": 0, "draining": 0, "down": 0, "stale": 0}
         for v in views.values():
-            counts["draining" if v.draining and v.up else
-                   "up" if v.up else "down"] += 1
+            counts["down" if not v.up else
+                   "draining" if v.draining else
+                   "stale" if v.stale else "up"] += 1
         for state, n in counts.items():
             self._replica_gauge.labels(state=state).set(float(n))
         return views
@@ -402,14 +427,28 @@ class Router:
         tried: set = set()
         retries = 0
         handoffs = 0
+        pinned: Optional[str] = None   # weight version a handoff pinned
         affinity0 = self.placement.affinity_hits
         while True:
             t_place = time.monotonic()
+            views = self.views()
             try:
-                name = self.placement.choose(self.views(), session,
+                name = self.placement.choose(views, session,
                                              exclude=tried)
             except NoReplicaAvailable as e:
                 self._shed(rid, str(e), ctx)
+            if pinned is not None:
+                view = views.get(name)
+                if view is not None and view.version is not None \
+                        and view.version != pinned:
+                    # the absorber serves a different weight version
+                    # than the checkpointed prefix was decoded under —
+                    # replaying here would break the bit-equal replay
+                    # contract.  Refuse and re-queue toward a
+                    # version-exact replica.
+                    self._mismatch_counter.inc()
+                    tried.add(name)
+                    continue
             col.span(ctx, spans.SPAN_PLACEMENT, t_place,
                      time.monotonic() - t_place, replica=name,
                      attempt=retries + handoffs)
@@ -456,6 +495,7 @@ class Router:
                 prefix.extend(hd.tokens_done)
                 prompt_cur = list(hd.prompt)
                 owed = int(hd.max_new_tokens)
+                pinned = hd.weight_version or pinned
                 handoffs += 1
                 self._handoff_counter.inc()
                 with self._lock:
@@ -558,7 +598,8 @@ class Router:
 def _view_dict(v: ReplicaView) -> dict:
     return {"up": v.up, "draining": v.draining,
             "queue_depth": v.queue_depth, "in_flight": v.in_flight,
-            "kv_frac": round(v.kv_frac, 4)}
+            "kv_frac": round(v.kv_frac, 4), "stale": v.stale,
+            "weight_version": v.version}
 
 
 # ------------------------------------------------------------- HTTP front
@@ -602,8 +643,11 @@ class RouterServer:
                     return self._send({
                         "status": "ok",
                         "replicas": {n: ("draining" if v.draining
+                                         else "stale" if v.stale
                                          else "up" if v.up else "down")
-                                     for n, v in views.items()}})
+                                     for n, v in views.items()},
+                        "weight_versions": {n: v.version
+                                            for n, v in views.items()}})
                 if self.path == "/stats":
                     return self._send(outer.router.stats())
                 return self._send({"error": "not found"}, 404)

@@ -98,7 +98,17 @@ class ServingServer:
 
             def do_GET(self):  # noqa: N802
                 if self.path == "/healthz":
-                    return self._send({"status": "ok"})
+                    body = {"status": "ok"}
+                    if outer.lm is not None:
+                        # the fleet reads what each replica SERVES here
+                        # — the rollout canary's version-skew check and
+                        # the operator's stuck-rollout triage both key
+                        # on this pair
+                        body["weight_version"] = getattr(
+                            outer.lm, "weight_version", None)
+                        body["manifest_sha"] = getattr(
+                            outer.lm, "manifest_sha", None)
+                    return self._send(body)
                 if self.path == "/stats":
                     return self._send({
                         "lm": outer.lm.stats() if outer.lm else None,
@@ -164,7 +174,9 @@ class ServingServer:
                             "request_id": req.router_id,
                             "trace": (req.trace.to_header()
                                       if req.trace is not None
-                                      else None)}},
+                                      else None),
+                            "weight_version": getattr(
+                                outer.lm, "weight_version", None)}},
                         503,
                         headers={"Retry-After":
                                  f"{max(1, round(outer.retry_after_s))}"})

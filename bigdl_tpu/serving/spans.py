@@ -59,6 +59,12 @@ EVENT_ADMIT = "serve.admit"
 EVENT_PREEMPT = "serve.preempt"
 #: one chaos-scenario verdict (sim/serve.py)
 EVENT_SCENARIO = "serve.scenario"
+#: a live weight hot-swap completed (pointer flip between decode steps)
+EVENT_WEIGHT_SWAP = "serve.weight_swap"
+#: the rollout watcher refused a published checkpoint (verify failed)
+EVENT_ROLLOUT_REJECT = "rollout.reject"
+#: one canary decision (offer / promote / rollback / suppressed)
+EVENT_ROLLOUT_DECISION = "rollout.decision"
 
 
 def hop_key(span_name: str) -> str:
@@ -70,4 +76,5 @@ def hop_key(span_name: str) -> str:
 __all__ = ["SPAN_ROUTE", "SPAN_PLACEMENT", "SPAN_RETRY", "SPAN_HANDOFF",
            "SPAN_QUEUE", "SPAN_PREFILL", "SPAN_PREEMPT", "SPAN_DECODE",
            "SPAN_STEP_DECODE", "HOP_ORDER", "EVENT_ADMIT",
-           "EVENT_PREEMPT", "EVENT_SCENARIO", "hop_key"]
+           "EVENT_PREEMPT", "EVENT_SCENARIO", "EVENT_WEIGHT_SWAP",
+           "EVENT_ROLLOUT_REJECT", "EVENT_ROLLOUT_DECISION", "hop_key"]

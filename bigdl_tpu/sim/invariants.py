@@ -71,6 +71,22 @@ Serving data-plane invariants (the router chaos scenarios in
 * **slo_stability** — the backlog-driven SLO-burn alert fires at most
   the declared number of times and is resolved by scenario end:
   absorbing a preemption storm must not flap the alert.
+
+Live-weight-rollout invariants (the ``weight_rollout`` scenario —
+composed into :func:`check_serve_scenario` when the scenario's
+``expect`` block declares rollout expectations):
+
+* **rollback_exactly_once** — a bad canary triggers exactly the
+  declared number of rollback episodes (hysteresis means one, not a
+  promote/rollback flap), and the declared promotions all landed;
+* **no_version_skew_after_settle** — after the run settles, every
+  replica serves the expected incumbent version: no canary left
+  behind, no half-promoted fleet;
+* **corrupt_never_loaded** — a corrupt-mid-publish checkpoint is
+  rejected by the verify-before-swap gate and reaches zero replicas;
+* **zero_dropped_requests** — across promote, canary, rollback and
+  the drain/handoff cycles they drive: zero lost, zero duplicated,
+  zero shed.
 """
 
 from __future__ import annotations
@@ -398,16 +414,112 @@ def check_slo_stability(observed: dict,
         f"{flaps} firing(s), resolved by scenario end")
 
 
+def check_rollback_exactly_once(observed: dict,
+                                expect: dict) -> InvariantResult:
+    """A bad canary rolls back exactly the declared number of times —
+    hysteresis means one decisive episode, never a promote/rollback
+    flap — and the declared promotions all happened, in order."""
+    problems = []
+    want = int(expect.get("rollbacks", 0))
+    got = int(observed.get("rollbacks", 0))
+    if got != want:
+        problems.append(f"{got} rollback episode(s), expected exactly "
+                        f"{want}")
+    promos = expect.get("promotions")
+    if promos is not None and \
+            list(observed.get("promotions") or []) != list(promos):
+        problems.append(f"promotions {observed.get('promotions')} != "
+                        f"expected {list(promos)}")
+    state = observed.get("rollout_state")
+    if state not in (None, "idle"):
+        problems.append(f"controller still {state!r} at scenario end")
+    return _result(
+        "rollback_exactly_once", not problems,
+        "; ".join(problems) or
+        f"{got} rollback(s), promotions "
+        f"{observed.get('promotions')}, controller idle")
+
+
+def check_no_version_skew(observed: dict,
+                          expect: dict) -> InvariantResult:
+    """After the run settles every replica serves one version — the
+    expected one when declared.  A canary left behind or a
+    half-promoted fleet is exactly the skew the rollout tier exists to
+    prevent."""
+    problems = []
+    versions = observed.get("versions_at_end") or {}
+    distinct = sorted(set(versions.values()))
+    if len(distinct) > 1:
+        problems.append(f"fleet did not converge: {distinct} "
+                        f"({versions})")
+    settle = expect.get("settle_version")
+    if settle is not None:
+        skewed = sorted(n for n, v in versions.items() if v != settle)
+        if skewed:
+            problems.append(f"{skewed} not on expected {settle!r} "
+                            f"({versions})")
+    return _result(
+        "no_version_skew_after_settle", not problems,
+        "; ".join(problems) or
+        f"all {len(versions)} replica(s) on "
+        f"{distinct[0] if distinct else '?'}")
+
+
+def check_corrupt_never_loaded(observed: dict,
+                               expect: dict) -> InvariantResult:
+    """The verify-before-swap gate held: every corrupt-mid-publish
+    checkpoint was rejected, and none reached a replica."""
+    problems = []
+    need = int(expect.get("min_corrupt_rejected", 0))
+    rejected = int(observed.get("corrupt_rejected", 0))
+    if rejected < need:
+        problems.append(f"only {rejected} corrupt publish(es) "
+                        f"rejected, scenario injects >= {need}")
+    loaded = int(observed.get("corrupt_loaded", 0))
+    if loaded > 0:
+        problems.append(f"{loaded} corrupt publish(es) REACHED a "
+                        "replica — the verify gate is porous")
+    return _result(
+        "corrupt_never_loaded", not problems,
+        "; ".join(problems) or
+        f"{rejected} corrupt publish(es) rejected, 0 loaded")
+
+
+def check_zero_dropped(observed: dict) -> InvariantResult:
+    """The rollout path's hard conservation bar: promote, canary and
+    rollback (with their drain/handoff cycles) drop NOTHING — zero
+    lost, zero duplicated, zero shed."""
+    problems = []
+    for key in ("lost", "duplicates", "shed"):
+        n = int(observed.get(key, 0))
+        if n > 0:
+            problems.append(f"{n} request(s) {key}")
+    return _result(
+        "zero_dropped_requests", not problems,
+        "; ".join(problems) or
+        f"{observed.get('requests', 0)} requests, 0 lost / 0 dup / "
+        "0 shed across the rollout cycle")
+
+
 def check_serve_scenario(observed: dict,
                          expect: dict) -> List[InvariantResult]:
     """All serving data-plane invariants over one scenario's
     observation bundle (:func:`bigdl_tpu.sim.serve.run_serve_scenario`
-    builds ``observed``)."""
-    return [
+    builds ``observed``).  Rollout invariants join the list when the
+    scenario declares rollout expectations."""
+    out = [
         check_request_conservation(observed, expect),
         check_retry_amplification(observed, expect),
         check_slo_stability(observed, expect),
     ]
+    if "rollbacks" in expect or "settle_version" in expect:
+        out += [
+            check_rollback_exactly_once(observed, expect),
+            check_no_version_skew(observed, expect),
+            check_corrupt_never_loaded(observed, expect),
+            check_zero_dropped(observed),
+        ]
+    return out
 
 
 # -------------------------------------------------- standalone probes

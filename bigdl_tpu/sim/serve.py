@@ -32,7 +32,16 @@ replicas):
   from the zombie are discarded, never double-answered;
 * ``drain_wave`` — replicas drain under a diurnal wave;
   checkpoint-and-replay must conserve every request: zero dropped,
-  zero duplicated, zero shed across the full drain/handoff cycle.
+  zero duplicated, zero shed across the full drain/handoff cycle;
+* ``weight_rollout`` — the live-weight-rollout control loop (the REAL
+  :class:`~bigdl_tpu.serving.rollout.CanaryController` driving sim
+  replicas): a good version canaries and promotes cleanly; a bad
+  version (injected latency + divergent logits) triggers exactly one
+  hysteresis-gated rollback whose canary drains replay everything; a
+  corrupt-mid-publish checkpoint is rejected by the verify gate and
+  reaches zero replicas.  Invariants: ``rollback_exactly_once``,
+  ``no_version_skew_after_settle``, ``corrupt_never_loaded``,
+  ``zero_dropped_requests``.
 
 :func:`run_serve_scenario` runs one scenario tick by tick and hands
 the observation bundle to the serve invariants
@@ -95,6 +104,7 @@ class SimServeReplica:
         self.up = True
         self.draining = False
         self.slow_factor = 1.0
+        self.version = "v0"     # weight version served (rollout tier)
         self.queue: List[_SimJob] = []
         self.active: List[_SimJob] = []
 
@@ -205,6 +215,14 @@ class ServeScenario:
     kv_weight: float = 4.0
     slo_fire_backlog: float = 1.5   # x total slots -> alert fires
     slo_resolve_backlog: float = 0.8
+    # rollout tier (active when publish_* events appear): canary
+    # evaluation cadence, the incumbent everyone starts on, and the
+    # damage a "bad" version injects — extra per-request latency on
+    # its canaries plus a divergent pinned-prompt replay signal
+    rollout_eval_s: float = 5.0
+    incumbent_version: str = "v0"
+    bad_slow_factor: float = 6.0
+    bad_divergence: float = 0.5
     events: List[dict] = dataclasses.field(default_factory=list)
     expect: dict = dataclasses.field(default_factory=dict)
 
@@ -262,6 +280,26 @@ SERVE_SCENARIOS: Dict[str, dict] = {
                 "max_late_discarded": 0, "min_handoff_replays": 1,
                 "min_drains": 3, "max_slo_flaps": 1,
                 "amplification_slack": 0.1}),
+    "weight_rollout": dict(
+        name="weight_rollout", duration_s=200.0, replicas=8,
+        arrival_rps=40.0, arrival_stop_s=170.0,
+        events=[
+            # a good version canaries on the fraction, holds clean for
+            # hold_evals rounds, and promotes fleet-wide
+            {"t": 30.0, "kind": "publish_good", "version": "v1"},
+            # a bad version (6x latency + 0.5 token divergence on its
+            # canaries) must trigger EXACTLY one rollback — hysteresis,
+            # not flapping — and the canary drains replay everything
+            {"t": 80.0, "kind": "publish_bad", "version": "v2"},
+            # a corrupt-mid-publish checkpoint is refused by the
+            # verify-before-swap gate and reaches zero replicas
+            {"t": 140.0, "kind": "publish_corrupt", "version": "v3"},
+        ],
+        expect={"max_lost": 0, "max_duplicates": 0, "max_shed": 0,
+                "min_handoff_replays": 1, "rollbacks": 1,
+                "settle_version": "v1", "promotions": ["v1"],
+                "min_corrupt_rejected": 1, "max_slo_flaps": 1,
+                "amplification_slack": 0.1}),
 }
 
 
@@ -304,8 +342,12 @@ def load_serve_scenario(spec, replicas: Optional[int] = None,
         raise ValueError("a router scenario needs >= 2 replicas")
     for ev in sc.events:
         if ev["kind"] not in ("preempt", "recover", "slow", "drain",
-                              "undrain"):
+                              "undrain", "publish_good", "publish_bad",
+                              "publish_corrupt"):
             raise ValueError(f"unknown event kind {ev['kind']!r}")
+        if ev["kind"].startswith("publish") and not ev.get("version"):
+            raise ValueError(f"publish event at t={ev['t']} needs a "
+                             "version")
         if not 0 <= float(ev["t"]) <= sc.duration_s:
             raise ValueError(f"event at t={ev['t']} outside the "
                              f"{sc.duration_s:g}s scenario")
@@ -346,6 +388,10 @@ class ServeScenarioResult:
     # is IN the verdict, not a separate archaeology dig
     offending_traces: List[dict] = dataclasses.field(
         default_factory=list)
+    # rollout observations (versions at end, rollback/promotion
+    # episodes, corrupt-publish accounting) when the scenario drove a
+    # CanaryController; None otherwise
+    rollout: Optional[dict] = None
 
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)
@@ -433,6 +479,51 @@ def run_serve_scenario(spec, replicas: Optional[int] = None,
     acc = 0.0
     rid_seq = 0
 
+    # -- rollout tier: the REAL CanaryController over sim callables ----
+    controller = None
+    rollout = {"bad": set(), "corrupt_rejected": 0, "corrupt_loaded": 0,
+               "refused_offers": 0, "next_eval": 0.0, "t": 0.0}
+    if any(ev["kind"].startswith("publish") for ev in sc.events):
+        from bigdl_tpu.serving.rollout import (SLO_BURN_ALERT,
+                                               CanaryController)
+
+        for rep in fleet.values():
+            rep.version = sc.incumbent_version
+
+        def _apply_version(name: str, version: str):
+            # the harness's set_version: a sim hot-swap.  A bad version
+            # manifests as injected per-request latency (its divergence
+            # rides the probe below)
+            rep = fleet[name]
+            rep.version = version
+            rep.slow_factor = (sc.bad_slow_factor
+                               if version in rollout["bad"] else 1.0)
+
+        def _divergence() -> float:
+            # pinned-prompt replay signal: a bad candidate's canaries
+            # produce divergent tokens, a good one's are bit-equal
+            return (sc.bad_divergence
+                    if controller.candidate in rollout["bad"] else 0.0)
+
+        def _alerts():
+            return [SLO_BURN_ALERT] if slo["firing"] else []
+
+        def _drain_cb(name: str):
+            counts["drains"] += 1
+            for rid, rem in fleet[name].drain():
+                outstanding.pop(rid, None)
+                replay(rid, rem, name, rollout["t"])
+            placement.unbind_replica(name)
+
+        def _undrain_cb(name: str):
+            fleet[name].undrain()
+
+        controller = CanaryController(
+            sorted(fleet), set_version=_apply_version,
+            incumbent=sc.incumbent_version,
+            measure_divergence=_divergence, alerts=_alerts,
+            drain=_drain_cb, undrain=_undrain_cb, clock=clock)
+
     def views() -> Dict[str, ReplicaView]:
         out = {}
         in_flight: Dict[str, int] = {}
@@ -510,10 +601,26 @@ def run_serve_scenario(spec, replicas: Optional[int] = None,
 
     def step(t: float, dt: float, arrivals: bool):
         nonlocal acc, rid_seq, next_event
+        rollout["t"] = t
         # 1. chaos events reach their virtual time
         while next_event < len(events) and events[next_event]["t"] <= t:
             ev = events[next_event]
             next_event += 1
+            if ev["kind"].startswith("publish"):
+                version = str(ev["version"])
+                if ev["kind"] == "publish_bad":
+                    rollout["bad"].add(version)
+                if ev["kind"] == "publish_corrupt":
+                    # the watcher's verify-before-swap gate: a torn /
+                    # corrupt publish is counted and rejected before
+                    # any replica sees it (the real file-level gate is
+                    # exercised by rollout_smoke and the unit tests —
+                    # the sim pins the ORDERING: reject precedes offer)
+                    rollout["corrupt_rejected"] += 1
+                    continue
+                if not controller.offer(version, now=t):
+                    rollout["refused_offers"] += 1
+                continue
             for name in ev["replicas"]:
                 rep = fleet[name]
                 if ev["kind"] == "preempt":
@@ -607,6 +714,13 @@ def run_serve_scenario(spec, replicas: Optional[int] = None,
         elif slo["firing"] and backlog < sc.slo_resolve_backlog \
                 * total_slots:
             slo["firing"] = False
+        # 7. canary evaluation on its own cadence (the controller's
+        #    rollback path drains through _drain_cb -> replay, so a
+        #    rollback's in-flight work re-enters placement this tick)
+        if controller is not None and controller.state == "canary" \
+                and t >= rollout["next_eval"]:
+            rollout["next_eval"] = t + sc.rollout_eval_s
+            controller.evaluate(now=t)
 
     t_wall0 = time.perf_counter()
     for _ in range(sc.n_ticks()):
@@ -642,6 +756,21 @@ def run_serve_scenario(spec, replicas: Optional[int] = None,
         "slo_flaps": slo["flaps"],
         "slo_firing_at_end": slo["firing"],
     }
+    rollout_obs = None
+    if controller is not None:
+        rollout_obs = {
+            "rollbacks": len(controller.rollbacks),
+            "rollback_episodes": list(controller.rollbacks),
+            "promotions": list(controller.promotions),
+            "versions_at_end": {n: fleet[n].version
+                                for n in sorted(fleet)},
+            "corrupt_rejected": rollout["corrupt_rejected"],
+            "corrupt_loaded": rollout["corrupt_loaded"],
+            "refused_offers": rollout["refused_offers"],
+            "rollout_state": controller.state,
+            "incumbent": controller.incumbent,
+        }
+        observed.update(rollout_obs)
     invariants = check_serve_scenario(observed, sc.expect)
     # invariant postmortem: when tracing is on and a conservation
     # invariant broke, dump the buffered hop traces of the offending
@@ -692,6 +821,7 @@ def run_serve_scenario(spec, replicas: Optional[int] = None,
         budget=budget.stats(),
         invariants=invariants,
         offending_traces=offending,
+        rollout=rollout_obs,
     )
     from bigdl_tpu import obs
 

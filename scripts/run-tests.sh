@@ -141,6 +141,29 @@
 #                                                # ROUTER_SMOKE.json for
 #                                                # BENCH extras.router
 #                                                # (no pytest)
+#   scripts/run-tests.sh --rollout               # live-weight-rollout smoke:
+#                                                # a checkpoint watcher hot-
+#                                                # swaps a published version
+#                                                # into a live engine mid-
+#                                                # decode (in-flight request
+#                                                # finishes, pages stable,
+#                                                # post-swap output bit-equal
+#                                                # to generate() on the new
+#                                                # weights), torn and corrupt
+#                                                # publishes are rejected by
+#                                                # the verify gate without
+#                                                # touching serving state, a
+#                                                # canary controller promotes
+#                                                # a clean version and rolls
+#                                                # back a divergent one
+#                                                # exactly once (cooldown
+#                                                # refuses the re-offer), and
+#                                                # the weight_rollout chaos
+#                                                # scenario passes all
+#                                                # rollout invariants; banks
+#                                                # ROLLOUT_SMOKE.json for
+#                                                # BENCH extras.rollout
+#                                                # (no pytest)
 #   scripts/run-tests.sh --reqtrace              # request-tracing smoke: a
 #                                                # router over two live
 #                                                # engines with one rigged
@@ -299,6 +322,9 @@ elif [[ "${1:-}" == "--router" ]]; then
 elif [[ "${1:-}" == "--reqtrace" ]]; then
   shift
   exec python scripts/reqtrace_smoke.py "$@"
+elif [[ "${1:-}" == "--rollout" ]]; then
+  shift
+  exec python scripts/rollout_smoke.py "$@"
 fi
 
 # tier-1 wall clock is budgeted (ROADMAP: 870s) — print where the suite

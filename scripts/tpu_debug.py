@@ -169,6 +169,29 @@ territory: ``scripts/run-tests.sh --fleetobs`` re-proves
 hierarchical == flat at 1000 simulated hosts (FLEETOBS_SMOKE.json);
 see MIGRATION.md "Fleet-scale metrics".
 
+A STUCK ROLLOUT (new weights published, fleet still on the old
+version) or VERSION SKEW (replicas disagree on ``weight_version`` in
+``/healthz`` / ``stats()``) is triaged from the rollout plane's own
+counters before anyone re-publishes: ``bigdl_rollout_rejected_total
+{reason}`` says the watcher *refused* the checkpoint (``torn`` /
+``checksum`` / ``size`` / ``missing`` — re-publish via
+``publish_checkpoint``, which writes the manifest LAST, rather than
+hand-copying files); a publish that verified but never promoted shows
+in the CanaryController's stats — ``refused_offers`` (offered inside
+the post-rollback cooldown), ``bigdl_rollout_rollbacks_total
+{reason}`` (``slo_burn`` vs ``divergence`` says *which* signal keeps
+firing) and the ``bigdl_rollout_canary_divergence`` gauge (a high
+value is the pinned-prompt replay disagreeing with the incumbent —
+usually a genuinely different model, not an infra fault).  Lingering
+skew after a settle also shows up as drain replays refusing absorbers
+(``bigdl_rollout_version_mismatch_total`` climbing) — find the
+replica whose ``/healthz`` ``weight_version`` disagrees and offer it
+the incumbent.  ``scripts/run-tests.sh --rollout`` re-proves the
+whole plane end-to-end (ROLLOUT_SMOKE.json), and the fleet
+simulator's ``weight_rollout`` scenario replays promote / rollback /
+corrupt-publish against the real controller — see MIGRATION.md "Live
+weight rollout".
+
 A LINT FAILURE (``scripts/run-tests.sh --lint`` /
 ``tests/test_lint.py::test_repo_is_clean``) is triaged from the
 finding line itself — ``path:line: RULE message``.  JX* findings are
